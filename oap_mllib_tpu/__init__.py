@@ -1,0 +1,46 @@
+"""oap-mllib-tpu: a TPU-native distributed classical-ML framework.
+
+A brand-new framework with the capabilities of OAP MLlib (the reference at
+/root/reference): accelerated K-Means, PCA, and implicit ALS with
+Spark-MLlib-compatible parameters, numerical parity, and transparent fallback
+to a CPU reference path — redesigned TPU-first:
+
+- Compute kernels are JAX/XLA programs (MXU matmuls, fused elementwise),
+  jitted over a `jax.sharding.Mesh` (rows sharded over the ``data`` axis,
+  features/factors optionally over ``model``), replacing the reference's
+  oneDAL distributed step1Local/step2Master kernels
+  (reference: mllib-dal/src/main/native/{KMeans,PCA,ALS}DALImpl.cpp).
+- Cross-device sync is XLA collectives (psum / all_gather / all_to_all) over
+  ICI/DCN compiled into the program, replacing oneCCL
+  broadcast/allgatherv/alltoallv of serialized byte blobs
+  (reference: mllib-dal/src/main/native/OneCCL.cpp).
+- Multi-host bootstrap is the JAX distributed runtime (coordinator ip:port),
+  replacing the oneCCL TCP-KVS rendezvous (reference: OneCCL.cpp:47-86).
+- The native runtime layer (host tables, parsers, port probing) is C++
+  loaded via ctypes, replacing the JNI/oneDAL table layer
+  (reference: mllib-dal/src/main/native/OneDAL.cpp, LibLoader.java).
+
+Public API::
+
+    from oap_mllib_tpu import KMeans, PCA, ALS
+    model = KMeans(k=8, max_iter=20).fit(X)
+"""
+
+__version__ = "0.1.0"
+
+from oap_mllib_tpu.config import Config, get_config, set_config
+from oap_mllib_tpu.models.kmeans import KMeans, KMeansModel
+from oap_mllib_tpu.models.pca import PCA, PCAModel
+from oap_mllib_tpu.models.als import ALS, ALSModel
+
+__all__ = [
+    "KMeans",
+    "KMeansModel",
+    "PCA",
+    "PCAModel",
+    "ALS",
+    "ALSModel",
+    "Config",
+    "get_config",
+    "set_config",
+]

@@ -1,0 +1,105 @@
+"""Unified configuration system.
+
+The reference scatters configuration across five channels (survey §5): Spark
+conf keys under ``spark.oap.mllib.*`` (e.g. ``spark.oap.mllib.oneccl.kvs.ip`` /
+``.port``, reference KMeansDALImpl.scala:40-44), Spark resource settings
+(``spark.executor.cores``, Utils.scala:51-58), env vars set from code
+(``CCL_ATL_TRANSPORT``, OneCCL.scala:26-30), build-time env, and a deployment
+env script.  This framework unifies them into one dataclass with env-var
+overrides under the ``OAP_MLLIB_TPU_*`` namespace (the ``spark.oap.mllib.*``
+analog) plus a programmatic API.
+
+Env mapping: config field ``foo_bar`` <- env ``OAP_MLLIB_TPU_FOO_BAR``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Optional
+
+_ENV_PREFIX = "OAP_MLLIB_TPU_"
+
+
+def _env_bool(val: str) -> bool:
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class Config:
+    """Global framework configuration.
+
+    Fields mirror the reference's config surface:
+
+    - ``device``: backend selector, the ``spark.oap.mllib.device=tpu`` analog
+      (BASELINE.json north star). One of ``"tpu"``, ``"cpu"``, ``"auto"``.
+      ``"auto"`` uses an accelerator when present, else the host platform.
+    - ``coordinator_address`` / ``coordinator_port``: multi-host bootstrap
+      rendezvous — the ``spark.oap.mllib.oneccl.kvs.ip``/``.port`` analog
+      (reference KMeansDALImpl.scala:39-46). Empty means single-process.
+    - ``num_processes`` / ``process_id``: collective world shape — the
+      executor-count / rank pair (reference OneCCL.scala:32-42).
+    - ``data_axis`` / ``model_axis``: mesh axis names for row sharding and
+      feature/factor sharding.
+    - ``enable_x64``: run K-Means/PCA accumulation in float64 for parity with
+      the reference's double kernels (KMeansDALImpl.cpp:32); ALS uses float32
+      like the reference (ALSDALImpl.cpp:35).
+    - ``fallback``: when True (default), estimators silently fall back to the
+      CPU/NumPy reference path if the capability predicate fails — the
+      "unmodified user code" contract (reference Utils.scala:98-115).
+    - ``timing``: per-phase wall-time logging, the std::chrono log analog
+      (reference KMeansDALImpl.cpp:202-222).
+    """
+
+    device: str = "auto"
+    coordinator_address: str = ""
+    coordinator_port: int = 0
+    num_processes: int = 1
+    process_id: int = 0
+    data_axis: str = "data"
+    model_axis: str = "model"
+    enable_x64: bool = False
+    fallback: bool = True
+    timing: bool = False
+    seed: int = 0
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        cfg = cls()
+        for f in dataclasses.fields(cls):
+            env_key = _ENV_PREFIX + f.name.upper()
+            if env_key not in os.environ:
+                continue
+            raw = os.environ[env_key]
+            if f.type in ("bool", bool):
+                setattr(cfg, f.name, _env_bool(raw))
+            elif f.type in ("int", int):
+                setattr(cfg, f.name, int(raw))
+            else:
+                setattr(cfg, f.name, raw)
+        return cfg
+
+
+_lock = threading.Lock()
+_config: Optional[Config] = None
+
+
+def get_config() -> Config:
+    """Return the process-global config, initializing from env on first use."""
+    global _config
+    with _lock:
+        if _config is None:
+            _config = Config.from_env()
+        return _config
+
+
+def set_config(**updates) -> Config:
+    """Update the process-global config in place; returns it."""
+    cfg = get_config()
+    with _lock:
+        for k, v in updates.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown config field: {k!r}")
+            setattr(cfg, k, v)
+    return cfg

@@ -1,0 +1,24 @@
+"""Data plane: device-resident tables and file readers.
+
+TPU-native replacement for the reference's L3 data-plane conversion
+(mllib-dal OneDAL.scala: RDD[Vector] -> per-partition HomogenNumericTable ->
+executor-local RowMergedNumericTable) and its Java/C++ table layer
+(OneDAL.cpp).  Here a "table" is a logically-global `jax.Array` row-sharded
+over the mesh, with explicit valid-row accounting because XLA shapes are
+static and rows are padded.
+"""
+
+from oap_mllib_tpu.data.table import DenseTable, CSRTable
+from oap_mllib_tpu.data.io import (
+    read_libsvm,
+    read_csv,
+    read_ratings,
+)
+
+__all__ = [
+    "DenseTable",
+    "CSRTable",
+    "read_libsvm",
+    "read_csv",
+    "read_ratings",
+]

@@ -1,0 +1,70 @@
+"""File readers for the example/data formats the reference consumes.
+
+Formats (survey §2.8):
+- libsvm — ``examples/data/sample_kmeans_data.txt`` (label idx:val ...);
+  1-based feature indices, as Spark's libsvm loader expects.
+- dense CSV — ``examples/data/pca_data.csv``.
+- ratings — ``onedal_als_csr_ratings.txt``: ``user::item::rating`` lines
+  (MovieLens style, parsed in examples/als/.../ALSExample.scala).
+
+A fast C++ parser backs these when the native library is built
+(oap_mllib_tpu/native); these NumPy versions are the always-available
+fallback and the correctness oracle.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def read_libsvm(path: str, n_features: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Read a libsvm file into dense (labels, X). 1-based indices."""
+    labels = []
+    rows = []
+    max_idx = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            feats = {}
+            for tok in parts[1:]:
+                idx, val = tok.split(":")
+                idx = int(idx)
+                feats[idx] = float(val)
+                max_idx = max(max_idx, idx)
+            rows.append(feats)
+    d = n_features if n_features is not None else max_idx
+    X = np.zeros((len(rows), d), dtype=np.float64)
+    for i, feats in enumerate(rows):
+        for idx, val in feats.items():
+            X[i, idx - 1] = val
+    return np.asarray(labels), X
+
+
+def read_csv(path: str, delimiter: str = ",") -> np.ndarray:
+    """Read a dense numeric CSV (no header) into an (n, d) array."""
+    return np.loadtxt(path, delimiter=delimiter, dtype=np.float64, ndmin=2)
+
+
+def read_ratings(path: str, sep: str = "::") -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Read ``user<sep>item<sep>rating`` lines into (users, items, ratings)."""
+    users, items, ratings = [], [], []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            u, i, r = line.split(sep)[:3]
+            users.append(int(u))
+            items.append(int(i))
+            ratings.append(float(r))
+    return (
+        np.asarray(users, dtype=np.int64),
+        np.asarray(items, dtype=np.int64),
+        np.asarray(ratings, dtype=np.float32),
+    )

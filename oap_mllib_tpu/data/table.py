@@ -1,0 +1,151 @@
+"""Device-resident table abstractions.
+
+Replaces the reference's native table layer:
+
+- ``DenseTable`` ~ oneDAL ``HomogenNumericTable`` / ``RowMergedNumericTable``
+  (built in OneDAL.scala:92-166 via per-partition memcpy + executor-local
+  merge).  Here: one padded, row-sharded `jax.Array` plus valid-row count; a
+  per-row validity mask replaces variable per-rank row counts.
+- ``CSRTable`` ~ the one-based CSR table the reference builds for ALS
+  (ALSDALImpl.scala:184-230, OneDAL.cpp:109-145).  Here: zero-based COO/CSR
+  segment arrays padded to static shapes, the XLA-friendly sparse layout
+  (gather/segment_sum instead of sparse BLAS).
+
+Memory lifetime is JAX's (GC'd device buffers) — no explicit
+``releaseNumericTables`` registry needed (reference OneDAL.scala:81-90);
+``delete()`` is provided for eager HBM release on large tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oap_mllib_tpu.parallel.mesh import data_sharding, pad_rows
+
+
+@dataclasses.dataclass
+class DenseTable:
+    """A row-sharded dense matrix with padded rows.
+
+    ``data`` is (n_padded, d) sharded P(data, None) over the mesh;
+    ``mask`` is (n_padded,) float (1.0 valid / 0.0 pad), sharded the same
+    way so masked reductions stay local + psum.
+    """
+
+    data: jax.Array
+    mask: jax.Array
+    n_rows: int  # valid rows
+
+    @property
+    def n_padded(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def n_features(self) -> int:
+        return self.data.shape[1]
+
+    @classmethod
+    def from_numpy(cls, x: np.ndarray, mesh, dtype=None) -> "DenseTable":
+        x = np.asarray(x)
+        if x.ndim != 2:
+            raise ValueError(f"expected 2-D data, got shape {x.shape}")
+        if dtype is not None:
+            x = x.astype(dtype)
+        # pad so every data-axis shard has equal rows
+        n_data = mesh.shape[mesh.axis_names[0]]
+        padded, n_valid = pad_rows(x, n_data)
+        mask = np.zeros((padded.shape[0],), dtype=padded.dtype)
+        mask[:n_valid] = 1.0
+        sharding2 = data_sharding(mesh, 2)
+        sharding1 = data_sharding(mesh, 1)
+        return cls(
+            data=jax.device_put(padded, sharding2),
+            mask=jax.device_put(mask, sharding1),
+            n_rows=n_valid,
+        )
+
+    def to_numpy(self) -> np.ndarray:
+        """Gather valid rows back to host (reverse data plane,
+        ~ numericTableToVectors, OneDAL.scala:37-52)."""
+        return np.asarray(self.data)[: self.n_rows]
+
+    def delete(self) -> None:
+        """Eagerly drop device buffers (~ cFreeDataMemory, OneDAL.cpp:83-89)."""
+        self.data.delete()
+        self.mask.delete()
+
+
+@dataclasses.dataclass
+class CSRTable:
+    """A sparse ratings block in padded COO form with CSR row offsets.
+
+    Arrays are host-or-device; all zero-based (the reference's one-based CSR
+    is a oneDAL requirement, OneDAL.cpp:123-126 — not carried over).
+
+    - ``rows``/``cols``: (nnz_padded,) int32 indices; padding entries point
+      at row ``n_rows`` (one past the end) so segment ops drop them.
+    - ``values``: (nnz_padded,) float32.
+    - ``row_offsets``: (n_rows + 1,) int32 CSR offsets over the *valid* nnz.
+    - ``nnz``: valid entry count.
+    """
+
+    rows: jax.Array
+    cols: jax.Array
+    values: jax.Array
+    row_offsets: jax.Array
+    n_rows: int
+    n_cols: int
+    nnz: int
+
+    @classmethod
+    def from_coo(
+        cls,
+        rows: np.ndarray,
+        cols: np.ndarray,
+        values: np.ndarray,
+        n_rows: int,
+        n_cols: int,
+        nnz_padded: Optional[int] = None,
+    ) -> "CSRTable":
+        """Build from COO triples; sorts by (row, col) like the reference's
+        post-shuffle sort (ALSShuffle.cpp:111)."""
+        rows = np.asarray(rows, dtype=np.int32)
+        cols = np.asarray(cols, dtype=np.int32)
+        values = np.asarray(values, dtype=np.float32)
+        if len(rows) and (rows.max() >= n_rows or rows.min() < 0):
+            raise ValueError(f"row index out of range [0, {n_rows})")
+        if len(cols) and (cols.max() >= n_cols or cols.min() < 0):
+            raise ValueError(f"col index out of range [0, {n_cols})")
+        order = np.lexsort((cols, rows))
+        rows, cols, values = rows[order], cols[order], values[order]
+        nnz = len(values)
+        counts = np.bincount(rows, minlength=n_rows)
+        row_offsets = np.zeros((n_rows + 1,), dtype=np.int32)
+        np.cumsum(counts, out=row_offsets[1:])
+        if nnz_padded is not None and nnz_padded > nnz:
+            pad = nnz_padded - nnz
+            rows = np.concatenate([rows, np.full((pad,), n_rows, np.int32)])
+            cols = np.concatenate([cols, np.zeros((pad,), np.int32)])
+            values = np.concatenate([values, np.zeros((pad,), np.float32)])
+        return cls(
+            rows=jnp.asarray(rows),
+            cols=jnp.asarray(cols),
+            values=jnp.asarray(values),
+            row_offsets=jnp.asarray(row_offsets),
+            n_rows=n_rows,
+            n_cols=n_cols,
+            nnz=nnz,
+        )
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros((self.n_rows, self.n_cols), dtype=np.float32)
+        r = np.asarray(self.rows)[: self.nnz]
+        c = np.asarray(self.cols)[: self.nnz]
+        v = np.asarray(self.values)[: self.nnz]
+        out[r, c] = v
+        return out

@@ -1,0 +1,13 @@
+"""CPU/NumPy reference implementations — the fallback path.
+
+The reference's dispatch contract (survey §7.1 item 2): when the capability
+predicate fails, training runs on vanilla Spark MLlib instead of the
+accelerated native path, and user code never notices.  This package is that
+vanilla path: straightforward, dependency-free NumPy implementations of each
+estimator, covering the cases the accelerated path declines (e.g. cosine
+distance or row weights for K-Means — spark-3.1.1/ml/clustering/
+KMeans.scala:349-351; explicit-preference ALS — ALS.scala:925).
+
+They double as in-repo correctness baselines for development; the test-suite
+oracles are written independently in tests/ (survey §4 takeaway).
+"""

@@ -1,0 +1,101 @@
+"""NumPy ALS — fallback path and development baseline.
+
+Covers both explicit ALS (the case the reference's DAL path declines —
+accelerated only when implicitPrefs, spark-3.1.1/ml/recommendation/
+ALS.scala:925) and implicit-feedback ALS (Hu/Koren/Volinsky), the
+algorithm the reference accelerates via oneDAL's 4-step distributed scheme
+(native/ALSDALImpl.cpp).
+
+Normal equations (rank r, regularization lambda, confidence c = 1 + alpha*r):
+  implicit:  A_u = Y^T Y + sum_{i in R(u)} alpha*r_ui * y_i y_i^T + lambda I
+             b_u = sum_{i in R(u)} (1 + alpha*r_ui) * y_i
+  explicit:  A_u = sum_{i in R(u)} y_i y_i^T + lambda I
+             b_u = sum_{i in R(u)} r_ui * y_i
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def init_factors(n: int, rank: int, seed: int) -> np.ndarray:
+    """Spark-style init (ALS.initialize): signed gaussian rows, each
+    normalized to unit L2 norm.  (All-positive init is a trap: it sits in
+    a positive-orthant local minimum for signed low-rank data.)"""
+    rng = np.random.default_rng(seed)
+    f = rng.normal(size=(n, rank)).astype(np.float32)
+    norms = np.linalg.norm(f, axis=1, keepdims=True)
+    return (f / np.maximum(norms, 1e-12)).astype(np.float32)
+
+
+def _solve_side(
+    dst_n: int,
+    dst_idx: np.ndarray,
+    src_idx: np.ndarray,
+    ratings: np.ndarray,
+    src_factors: np.ndarray,
+    rank: int,
+    reg: float,
+    alpha: float,
+    implicit: bool,
+) -> np.ndarray:
+    out = np.zeros((dst_n, rank), dtype=np.float32)
+    eye = np.eye(rank, dtype=np.float64) * reg
+    gram = src_factors.astype(np.float64).T @ src_factors.astype(np.float64) if implicit else None
+    order = np.argsort(dst_idx, kind="stable")
+    dst_sorted = dst_idx[order]
+    bounds = np.searchsorted(dst_sorted, np.arange(dst_n + 1))
+    for u in range(dst_n):
+        sel = order[bounds[u] : bounds[u + 1]]
+        if len(sel) == 0:
+            continue
+        ys = src_factors[src_idx[sel]].astype(np.float64)  # (m, r)
+        rs = ratings[sel].astype(np.float64)  # (m,)
+        if implicit:
+            a = gram + ys.T @ (ys * (alpha * rs)[:, None]) + eye
+            b = ((1.0 + alpha * rs)[:, None] * ys).sum(axis=0)
+        else:
+            a = ys.T @ ys + eye
+            b = (rs[:, None] * ys).sum(axis=0)
+        out[u] = np.linalg.solve(a, b).astype(np.float32)
+    return out
+
+
+def als_np(
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    n_users: int,
+    n_items: int,
+    rank: int = 10,
+    max_iter: int = 10,
+    reg: float = 0.1,
+    alpha: float = 1.0,
+    implicit: bool = False,
+    seed: int = 0,
+    init: Tuple[np.ndarray, np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Alternating updates; returns (user_factors, item_factors)."""
+    users = np.asarray(users, dtype=np.int64)
+    items = np.asarray(items, dtype=np.int64)
+    ratings = np.asarray(ratings, dtype=np.float32)
+    if init is not None:
+        x, y = np.array(init[0], np.float32), np.array(init[1], np.float32)
+    else:
+        x = init_factors(n_users, rank, seed)
+        y = init_factors(n_items, rank, seed + 1)
+    for _ in range(max_iter):
+        x = _solve_side(n_users, users, items, ratings, y, rank, reg, alpha, implicit)
+        y = _solve_side(n_items, items, users, ratings, x, rank, reg, alpha, implicit)
+    return x, y
+
+
+def predict_np(x: np.ndarray, y: np.ndarray, users: np.ndarray, items: np.ndarray) -> np.ndarray:
+    return np.sum(x[users] * y[items], axis=1)
+
+
+def rmse_np(x, y, users, items, ratings) -> float:
+    pred = predict_np(x, y, users, items)
+    return float(np.sqrt(np.mean((pred - ratings) ** 2)))

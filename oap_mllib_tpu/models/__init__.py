@@ -1,0 +1,16 @@
+"""Estimator/driver layer: fit/transform estimators with Spark-ML-compatible
+parameters, transparent dispatch to accelerated or fallback paths, and model
+objects with save/load.
+
+Replaces the reference's L4 algorithm drivers + L6 Spark shims
+(KMeansDALImpl.scala / PCADALImpl.scala / ALSDALImpl.scala and the vendored
+per-version Spark API copies).  There is no classpath shadowing to replicate:
+the Python estimator IS the public API, and dispatch happens inside ``fit``
+(survey §7.2 step 4 — Python-first, PySpark-parity surface).
+"""
+
+from oap_mllib_tpu.models.kmeans import KMeans, KMeansModel
+from oap_mllib_tpu.models.pca import PCA, PCAModel
+from oap_mllib_tpu.models.als import ALS, ALSModel
+
+__all__ = ["KMeans", "KMeansModel", "PCA", "PCAModel", "ALS", "ALSModel"]

@@ -1,0 +1,181 @@
+"""ALS estimator with Spark-MLlib-compatible parameters.
+
+API parity target: ``org.apache.spark.ml.recommendation.ALS`` as shimmed by
+the reference (spark-3.1.1/ml/recommendation/ALS.scala): params rank,
+maxIter, regParam, alpha, implicitPrefs, seed; model surface userFactors /
+itemFactors and pairwise prediction.
+
+Dispatch: the reference accelerates ONLY implicit-feedback ALS
+(ALS.scala:925) and falls back to Spark otherwise.  Here both implicit and
+explicit run accelerated (the TPU kernels cover both); the fallback NumPy
+path remains for ``device=cpu`` or failed platform checks.
+
+Ids: like the reference (ALSDALImpl.scala:62-70 computes nUsers/nItems via
+RDD max), ids are dense non-negative ints; n_users/n_items default to
+max+1 and may be passed explicitly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from oap_mllib_tpu.fallback import als_np
+from oap_mllib_tpu.ops import als_ops
+from oap_mllib_tpu.utils.dispatch import should_accelerate
+from oap_mllib_tpu.utils.timing import Timings, phase_timer
+
+
+class ALSModel:
+    def __init__(self, user_factors: np.ndarray, item_factors: np.ndarray,
+                 summary: Optional[dict] = None):
+        self.user_factors_ = np.asarray(user_factors)
+        self.item_factors_ = np.asarray(item_factors)
+        self.summary = summary or {}
+
+    @property
+    def rank(self) -> int:
+        return self.user_factors_.shape[1]
+
+    def predict(self, users: np.ndarray, items: np.ndarray) -> np.ndarray:
+        """Predicted preference/rating for (user, item) pairs
+        (~ ALSModel.transform's dot-product predictions)."""
+        users = np.asarray(users, dtype=np.int32)
+        items = np.asarray(items, dtype=np.int32)
+        return np.asarray(
+            als_ops.predict_pairs(
+                jnp.asarray(self.user_factors_),
+                jnp.asarray(self.item_factors_),
+                jnp.asarray(users),
+                jnp.asarray(items),
+            )
+        )
+
+    def recommend_for_all_users(self, num_items: int) -> np.ndarray:
+        """Top-N item ids per user — one (n_users, r)x(r, n_items) MXU
+        matmul + top_k (~ ALSModel.recommendForAllUsers)."""
+        import jax
+
+        scores = jnp.asarray(self.user_factors_) @ jnp.asarray(self.item_factors_).T
+        _, idx = jax.lax.top_k(scores, num_items)
+        return np.asarray(idx)
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        np.save(os.path.join(path, "user_factors.npy"), self.user_factors_)
+        np.save(os.path.join(path, "item_factors.npy"), self.item_factors_)
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump({"type": "ALSModel", "rank": int(self.rank), "version": 1}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "ALSModel":
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        if meta.get("type") != "ALSModel":
+            raise ValueError(f"not an ALSModel directory: {path}")
+        return cls(
+            np.load(os.path.join(path, "user_factors.npy")),
+            np.load(os.path.join(path, "item_factors.npy")),
+        )
+
+
+class ALS:
+    """ALS estimator. Param parity with Spark ML ALS defaults:
+    rank=10, max_iter=10, reg_param=0.1, implicit_prefs=False, alpha=1.0."""
+
+    def __init__(
+        self,
+        rank: int = 10,
+        max_iter: int = 10,
+        reg_param: float = 0.1,
+        implicit_prefs: bool = False,
+        alpha: float = 1.0,
+        seed: int = 0,
+    ):
+        if rank < 1:
+            raise ValueError("rank must be >= 1")
+        if max_iter < 0:
+            raise ValueError("max_iter must be >= 0")
+        if reg_param < 0:
+            raise ValueError("reg_param must be >= 0")
+        if alpha < 0:
+            raise ValueError("alpha must be >= 0")
+        self.rank = rank
+        self.max_iter = max_iter
+        self.reg_param = reg_param
+        self.implicit_prefs = implicit_prefs
+        self.alpha = alpha
+        self.seed = seed
+
+    def fit(
+        self,
+        users: np.ndarray,
+        items: np.ndarray,
+        ratings: np.ndarray,
+        n_users: Optional[int] = None,
+        n_items: Optional[int] = None,
+        init: Optional[tuple] = None,
+    ) -> ALSModel:
+        users = np.asarray(users, dtype=np.int64)
+        items = np.asarray(items, dtype=np.int64)
+        ratings = np.asarray(ratings, dtype=np.float32)
+        if not (len(users) == len(items) == len(ratings)):
+            raise ValueError("users/items/ratings must have equal length")
+        if len(users) == 0:
+            raise ValueError("empty ratings")
+        if users.min() < 0 or items.min() < 0:
+            raise ValueError("ids must be non-negative")
+        if n_users is None:
+            n_users = int(users.max()) + 1
+        elif int(users.max()) >= n_users:
+            raise ValueError(
+                f"user id {int(users.max())} out of range for n_users={n_users}"
+            )
+        if n_items is None:
+            n_items = int(items.max()) + 1
+        elif int(items.max()) >= n_items:
+            raise ValueError(
+                f"item id {int(items.max())} out of range for n_items={n_items}"
+            )
+
+        accelerated = should_accelerate("ALS", True)
+        timings = Timings()
+        if init is not None:
+            x0, y0 = np.array(init[0], np.float32), np.array(init[1], np.float32)
+        else:
+            x0 = als_np.init_factors(n_users, self.rank, self.seed)
+            y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
+
+        if not accelerated:
+            with phase_timer(timings, "als_np"):
+                x, y = als_np.als_np(
+                    users, items, ratings, n_users, n_items, self.rank,
+                    self.max_iter, self.reg_param, self.alpha,
+                    self.implicit_prefs, self.seed, init=(x0, y0),
+                )
+            return ALSModel(x, y, {"timings": timings, "accelerated": False})
+
+        # accelerated path (~ ALSDALImpl.train, ALSDALImpl.scala:58)
+        with phase_timer(timings, "table_convert"):
+            u = jnp.asarray(users.astype(np.int32))
+            i = jnp.asarray(items.astype(np.int32))
+            c = jnp.asarray(ratings)
+            valid = jnp.ones_like(c)
+        with phase_timer(timings, "als_iterations"):
+            if self.implicit_prefs:
+                x, y = als_ops.als_implicit_run(
+                    u, i, c, valid, jnp.asarray(x0), jnp.asarray(y0),
+                    n_users, n_items, self.max_iter, self.reg_param, self.alpha,
+                )
+            else:
+                x, y = als_ops.als_explicit_run(
+                    u, i, c, valid, jnp.asarray(x0), jnp.asarray(y0),
+                    n_users, n_items, self.max_iter, self.reg_param,
+                )
+            x = np.asarray(x)
+            y = np.asarray(y)
+        return ALSModel(x, y, {"timings": timings, "accelerated": True})

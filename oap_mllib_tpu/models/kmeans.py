@@ -1,0 +1,210 @@
+"""K-Means estimator with Spark-MLlib-compatible parameters.
+
+API parity target: ``org.apache.spark.ml.clustering.KMeans`` as shimmed by
+the reference (spark-3.1.1/ml/clustering/KMeans.scala) — params k, maxIter,
+tol, seed, initMode (random | k-means||), initSteps, distanceMeasure — and
+its model surface: clusterCenters, predict, summary (trainingCost,
+numIter), save/load.
+
+Dispatch mirrors the reference's trainWithDAL guard
+(KMeans.scala:349-357): accelerated iff platform compatible AND
+distanceMeasure == euclidean.  Unlike the reference, row weights do NOT
+force fallback — the TPU kernel supports them natively (weights fold into
+the mask vector); cosine still falls back.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.data.table import DenseTable
+from oap_mllib_tpu.fallback.kmeans_np import lloyd_np, predict_np
+from oap_mllib_tpu.ops import kmeans_ops
+from oap_mllib_tpu.parallel.mesh import get_mesh
+from oap_mllib_tpu.utils.dispatch import should_accelerate
+from oap_mllib_tpu.utils.timing import Timings, phase_timer
+
+INIT_RANDOM = "random"
+INIT_PARALLEL = "k-means||"
+
+
+class KMeansSummary:
+    """Training summary (~ KMeansSummary + KMeansResult,
+    reference KMeansResult.java / KMeans.scala:359-368)."""
+
+    def __init__(self, training_cost: float, num_iter: int, timings: Timings, accelerated: bool):
+        self.training_cost = training_cost
+        self.num_iter = num_iter
+        self.timings = timings
+        self.accelerated = accelerated
+
+    def __repr__(self) -> str:
+        return (
+            f"KMeansSummary(cost={self.training_cost:.6g}, iters={self.num_iter}, "
+            f"accelerated={self.accelerated})"
+        )
+
+
+class KMeansModel:
+    def __init__(self, cluster_centers: np.ndarray, distance_measure: str = "euclidean",
+                 summary: Optional[KMeansSummary] = None):
+        self.cluster_centers_ = np.asarray(cluster_centers)
+        self.distance_measure = distance_measure
+        self.summary = summary
+
+    @property
+    def k(self) -> int:
+        return self.cluster_centers_.shape[0]
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Nearest-center assignment (the shim's transform/predict surface)."""
+        x = np.asarray(x, dtype=self.cluster_centers_.dtype)
+        if self.distance_measure == "euclidean" and x.shape[0] >= 1:
+            return np.asarray(
+                kmeans_ops.assign_clusters(jnp.asarray(x), jnp.asarray(self.cluster_centers_))
+            )
+        return predict_np(x, self.cluster_centers_, self.distance_measure)
+
+    def transform(self, x: np.ndarray) -> np.ndarray:
+        return self.predict(x)
+
+    def compute_cost(self, x: np.ndarray) -> float:
+        x = np.asarray(x, dtype=self.cluster_centers_.dtype)
+        if self.distance_measure != "euclidean":
+            from oap_mllib_tpu.fallback.kmeans_np import _sq_dists
+
+            d = _sq_dists(x, self.cluster_centers_, self.distance_measure)
+            return float(np.sum(np.min(d, axis=1)))
+        d2 = kmeans_ops.pairwise_sq_dists(jnp.asarray(x), jnp.asarray(self.cluster_centers_))
+        return float(jnp.sum(jnp.min(d2, axis=1)))
+
+    # -- persistence (~ Spark ML read/write, tested in IntelKMeansSuite) -----
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        np.save(os.path.join(path, "centers.npy"), self.cluster_centers_)
+        meta = {"type": "KMeansModel", "distance_measure": self.distance_measure,
+                "k": int(self.k), "version": 1}
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f)
+
+    @classmethod
+    def load(cls, path: str) -> "KMeansModel":
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        if meta.get("type") != "KMeansModel":
+            raise ValueError(f"not a KMeansModel directory: {path}")
+        centers = np.load(os.path.join(path, "centers.npy"))
+        return cls(centers, meta["distance_measure"])
+
+
+class KMeans:
+    """K-Means estimator.
+
+    Parameters mirror Spark ML (reference shim KMeans.scala param defaults):
+    k=2, max_iter=20, tol=1e-4, init_mode="k-means||", init_steps=2,
+    distance_measure="euclidean", seed derived from class name there, plain
+    int here.
+    """
+
+    def __init__(
+        self,
+        k: int = 2,
+        max_iter: int = 20,
+        tol: float = 1e-4,
+        seed: int = 0,
+        init_mode: str = INIT_PARALLEL,
+        init_steps: int = 2,
+        distance_measure: str = "euclidean",
+    ):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        if max_iter < 0:
+            raise ValueError("max_iter must be >= 0")
+        if init_mode not in (INIT_RANDOM, INIT_PARALLEL):
+            raise ValueError(f"init_mode must be '{INIT_RANDOM}' or '{INIT_PARALLEL}'")
+        if distance_measure not in ("euclidean", "cosine"):
+            raise ValueError("distance_measure must be 'euclidean' or 'cosine'")
+        if init_steps < 1:
+            raise ValueError("init_steps must be >= 1")
+        self.k = k
+        self.max_iter = max_iter
+        self.tol = tol
+        self.seed = seed
+        self.init_mode = init_mode
+        self.init_steps = init_steps
+        self.distance_measure = distance_measure
+
+    def fit(self, x: np.ndarray, sample_weight: Optional[np.ndarray] = None) -> KMeansModel:
+        x = np.asarray(x)
+        if x.ndim != 2:
+            raise ValueError(f"expected 2-D data, got shape {x.shape}")
+        if x.shape[0] < 1:
+            raise ValueError("empty input")
+        guard_ok = self.distance_measure == "euclidean"
+        accelerated = should_accelerate(
+            "KMeans", guard_ok, reason=f"distance_measure={self.distance_measure}"
+        )
+        if accelerated:
+            return self._fit_tpu(x, sample_weight)
+        return self._fit_fallback(x, sample_weight)
+
+    # -- accelerated path (~ KMeansDALImpl.train, KMeansDALImpl.scala:35) ----
+    def _fit_tpu(self, x: np.ndarray, sample_weight: Optional[np.ndarray]) -> KMeansModel:
+        cfg = get_config()
+        dtype = np.float64 if cfg.enable_x64 else np.float32
+        timings = Timings()
+        mesh = get_mesh()
+        with phase_timer(timings, "table_convert"):
+            table = DenseTable.from_numpy(x.astype(dtype), mesh)
+            weights = table.mask
+            if sample_weight is not None:
+                w = np.zeros((table.n_padded,), dtype=dtype)
+                w[: table.n_rows] = np.asarray(sample_weight, dtype=dtype)
+                weights = jnp.asarray(w)
+        with phase_timer(timings, "init_centers"):
+            if self.init_mode == INIT_RANDOM:
+                centers0 = kmeans_ops.init_random(
+                    table.data, table.n_rows, self.k, self.seed
+                ).astype(dtype)
+            else:
+                centers0 = kmeans_ops.init_kmeans_parallel(
+                    table.data, weights, table.n_rows, self.k, self.seed, self.init_steps
+                ).astype(dtype)
+        with phase_timer(timings, "lloyd_loop"):
+            centers, n_iter, cost = kmeans_ops.lloyd_run(
+                table.data,
+                weights,
+                jnp.asarray(centers0),
+                self.max_iter,
+                jnp.asarray(self.tol, dtype),
+            )
+            centers = np.asarray(centers)
+            n_iter = int(n_iter)
+            cost = float(cost)
+        summary = KMeansSummary(cost, n_iter, timings, accelerated=True)
+        return KMeansModel(centers, self.distance_measure, summary)
+
+    # -- fallback path (~ trainWithML, KMeans.scala:355) ---------------------
+    def _fit_fallback(self, x: np.ndarray, sample_weight: Optional[np.ndarray]) -> KMeansModel:
+        timings = Timings()
+        x = x.astype(np.float64)
+        with phase_timer(timings, "init_centers"):
+            if self.init_mode == INIT_RANDOM:
+                centers0 = kmeans_ops.init_random(x, x.shape[0], self.k, self.seed)
+            else:
+                # host k-means++ over full data as the || analog (small-data path)
+                rng = np.random.default_rng(self.seed)
+                w = np.ones(x.shape[0]) if sample_weight is None else np.asarray(sample_weight)
+                centers0 = kmeans_ops._weighted_kmeans_pp(x, w, self.k, rng)
+        with phase_timer(timings, "lloyd_loop"):
+            centers, n_iter, cost = lloyd_np(
+                x, centers0, self.max_iter, self.tol, sample_weight, self.distance_measure
+            )
+        summary = KMeansSummary(cost, n_iter, timings, accelerated=False)
+        return KMeansModel(centers, self.distance_measure, summary)

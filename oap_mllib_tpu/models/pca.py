@@ -1,0 +1,115 @@
+"""PCA estimator with Spark-MLlib-compatible parameters.
+
+API parity target: ``org.apache.spark.ml.feature.PCA`` as shimmed by the
+reference (spark-3.1.1/ml/feature/PCA.scala): param k; model surface
+``pc`` (d x k principal-component matrix), ``explainedVariance`` (top-k
+variance ratios), transform = projection WITHOUT mean-centering.
+
+Dispatch mirrors the reference guard (PCA.scala:103): accelerated iff
+platform compatible AND numFeatures < 65535.  Explained-variance ratios are
+normalized by total variance, per Spark's
+computePrincipalComponentsAndExplainedVariance (the oracle used by the
+reference's own parity suite, IntelPCASuite.scala:51-54).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.data.table import DenseTable
+from oap_mllib_tpu.fallback.pca_np import pca_np
+from oap_mllib_tpu.ops import pca_ops
+from oap_mllib_tpu.parallel.mesh import get_mesh
+from oap_mllib_tpu.utils.dispatch import MAX_PCA_FEATURES, should_accelerate
+from oap_mllib_tpu.utils.timing import Timings, phase_timer
+
+
+class PCAModel:
+    def __init__(self, components: np.ndarray, explained_variance: np.ndarray,
+                 summary: Optional[dict] = None):
+        # components: (d, k), columns are principal axes (Spark's `pc`)
+        self.components_ = np.asarray(components)
+        self.explained_variance_ = np.asarray(explained_variance)
+        self.summary = summary or {}
+
+    @property
+    def k(self) -> int:
+        return self.components_.shape[1]
+
+    def transform(self, x: np.ndarray) -> np.ndarray:
+        """Project into the PC basis (no centering — Spark parity)."""
+        x = np.asarray(x, dtype=self.components_.dtype)
+        return np.asarray(pca_ops.project(jnp.asarray(x), jnp.asarray(self.components_)))
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        np.save(os.path.join(path, "components.npy"), self.components_)
+        np.save(os.path.join(path, "explained_variance.npy"), self.explained_variance_)
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump({"type": "PCAModel", "k": int(self.k), "version": 1}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "PCAModel":
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        if meta.get("type") != "PCAModel":
+            raise ValueError(f"not a PCAModel directory: {path}")
+        return cls(
+            np.load(os.path.join(path, "components.npy")),
+            np.load(os.path.join(path, "explained_variance.npy")),
+        )
+
+
+class PCA:
+    """PCA estimator. Param parity: k (number of components)."""
+
+    def __init__(self, k: int):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.k = k
+
+    def fit(self, x: np.ndarray) -> PCAModel:
+        x = np.asarray(x)
+        if x.ndim != 2:
+            raise ValueError(f"expected 2-D data, got shape {x.shape}")
+        n, d = x.shape
+        if self.k > d:
+            raise ValueError(f"k={self.k} exceeds n_features={d}")
+        guard_ok = d < MAX_PCA_FEATURES
+        if should_accelerate("PCA", guard_ok, reason=f"n_features={d}"):
+            return self._fit_tpu(x)
+        return self._fit_fallback(x)
+
+    # -- accelerated path (~ PCADALImpl.train, PCADALImpl.scala:35) ----------
+    def _fit_tpu(self, x: np.ndarray) -> PCAModel:
+        cfg = get_config()
+        dtype = np.float64 if cfg.enable_x64 else np.float32
+        timings = Timings()
+        mesh = get_mesh()
+        with phase_timer(timings, "table_convert"):
+            table = DenseTable.from_numpy(x.astype(dtype), mesh)
+        with phase_timer(timings, "covariance"):
+            cov, _ = pca_ops.covariance(
+                table.data, table.mask, jnp.asarray(float(table.n_rows), dtype)
+            )
+        with phase_timer(timings, "eigh"):
+            vals, vecs = pca_ops.eigh_descending(cov)
+            vals = np.asarray(vals)
+            vecs = np.asarray(vecs)
+        total = float(vals.sum())
+        ratio = vals[: self.k] / total if total > 0 else np.zeros(self.k)
+        summary = {"timings": timings, "accelerated": True}
+        return PCAModel(vecs[:, : self.k], ratio, summary)
+
+    # -- fallback path (~ vanilla mllib.feature.PCA, PCA.scala:110-116) ------
+    def _fit_fallback(self, x: np.ndarray) -> PCAModel:
+        timings = Timings()
+        with phase_timer(timings, "pca_np"):
+            comps, ratio = pca_np(x, self.k)
+        return PCAModel(comps, ratio, {"timings": timings, "accelerated": False})

@@ -1,0 +1,11 @@
+"""Compute kernels: jitted, mesh-sharded JAX/XLA programs.
+
+TPU-native replacement for the reference's L0 native kernels
+(mllib-dal/src/main/native/{KMeans,PCA,ALS}DALImpl.cpp, which call oneDAL's
+distributed step1Local/step2Master algorithms and stitch them together with
+oneCCL collectives).  Here each algorithm is a single compiled program over
+the sharded table: local math and cross-device reductions are expressed
+globally and XLA lowers the reductions to ICI collectives — there is no
+separate "master step" rank; reductions materialize replicated results
+everywhere (survey §2.6 TPU-equivalent row).
+"""

@@ -1,0 +1,61 @@
+"""PCA compute kernels: sharded covariance + eigendecomposition.
+
+Replaces the reference's one-shot distributed PCA
+(native/PCADALImpl.cpp): there, inputs are mean-centered on the JVM via
+StandardScaler (PCADALImpl.scala:101-106), each rank runs oneDAL
+``pca::Distributed<step1Local, svdDense>`` (:63-69), serialized partials are
+allgatherv'd (:79-113), and the root's step2Master + finalizeCompute yields
+eigenvalues/eigenvectors (:122-153).
+
+TPU-first redesign: the covariance of a row-sharded table is two global
+reductions — ``sum_i x_i`` and ``X^T X`` (one (d,n)x(n,d) MXU matmul) —
+which GSPMD lowers to psums over the data axis; then
+``cov = (Gram - n * mu mu^T) / (n - 1)`` and a replicated d x d ``eigh``.
+One jitted program, no serialization, no master rank.  The d < 65535 guard
+(reference PCA.scala:103) carries over as the bound on the replicated d x d.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from typing import Tuple
+
+
+@jax.jit
+def covariance(x: jax.Array, mask: jax.Array, n_rows: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Sample covariance (d, d) and mean (d,) of the valid rows.
+
+    ``mask`` zeroes padded rows so they drop out of both reductions.
+    Matches Spark's RowMatrix covariance: (X^T X - n mu mu^T) / (n - 1).
+    """
+    xm = x * mask[:, None]
+    total = jnp.sum(xm, axis=0)  # psum over data axis
+    mean = total / n_rows
+    # HIGHEST precision: bf16 Gram accumulation cannot hit 1e-4 parity
+    gram = jnp.matmul(xm.T, x, precision=lax.Precision.HIGHEST)  # (d, d) <- MXU
+    cov = (gram - n_rows * jnp.outer(mean, mean)) / jnp.maximum(n_rows - 1.0, 1.0)
+    # numerical symmetry guard before eigh
+    return 0.5 * (cov + cov.T), mean
+
+
+@jax.jit
+def eigh_descending(cov: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Eigenvalues (descending) and matching eigenvectors (columns) of a
+    symmetric matrix — the finalizeCompute analog (PCADALImpl.cpp:122-153).
+    """
+    vals, vecs = jnp.linalg.eigh(cov)  # ascending
+    vals = vals[::-1]
+    vecs = vecs[:, ::-1]
+    return vals, vecs
+
+
+@jax.jit
+def project(x: jax.Array, components: jax.Array) -> jax.Array:
+    """Transform rows into the component basis: (n, d) @ (d, k).
+
+    NOTE Spark parity: PCAModel.transform does NOT mean-center before
+    projecting (mllib.feature.PCAModel), so neither do we.
+    """
+    return jnp.matmul(x, components, precision=lax.Precision.HIGHEST)

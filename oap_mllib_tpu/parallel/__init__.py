@@ -1,0 +1,35 @@
+"""Parallelism layer: device mesh, shardings, collectives, multi-host bootstrap.
+
+TPU-native replacement for the reference's L1 communication layer
+(mllib-dal/src/main/scala/org/apache/spark/ml/util/OneCCL.scala + native
+OneCCL.cpp): instead of a oneCCL rank/world communicator carrying serialized
+byte blobs over libfabric TCP, this layer builds a `jax.sharding.Mesh` over
+(hosts x chips), annotates tensors with `NamedSharding`, and lets XLA compile
+psum/all_gather/all_to_all collectives onto ICI/DCN.
+"""
+
+from oap_mllib_tpu.parallel.mesh import (
+    get_mesh,
+    data_sharding,
+    replicated_sharding,
+    shard_rows,
+    pad_rows,
+)
+from oap_mllib_tpu.parallel.collective import (
+    broadcast,
+    allgather_rows,
+    allreduce_sum,
+    alltoall_rows,
+)
+
+__all__ = [
+    "get_mesh",
+    "data_sharding",
+    "replicated_sharding",
+    "shard_rows",
+    "pad_rows",
+    "broadcast",
+    "allgather_rows",
+    "allreduce_sum",
+    "alltoall_rows",
+]

@@ -1,0 +1,102 @@
+"""Collective facade: named-axis collectives over the mesh.
+
+The reference funnels every cross-rank exchange through four oneCCL
+primitives carrying serialized oneDAL archives: ``broadcast`` (2-phase,
+length then payload — KMeansDALImpl.cpp:49-59), ``allgatherv``
+(KMeansDALImpl.cpp:97-99, PCADALImpl.cpp:111-113), and
+``alltoall``/``alltoallv`` (ALSShuffle.cpp:92-109).  Because XLA programs
+have static shapes, the TPU-native facade exchanges fixed-shape tensors
+(padded where sizes differ per rank) and compiles to ICI/DCN collectives.
+
+These wrappers are `shard_map`-based so they can be called eagerly on
+sharded arrays (useful in drivers and tests); inside jitted estimator
+kernels the same collectives are emitted implicitly by XLA from sharding
+annotations, or explicitly via `lax.psum` etc. under `shard_map`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from oap_mllib_tpu.config import get_config
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def broadcast(x: jax.Array, mesh: Mesh, root: int = 0) -> jax.Array:
+    """Replicate the root shard of a row-sharded array to all devices.
+
+    Analog of the reference's serialized-centroid broadcast
+    (KMeansDALImpl.cpp:49-59); here it is one compiled collective, no
+    length pre-exchange needed.
+    """
+    cfg = get_config()
+    axis = cfg.data_axis
+
+    def _bcast(shard):
+        full = lax.all_gather(shard, axis, tiled=True)
+        size = shard.shape[0]
+        return lax.dynamic_slice_in_dim(full, root * size, size, axis=0)
+
+    spec = P(axis, *([None] * (x.ndim - 1)))
+    return _shard_map(_bcast, mesh, (spec,), spec)(x)
+
+
+def allgather_rows(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """Gather row shards onto every device (replicated result).
+
+    Analog of allgatherv of serialized partials (PCADALImpl.cpp:111-113),
+    with fixed-shape shards instead of variable-length archives.
+    """
+    cfg = get_config()
+    axis = cfg.data_axis
+
+    def _ag(shard):
+        return lax.all_gather(shard, axis, tiled=True)
+
+    in_spec = P(axis, *([None] * (x.ndim - 1)))
+    return _shard_map(_ag, mesh, (in_spec,), P(*([None] * x.ndim)))(x)
+
+
+def allreduce_sum(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """Sum identically-shaped per-device values; replicated result.
+
+    The reference has no direct allreduce — it emulates one with
+    allgatherv + a root-side master step (KMeansDALImpl.cpp:97-131); on
+    TPU a psum rides ICI directly.
+    """
+    cfg = get_config()
+    axis = cfg.data_axis
+
+    def _ar(shard):
+        return lax.psum(shard, axis)
+
+    in_spec = P(axis, *([None] * (x.ndim - 1)))
+    out_spec = P(*([None] * x.ndim))
+    return _shard_map(_ar, mesh, (in_spec,), out_spec)(x)
+
+
+def alltoall_rows(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """All-to-all exchange of equal row blocks.
+
+    Each device's shard is viewed as ``world_size`` equal sub-blocks along
+    rows; sub-block j goes to device j.  Analog of the reference's rating
+    shuffle ``alltoallv`` (ALSShuffle.cpp:92-109) after padding each bucket
+    to the max bucket size (survey §7.3 variable-length-exchange note).
+    """
+    cfg = get_config()
+    axis = cfg.data_axis
+    world = mesh.shape[axis]
+
+    def _a2a(shard):
+        blocks = shard.reshape((world, shard.shape[0] // world) + shard.shape[1:])
+        out = lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0)
+        return out.reshape(shard.shape)
+
+    spec = P(axis, *([None] * (x.ndim - 1)))
+    return _shard_map(_a2a, mesh, (spec,), spec)(x)

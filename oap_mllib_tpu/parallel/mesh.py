@@ -1,0 +1,99 @@
+"""Device mesh construction and sharding helpers.
+
+The reference forms its collective world as (world size = Spark executor
+count, rank = partition index) with partition->executor pinning via a custom
+coalescer (reference OneCCL.scala:42, ExecutorInProcessCoalescePartitioner
+.scala:28-57).  The TPU-native equivalent is a named `jax.sharding.Mesh`:
+
+- ``data`` axis — row sharding across devices (the executor-count analog);
+- ``model`` axis — optional feature/factor sharding for tables whose second
+  dimension outgrows one chip's HBM (the survey §5 "mesh-sharded linalg"
+  scope; the reference has no equivalent because oneDAL kernels are
+  single-node-memory bound).
+
+A mesh is cheap to build; estimators call :func:`get_mesh` per fit, mirroring
+the reference's per-training-job communicator lifecycle (OneCCL.cpp:60-99).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from oap_mllib_tpu.config import get_config
+
+
+def get_mesh(
+    n_devices: Optional[int] = None,
+    model_parallel: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, model) mesh over available devices.
+
+    ``model_parallel`` splits the device pool into a second axis used to
+    shard feature/factor dimensions; default 1 (pure data parallel, the
+    reference's only mode — survey §2.5).
+    """
+    cfg = get_config()
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % model_parallel != 0:
+        raise ValueError(
+            f"device count {n} not divisible by model_parallel={model_parallel}"
+        )
+    dev_array = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(dev_array, (cfg.data_axis, cfg.model_axis))
+
+
+def data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Rows sharded over the data axis, remaining dims replicated."""
+    cfg = get_config()
+    spec = P(cfg.data_axis, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_rows(x: np.ndarray, multiple: int, fill: float = 0.0):
+    """Pad the leading dim of ``x`` up to a multiple; returns (padded, n_valid).
+
+    XLA requires static shapes, so row counts that don't divide the data-axis
+    size are padded and masked, replacing the reference's variable-length
+    per-rank tables (OneDAL.scala:92-166; survey §2.6 "fixed-shape padded
+    tensor exchange" design note).
+    """
+    n = x.shape[0]
+    pad = (-n) % multiple
+    if pad == 0:
+        return x, n
+    pad_width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad_width, constant_values=fill), n
+
+
+def shard_rows(x: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Pad ``x`` to the data-axis size and place it row-sharded on the mesh.
+
+    This is the data plane: the analog of the reference's
+    ``vectorsToMergedNumericTables`` RDD->native-table conversion
+    (OneDAL.scala:92-166), except the result is a single logically-global
+    jax.Array whose shards live one-per-device.
+    """
+    cfg = get_config()
+    n_data = mesh.shape[cfg.data_axis]
+    padded, _ = pad_rows(np.asarray(x), n_data)
+    return jax.device_put(padded, data_sharding(mesh, padded.ndim))
+
+
+def row_mask(n_valid: int, n_padded: int, dtype=None) -> np.ndarray:
+    """Validity mask for padded rows (True for real rows)."""
+    mask = np.zeros((n_padded,), dtype=bool)
+    mask[:n_valid] = True
+    return mask

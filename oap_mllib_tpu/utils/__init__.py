@@ -1,0 +1,16 @@
+"""Utilities: capability dispatch, per-phase timing, logging."""
+
+from oap_mllib_tpu.utils.dispatch import (
+    accelerator_available,
+    platform_compatible,
+    should_accelerate,
+)
+from oap_mllib_tpu.utils.timing import phase_timer, Timings
+
+__all__ = [
+    "accelerator_available",
+    "platform_compatible",
+    "should_accelerate",
+    "phase_timer",
+    "Timings",
+]

@@ -1,0 +1,78 @@
+"""Capability check + transparent dispatch.
+
+Replaces the reference's L5 runtime dispatch: the cluster-wide platform
+compatibility gate (``Utils.checkClusterPlatformCompatibility`` running
+``daal_check_is_intel_cpu()`` on driver + every executor, reference
+Utils.scala:98-115 / OneDAL.cpp:96-102) and the per-algorithm guards in the
+Spark shims (e.g. euclidean-only + no-weight for K-Means,
+spark-3.1.1/ml/clustering/KMeans.scala:349-351; d<65535 for PCA,
+PCA.scala:103; implicitPrefs for ALS, ALS.scala:925).
+
+Semantics preserved: when the predicate fails and ``config.fallback`` is
+True, the estimator silently runs the CPU/NumPy reference path — user code
+unchanged.  When fallback is disabled, failing the predicate raises.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+from oap_mllib_tpu.config import get_config
+
+log = logging.getLogger("oap_mllib_tpu")
+
+# PCA feature-count guard, mirroring the reference's numFeatures < 65535
+# (spark-3.1.1/ml/feature/PCA.scala:103) — there it is a oneDAL table limit,
+# here it bounds the replicated d x d Gram matrix (65534^2 f64 ~ 34 GB is
+# far past one chip's HBM; realistic ceiling enforced at estimator level).
+MAX_PCA_FEATURES = 65535
+
+
+def accelerator_available() -> bool:
+    """True if a non-CPU XLA backend is present (~ daal_check_is_intel_cpu)."""
+    try:
+        return jax.devices()[0].platform != "cpu"
+    except RuntimeError:
+        return False
+
+
+def platform_compatible() -> bool:
+    """Cluster-wide compatibility: can we run compiled sharded programs?
+
+    Single-process: any JAX backend works (CPU included — the CPU backend is
+    this framework's 1-rank pseudo-cluster, like the reference's local[*]
+    1-rank CCL world, Utils.scala:119-121).  The ``device`` config forces the
+    decision either way.
+    """
+    cfg = get_config()
+    if cfg.device == "cpu":
+        return False
+    if cfg.device == "tpu":
+        return accelerator_available()
+    # auto: accelerated path whenever JAX initializes at all
+    try:
+        jax.devices()
+        return True
+    except RuntimeError:
+        return False
+
+
+def should_accelerate(algo: str, guard_ok: bool, reason: str = "") -> bool:
+    """Decide accelerated vs. fallback path; raise if fallback disabled."""
+    cfg = get_config()
+    ok = platform_compatible() and guard_ok
+    if ok:
+        return True
+    if not guard_ok:
+        why = reason or "guard failed"
+    else:
+        why = "platform incompatible"
+    if not cfg.fallback:
+        raise RuntimeError(
+            f"{algo}: accelerated path unavailable ({why}) and fallback disabled"
+        )
+    log.info("%s: falling back to CPU reference path (%s)", algo, why)
+    return False

@@ -1,0 +1,57 @@
+"""Per-phase wall-time instrumentation.
+
+The reference hand-rolls std::chrono timers around every expensive phase and
+prints to stdout (KMeansDALImpl.cpp:202-222, PCADALImpl.cpp:61-120,
+ALSDALImpl.cpp:337-437, OneCCL.cpp:53-72; survey §5).  Here the same
+observability is one structured registry: ``phase_timer`` context managers
+record named durations into a ``Timings`` object attached to each fitted
+model's training summary, and optionally log when ``config.timing`` is set.
+
+For deep profiles, wrap a fit in ``jax.profiler.trace`` — the XLA/ICI-level
+analog the reference has no equivalent of.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Dict, List
+
+from oap_mllib_tpu.config import get_config
+
+log = logging.getLogger("oap_mllib_tpu")
+
+
+class Timings:
+    """Ordered registry of (phase -> seconds) measurements."""
+
+    def __init__(self) -> None:
+        self._records: List[tuple] = []
+
+    def add(self, phase: str, seconds: float) -> None:
+        self._records.append((phase, seconds))
+        if get_config().timing:
+            log.info("phase %-28s %8.3f s", phase, seconds)
+
+    def as_dict(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for phase, sec in self._records:
+            out[phase] = out.get(phase, 0.0) + sec
+        return out
+
+    def total(self) -> float:
+        return sum(sec for _, sec in self._records)
+
+    def __repr__(self) -> str:
+        parts = ", ".join(f"{p}={s:.3f}s" for p, s in self._records)
+        return f"Timings({parts})"
+
+
+@contextlib.contextmanager
+def phase_timer(timings: Timings, phase: str):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        timings.add(phase, time.perf_counter() - t0)

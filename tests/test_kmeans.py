@@ -1,0 +1,202 @@
+"""K-Means parity + behavior tests.
+
+Modeled on the reference's IntelKMeansSuite (forked Spark estimator suite:
+default params, param validation, fit/transform/summary, persistence) plus
+the survey §4 takeaway: oracle-parity with absTol against independent
+NumPy math, and cost-based (not center-exact) comparison for RNG-sensitive
+init (survey §7.3).
+"""
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu import KMeans, KMeansModel
+from oap_mllib_tpu.config import set_config
+
+
+def _blobs(rng, n=600, d=8, k=4, spread=0.05):
+    """Well-separated gaussian blobs with known centers."""
+    centers = rng.normal(size=(k, d)) * 5.0
+    assign = rng.integers(k, size=n)
+    x = centers[assign] + rng.normal(size=(n, d)) * spread
+    return x, centers, assign
+
+
+def _oracle_lloyd(x, centers, max_iter=50, tol=1e-6):
+    """Independent plain-NumPy Lloyd oracle (test-local, not framework code)."""
+    c = centers.copy()
+    for _ in range(max_iter):
+        d2 = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+        a = d2.argmin(1)
+        newc = np.stack(
+            [x[a == j].mean(0) if np.any(a == j) else c[j] for j in range(len(c))]
+        )
+        if ((newc - c) ** 2).sum(1).max() <= tol * tol:
+            c = newc
+            break
+        c = newc
+    d2 = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+    return c, float(d2.min(1).sum())
+
+
+class TestDefaults:
+    def test_default_params(self):
+        km = KMeans()
+        assert km.k == 2
+        assert km.max_iter == 20
+        assert km.tol == 1e-4
+        assert km.init_mode == "k-means||"
+        assert km.distance_measure == "euclidean"
+
+    def test_param_validation(self):
+        with pytest.raises(ValueError):
+            KMeans(k=0)
+        with pytest.raises(ValueError):
+            KMeans(max_iter=-1)
+        with pytest.raises(ValueError):
+            KMeans(init_mode="bogus")
+        with pytest.raises(ValueError):
+            KMeans(distance_measure="manhattan")
+        with pytest.raises(ValueError):
+            KMeans(init_steps=0)
+
+
+class TestParity:
+    def test_cost_matches_oracle_fixed_init(self, rng):
+        """Same init => same converged centers/cost as the NumPy oracle."""
+        x, true_centers, _ = _blobs(rng)
+        k = 4
+        init = x[rng.choice(len(x), k, replace=False)]
+
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.ops.kmeans_ops import lloyd_run
+
+        xj = jnp.asarray(x, jnp.float32)
+        w = jnp.ones((len(x),), jnp.float32)
+        centers, n_iter, cost = lloyd_run(
+            xj, w, jnp.asarray(init, jnp.float32), 50, jnp.asarray(1e-6, jnp.float32)
+        )
+        oc, ocost = _oracle_lloyd(x, init)
+        # sort both center sets for comparison
+        order = np.lexsort(np.asarray(centers).T)
+        oorder = np.lexsort(oc.T)
+        np.testing.assert_allclose(
+            np.asarray(centers)[order], oc[oorder], atol=1e-3, rtol=1e-3
+        )
+        assert abs(float(cost) - ocost) / max(ocost, 1e-9) < 1e-3
+
+    def test_recovers_blob_centers(self, rng):
+        x, true_centers, _ = _blobs(rng, n=2000, k=4)
+        model = KMeans(k=4, max_iter=50, tol=1e-6, seed=7).fit(x)
+        # every true center should be close to some learned center
+        d = np.linalg.norm(
+            true_centers[:, None, :] - model.cluster_centers_[None, :, :], axis=-1
+        )
+        assert d.min(axis=1).max() < 0.1
+
+    def test_accelerated_vs_fallback_cost_parity(self, rng):
+        """TPU path and fallback path converge to comparable cost."""
+        x, _, _ = _blobs(rng, n=1000, k=3)
+        m_acc = KMeans(k=3, max_iter=50, tol=1e-6, seed=3).fit(x)
+        assert m_acc.summary.accelerated
+        set_config(device="cpu")
+        m_fb = KMeans(k=3, max_iter=50, tol=1e-6, seed=3).fit(x)
+        assert not m_fb.summary.accelerated
+        a, b = m_acc.summary.training_cost, m_fb.summary.training_cost
+        assert abs(a - b) / max(b, 1e-9) < 0.05
+
+
+class TestBehavior:
+    def test_fit_predict_shapes(self, rng):
+        x, _, _ = _blobs(rng)
+        model = KMeans(k=4, seed=1).fit(x)
+        assert model.cluster_centers_.shape == (4, x.shape[1])
+        pred = model.predict(x)
+        assert pred.shape == (len(x),)
+        assert pred.min() >= 0 and pred.max() < 4
+
+    def test_summary(self, rng):
+        x, _, _ = _blobs(rng)
+        model = KMeans(k=4, max_iter=30, seed=1).fit(x)
+        s = model.summary
+        assert s.num_iter >= 1 and s.num_iter <= 30
+        assert s.training_cost >= 0
+        assert s.timings.total() > 0
+
+    def test_predict_consistent_with_centers(self, rng):
+        x, _, _ = _blobs(rng)
+        model = KMeans(k=4, seed=1).fit(x)
+        d2 = ((x[:, None, :] - model.cluster_centers_[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(model.predict(x), d2.argmin(1))
+
+    def test_k_equals_one(self, rng):
+        x, _, _ = _blobs(rng, k=2)
+        model = KMeans(k=1, max_iter=10, seed=0).fit(x)
+        np.testing.assert_allclose(
+            model.cluster_centers_[0], x.mean(0), atol=1e-3, rtol=1e-3
+        )
+
+    def test_max_iter_zero_returns_init(self, rng):
+        x, _, _ = _blobs(rng)
+        model = KMeans(k=3, max_iter=0, init_mode="random", seed=5).fit(x)
+        assert model.cluster_centers_.shape == (3, x.shape[1])
+
+    def test_random_init_mode(self, rng):
+        x, _, _ = _blobs(rng)
+        model = KMeans(k=4, init_mode="random", seed=2, max_iter=50, tol=1e-6).fit(x)
+        assert model.summary.training_cost < KMeans(k=4, max_iter=0, init_mode="random", seed=2).fit(x).summary.training_cost + 1e-6
+
+    def test_weighted_fit(self, rng):
+        """Row weights shift the k=1 center to the weighted mean."""
+        x = np.array([[0.0, 0.0], [10.0, 10.0]])
+        w = np.array([3.0, 1.0])
+        model = KMeans(k=1, max_iter=5, seed=0).fit(x, sample_weight=w)
+        np.testing.assert_allclose(model.cluster_centers_[0], [2.5, 2.5], atol=1e-4)
+
+    def test_cosine_falls_back(self, rng):
+        x, _, _ = _blobs(rng)
+        x = np.abs(x) + 0.1
+        model = KMeans(k=3, distance_measure="cosine", seed=1).fit(x)
+        assert not model.summary.accelerated
+        assert model.cluster_centers_.shape == (3, x.shape[1])
+
+    def test_non2d_raises(self):
+        with pytest.raises(ValueError):
+            KMeans(k=2).fit(np.zeros((5,)))
+
+
+class TestPersistence:
+    def test_save_load_roundtrip(self, tmp_path, rng):
+        x, _, _ = _blobs(rng)
+        model = KMeans(k=4, seed=1).fit(x)
+        p = str(tmp_path / "kmeans_model")
+        model.save(p)
+        loaded = KMeansModel.load(p)
+        np.testing.assert_array_equal(loaded.cluster_centers_, model.cluster_centers_)
+        assert loaded.distance_measure == model.distance_measure
+        np.testing.assert_array_equal(loaded.predict(x), model.predict(x))
+
+
+class TestSharding:
+    def test_uneven_rows_padding(self, rng):
+        """Row counts not divisible by 8 devices are padded and masked out."""
+        for n in (7, 8, 9, 123):
+            x = rng.normal(size=(n, 4))
+            model = KMeans(k=2, max_iter=20, seed=0, init_mode="random").fit(x)
+            # cost must equal direct recomputation on unpadded data
+            d2 = ((x[:, None, :] - model.cluster_centers_[None, :, :]) ** 2).sum(-1)
+            direct = d2.min(1).sum()
+            assert abs(model.summary.training_cost - direct) / max(direct, 1e-9) < 1e-4
+
+
+class TestRegressions:
+    def test_cosine_compute_cost_consistent_with_training(self, rng):
+        """compute_cost must use the model's distance measure (cosine models
+        previously got a squared-euclidean cost)."""
+        x = np.abs(rng.normal(size=(60, 5))) + 0.1
+        m = KMeans(k=3, distance_measure="cosine", seed=1, max_iter=30, tol=1e-6).fit(x)
+        # recomputed cost on training data should match training cost closely
+        assert abs(m.compute_cost(x) - m.summary.training_cost) < 1e-6 + 0.05 * m.summary.training_cost
+        # and must be on the cosine scale (bounded by n since 1-cos <= 2)
+        assert m.compute_cost(x) < 2 * len(x)
