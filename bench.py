@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Headline benchmark: K-Means iterations/second on TPU.
+
+Config follows the BASELINE.md north star (K-Means iters/sec, large dense
+matrix, k=1000) scaled to one chip's HBM: 1M x 256 float32, k=1000,
+row-chunked Lloyd so the (n, k) distance matrix never materializes.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "iters/sec", "vs_baseline": N}
+
+``vs_baseline`` is the speedup over the CPU reference path (the vanilla
+NumPy Lloyd this framework falls back to — the analog of the reference
+project's vanilla Spark MLlib baseline, whose repo publishes no numbers,
+BASELINE.md), measured live on a subsample and scaled linearly to the full
+row count.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from oap_mllib_tpu.ops import kmeans_ops
+
+    n, d, k = 1 << 20, 256, 1000
+    row_chunks = 16
+    iters = 10
+    rng = np.random.default_rng(0)
+    # blob-ish data so assignments are non-degenerate
+    proto = rng.normal(size=(k, d)).astype(np.float32)
+    x = proto[rng.integers(k, size=n)] + rng.normal(size=(n, d)).astype(np.float32) * 0.3
+    w = np.ones((n,), np.float32)
+    init = proto + rng.normal(size=(k, d)).astype(np.float32) * 0.01
+
+    xj = jax.device_put(jnp.asarray(x))
+    wj = jnp.asarray(w)
+    cj = jnp.asarray(init)
+    tol = jnp.asarray(0.0, jnp.float32)  # tol=0: never converge early
+
+    def run(max_iter):
+        c, it, cost = kmeans_ops.lloyd_run(xj, wj, cj, max_iter, tol, row_chunks)
+        # fetch scalars: on remote-execution backends block_until_ready can
+        # be a no-op, so only a host transfer truly synchronizes
+        return np.asarray(c), int(it), float(cost)
+
+    # Warm up the SAME static-arg variant that gets timed: max_iter is a
+    # static jit arg, so run(1) and run(iters) are different compilations.
+    run(iters)
+    t0 = time.perf_counter()
+    _, it, cost = run(iters)
+    dt = time.perf_counter() - t0
+    iters_per_sec = it / dt
+
+    # CPU reference baseline: one Lloyd pass on a subsample, scaled to n.
+    sub = 1 << 14
+    xs, ws = x[:sub], w[:sub]
+    from oap_mllib_tpu.fallback.kmeans_np import lloyd_np
+
+    t0 = time.perf_counter()
+    lloyd_np(xs.astype(np.float64), init.astype(np.float64), 1, 0.0, ws)
+    t_cpu_sub = time.perf_counter() - t0
+    cpu_iters_per_sec = 1.0 / (t_cpu_sub * (n / sub))
+
+    print(
+        json.dumps(
+            {
+                "metric": "kmeans_1Mx256_k1000_iters_per_sec",
+                "value": round(iters_per_sec, 4),
+                "unit": "iters/sec",
+                "vs_baseline": round(iters_per_sec / cpu_iters_per_sec, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -71,13 +71,46 @@ def _accumulate(x, weights, centers):
     return sums, counts, cost
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter",))
+def _accumulate_chunked(x, weights, centers, row_chunks: int):
+    """Chunked assignment pass: bounds the live (chunk, k) distance/one-hot
+    buffers so n*k never materializes in HBM (needed for bench-scale runs
+    like 1M x 256 with k=1000, where (n, k) f32 alone is 4 GB).
+
+    NOTE single-chip only for now: the reshape assumes the leading dim can
+    be freely split, which conflicts with row-sharding over a mesh; the
+    sharded path uses the unchunked accumulate (modest k).
+    """
+    n = x.shape[0]
+    if n % row_chunks != 0:
+        raise ValueError(f"rows {n} not divisible by row_chunks={row_chunks}")
+    cs = n // row_chunks
+    xc = x.reshape(row_chunks, cs, x.shape[1])
+    wc = weights.reshape(row_chunks, cs)
+
+    def step(carry, chunk):
+        sums, counts, cost = carry
+        xi, wi = chunk
+        s, c, t = _accumulate(xi, wi, centers)
+        return (sums + s, counts + c, cost + t), None
+
+    k, d = centers.shape[0], x.shape[1]
+    zero = (
+        jnp.zeros((k, d), x.dtype),
+        jnp.zeros((k,), x.dtype),
+        jnp.asarray(0.0, x.dtype),
+    )
+    (sums, counts, cost), _ = lax.scan(step, zero, (xc, wc))
+    return sums, counts, cost
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "row_chunks"))
 def lloyd_run(
     x: jax.Array,
     weights: jax.Array,
     init_centers: jax.Array,
     max_iter: int,
     tol: jax.Array,
+    row_chunks: int = 1,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full Lloyd optimization: returns (centers, n_iter, cost).
 
@@ -88,13 +121,18 @@ def lloyd_run(
     """
     tol_sq = tol * tol
 
+    def accum(centers):
+        if row_chunks > 1:
+            return _accumulate_chunked(x, weights, centers, row_chunks)
+        return _accumulate(x, weights, centers)
+
     def cond(state):
         _, it, converged, _ = state
         return jnp.logical_and(it < max_iter, jnp.logical_not(converged))
 
     def body(state):
         centers, it, _, _ = state
-        sums, counts, cost = _accumulate(x, weights, centers)
+        sums, counts, cost = accum(centers)
         safe = counts[:, None] > 0
         new_centers = jnp.where(safe, sums / jnp.maximum(counts[:, None], 1e-30), centers)
         moved_sq = jnp.sum((new_centers - centers) ** 2, axis=1)
@@ -110,7 +148,7 @@ def lloyd_run(
     centers, n_iter, _, _ = lax.while_loop(cond, body, init_state)
     # cost w.r.t. final centers (the reference reports the master-step
     # objective for the last completed iteration, KMeansDALImpl.cpp:120-131)
-    _, _, cost = _accumulate(x, weights, centers)
+    _, _, cost = accum(centers)
     return centers, n_iter, cost
 
 

@@ -9,12 +9,19 @@ the suite actually executes 8-way SPMD with real XLA collectives.
 
 import os
 
-# Must be set before jax import. Force CPU even if the session env points at
-# a real accelerator — the suite is the 8-rank pseudo-cluster.
+# Force CPU even if the session env points at a real accelerator — the suite
+# is the 8-rank pseudo-cluster.  Env vars alone are NOT enough: a site hook
+# may pin the platform at interpreter start, so set jax config explicitly
+# (wins as long as no backend has initialized yet).
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -200,3 +200,32 @@ class TestRegressions:
         assert abs(m.compute_cost(x) - m.summary.training_cost) < 1e-6 + 0.05 * m.summary.training_cost
         # and must be on the cosine scale (bounded by n since 1-cos <= 2)
         assert m.compute_cost(x) < 2 * len(x)
+
+    def test_chunked_accumulate_matches_unchunked(self, rng):
+        """row_chunks>1 (the bench kernel path) must match the unchunked
+        accumulate bit-for-bit-ish on identical inputs."""
+        import jax.numpy as jnp
+        from oap_mllib_tpu.ops.kmeans_ops import lloyd_run
+
+        x, _, _ = _blobs(rng, n=640, d=8, k=4)
+        init = x[rng.choice(len(x), 4, replace=False)]
+        xj = jnp.asarray(x, jnp.float32)
+        w = jnp.ones((len(x),), jnp.float32)
+        cj = jnp.asarray(init, jnp.float32)
+        tol = jnp.asarray(1e-6, jnp.float32)
+        c1, i1, cost1 = lloyd_run(xj, w, cj, 20, tol)
+        c2, i2, cost2 = lloyd_run(xj, w, cj, 20, tol, 8)
+        assert int(i1) == int(i2)
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), atol=1e-4, rtol=1e-5)
+        # f32 cost sums reassociate across chunk boundaries -> ~1e-4 rel drift
+        np.testing.assert_allclose(float(cost1), float(cost2), rtol=1e-3)
+
+    def test_chunked_rejects_indivisible_rows(self, rng):
+        import jax.numpy as jnp
+        from oap_mllib_tpu.ops.kmeans_ops import lloyd_run
+
+        x = jnp.asarray(rng.normal(size=(10, 3)), jnp.float32)
+        w = jnp.ones((10,), jnp.float32)
+        c = x[:2]
+        with pytest.raises(ValueError):
+            lloyd_run(x, w, c, 2, jnp.asarray(0.0, jnp.float32), 3)
