@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""PCA example — mirror of the reference's examples/pca
+(PCAExample.scala / pca-pyspark.py): load dense CSV, fit, print principal
+components and explained variance."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    p = argparse.ArgumentParser(description="oap-mllib-tpu PCA example")
+    p.add_argument("--data", default=os.path.join(HERE, "data", "pca_data.csv"))
+    p.add_argument("--k", type=int, default=3)
+    p.add_argument("--device", default=None)
+    p.add_argument("--timing", action="store_true")
+    args = p.parse_args()
+
+    from oap_mllib_tpu import PCA
+    from oap_mllib_tpu.config import set_config
+    from oap_mllib_tpu.data.io import read_csv
+
+    if args.device:
+        set_config(device=args.device)
+    if args.timing:
+        import logging
+
+        logging.basicConfig(level=logging.INFO)
+        set_config(timing=True)
+
+    x = read_csv(args.data)
+    print(f"Loaded {x.shape[0]} rows x {x.shape[1]} features from {args.data}")
+
+    model = PCA(k=args.k).fit(x)
+    print(f"Accelerated path: {model.summary['accelerated']}")
+    print("Principal components (columns):")
+    for row in model.components_:
+        print("  [" + ", ".join(f"{v: .4f}" for v in row) + "]")
+    print("Explained variance ratios:",
+          "[" + ", ".join(f"{v:.6f}" for v in model.explained_variance_) + "]")
+    proj = model.transform(x[:3])
+    print("First 3 projected rows:")
+    for row in proj:
+        print("  [" + ", ".join(f"{v: .4f}" for v in row) + "]")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Run all examples (the reference's examples/run-all-scala.sh /
+# run-all-pyspark.sh analog). Any failure fails the script.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+for ex in kmeans_example.py pca_example.py als_example.py; do
+  echo "=== $ex ==="
+  python "$ex" "$@"
+  echo
+done
+echo "All examples completed."

@@ -14,13 +14,32 @@ fallback and the correctness oracle.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import numpy as np
 
+def _force_py() -> bool:
+    """Env escape hatch to force the pure-Python parsers (tests, debugging).
+    Read per call so it works even when set after import."""
+    return os.environ.get("OAP_MLLIB_TPU_PURE_PYTHON_IO", "").strip().lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
+def _native():
+    if _force_py():
+        return None
+    from oap_mllib_tpu import native
+
+    return native if native.available() else None
+
 
 def read_libsvm(path: str, n_features: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
     """Read a libsvm file into dense (labels, X). 1-based indices."""
+    nat = _native()
+    if nat is not None:
+        return nat.parse_libsvm(path, n_features or 0)
     labels = []
     rows = []
     max_idx = 0
@@ -39,6 +58,10 @@ def read_libsvm(path: str, n_features: Optional[int] = None) -> Tuple[np.ndarray
                 max_idx = max(max_idx, idx)
             rows.append(feats)
     d = n_features if n_features is not None else max_idx
+    if n_features is not None and max_idx > n_features:
+        raise ValueError(
+            f"libsvm feature index {max_idx} exceeds n_features={n_features}"
+        )
     X = np.zeros((len(rows), d), dtype=np.float64)
     for i, feats in enumerate(rows):
         for idx, val in feats.items():
@@ -48,11 +71,17 @@ def read_libsvm(path: str, n_features: Optional[int] = None) -> Tuple[np.ndarray
 
 def read_csv(path: str, delimiter: str = ",") -> np.ndarray:
     """Read a dense numeric CSV (no header) into an (n, d) array."""
+    nat = _native()
+    if nat is not None:
+        return nat.parse_csv(path, delimiter)
     return np.loadtxt(path, delimiter=delimiter, dtype=np.float64, ndmin=2)
 
 
 def read_ratings(path: str, sep: str = "::") -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Read ``user<sep>item<sep>rating`` lines into (users, items, ratings)."""
+    nat = _native()
+    if nat is not None:
+        return nat.parse_ratings(path, sep)
     users, items, ratings = [], [], []
     with open(path) as f:
         for line in f:

@@ -1,0 +1,253 @@
+"""Native runtime bindings (ctypes).
+
+The C++ layer (src/) replaces the reference's native components that are
+not device compute: the table store (~ OneDAL.cpp), file parsers (~ the
+Spark readers / Service.java helpers), bootstrap network probing
+(~ OneCCL.cpp's interface/port scanning), and the ALS shuffle prep
+(~ ALSShuffle.cpp).  Loading mirrors the reference's LibLoader
+(LibLoader.java: extract + System.load at first use): the .so is built
+on demand with `make` the first time it's needed and cached under
+native/build/.  Every entry point has a pure-NumPy fallback, so the
+framework works without a toolchain (the capability-fallback contract).
+
+Use ``available()`` to check, or call the wrappers — they fall back
+silently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("oap_mllib_tpu")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "build", "liboapmllibtpu.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _HERE, "-j4"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_SO_PATH)
+    except (subprocess.SubprocessError, OSError) as e:
+        log.info("native build failed (using NumPy fallbacks): %s", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            log.info("native load failed (using NumPy fallbacks): %s", e)
+            return None
+        # signatures
+        i64, i32, f64p = ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_double)
+        lib.oap_table_create.restype = i64
+        lib.oap_table_create.argtypes = [i64, i64]
+        lib.oap_table_append.restype = i64
+        lib.oap_table_append.argtypes = [i64, f64p, i64]
+        lib.oap_table_merge.restype = i64
+        lib.oap_table_merge.argtypes = [i64, i64]
+        lib.oap_table_rows.restype = i64
+        lib.oap_table_rows.argtypes = [i64]
+        lib.oap_table_cols.restype = i64
+        lib.oap_table_cols.argtypes = [i64]
+        lib.oap_table_copy_out.restype = i64
+        lib.oap_table_copy_out.argtypes = [i64, f64p, i64]
+        lib.oap_table_free.restype = i64
+        lib.oap_table_free.argtypes = [i64]
+        lib.oap_table_count.restype = i64
+        lib.oap_table_count.argtypes = []
+        lib.oap_parse_libsvm.restype = i64
+        lib.oap_parse_libsvm.argtypes = [ctypes.c_char_p, i64, ctypes.POINTER(i64)]
+        lib.oap_parse_csv.restype = i64
+        lib.oap_parse_csv.argtypes = [ctypes.c_char_p, ctypes.c_char]
+        lib.oap_parse_ratings.restype = i64
+        lib.oap_parse_ratings.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.oap_local_ip.restype = ctypes.c_int
+        lib.oap_local_ip.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.oap_free_port.restype = ctypes.c_int
+        lib.oap_free_port.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.oap_shuffle_block_ids.restype = None
+        lib.oap_shuffle_block_ids.argtypes = [
+            ctypes.POINTER(i64), i64, i64, i64, ctypes.POINTER(i32)]
+        lib.oap_shuffle_block_counts.restype = None
+        lib.oap_shuffle_block_counts.argtypes = [
+            ctypes.POINTER(i32), i64, i64, ctypes.POINTER(i64)]
+        lib.oap_shuffle_sort_perm.restype = None
+        lib.oap_shuffle_sort_perm.argtypes = [
+            ctypes.POINTER(i32), ctypes.POINTER(i64), ctypes.POINTER(i64),
+            i64, ctypes.POINTER(i64)]
+        lib.oap_distinct_count.restype = i64
+        lib.oap_distinct_count.argtypes = [ctypes.POINTER(i64), i64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _table_to_numpy(lib, handle: int) -> np.ndarray:
+    rows = lib.oap_table_rows(handle)
+    cols = lib.oap_table_cols(handle)
+    if rows < 0 or cols < 0:
+        raise RuntimeError("invalid native table handle")
+    out = np.empty((rows, cols), dtype=np.float64)
+    got = lib.oap_table_copy_out(
+        handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), rows
+    )
+    if got != rows:
+        raise RuntimeError("native table copy_out failed")
+    return out
+
+
+# -- parsers ----------------------------------------------------------------
+
+def parse_libsvm(path: str, n_features: int = 0) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native libsvm parse; returns (labels, X) or None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    lh = ctypes.c_int64(-1)
+    h = lib.oap_parse_libsvm(path.encode(), n_features, ctypes.byref(lh))
+    if h < 0:
+        raise ValueError(f"native libsvm parse failed: {path}")
+    try:
+        x = _table_to_numpy(lib, h)
+        labels = _table_to_numpy(lib, lh.value)[:, 0]
+    finally:
+        lib.oap_table_free(h)
+        if lh.value >= 0:
+            lib.oap_table_free(lh.value)
+    return labels, x
+
+
+def parse_csv(path: str, delimiter: str = ",") -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    h = lib.oap_parse_csv(path.encode(), delimiter.encode()[:1])
+    if h < 0:
+        raise ValueError(f"native csv parse failed: {path}")
+    try:
+        return _table_to_numpy(lib, h)
+    finally:
+        lib.oap_table_free(h)
+
+
+def parse_ratings(path: str, sep: str = "::") -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    lib = _load()
+    if lib is None:
+        return None
+    h = lib.oap_parse_ratings(path.encode(), sep.encode())
+    if h < 0:
+        raise ValueError(f"native ratings parse failed: {path}")
+    try:
+        t = _table_to_numpy(lib, h)
+    finally:
+        lib.oap_table_free(h)
+    return (
+        t[:, 0].astype(np.int64),
+        t[:, 1].astype(np.int64),
+        t[:, 2].astype(np.float32),
+    )
+
+
+# -- bootstrap probing ------------------------------------------------------
+
+def local_ip() -> Optional[str]:
+    """First non-loopback IPv4 (~ Utils.sparkFirstExecutorIP analog's
+    native side). None if native lib unavailable or no interface."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = ctypes.create_string_buffer(64)
+    if lib.oap_local_ip(buf, 64) != 0:
+        return None
+    return buf.value.decode()
+
+
+def free_port(ip: str = "", start: int = 3000, max_tries: int = 1000) -> Optional[int]:
+    """Scan for a bindable TCP port (~ OneCCL.cpp:207-247)."""
+    lib = _load()
+    if lib is None:
+        return None
+    port = lib.oap_free_port(ip.encode(), start, max_tries)
+    return port if port > 0 else None
+
+
+# -- shuffle prep -----------------------------------------------------------
+
+def shuffle_prep(
+    users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
+    keys_per_block: int, n_blocks: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Bucket + sort ratings by (user block, user, item).
+
+    Returns (users, items, ratings, block_counts, perm) with records
+    reordered block-grouped, per-block counts for the alltoall size
+    exchange, and the permutation applied.  Falls back to NumPy.
+    """
+    if keys_per_block <= 0:
+        raise ValueError(f"keys_per_block must be > 0, got {keys_per_block}")
+    if n_blocks <= 0:
+        raise ValueError(f"n_blocks must be > 0, got {n_blocks}")
+    users = np.ascontiguousarray(users, dtype=np.int64)
+    items = np.ascontiguousarray(items, dtype=np.int64)
+    ratings = np.asarray(ratings)
+    n = len(users)
+    lib = _load()
+    if lib is None:
+        block = np.minimum(users // keys_per_block, n_blocks - 1).astype(np.int32)
+        perm = np.lexsort((items, users, block))
+        counts = np.bincount(block, minlength=n_blocks).astype(np.int64)
+        return users[perm], items[perm], ratings[perm], counts, perm
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    block = np.empty((n,), dtype=np.int32)
+    lib.oap_shuffle_block_ids(
+        users.ctypes.data_as(i64p), n, keys_per_block, n_blocks,
+        block.ctypes.data_as(i32p))
+    counts = np.empty((n_blocks,), dtype=np.int64)
+    lib.oap_shuffle_block_counts(
+        block.ctypes.data_as(i32p), n, n_blocks, counts.ctypes.data_as(i64p))
+    perm = np.empty((n,), dtype=np.int64)
+    lib.oap_shuffle_sort_perm(
+        block.ctypes.data_as(i32p), users.ctypes.data_as(i64p),
+        items.ctypes.data_as(i64p), n, perm.ctypes.data_as(i64p))
+    return users[perm], items[perm], ratings[perm], counts, perm
+
+
+def distinct_count(sorted_keys: np.ndarray) -> int:
+    sorted_keys = np.ascontiguousarray(sorted_keys, dtype=np.int64)
+    lib = _load()
+    if lib is None:
+        if len(sorted_keys) == 0:
+            return 0
+        return int(1 + np.count_nonzero(np.diff(sorted_keys)))
+    return int(lib.oap_distinct_count(
+        sorted_keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(sorted_keys)))

@@ -1,0 +1,211 @@
+// Fast file parsers: libsvm, dense CSV, and "user::item::rating" files.
+//
+// Native data-loader layer: the reference reads example data through Spark
+// (libsvm via spark.read.format, CSV, MovieLens-style ratings parsed in
+// examples/als/.../ALSExample.scala); its Java-side debug readers live in
+// Service.java.  Here parsing is C++ for throughput and the result lands
+// in the table store (table_store.cpp) for zero-copy numpy views.
+//
+// All parsers return a table handle (dense row-major doubles) or -1.
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+int64_t oap_table_create(int64_t capacity_rows, int64_t cols);
+int64_t oap_table_append(int64_t handle, const double* batch, int64_t n_rows);
+int64_t oap_table_free(int64_t handle);
+}
+
+namespace {
+
+// Read a whole file into a string; returns false on error.
+bool slurp(const char* path, std::string* out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  if (sz < 0) {
+    fclose(f);
+    return false;
+  }
+  fseek(f, 0, SEEK_SET);
+  out->resize(static_cast<size_t>(sz));
+  size_t rd = sz ? fread(&(*out)[0], 1, static_cast<size_t>(sz), f) : 0;
+  fclose(f);
+  return rd == static_cast<size_t>(sz);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse libsvm ("label idx:val ..." with 1-based indices) into a dense
+// table of n_features columns (0 => auto-detect max index).
+// Labels are returned in a separate 1-column table via *labels_handle.
+int64_t oap_parse_libsvm(const char* path, int64_t n_features,
+                         int64_t* labels_handle) {
+  std::string buf;
+  if (!slurp(path, &buf)) return -1;
+
+  struct Row {
+    double label;
+    std::vector<std::pair<int64_t, double>> feats;
+  };
+  std::vector<Row> rows;
+  int64_t max_idx = 0;
+
+  const char* p = buf.c_str();
+  const char* end = p + buf.size();
+  while (p < end) {
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    if (*p == '#') {  // comment line
+      while (p < end && *p != '\n') ++p;
+      continue;
+    }
+    Row row;
+    char* next = nullptr;
+    row.label = strtod(p, &next);
+    if (next == p) {  // blank/garbage line
+      while (p < end && *p != '\n') ++p;
+      continue;
+    }
+    p = next;
+    while (p < end && *p != '\n') {
+      while (p < end && (*p == ' ' || *p == '\t')) ++p;
+      if (p >= end || *p == '\n' || *p == '\r' || *p == '#') break;
+      int64_t idx = strtoll(p, &next, 10);
+      if (next == p || *next != ':') return -1;  // malformed token
+      p = next + 1;
+      double val = strtod(p, &next);
+      if (next == p) return -1;
+      p = next;
+      row.feats.emplace_back(idx, val);
+      if (idx > max_idx) max_idx = idx;
+    }
+    rows.push_back(std::move(row));
+  }
+
+  int64_t d = n_features > 0 ? n_features : max_idx;
+  if (d <= 0) return -1;
+  // explicit n_features with an out-of-range index is an error, not a
+  // silent truncation (keeps native and Python paths equivalent)
+  if (n_features > 0 && max_idx > n_features) return -1;
+  int64_t h = oap_table_create(static_cast<int64_t>(rows.size()), d);
+  int64_t lh = oap_table_create(static_cast<int64_t>(rows.size()), 1);
+  if (h < 0 || lh < 0) {
+    if (h >= 0) oap_table_free(h);
+    if (lh >= 0) oap_table_free(lh);
+    return -1;
+  }
+  std::vector<double> dense(static_cast<size_t>(d));
+  for (const Row& row : rows) {
+    std::fill(dense.begin(), dense.end(), 0.0);
+    for (auto& kv : row.feats) {
+      if (kv.first >= 1 && kv.first <= d) dense[kv.first - 1] = kv.second;
+    }
+    oap_table_append(h, dense.data(), 1);
+    oap_table_append(lh, &row.label, 1);
+  }
+  if (labels_handle) *labels_handle = lh;
+  else oap_table_free(lh);
+  return h;
+}
+
+// Parse dense numeric CSV (no header). Returns table handle or -1.
+int64_t oap_parse_csv(const char* path, char delimiter) {
+  std::string buf;
+  if (!slurp(path, &buf)) return -1;
+  const char* p = buf.c_str();
+  const char* end = p + buf.size();
+
+  int64_t h = -1, cols = 0;
+  std::vector<double> row;
+  while (p < end) {
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    row.clear();
+    while (p < end && *p != '\n' && *p != '\r') {
+      char* next = nullptr;
+      double v = strtod(p, &next);
+      if (next == p) {  // non-numeric cell
+        if (h >= 0) oap_table_free(h);
+        return -1;
+      }
+      row.push_back(v);
+      p = next;
+      while (p < end && (*p == ' ' || *p == '\t')) ++p;
+      // strict: after a value only the delimiter or end-of-line may follow
+      // (matches the np.loadtxt fallback, which rejects stray separators)
+      if (p < end && *p == delimiter) {
+        ++p;
+      } else if (p < end && *p != '\n' && *p != '\r') {
+        if (h >= 0) oap_table_free(h);
+        return -1;
+      }
+    }
+    if (row.empty()) continue;
+    if (h < 0) {
+      cols = static_cast<int64_t>(row.size());
+      h = oap_table_create(64, cols);
+      if (h < 0) return -1;
+    } else if (static_cast<int64_t>(row.size()) != cols) {
+      oap_table_free(h);
+      return -1;  // ragged rows
+    }
+    oap_table_append(h, row.data(), 1);
+  }
+  return h;
+}
+
+// Parse "user<sep>item<sep>rating" lines (sep = "::" or any single char
+// string). Returns a 3-column table (user, item, rating) or -1.
+int64_t oap_parse_ratings(const char* path, const char* sep) {
+  std::string buf;
+  if (!slurp(path, &buf)) return -1;
+  size_t seplen = strlen(sep);
+  if (seplen == 0) return -1;
+  const char* p = buf.c_str();
+  const char* end = p + buf.size();
+  int64_t h = oap_table_create(64, 3);
+  if (h < 0) return -1;
+
+  while (p < end) {
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    char* next = nullptr;
+    double vals[3];
+    bool ok = true;
+    for (int k = 0; k < 3; ++k) {
+      vals[k] = strtod(p, &next);
+      if (next == p) {
+        ok = false;
+        break;
+      }
+      p = next;
+      if (k < 2) {
+        if (p + seplen <= end && strncmp(p, sep, seplen) == 0) {
+          p += seplen;
+        } else {
+          ok = false;
+          break;
+        }
+      }
+    }
+    if (!ok) {
+      oap_table_free(h);
+      return -1;
+    }
+    oap_table_append(h, vals, 1);
+    while (p < end && *p != '\n') ++p;
+  }
+  return h;
+}
+
+}  // extern "C"

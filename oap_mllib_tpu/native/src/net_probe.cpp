@@ -1,0 +1,67 @@
+// Multi-host bootstrap helpers: local IP discovery + free-port probing.
+//
+// TPU-native analog of the reference's oneCCL KVS rendezvous plumbing
+// (mllib-dal/src/main/native/OneCCL.cpp): fill_local_host_ip enumerates
+// non-loopback interfaces via getifaddrs (:141-200), and the free-port
+// scanner binds successive ports starting at 3000 (:207-247).  Here the
+// discovered ip:port seeds jax.distributed.initialize (the KVS analog,
+// survey §2.6) instead of a oneCCL KVS.
+
+#include <arpa/inet.h>
+#include <cstdint>
+#include <cstring>
+#include <ifaddrs.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+extern "C" {
+
+// First non-loopback IPv4 address, written as dotted quad into out
+// (at least 16 bytes). Returns 0 on success, -1 if none found.
+// (~ fill_local_host_ip, OneCCL.cpp:141-200 — which likewise excludes "lo")
+int oap_local_ip(char* out, int out_len) {
+  if (!out || out_len < INET_ADDRSTRLEN) return -1;
+  struct ifaddrs* ifaddr = nullptr;
+  if (getifaddrs(&ifaddr) != 0) return -1;
+  int rc = -1;
+  for (struct ifaddrs* ifa = ifaddr; ifa; ifa = ifa->ifa_next) {
+    if (!ifa->ifa_addr || ifa->ifa_addr->sa_family != AF_INET) continue;
+    if (strcmp(ifa->ifa_name, "lo") == 0) continue;
+    auto* sin = reinterpret_cast<struct sockaddr_in*>(ifa->ifa_addr);
+    if (inet_ntop(AF_INET, &sin->sin_addr, out, out_len)) {
+      rc = 0;
+      break;
+    }
+  }
+  freeifaddrs(ifaddr);
+  return rc;
+}
+
+// Scan for a bindable TCP port on `ip` starting at `start_port`
+// (reference starts at 3000, OneCCL.cpp:213). Returns the port or -1.
+int oap_free_port(const char* ip, int start_port, int max_tries) {
+  if (start_port <= 0 || start_port > 65535) return -1;
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  if (!ip || !*ip) {
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  } else if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) {
+    return -1;
+  }
+  for (int port = start_port;
+       port <= 65535 && port < start_port + max_tries; ++port) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    int rc = bind(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr));
+    close(fd);
+    if (rc == 0) return port;
+  }
+  return -1;
+}
+
+}  // extern "C"

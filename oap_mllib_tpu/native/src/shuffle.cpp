@@ -1,0 +1,69 @@
+// Host-side ratings shuffle prep: block bucketing, sort, distinct counts.
+//
+// TPU-native analog of the reference's native ALS shuffle
+// (mllib-dal/src/main/native/ALSShuffle.cpp): there, each rank buckets its
+// packed Rating{i64 user, i64 item, f32 rating} records by user block
+// (getPartiton, :30-35), exchanges them via oneCCL alltoall/alltoallv
+// (:92-109), sorts by (user, item) (:111) and counts distinct users for
+// the CSR row count (:50-60).
+//
+// On TPU the exchange itself is an XLA all_to_all of padded fixed-shape
+// tensors compiled into the program (parallel/shuffle.py); what stays on
+// the host is the O(nnz log nnz) bucket/sort/count prep, which this file
+// does in C++ for throughput.  Records are struct-of-arrays (three parallel
+// arrays) rather than the reference's packed 20-byte struct — SoA is what
+// both numpy and the device runtime want.
+
+#include <algorithm>
+#include <cstdint>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// Assign each record to a block: min(key / keys_per_block, n_blocks-1).
+// (~ getPartiton, ALSShuffle.cpp:30-35)
+void oap_shuffle_block_ids(const int64_t* keys, int64_t n, int64_t keys_per_block,
+                           int64_t n_blocks, int32_t* out_block_ids) {
+  if (keys_per_block <= 0 || n_blocks <= 0) {  // avoid SIGFPE; caller validates too
+    for (int64_t i = 0; i < n; ++i) out_block_ids[i] = 0;
+    return;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t b = keys[i] / keys_per_block;
+    out_block_ids[i] = static_cast<int32_t>(b < n_blocks - 1 ? b : n_blocks - 1);
+  }
+}
+
+// Counts per block (for the alltoall size exchange).
+void oap_shuffle_block_counts(const int32_t* block_ids, int64_t n,
+                              int64_t n_blocks, int64_t* out_counts) {
+  std::fill(out_counts, out_counts + n_blocks, 0);
+  for (int64_t i = 0; i < n; ++i) ++out_counts[block_ids[i]];
+}
+
+// Sort records by (block, user, item): writes a permutation into out_perm
+// such that records[out_perm] is block-grouped and (user, item)-sorted
+// within each block. (~ the sort at ALSShuffle.cpp:111)
+void oap_shuffle_sort_perm(const int32_t* block_ids, const int64_t* users,
+                           const int64_t* items, int64_t n, int64_t* out_perm) {
+  std::iota(out_perm, out_perm + n, 0);
+  std::stable_sort(out_perm, out_perm + n, [&](int64_t a, int64_t b) {
+    if (block_ids[a] != block_ids[b]) return block_ids[a] < block_ids[b];
+    if (users[a] != users[b]) return users[a] < users[b];
+    return items[a] < items[b];
+  });
+}
+
+// Distinct consecutive keys in a sorted array — the CSR row count.
+// (~ distinct_count, ALSShuffle.cpp:50-60)
+int64_t oap_distinct_count(const int64_t* sorted_keys, int64_t n) {
+  if (n == 0) return 0;
+  int64_t count = 1;
+  for (int64_t i = 1; i < n; ++i) {
+    if (sorted_keys[i] != sorted_keys[i - 1]) ++count;
+  }
+  return count;
+}
+
+}  // extern "C"

@@ -1,0 +1,39 @@
+"""Data IO tests: native and pure-Python parsers agree on all formats."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DATA = os.path.join(HERE, "..", "examples", "data")
+
+
+def test_readers_native_python_equivalence(monkeypatch):
+    """read_* must give identical results with and without the native lib."""
+    from oap_mllib_tpu.data import io as io_mod
+
+    l1, x1 = io_mod.read_libsvm(os.path.join(DATA, "sample_kmeans_data.txt"))
+    c1 = io_mod.read_csv(os.path.join(DATA, "pca_data.csv"))
+    u1, i1, r1 = io_mod.read_ratings(os.path.join(DATA, "sample_als_ratings.txt"))
+
+    # run the pure-python variants via the env escape hatch (read per call)
+    monkeypatch.setenv("OAP_MLLIB_TPU_PURE_PYTHON_IO", "1")
+    l2, x2 = io_mod.read_libsvm(os.path.join(DATA, "sample_kmeans_data.txt"))
+    c2 = io_mod.read_csv(os.path.join(DATA, "pca_data.csv"))
+    u2, i2, r2 = io_mod.read_ratings(os.path.join(DATA, "sample_als_ratings.txt"))
+
+    np.testing.assert_array_equal(x1, x2)
+    np.testing.assert_array_equal(l1, l2)
+    np.testing.assert_array_equal(c1, c2)
+    np.testing.assert_array_equal(u1, u2)
+    np.testing.assert_array_equal(i1, i2)
+    np.testing.assert_array_equal(r1, r2)
+
+
+def test_libsvm_n_features_override():
+    from oap_mllib_tpu.data import io as io_mod
+
+    _, x = io_mod.read_libsvm(os.path.join(DATA, "sample_kmeans_data.txt"), n_features=7)
+    assert x.shape[1] == 7

@@ -1,0 +1,207 @@
+"""Native runtime layer tests: parsers vs Python oracles, table store
+lifecycle, bootstrap probing, shuffle prep.
+
+The reference had NO native unit tests (survey §4 "fixtures/mocks: none") —
+this suite adds the coverage the survey takeaway calls for.  Tests skip if
+no C++ toolchain is present (the NumPy fallbacks are covered either way via
+the OAP_MLLIB_TPU_PURE_PYTHON_IO path in test_io.py).
+"""
+
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no toolchain)"
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DATA = os.path.join(HERE, "..", "examples", "data")
+
+
+class TestParsers:
+    def test_libsvm_matches_python(self):
+        from oap_mllib_tpu.data import io as io_mod
+
+        path = os.path.join(DATA, "sample_kmeans_data.txt")
+        nl, nx = native.parse_libsvm(path)
+        # python oracle: bypass native
+        labels, x = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                labels.append(float(parts[0]))
+                row = {}
+                for tok in parts[1:]:
+                    k, v = tok.split(":")
+                    row[int(k)] = float(v)
+                x.append(row)
+        d = max(max(r) for r in x)
+        px = np.zeros((len(x), d))
+        for i, r in enumerate(x):
+            for k, v in r.items():
+                px[i, k - 1] = v
+        np.testing.assert_array_equal(nx, px)
+        np.testing.assert_array_equal(nl, labels)
+
+    def test_csv_matches_numpy(self):
+        path = os.path.join(DATA, "pca_data.csv")
+        nx = native.parse_csv(path)
+        px = np.loadtxt(path, delimiter=",", ndmin=2)
+        np.testing.assert_allclose(nx, px, atol=0)
+
+    def test_ratings_matches_python(self):
+        path = os.path.join(DATA, "sample_als_ratings.txt")
+        nu, ni, nr = native.parse_ratings(path)
+        pu, pi, pr = [], [], []
+        with open(path) as f:
+            for line in f:
+                a, b, c = line.strip().split("::")
+                pu.append(int(a)); pi.append(int(b)); pr.append(float(c))
+        np.testing.assert_array_equal(nu, pu)
+        np.testing.assert_array_equal(ni, pi)
+        np.testing.assert_array_equal(nr, np.asarray(pr, np.float32))
+
+    def test_malformed_libsvm_raises(self, tmp_path):
+        p = tmp_path / "bad.txt"
+        p.write_text("1.0 not_a_token\n")
+        with pytest.raises(ValueError):
+            native.parse_libsvm(str(p))
+
+    def test_ragged_csv_raises(self, tmp_path):
+        p = tmp_path / "bad.csv"
+        p.write_text("1,2,3\n4,5\n")
+        with pytest.raises(ValueError):
+            native.parse_csv(str(p))
+
+    def test_missing_file_raises(self):
+        with pytest.raises(ValueError):
+            native.parse_csv("/nonexistent/file.csv")
+
+
+class TestTableStore:
+    def test_create_append_copyout_free(self):
+        lib = native._load()
+        before = lib.oap_table_count()
+        h = lib.oap_table_create(2, 3)
+        assert h > 0
+        batch = np.arange(6, dtype=np.float64)
+        p = batch.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        assert lib.oap_table_append(h, p, 2) == 2
+        # growth past capacity
+        assert lib.oap_table_append(h, p, 2) == 4
+        assert lib.oap_table_rows(h) == 4
+        assert lib.oap_table_cols(h) == 3
+        out = np.empty((4, 3))
+        got = lib.oap_table_copy_out(
+            h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 4)
+        assert got == 4
+        np.testing.assert_array_equal(out[:2].ravel(), batch)
+        np.testing.assert_array_equal(out[2:].ravel(), batch)
+        assert lib.oap_table_free(h) == 0
+        assert lib.oap_table_count() == before  # no leak
+
+    def test_merge(self):
+        lib = native._load()
+        a = lib.oap_table_create(1, 2)
+        b = lib.oap_table_create(1, 2)
+        r1 = np.array([1.0, 2.0])
+        r2 = np.array([3.0, 4.0])
+        f64p = ctypes.POINTER(ctypes.c_double)
+        lib.oap_table_append(a, r1.ctypes.data_as(f64p), 1)
+        lib.oap_table_append(b, r2.ctypes.data_as(f64p), 1)
+        assert lib.oap_table_merge(a, b) == 2
+        out = np.empty((2, 2))
+        lib.oap_table_copy_out(a, out.ctypes.data_as(f64p), 2)
+        np.testing.assert_array_equal(out, [[1, 2], [3, 4]])
+        lib.oap_table_free(a)
+        # src was consumed
+        assert lib.oap_table_rows(b) == -1
+
+    def test_bad_handle(self):
+        lib = native._load()
+        assert lib.oap_table_rows(999999) == -1
+        assert lib.oap_table_free(999999) == -1
+
+
+class TestNetProbe:
+    def test_local_ip_format(self):
+        ip = native.local_ip()
+        if ip is None:
+            pytest.skip("no non-loopback interface")
+        parts = ip.split(".")
+        assert len(parts) == 4 and all(0 <= int(p) <= 255 for p in parts)
+        assert not ip.startswith("127.")
+
+    def test_free_port_bindable(self):
+        import socket
+
+        port = native.free_port(start=39000)
+        assert port is not None and 39000 <= port <= 65535
+        s = socket.socket()
+        s.bind(("", port))  # should succeed right after probe
+        s.close()
+
+
+class TestShuffle:
+    def test_prep_matches_numpy(self, rng):
+        n = 500
+        u = rng.integers(0, 40, n)
+        i = rng.integers(0, 30, n)
+        r = rng.random(n).astype(np.float32)
+        us, it, rs, counts, perm = native.shuffle_prep(u, i, r, 10, 4)
+        block = np.minimum(u // 10, 3)
+        pperm = np.lexsort((i, u, block))
+        np.testing.assert_array_equal(us, u[pperm])
+        np.testing.assert_array_equal(it, i[pperm])
+        np.testing.assert_array_equal(counts, np.bincount(block, minlength=4))
+        assert counts.sum() == n
+
+    def test_distinct_count(self):
+        assert native.distinct_count(np.array([1, 1, 2, 5, 5, 9])) == 4
+        assert native.distinct_count(np.array([], dtype=np.int64)) == 0
+
+
+class TestReviewRegressions:
+    def test_shuffle_zero_block_size_raises(self, rng):
+        with pytest.raises(ValueError):
+            native.shuffle_prep(
+                np.array([1]), np.array([1]), np.array([1.0]), 0, 4)
+        with pytest.raises(ValueError):
+            native.shuffle_prep(
+                np.array([1]), np.array([1]), np.array([1.0]), 10, 0)
+
+    def test_csv_bad_cell_no_leak(self, tmp_path):
+        lib = native._load()
+        before = lib.oap_table_count()
+        p = tmp_path / "bad2.csv"
+        p.write_text("1,2\n3,x\n")
+        with pytest.raises(ValueError):
+            native.parse_csv(str(p))
+        assert lib.oap_table_count() == before
+
+    def test_csv_wrong_delimiter_rejected(self, tmp_path):
+        p = tmp_path / "ws.csv"
+        p.write_text("1.0 2.0\n")
+        with pytest.raises(ValueError):
+            native.parse_csv(str(p), ",")
+
+    def test_libsvm_index_beyond_n_features_errors_both_paths(self, tmp_path, monkeypatch):
+        p = tmp_path / "over.txt"
+        p.write_text("1.0 1:1.0 3:1.5\n")
+        with pytest.raises(ValueError):
+            native.parse_libsvm(str(p), 2)
+        from oap_mllib_tpu.data import io as io_mod
+        monkeypatch.setenv("OAP_MLLIB_TPU_PURE_PYTHON_IO", "1")
+        with pytest.raises(ValueError):
+            io_mod.read_libsvm(str(p), n_features=2)
+
+    def test_merge_self_rejected(self):
+        lib = native._load()
+        h = lib.oap_table_create(1, 2)
+        assert lib.oap_table_merge(h, h) == -1
+        lib.oap_table_free(h)
