@@ -46,8 +46,14 @@ def main():
     cj = jnp.asarray(init)
     tol = jnp.asarray(0.0, jnp.float32)  # tol=0: never converge early
 
+    from oap_mllib_tpu.config import get_config
+
+    precision = get_config().matmul_precision  # env-overridable via config
+
     def run(max_iter):
-        c, it, cost = kmeans_ops.lloyd_run(xj, wj, cj, max_iter, tol, row_chunks)
+        c, it, cost = kmeans_ops.lloyd_run(
+            xj, wj, cj, max_iter, tol, row_chunks, precision
+        )
         # fetch scalars: on remote-execution backends block_until_ready can
         # be a no-op, so only a host transfer truly synchronizes
         return np.asarray(c), int(it), float(cost)

@@ -63,6 +63,10 @@ class Config:
     fallback: bool = True
     timing: bool = False
     seed: int = 0
+    # "highest" = full f32 on the MXU (multi-pass) — required for the 1e-4
+    # numerical-parity contract.  "default" = bf16 inputs, ~1.8x faster
+    # K-Means iterations on v5e; opt-in for throughput-first workloads.
+    matmul_precision: str = "highest"
 
     @classmethod
     def from_env(cls) -> "Config":

@@ -183,6 +183,7 @@ class KMeans:
                 jnp.asarray(centers0),
                 self.max_iter,
                 jnp.asarray(self.tol, dtype),
+                precision=cfg.matmul_precision,
             )
             centers = np.asarray(centers)
             n_iter = int(n_iter)

@@ -76,6 +76,8 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.oap_table_cols.argtypes = [i64]
         lib.oap_table_copy_out.restype = i64
         lib.oap_table_copy_out.argtypes = [i64, f64p, i64]
+        lib.oap_table_data.restype = f64p
+        lib.oap_table_data.argtypes = [i64]
         lib.oap_table_free.restype = i64
         lib.oap_table_free.argtypes = [i64]
         lib.oap_table_count.restype = i64
@@ -108,6 +110,22 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+def table_view(handle: int) -> np.ndarray:
+    """Zero-copy numpy view of a live native table (no copy; the caller
+    must keep the table alive and not free it while the view exists).
+    This is the handoff point to the device runtime: jnp.asarray /
+    jax.device_put consume the view directly."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    rows = lib.oap_table_rows(handle)
+    cols = lib.oap_table_cols(handle)
+    ptr = lib.oap_table_data(handle)
+    if rows < 0 or cols < 0 or not ptr:
+        raise RuntimeError("invalid native table handle")
+    return np.ctypeslib.as_array(ptr, shape=(rows, cols))
 
 
 def _table_to_numpy(lib, handle: int) -> np.ndarray:

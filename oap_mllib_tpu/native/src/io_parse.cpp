@@ -130,6 +130,10 @@ int64_t oap_parse_csv(const char* path, char delimiter) {
   while (p < end) {
     while (p < end && (*p == '\n' || *p == '\r')) ++p;
     if (p >= end) break;
+    if (*p == '#') {  // comment line (np.loadtxt-compatible)
+      while (p < end && *p != '\n') ++p;
+      continue;
+    }
     row.clear();
     while (p < end && *p != '\n' && *p != '\r') {
       char* next = nullptr;
@@ -183,10 +187,21 @@ int64_t oap_parse_ratings(const char* path, const char* sep) {
     double vals[3];
     bool ok = true;
     for (int k = 0; k < 3; ++k) {
-      vals[k] = strtod(p, &next);
-      if (next == p) {
-        ok = false;
-        break;
+      if (k < 2) {
+        // ids are strict integers (the Python path uses int()); strtod
+        // would silently truncate "1.5" -> 1
+        int64_t id = strtoll(p, &next, 10);
+        if (next == p) {
+          ok = false;
+          break;
+        }
+        vals[k] = static_cast<double>(id);
+      } else {
+        vals[k] = strtod(p, &next);
+        if (next == p) {
+          ok = false;
+          break;
+        }
       }
       p = next;
       if (k < 2) {
@@ -198,6 +213,9 @@ int64_t oap_parse_ratings(const char* path, const char* sep) {
         }
       }
     }
+    // nothing but whitespace may follow the rating on the line
+    while (ok && p < end && (*p == ' ' || *p == '\t')) ++p;
+    if (ok && p < end && *p != '\n' && *p != '\r') ok = false;
     if (!ok) {
       oap_table_free(h);
       return -1;

@@ -37,14 +37,29 @@ import numpy as np
 from jax import lax
 
 
-def pairwise_sq_dists(x: jax.Array, centers: jax.Array) -> jax.Array:
+def _prec(precision: str):
+    """Map config's matmul_precision to a lax.Precision.
+
+    "highest" (default) keeps full f32 on the MXU via multi-pass
+    accumulation — required for the 1e-4 parity contract (survey §7.3
+    determinism note); "default" allows bf16 inputs (~1.8x faster).
+    Unknown values raise — a typo must not silently degrade to bf16."""
+    if precision == "highest":
+        return lax.Precision.HIGHEST
+    if precision == "default":
+        return lax.Precision.DEFAULT
+    raise ValueError(
+        f"matmul_precision must be 'highest' or 'default', got {precision!r}"
+    )
+
+
+def pairwise_sq_dists(
+    x: jax.Array, centers: jax.Array, precision: str = "highest"
+) -> jax.Array:
     """(n, k) squared euclidean distances via the MXU-friendly identity."""
     x_sq = jnp.sum(x * x, axis=1, keepdims=True)  # (n, 1)
     c_sq = jnp.sum(centers * centers, axis=1)  # (k,)
-    # precision=HIGHEST: TPU matmuls default to bf16 inputs, which breaks
-    # the 1e-4 parity contract (survey §7.3 determinism note); HIGHEST keeps
-    # full f32 on the MXU via multi-pass accumulation.
-    cross = jnp.matmul(x, centers.T, precision=lax.Precision.HIGHEST)  # (n, k)  <- MXU
+    cross = jnp.matmul(x, centers.T, precision=_prec(precision))  # (n, k)  <- MXU
     d2 = x_sq + c_sq[None, :] - 2.0 * cross
     return jnp.maximum(d2, 0.0)
 
@@ -54,24 +69,24 @@ def assign_clusters(x: jax.Array, centers: jax.Array) -> jax.Array:
     return jnp.argmin(pairwise_sq_dists(x, centers), axis=1)
 
 
-def _accumulate(x, weights, centers):
+def _accumulate(x, weights, centers, precision: str = "highest"):
     """One assignment pass: per-cluster weighted sums, counts, and cost.
 
     Returns (sums (k,d), counts (k,), cost scalar).  All reductions are
     global over the row-sharded inputs — GSPMD inserts the psum.
     """
     k = centers.shape[0]
-    d2 = pairwise_sq_dists(x, centers)  # (n, k)
+    d2 = pairwise_sq_dists(x, centers, precision)  # (n, k)
     assign = jnp.argmin(d2, axis=1)  # (n,)
     min_d2 = jnp.min(d2, axis=1)  # (n,)
     one_hot = jax.nn.one_hot(assign, k, dtype=x.dtype) * weights[:, None]  # (n, k)
-    sums = jnp.matmul(one_hot.T, x, precision=lax.Precision.HIGHEST)  # (k, d)  <- MXU
+    sums = jnp.matmul(one_hot.T, x, precision=_prec(precision))  # (k, d)  <- MXU
     counts = jnp.sum(one_hot, axis=0)  # (k,)
     cost = jnp.sum(min_d2 * weights)
     return sums, counts, cost
 
 
-def _accumulate_chunked(x, weights, centers, row_chunks: int):
+def _accumulate_chunked(x, weights, centers, row_chunks: int, precision: str = "highest"):
     """Chunked assignment pass: bounds the live (chunk, k) distance/one-hot
     buffers so n*k never materializes in HBM (needed for bench-scale runs
     like 1M x 256 with k=1000, where (n, k) f32 alone is 4 GB).
@@ -90,7 +105,7 @@ def _accumulate_chunked(x, weights, centers, row_chunks: int):
     def step(carry, chunk):
         sums, counts, cost = carry
         xi, wi = chunk
-        s, c, t = _accumulate(xi, wi, centers)
+        s, c, t = _accumulate(xi, wi, centers, precision)
         return (sums + s, counts + c, cost + t), None
 
     k, d = centers.shape[0], x.shape[1]
@@ -103,7 +118,7 @@ def _accumulate_chunked(x, weights, centers, row_chunks: int):
     return sums, counts, cost
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "row_chunks"))
+@functools.partial(jax.jit, static_argnames=("max_iter", "row_chunks", "precision"))
 def lloyd_run(
     x: jax.Array,
     weights: jax.Array,
@@ -111,6 +126,7 @@ def lloyd_run(
     max_iter: int,
     tol: jax.Array,
     row_chunks: int = 1,
+    precision: str = "highest",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full Lloyd optimization: returns (centers, n_iter, cost).
 
@@ -123,8 +139,8 @@ def lloyd_run(
 
     def accum(centers):
         if row_chunks > 1:
-            return _accumulate_chunked(x, weights, centers, row_chunks)
-        return _accumulate(x, weights, centers)
+            return _accumulate_chunked(x, weights, centers, row_chunks, precision)
+        return _accumulate(x, weights, centers, precision)
 
     def cond(state):
         _, it, converged, _ = state

@@ -1,0 +1,181 @@
+"""Fused Lloyd accumulate: distance + argmin + cluster sums in one kernel.
+
+The XLA path (ops/kmeans_ops._accumulate) materializes the (n, k) distance
+matrix and an (n, k) one-hot in HBM each iteration — 2*n*k*4 bytes of
+traffic on top of reading X.  This kernel streams X once per iteration:
+for each row block, it computes the (bn, k) distances in VMEM, reduces
+min/argmin on the VPU, forms the block one-hot in VMEM, and accumulates
+``one_hot.T @ x`` into the (k, d) sums output, exploiting the TPU grid's
+sequential execution for safe read-modify-write accumulation (the pallas
+accumulate pattern).  HBM traffic per iteration drops from
+O(n*d + 2*n*k) to O(n*d + k*d).
+
+Caller contract (see ``lloyd_accumulate_pallas``): rows padded to the block
+size with weight 0; k and d padded to lane multiples (128) by the wrapper —
+dummy centers get +inf-like coordinates so no row ever selects them.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import numpy as np
+
+_BLOCK_ROWS = 512
+_LANE = 128
+
+
+def _kernel(x_ref, w_ref, c_ref, sums_ref, counts_ref, cost_ref):
+    """One grid step: process a (bn, d) row block against all k centers."""
+    # zero accumulators on the first block (sequential TPU grid)
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        cost_ref[0, 0] = jnp.float32(0.0)
+
+    x = x_ref[:]  # (bn, d)
+    w = w_ref[:]  # (bn, 1)
+    c = c_ref[:]  # (k, d)
+
+    # squared distances via the matmul identity (MXU)
+    x_sq = jnp.sum(x * x, axis=1, keepdims=True)  # (bn, 1)
+    c_sq = jnp.sum(c * c, axis=1)[None, :]  # (1, k)
+    cross = jax.lax.dot_general(
+        x, c,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # (bn, k)
+    d2 = jnp.maximum(x_sq + c_sq - 2.0 * cross, 0.0)
+
+    assign = jnp.argmin(d2, axis=1)  # (bn,)
+    min_d2 = jnp.min(d2, axis=1, keepdims=True)  # (bn, 1)
+
+    # block one-hot weighted by row weights (VPU compare against 2-D iota)
+    k = c.shape[0]
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
+    one_hot = jnp.where(col_ids == assign[:, None], w, 0.0)  # (bn, k)
+
+    # accumulate cluster sums on the MXU: (k, bn) @ (bn, d)
+    sums_ref[:] += jax.lax.dot_general(
+        one_hot, x,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    counts_ref[:] += jnp.sum(one_hot, axis=0, keepdims=True)  # (1, k)
+    cost_ref[0, 0] += jnp.sum(min_d2 * w)
+
+
+def _pad_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _call(x, w, centers, interpret=False):
+    n, d = x.shape
+    k = centers.shape[0]
+    grid = (n // _BLOCK_ROWS,)
+    sums, counts, cost = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((k, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, w, centers)
+    return sums, counts, cost
+
+
+def lloyd_accumulate_pallas(
+    x: jax.Array,
+    weights: jax.Array,
+    centers: jax.Array,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Drop-in replacement for ops.kmeans_ops._accumulate (f32 only).
+
+    Pads rows to the 512-row block, k and d to 128-lane multiples.  Dummy
+    centers are placed at 1e15 so no real row selects them; their
+    counts/sums come back zero and are sliced off.
+    """
+    n, d = x.shape
+    k = centers.shape[0]
+    n_pad = _pad_to(max(n, _BLOCK_ROWS), _BLOCK_ROWS)
+    d_pad = _pad_to(d, _LANE)
+    k_pad = _pad_to(k, _LANE)
+
+    x_p = jnp.zeros((n_pad, d_pad), jnp.float32).at[:n, :d].set(x.astype(jnp.float32))
+    w_p = jnp.zeros((n_pad, 1), jnp.float32).at[:n, 0].set(weights.astype(jnp.float32))
+    c_p = jnp.full((k_pad, d_pad), 1e15, jnp.float32).at[:k, :d].set(
+        centers.astype(jnp.float32)
+    )
+    # dummy feature columns of real centers must be 0 (match padded x cols)
+    c_p = c_p.at[:k, d:].set(0.0)
+
+    sums, counts, cost = _call(x_p, w_p, c_p, interpret=interpret)
+    return sums[:k, :d], counts[0, :k], cost[0, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "interpret"))
+def _lloyd_loop_padded(x_p, w_p, c_p, max_iter, tol, interpret=False):
+    """while_loop over the fused kernel on pre-padded operands."""
+    tol_sq = tol * tol
+
+    def cond(state):
+        _, it, converged, _ = state
+        return jnp.logical_and(it < max_iter, jnp.logical_not(converged))
+
+    def body(state):
+        centers, it, _, _ = state
+        sums, counts, cost = _call(x_p, w_p, centers, interpret=interpret)
+        counts_col = counts[0][:, None]  # (k_pad, 1)
+        new_centers = jnp.where(
+            counts_col > 0, sums / jnp.maximum(counts_col, 1e-30), centers
+        )
+        moved_sq = jnp.sum((new_centers - centers) ** 2, axis=1)
+        converged = jnp.all(moved_sq <= tol_sq)
+        return new_centers, it + 1, converged, cost[0, 0]
+
+    state = (c_p, jnp.asarray(0, jnp.int32), jnp.asarray(False), jnp.float32(0))
+    centers, n_iter, _, _ = jax.lax.while_loop(cond, body, state)
+    _, _, cost = _call(x_p, w_p, centers, interpret=interpret)
+    return centers, n_iter, cost[0, 0]
+
+
+def lloyd_run_pallas(x, weights, init_centers, max_iter, tol, interpret=False):
+    """Fused-kernel Lloyd loop; same contract as ops.kmeans_ops.lloyd_run
+    (f32). Pads once outside the loop, slices the result back."""
+    n, d = x.shape
+    k = init_centers.shape[0]
+    n_pad = _pad_to(max(n, _BLOCK_ROWS), _BLOCK_ROWS)
+    d_pad = _pad_to(d, _LANE)
+    k_pad = _pad_to(k, _LANE)
+    x_p = jnp.zeros((n_pad, d_pad), jnp.float32).at[:n, :d].set(x.astype(jnp.float32))
+    w_p = jnp.zeros((n_pad, 1), jnp.float32).at[:n, 0].set(weights.astype(jnp.float32))
+    c_p = jnp.full((k_pad, d_pad), 1e15, jnp.float32).at[:k, :d].set(
+        init_centers.astype(jnp.float32)
+    )
+    c_p = c_p.at[:k, d:].set(0.0)
+    centers, n_iter, cost = _lloyd_loop_padded(
+        x_p, w_p, c_p, max_iter, jnp.asarray(tol, jnp.float32), interpret
+    )
+    return centers[:k, :d], n_iter, cost

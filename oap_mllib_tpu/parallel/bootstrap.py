@@ -1,0 +1,123 @@
+"""Multi-host bootstrap: the KVS-rendezvous analog.
+
+The reference forms its collective world with a oneCCL TCP KVS: the driver
+discovers the first executor's IP (Utils.scala:60-74), probes a free port on
+it starting at 3000 (Utils.scala:76-96, native OneCCL.cpp:207-247), passes
+``ip_port`` to every rank, and each rank calls
+``ccl::create_communicator(size, rank, kvs)`` which blocks until the world
+is complete (OneCCL.cpp:47-86).  Config keys
+``spark.oap.mllib.oneccl.kvs.ip/.port`` override discovery.
+
+TPU-native equivalent: ``jax.distributed.initialize(coordinator_address,
+num_processes, process_id)`` — process 0 hosts the coordination service
+(the KVS analog), everyone else TCP-connects, and the global device mesh
+then spans all hosts.  Discovery reuses the same pattern: first host's
+non-loopback IP + free-port scan (native/net_probe.cpp), overridable via
+``OAP_MLLIB_TPU_COORDINATOR_ADDRESS`` / ``_PORT`` (the spark conf analog).
+
+Single-process runs (the `local[*]` analog) skip initialization entirely —
+same behavior as the reference's 1-rank world (Utils.scala:119-121).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+from typing import Optional
+
+from oap_mllib_tpu.config import get_config
+
+log = logging.getLogger("oap_mllib_tpu")
+
+_initialized = False
+
+
+def local_ip() -> str:
+    """First non-loopback IPv4 of this host (native probe, Python fallback)."""
+    from oap_mllib_tpu import native
+
+    ip = native.local_ip()
+    if ip:
+        return ip
+    # Python fallback: kernel-chosen source IP for an outbound route
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))  # no packets sent (UDP, no data)
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def free_port(ip: str = "", start: int = 3000) -> int:
+    """First bindable TCP port >= start (reference scans from 3000)."""
+    from oap_mllib_tpu import native
+
+    port = native.free_port(ip, start)
+    if port:
+        return port
+    for p in range(start, 65536):
+        s = socket.socket()
+        try:
+            s.bind((ip or "", p))
+            return p
+        except OSError:
+            continue
+        finally:
+            s.close()
+    raise RuntimeError("no free port found")
+
+
+def default_coordinator(start_port: int = 3000) -> str:
+    """ip:port string for process 0 to host coordination on."""
+    ip = local_ip()
+    return f"{ip}:{free_port(ip, start_port)}"
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host world; returns True if distributed init ran.
+
+    No-op (returns False) for single-process configs.  Idempotent — the
+    reference creates/destroys a communicator per training job
+    (OneCCL.cpp:60-99), but JAX's runtime is process-wide, so one init
+    serves all subsequent fits.
+    """
+    global _initialized
+    cfg = get_config()
+    num_processes = num_processes if num_processes is not None else cfg.num_processes
+    process_id = process_id if process_id is not None else cfg.process_id
+    if num_processes <= 1:
+        return False
+    if _initialized:
+        return True
+
+    if coordinator_address is None:
+        if cfg.coordinator_address:
+            port = cfg.coordinator_port or 3000
+            coordinator_address = f"{cfg.coordinator_address}:{port}"
+        elif process_id == 0:
+            coordinator_address = default_coordinator()
+        else:
+            raise ValueError(
+                "non-zero process_id requires a coordinator address "
+                "(set OAP_MLLIB_TPU_COORDINATOR_ADDRESS / _PORT)"
+            )
+
+    import jax
+
+    log.info(
+        "joining world: coordinator=%s size=%d rank=%d",
+        coordinator_address, num_processes, process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True

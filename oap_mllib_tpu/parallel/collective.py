@@ -25,7 +25,12 @@ from oap_mllib_tpu.config import get_config
 
 
 def _shard_map(f, mesh, in_specs, out_specs):
-    return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    # check_vma=False: outputs of all_gather/psum ARE replicated over the
+    # data axis, but the static replication checker can't always prove it
+    # for P(None, ...) out_specs on a multi-axis mesh.
+    return jax.shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+    )
 
 
 def broadcast(x: jax.Array, mesh: Mesh, root: int = 0) -> jax.Array:

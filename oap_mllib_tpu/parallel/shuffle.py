@@ -1,0 +1,163 @@
+"""Distributed ratings shuffle: the ALS 2-D block data plane.
+
+Reference flow (survey §3.3): each rank packs its ratings into a byte
+buffer (ALSDALImpl.scala:172-182), calls native cShuffleData which buckets
+records by user block and exchanges them via oneCCL alltoall (lengths) +
+alltoallv (payload), then sorts and builds a one-based CSR block
+(ALSShuffle.cpp:62-127, OneDAL.cpp:109-145).
+
+TPU-native redesign:
+- Host prep per rank (bucket, sort, count) is the C++ layer
+  (native/shuffle.cpp) — same role as the reference's host-side bucketing.
+- The exchange is ONE compiled XLA ``all_to_all`` of a fixed-shape padded
+  tensor (survey §2.6: variable-length alltoallv becomes max-bucket-padded
+  static shapes; the size pre-exchange disappears because shapes are
+  static).
+- The received block becomes a zero-based local CSR (data/table.CSRTable)
+  with user ids rebased by the block offset — the userOffset bookkeeping
+  the reference threads through ALSResult (ALSDALImpl.cpp:529-575).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.data.table import CSRTable
+
+
+@dataclasses.dataclass
+class ShuffledBlocks:
+    """Per-rank user-block shards after the exchange (host-side view)."""
+
+    blocks: List[CSRTable]  # one per rank; local (rebased) user rows
+    block_offsets: np.ndarray  # (world + 1,) global user-id boundaries
+    n_items: int
+
+
+def _pad_bucket(arr: np.ndarray, size: int, fill) -> np.ndarray:
+    pad = size - arr.shape[0]
+    if pad <= 0:
+        return arr[:size]
+    return np.concatenate([arr, np.full((pad,) + arr.shape[1:], fill, arr.dtype)])
+
+
+def exchange_ratings(
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    mesh: Mesh,
+    n_users: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, np.ndarray]:
+    """Run the block shuffle through a compiled all_to_all on the mesh.
+
+    The input is split evenly across ranks in arrival order (the arbitrary
+    Spark partitioning analog); the output is (users, items, ratings,
+    valid) sharded so rank b holds exactly user-block b, padded to the
+    global max bucket size.  Returns device arrays + block offsets.
+    """
+    from oap_mllib_tpu import native
+
+    if n_users >= 2**31 or (len(items) and int(np.max(items)) >= 2**31):
+        raise ValueError(
+            "ids must fit int32 (the on-device CSR index dtype); "
+            f"got n_users={n_users}, max item={int(np.max(items))}"
+        )
+    cfg = get_config()
+    axis = cfg.data_axis
+    world = mesh.shape[axis]
+    kpb = max(1, math.ceil(n_users / world))
+    offsets = np.minimum(np.arange(world + 1) * kpb, n_users)
+
+    n = len(users)
+    per_src = math.ceil(n / world)
+
+    # host prep per source rank: bucket + sort + count (native C++)
+    src_buckets = []  # [src][dst] -> (u, i, r) arrays
+    max_bucket = 1
+    for s in range(world):
+        lo, hi = s * per_src, min((s + 1) * per_src, n)
+        us, it, rs, counts, _ = native.shuffle_prep(
+            users[lo:hi], items[lo:hi], ratings[lo:hi], kpb, world
+        )
+        row = []
+        pos = 0
+        for b in range(world):
+            c = int(counts[b])
+            row.append((us[pos:pos + c], it[pos:pos + c], rs[pos:pos + c]))
+            max_bucket = max(max_bucket, c)
+            pos += c
+        src_buckets.append(row)
+
+    # pack into (world_src * world_dst * max_bucket, 4) padded records
+    rec = np.zeros((world, world, max_bucket, 4), dtype=np.float64)
+    for s in range(world):
+        for b in range(world):
+            u, i, r = src_buckets[s][b]
+            c = len(u)
+            rec[s, b, :c, 0] = u
+            rec[s, b, :c, 1] = i
+            rec[s, b, :c, 2] = r
+            rec[s, b, :c, 3] = 1.0  # valid flag
+    flat = rec.reshape(world * world * max_bucket, 4)
+
+    # ONE compiled all_to_all: rank s's bucket b -> rank b
+    from oap_mllib_tpu.parallel.collective import alltoall_rows
+
+    sharded = jax.device_put(
+        jnp.asarray(flat), NamedSharding(mesh, P(axis, None))
+    )
+    exchanged = alltoall_rows(sharded, mesh)  # rank b now holds all s's bucket b
+
+    out_u = exchanged[:, 0].astype(jnp.int32)
+    out_i = exchanged[:, 1].astype(jnp.int32)
+    out_r = exchanged[:, 2].astype(jnp.float32)
+    out_valid = exchanged[:, 3].astype(jnp.float32)
+    return out_u, out_i, out_r, out_valid, offsets
+
+
+def shuffle_to_blocks(
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    mesh: Mesh,
+    n_users: int,
+    n_items: int,
+) -> ShuffledBlocks:
+    """Full host-visible pipeline: exchange + per-rank local CSR build
+    (~ cShuffleData + bufferToCSRNumericTable, ALSDALImpl.scala:107-109)."""
+    from oap_mllib_tpu import native
+
+    cfg = get_config()
+    world = mesh.shape[cfg.data_axis]
+    u, i, r, valid, offsets = exchange_ratings(users, items, ratings, mesh, n_users)
+
+    # pull per-rank shards back to host for CSR construction
+    per_rank = u.shape[0] // world
+    uh = np.asarray(u).reshape(world, per_rank)
+    ih = np.asarray(i).reshape(world, per_rank)
+    rh = np.asarray(r).reshape(world, per_rank)
+    vh = np.asarray(valid).reshape(world, per_rank)
+
+    blocks = []
+    for b in range(world):
+        sel = vh[b] > 0
+        lo, hi = int(offsets[b]), int(offsets[b + 1])
+        local_rows = hi - lo
+        blocks.append(
+            CSRTable.from_coo(
+                uh[b][sel] - lo,  # rebase to local row ids
+                ih[b][sel],
+                rh[b][sel],
+                n_rows=max(local_rows, 1),
+                n_cols=n_items,
+            )
+        )
+    return ShuffledBlocks(blocks=blocks, block_offsets=offsets, n_items=n_items)

@@ -229,3 +229,12 @@ class TestRegressions:
         c = x[:2]
         with pytest.raises(ValueError):
             lloyd_run(x, w, c, 2, jnp.asarray(0.0, jnp.float32), 3)
+
+    def test_bad_precision_string_raises(self, rng):
+        import jax.numpy as jnp
+        from oap_mllib_tpu.ops.kmeans_ops import lloyd_run
+
+        x = jnp.asarray(rng.normal(size=(8, 2)), jnp.float32)
+        w = jnp.ones((8,), jnp.float32)
+        with pytest.raises(ValueError):
+            lloyd_run(x, w, x[:2], 2, jnp.asarray(0.0, jnp.float32), 1, "Highest")

@@ -205,3 +205,30 @@ class TestReviewRegressions:
         h = lib.oap_table_create(1, 2)
         assert lib.oap_table_merge(h, h) == -1
         lib.oap_table_free(h)
+
+    def test_csv_comment_lines_match_loadtxt(self, tmp_path):
+        p = tmp_path / "c.csv"
+        p.write_text("# header comment\n1,2\n# mid comment\n3,4\n")
+        nx = native.parse_csv(str(p))
+        px = np.loadtxt(str(p), delimiter=",", ndmin=2)
+        np.testing.assert_array_equal(nx, px)
+
+    def test_ratings_reject_float_ids_and_garbage(self, tmp_path):
+        for bad in ("1.5::2::3\n", "1::2::3junk\n"):
+            p = tmp_path / "bad_r.txt"
+            p.write_text(bad)
+            with pytest.raises(ValueError):
+                native.parse_ratings(str(p))
+
+    def test_table_view_zero_copy(self):
+        lib = native._load()
+        h = lib.oap_table_create(1, 2)
+        row = np.array([5.0, 6.0])
+        lib.oap_table_append(h, row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 1)
+        view = native.table_view(h)
+        np.testing.assert_array_equal(view, [[5.0, 6.0]])
+        view[0, 0] = 7.0  # writes through — same memory
+        out = np.empty((1, 2))
+        lib.oap_table_copy_out(h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 1)
+        assert out[0, 0] == 7.0
+        lib.oap_table_free(h)
