@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# CI harness (~ the reference's dev/ci-build.sh + ci-test.sh): build the
+# native library, run the full pseudo-cluster test suite (8-way SPMD on a
+# virtual CPU mesh), then run every example end-to-end on the CPU fallback
+# path (the pseudo-cluster example run analog).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== build native =="
+make -C oap_mllib_tpu/native -j4
+
+echo "== test suite (8-device CPU pseudo-cluster) =="
+python -m pytest tests/ -q
+
+echo "== examples (CPU fallback path) =="
+bash examples/run_all.sh --device cpu
+
+echo "== examples (accelerated path on default backend) =="
+bash examples/run_all.sh
+
+echo "CI OK"

@@ -69,6 +69,50 @@ class DenseTable:
             n_rows=n_valid,
         )
 
+    @classmethod
+    def from_process_local(cls, x_local: np.ndarray, mesh, dtype=None) -> "DenseTable":
+        """Multi-host ingestion: each process contributes its LOCAL row shard
+        and the result is one global row-sharded table spanning all hosts.
+
+        This is the multi-host analog of the reference's per-executor table
+        build (OneDAL.scala:92-166, where each executor converts only its
+        partitions) — here `jax.make_array_from_process_local_data` stitches
+        the per-host shards into a global array without any host ever
+        holding the full table.  Every process must call this collectively
+        with equally-shaped shards (pad the last host's shard with zero-
+        weight rows).  In a single-process world it's identical to
+        ``from_numpy``.
+        """
+        import jax
+
+        x_local = np.asarray(x_local)
+        if dtype is not None:
+            x_local = x_local.astype(dtype)
+        n_proc = getattr(jax, "process_count", lambda: 1)()
+        if n_proc == 1:
+            return cls.from_numpy(x_local, mesh, dtype)
+        n_data = mesh.shape[mesh.axis_names[0]]
+        from oap_mllib_tpu.parallel.mesh import data_sharding
+
+        local_devices = max(1, n_data // n_proc)
+        padded, n_valid_local = pad_rows(x_local, local_devices)
+        mask_local = np.zeros((padded.shape[0],), dtype=padded.dtype)
+        mask_local[:n_valid_local] = 1.0
+        data = jax.make_array_from_process_local_data(
+            data_sharding(mesh, 2), padded
+        )
+        mask = jax.make_array_from_process_local_data(
+            data_sharding(mesh, 1), mask_local
+        )
+        # global valid count: exact int allgather of per-process counts
+        # (summing the f32 mask on device loses integers past 2^24)
+        from jax.experimental import multihost_utils
+
+        n_rows = int(
+            multihost_utils.process_allgather(np.int64(n_valid_local)).sum()
+        )
+        return cls(data=data, mask=mask, n_rows=n_rows)
+
     def to_numpy(self) -> np.ndarray:
         """Gather valid rows back to host (reverse data plane,
         ~ numericTableToVectors, OneDAL.scala:37-52)."""

@@ -160,12 +160,27 @@ class ALS:
             return ALSModel(x, y, {"timings": timings, "accelerated": False})
 
         # accelerated path (~ ALSDALImpl.train, ALSDALImpl.scala:58)
+        import jax
+
+        from oap_mllib_tpu.parallel.mesh import get_mesh
+
+        mesh = get_mesh()
+        world = mesh.shape[mesh.axis_names[0]]
+        if self.implicit_prefs and world > 1:
+            # distributed 2-D block layout: ratings shuffled by user block,
+            # X block-sharded, Y replicated (~ the reference's full
+            # cShuffleData + 4-step pipeline, survey §3.3)
+            return self._fit_block_parallel(
+                users, items, ratings, n_users, n_items, x0, y0, mesh, timings
+            )
         with phase_timer(timings, "table_convert"):
             u = jnp.asarray(users.astype(np.int32))
             i = jnp.asarray(items.astype(np.int32))
             c = jnp.asarray(ratings)
             valid = jnp.ones_like(c)
-        with phase_timer(timings, "als_iterations"):
+        from oap_mllib_tpu.utils.profiling import maybe_trace
+
+        with phase_timer(timings, "als_iterations"), maybe_trace():
             if self.implicit_prefs:
                 x, y = als_ops.als_implicit_run(
                     u, i, c, valid, jnp.asarray(x0), jnp.asarray(y0),
@@ -179,3 +194,47 @@ class ALS:
             x = np.asarray(x)
             y = np.asarray(y)
         return ALSModel(x, y, {"timings": timings, "accelerated": True})
+
+    def _fit_block_parallel(
+        self, users, items, ratings, n_users, n_items, x0, y0, mesh, timings
+    ) -> ALSModel:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from oap_mllib_tpu.config import get_config
+        from oap_mllib_tpu.ops import als_block
+
+        cfg = get_config()
+        axis = cfg.data_axis
+        world = mesh.shape[axis]
+        with phase_timer(timings, "ratings_shuffle"):
+            u_loc, i_glob, conf, valid, offsets, upb = als_block.prepare_block_inputs(
+                users, items, ratings, mesh, n_users
+            )
+        with phase_timer(timings, "table_convert"):
+            # block-pad X: rank b's rows = x0[offsets[b]:offsets[b+1]] + pad
+            x0_blocks = np.zeros((world * upb, self.rank), np.float32)
+            for b in range(world):
+                lo, hi = int(offsets[b]), int(offsets[b + 1])
+                x0_blocks[b * upb : b * upb + (hi - lo)] = x0[lo:hi]
+            x0_dev = jax.device_put(
+                jnp.asarray(x0_blocks), NamedSharding(mesh, P(axis, None))
+            )
+            y0_dev = jax.device_put(jnp.asarray(y0), NamedSharding(mesh, P()))
+        from oap_mllib_tpu.utils.profiling import maybe_trace
+
+        with phase_timer(timings, "als_iterations"), maybe_trace():
+            x_blocks, y = als_block.als_implicit_block(
+                u_loc, i_glob, conf, valid, x0_dev, y0_dev,
+                self.max_iter, self.reg_param, self.alpha, mesh,
+            )
+            xb = np.asarray(x_blocks)
+            y = np.asarray(y)
+        # reassemble global X from blocks (offset bookkeeping ~ ALSResult
+        # cUserOffset, ALSDALImpl.cpp:529-575)
+        x = np.zeros((n_users, self.rank), np.float32)
+        for b in range(world):
+            lo, hi = int(offsets[b]), int(offsets[b + 1])
+            x[lo:hi] = xb[b * upb : b * upb + (hi - lo)]
+        return ALSModel(x, y, {"timings": timings, "accelerated": True,
+                               "block_parallel": True})

@@ -151,17 +151,36 @@ class KMeans:
             "KMeans", guard_ok, reason=f"distance_measure={self.distance_measure}"
         )
         if accelerated:
-            return self._fit_tpu(x, sample_weight)
+            from oap_mllib_tpu.utils.profiling import maybe_trace
+
+            with maybe_trace():
+                return self._fit_tpu(x, sample_weight)
         return self._fit_fallback(x, sample_weight)
 
     # -- accelerated path (~ KMeansDALImpl.train, KMeansDALImpl.scala:35) ----
     def _fit_tpu(self, x: np.ndarray, sample_weight: Optional[np.ndarray]) -> KMeansModel:
+        import jax
+
+        from oap_mllib_tpu.utils.timing import x64_scope
+
         cfg = get_config()
         dtype = np.float64 if cfg.enable_x64 else np.float32
+        with x64_scope(cfg.enable_x64):
+            return self._fit_tpu_inner(x, sample_weight, dtype, jax)
+
+    def _fit_tpu_inner(self, x, sample_weight, dtype, jax) -> KMeansModel:
+        cfg = get_config()
         timings = Timings()
         mesh = get_mesh()
         with phase_timer(timings, "table_convert"):
-            table = DenseTable.from_numpy(x.astype(dtype), mesh)
+            # multi-process: each host contributes its local shard
+            # (README multi-host flow); single-process: the full table
+            make = (
+                DenseTable.from_process_local
+                if jax.process_count() > 1
+                else DenseTable.from_numpy
+            )
+            table = make(x.astype(dtype), mesh)
             weights = table.mask
             if sample_weight is not None:
                 w = np.zeros((table.n_padded,), dtype=dtype)

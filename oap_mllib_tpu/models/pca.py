@@ -83,17 +83,33 @@ class PCA:
             raise ValueError(f"k={self.k} exceeds n_features={d}")
         guard_ok = d < MAX_PCA_FEATURES
         if should_accelerate("PCA", guard_ok, reason=f"n_features={d}"):
-            return self._fit_tpu(x)
+            from oap_mllib_tpu.utils.profiling import maybe_trace
+
+            with maybe_trace():
+                return self._fit_tpu(x)
         return self._fit_fallback(x)
 
     # -- accelerated path (~ PCADALImpl.train, PCADALImpl.scala:35) ----------
     def _fit_tpu(self, x: np.ndarray) -> PCAModel:
+        import jax
+
+        from oap_mllib_tpu.utils.timing import x64_scope
+
         cfg = get_config()
         dtype = np.float64 if cfg.enable_x64 else np.float32
+        with x64_scope(cfg.enable_x64):
+            return self._fit_tpu_inner(x, dtype, jax)
+
+    def _fit_tpu_inner(self, x, dtype, jax) -> PCAModel:
         timings = Timings()
         mesh = get_mesh()
         with phase_timer(timings, "table_convert"):
-            table = DenseTable.from_numpy(x.astype(dtype), mesh)
+            make = (
+                DenseTable.from_process_local
+                if jax.process_count() > 1
+                else DenseTable.from_numpy
+            )
+            table = make(x.astype(dtype), mesh)
         with phase_timer(timings, "covariance"):
             cov, _ = pca_ops.covariance(
                 table.data, table.mask, jnp.asarray(float(table.n_rows), dtype)

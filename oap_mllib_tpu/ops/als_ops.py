@@ -39,12 +39,49 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _half_update(
+def implicit_partials(
     dst_idx: jax.Array,  # (nnz,) int32 — side being solved (e.g. users)
     src_idx: jax.Array,  # (nnz,) int32 — fixed side (e.g. items)
     conf: jax.Array,  # (nnz,) f32 ratings/confidences
     valid: jax.Array,  # (nnz,) f32 1/0 mask
     src_factors: jax.Array,  # (n_src, r)
+    n_dst: int,
+    alpha: float,
+):
+    """Per-edge implicit normal-equation partials grouped by dst id.
+
+    Returns (a_part (n_dst, r, r), b (n_dst, r), deg (n_dst,)).  Shared by
+    the global-program path (this file) and the block-parallel path
+    (als_block.py, which psums these across the mesh) so the two can never
+    diverge in the weighting math.
+    """
+    ys = src_factors[src_idx]  # (nnz, r) gather
+    w = alpha * conf * valid  # (nnz,)
+    # A contributions: sum_e w_e * y_e y_e^T, grouped by dst id
+    outer = jnp.einsum("er,es->ers", ys * w[:, None], ys,
+                       precision=lax.Precision.HIGHEST)  # (nnz, r, r)
+    a_part = jax.ops.segment_sum(outer, dst_idx, num_segments=n_dst)
+    # b contributions: sum_e (1 + alpha c_e) y_e
+    b_w = (1.0 + alpha * conf) * valid
+    b = jax.ops.segment_sum(ys * b_w[:, None], dst_idx, num_segments=n_dst)
+    deg = jax.ops.segment_sum(valid, dst_idx, num_segments=n_dst)
+    return a_part, b, deg
+
+
+def masked_solve(a: jax.Array, b: jax.Array, deg: jax.Array) -> jax.Array:
+    """Batched SPD solve; rows with no ratings get zero factors
+    (fallback-path semantics) — also shields against NaN from a singular A
+    when reg == 0."""
+    factors = jnp.linalg.solve(a, b[:, :, None])[:, :, 0]
+    return jnp.where(deg[:, None] > 0, jnp.nan_to_num(factors), 0.0)
+
+
+def _half_update(
+    dst_idx: jax.Array,
+    src_idx: jax.Array,
+    conf: jax.Array,
+    valid: jax.Array,
+    src_factors: jax.Array,
     n_dst: int,
     reg: float,
     alpha: float,
@@ -52,24 +89,12 @@ def _half_update(
     """Solve one side's factors given the other side's. Returns (n_dst, r)."""
     r = src_factors.shape[1]
     gram = jnp.matmul(src_factors.T, src_factors, precision=lax.Precision.HIGHEST)  # (r, r) <- MXU, psum over mesh
-    ys = src_factors[src_idx]  # (nnz, r) gather
-    w = (alpha * conf * valid)  # (nnz,)
-    # A contributions: sum_e w_e * y_e y_e^T, grouped by dst id
-    outer = jnp.einsum("er,es->ers", ys * w[:, None], ys,
-                       precision=lax.Precision.HIGHEST)  # (nnz, r, r)
-    a_part = jax.ops.segment_sum(outer, dst_idx, num_segments=n_dst)  # (n_dst, r, r)
-    # b contributions: sum_e (1 + alpha c_e) y_e
-    b_w = (1.0 + alpha * conf) * valid
-    b = jax.ops.segment_sum(ys * b_w[:, None], dst_idx, num_segments=n_dst)
+    a_part, b, deg = implicit_partials(
+        dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha
+    )
     eye = jnp.eye(r, dtype=src_factors.dtype)
     a = gram[None, :, :] + a_part + reg * eye[None, :, :]
-    # batched symmetric-positive-definite solve
-    factors = jnp.linalg.solve(a, b[:, :, None])[:, :, 0]
-    # rows with no ratings get zero factors (fallback-path semantics); also
-    # shields against NaN from a singular A when reg == 0
-    deg = jax.ops.segment_sum(valid, dst_idx, num_segments=n_dst)
-    factors = jnp.where(deg[:, None] > 0, jnp.nan_to_num(factors), 0.0)
-    return factors.astype(src_factors.dtype)
+    return masked_solve(a, b, deg).astype(src_factors.dtype)
 
 
 @functools.partial(

@@ -1,0 +1,51 @@
+"""XLA-level profiling: the deep-trace layer the reference never had.
+
+The reference's only observability is wall-time prints (survey §5);
+utils/timing.py replicates that.  This module adds the TPU-native layer
+beneath it: ``jax.profiler`` traces capture per-op device timelines,
+HBM usage, and ICI collective timing, viewable in TensorBoard/XProf.
+
+Usage::
+
+    from oap_mllib_tpu.utils.profiling import trace
+    with trace("/tmp/oap_trace"):
+        KMeans(k=8).fit(x)
+
+or set ``OAP_MLLIB_TPU_PROFILE_DIR`` and every estimator fit is traced.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+
+log = logging.getLogger("oap_mllib_tpu")
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax.profiler trace for the enclosed block."""
+    import jax
+
+    log.info("profiler trace -> %s", log_dir)
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def maybe_trace():
+    """Trace if OAP_MLLIB_TPU_PROFILE_DIR is set; no-op otherwise."""
+    log_dir = os.environ.get("OAP_MLLIB_TPU_PROFILE_DIR", "")
+    if not log_dir:
+        yield
+        return
+    with trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named sub-span inside a trace (jax.profiler.TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -55,3 +55,21 @@ def phase_timer(timings: Timings, phase: str):
         yield
     finally:
         timings.add(phase, time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def x64_scope(enable: bool):
+    """Temporarily enable jax x64 for one fit; restores the prior value so
+    one f64 fit doesn't permanently flip the whole process (the flag is
+    process-global)."""
+    if not enable:
+        yield
+        return
+    import jax
+
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", prev)
