@@ -51,7 +51,7 @@ def main():
     precision = get_config().matmul_precision  # env-overridable via config
 
     def run(max_iter):
-        c, it, cost = kmeans_ops.lloyd_run(
+        c, it, cost, _ = kmeans_ops.lloyd_run(
             xj, wj, cj, max_iter, tol, row_chunks, precision
         )
         # fetch scalars: on remote-execution backends block_until_ready can

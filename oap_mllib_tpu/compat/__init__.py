@@ -1,0 +1,17 @@
+"""Spark-ML-style compatibility API.
+
+The reference's public surface IS Spark ML: builder-style estimators
+(``new KMeans().setK(2).setMaxIter(5).fit(df)``) over DataFrames with
+named columns, shadowed by classpath substitution (survey §2.2).  This
+package provides that calling convention for users migrating Spark ML /
+PySpark code: the same param names in the same camelCase, column-oriented
+input, ``transform`` that appends an output column.
+
+A "DataFrame" here is a plain ``dict[str, np.ndarray]`` (column name ->
+column values) — the dependency-free stand-in; ``fit`` also accepts a bare
+ndarray for the features-only case.
+"""
+
+from oap_mllib_tpu.compat.spark import ALS, KMeans, PCA
+
+__all__ = ["KMeans", "PCA", "ALS"]

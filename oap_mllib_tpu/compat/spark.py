@@ -1,0 +1,280 @@
+"""Builder-style estimators mirroring Spark ML's param surface.
+
+Parity map (reference shims, survey §2.2):
+- ``ml.clustering.KMeans``: setK/setMaxIter/setTol/setSeed/setInitMode/
+  setInitSteps/setDistanceMeasure/setFeaturesCol/setPredictionCol/
+  setWeightCol; model: clusterCenters(), predict(), summary.
+- ``ml.feature.PCA``: setK/setInputCol/setOutputCol; model: pc,
+  explainedVariance, transform.
+- ``ml.recommendation.ALS``: setRank/setMaxIter/setRegParam/setAlpha/
+  setImplicitPrefs/setSeed/setUserCol/setItemCol/setRatingCol; model:
+  userFactors, itemFactors, transform (appends "prediction"),
+  recommendForAllUsers/recommendForAllItems.
+
+Input "DataFrames" are dicts of numpy columns; transform returns a new
+dict with the output column appended (input never mutated).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+from oap_mllib_tpu.models import als as _als
+from oap_mllib_tpu.models import kmeans as _kmeans
+from oap_mllib_tpu.models import pca as _pca
+
+DataFrame = Dict[str, np.ndarray]
+
+
+def _features_from(data: Union[np.ndarray, DataFrame], col: str) -> np.ndarray:
+    if isinstance(data, dict):
+        if col not in data:
+            raise KeyError(f"column {col!r} not in data (has {list(data)})")
+        return np.asarray(data[col])
+    return np.asarray(data)
+
+
+class KMeans:
+    """Spark-ML-style K-Means builder (reference shim: ml.clustering.KMeans)."""
+
+    def __init__(self):
+        self._k = 2
+        self._maxIter = 20
+        self._tol = 1e-4
+        self._seed = 0
+        self._initMode = "k-means||"
+        self._initSteps = 2
+        self._distanceMeasure = "euclidean"
+        self._featuresCol = "features"
+        self._predictionCol = "prediction"
+        self._weightCol: Optional[str] = None
+
+    # -- setters (each returns self, Spark-style) --
+    def setK(self, v):                self._k = v; return self
+    def setMaxIter(self, v):          self._maxIter = v; return self
+    def setTol(self, v):              self._tol = v; return self
+    def setSeed(self, v):             self._seed = v; return self
+    def setInitMode(self, v):         self._initMode = v; return self
+    def setInitSteps(self, v):        self._initSteps = v; return self
+    def setDistanceMeasure(self, v):  self._distanceMeasure = v; return self
+    def setFeaturesCol(self, v):      self._featuresCol = v; return self
+    def setPredictionCol(self, v):    self._predictionCol = v; return self
+    def setWeightCol(self, v):        self._weightCol = v; return self
+
+    # -- getters --
+    def getK(self):                return self._k
+    def getMaxIter(self):          return self._maxIter
+    def getTol(self):              return self._tol
+    def getSeed(self):             return self._seed
+    def getInitMode(self):         return self._initMode
+    def getInitSteps(self):        return self._initSteps
+    def getDistanceMeasure(self):  return self._distanceMeasure
+    def getFeaturesCol(self):      return self._featuresCol
+    def getPredictionCol(self):    return self._predictionCol
+
+    def fit(self, data: Union[np.ndarray, DataFrame]) -> "KMeansModel":
+        x = _features_from(data, self._featuresCol)
+        w = None
+        if self._weightCol is not None:
+            if not isinstance(data, dict):
+                raise ValueError(
+                    f"weightCol={self._weightCol!r} is set but data has no "
+                    "columns; pass a dict with the weight column"
+                )
+            w = np.asarray(data[self._weightCol])
+        est = _kmeans.KMeans(
+            k=self._k, max_iter=self._maxIter, tol=self._tol, seed=self._seed,
+            init_mode=self._initMode, init_steps=self._initSteps,
+            distance_measure=self._distanceMeasure,
+        )
+        return KMeansModel(est.fit(x, sample_weight=w), self._featuresCol,
+                           self._predictionCol)
+
+
+class KMeansModel:
+    def __init__(self, inner: _kmeans.KMeansModel, features_col: str, prediction_col: str):
+        self._inner = inner
+        self._featuresCol = features_col
+        self._predictionCol = prediction_col
+
+    def clusterCenters(self) -> np.ndarray:
+        return self._inner.cluster_centers_
+
+    @property
+    def summary(self):
+        return self._inner.summary
+
+    def predict(self, features: np.ndarray) -> int:
+        """Single-vector predict (Spark's model.predict(Vector)).
+        For batches, use ``transform`` — a 2-D input here is a misuse that
+        would silently drop rows, so it raises."""
+        features = np.asarray(features)
+        if features.ndim != 1:
+            raise TypeError(
+                f"predict takes a single 1-D vector, got shape {features.shape}; "
+                "use transform() for batches"
+            )
+        return int(self._inner.predict(features[None, :])[0])
+
+    def transform(self, data: Union[np.ndarray, DataFrame]) -> DataFrame:
+        x = _features_from(data, self._featuresCol)
+        out = dict(data) if isinstance(data, dict) else {self._featuresCol: x}
+        out[self._predictionCol] = self._inner.predict(x)
+        return out
+
+    def computeCost(self, data: Union[np.ndarray, DataFrame]) -> float:
+        return self._inner.compute_cost(_features_from(data, self._featuresCol))
+
+    def save(self, path: str) -> None:
+        self._inner.save(path)
+
+    @classmethod
+    def load(cls, path: str) -> "KMeansModel":
+        return cls(_kmeans.KMeansModel.load(path), "features", "prediction")
+
+
+class PCA:
+    """Spark-ML-style PCA builder (reference shim: ml.feature.PCA)."""
+
+    def __init__(self):
+        self._k = None
+        self._inputCol = "features"
+        self._outputCol = "pcaFeatures"
+
+    def setK(self, v):          self._k = v; return self
+    def setInputCol(self, v):   self._inputCol = v; return self
+    def setOutputCol(self, v):  self._outputCol = v; return self
+
+    def getK(self):         return self._k
+    def getInputCol(self):  return self._inputCol
+    def getOutputCol(self): return self._outputCol
+
+    def fit(self, data: Union[np.ndarray, DataFrame]) -> "PCAModel":
+        if self._k is None:
+            raise ValueError("k is not set (call setK)")
+        x = _features_from(data, self._inputCol)
+        return PCAModel(_pca.PCA(k=self._k).fit(x), self._inputCol, self._outputCol)
+
+
+class PCAModel:
+    def __init__(self, inner: _pca.PCAModel, input_col: str, output_col: str):
+        self._inner = inner
+        self._inputCol = input_col
+        self._outputCol = output_col
+
+    @property
+    def pc(self) -> np.ndarray:
+        """(d, k) principal components matrix (Spark's `pc`)."""
+        return self._inner.components_
+
+    @property
+    def explainedVariance(self) -> np.ndarray:
+        return self._inner.explained_variance_
+
+    def transform(self, data: Union[np.ndarray, DataFrame]) -> DataFrame:
+        x = _features_from(data, self._inputCol)
+        out = dict(data) if isinstance(data, dict) else {self._inputCol: x}
+        out[self._outputCol] = self._inner.transform(x)
+        return out
+
+    def save(self, path: str) -> None:
+        self._inner.save(path)
+
+    @classmethod
+    def load(cls, path: str) -> "PCAModel":
+        return cls(_pca.PCAModel.load(path), "features", "pcaFeatures")
+
+
+class ALS:
+    """Spark-ML-style ALS builder (reference shim: ml.recommendation.ALS)."""
+
+    def __init__(self):
+        self._rank = 10
+        self._maxIter = 10
+        self._regParam = 0.1
+        self._alpha = 1.0
+        self._implicitPrefs = False
+        self._seed = 0
+        self._nonnegative = False
+        self._userCol = "user"
+        self._itemCol = "item"
+        self._ratingCol = "rating"
+
+    def setRank(self, v):           self._rank = v; return self
+    def setMaxIter(self, v):        self._maxIter = v; return self
+    def setRegParam(self, v):       self._regParam = v; return self
+    def setAlpha(self, v):          self._alpha = v; return self
+    def setImplicitPrefs(self, v):  self._implicitPrefs = v; return self
+    def setSeed(self, v):           self._seed = v; return self
+    def setNonnegative(self, v):    self._nonnegative = v; return self
+    def setUserCol(self, v):        self._userCol = v; return self
+    def setItemCol(self, v):        self._itemCol = v; return self
+    def setRatingCol(self, v):      self._ratingCol = v; return self
+
+    def getRank(self):          return self._rank
+    def getMaxIter(self):       return self._maxIter
+    def getRegParam(self):      return self._regParam
+    def getAlpha(self):         return self._alpha
+    def getImplicitPrefs(self): return self._implicitPrefs
+    def getNonnegative(self):   return self._nonnegative
+    def getUserCol(self):       return self._userCol
+    def getItemCol(self):       return self._itemCol
+    def getRatingCol(self):     return self._ratingCol
+
+    def fit(self, data: DataFrame) -> "ALSModel":
+        if not isinstance(data, dict):
+            raise TypeError("ALS.fit expects a dict with user/item/rating columns")
+        est = _als.ALS(
+            rank=self._rank, max_iter=self._maxIter, reg_param=self._regParam,
+            implicit_prefs=self._implicitPrefs, alpha=self._alpha, seed=self._seed,
+            nonnegative=self._nonnegative,
+        )
+        inner = est.fit(
+            np.asarray(data[self._userCol]),
+            np.asarray(data[self._itemCol]),
+            np.asarray(data[self._ratingCol]),
+        )
+        return ALSModel(inner, self._userCol, self._itemCol)
+
+
+class ALSModel:
+    def __init__(self, inner: _als.ALSModel, user_col: str, item_col: str):
+        self._inner = inner
+        self._userCol = user_col
+        self._itemCol = item_col
+
+    @property
+    def rank(self) -> int:
+        return self._inner.rank
+
+    @property
+    def userFactors(self) -> np.ndarray:
+        return self._inner.user_factors_
+
+    @property
+    def itemFactors(self) -> np.ndarray:
+        return self._inner.item_factors_
+
+    def transform(self, data: DataFrame) -> DataFrame:
+        """Append a "prediction" column for (user, item) pairs."""
+        out = dict(data)
+        out["prediction"] = self._inner.predict(
+            np.asarray(data[self._userCol]), np.asarray(data[self._itemCol])
+        )
+        return out
+
+    def recommendForAllUsers(self, numItems: int) -> np.ndarray:
+        return self._inner.recommend_for_all_users(numItems)
+
+    def recommendForAllItems(self, numUsers: int) -> np.ndarray:
+        """Top-N user ids per item."""
+        return self._inner.recommend_for_all_items(numUsers)
+
+    def save(self, path: str) -> None:
+        self._inner.save(path)
+
+    @classmethod
+    def load(cls, path: str) -> "ALSModel":
+        return cls(_als.ALSModel.load(path), "user", "item")

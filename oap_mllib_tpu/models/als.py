@@ -55,14 +55,22 @@ class ALSModel:
             )
         )
 
+    @staticmethod
+    def _top_k_scores(query: np.ndarray, targets: np.ndarray, n: int) -> np.ndarray:
+        import jax
+
+        scores = jnp.asarray(query) @ jnp.asarray(targets).T
+        _, idx = jax.lax.top_k(scores, n)
+        return np.asarray(idx)
+
     def recommend_for_all_users(self, num_items: int) -> np.ndarray:
         """Top-N item ids per user — one (n_users, r)x(r, n_items) MXU
         matmul + top_k (~ ALSModel.recommendForAllUsers)."""
-        import jax
+        return self._top_k_scores(self.user_factors_, self.item_factors_, num_items)
 
-        scores = jnp.asarray(self.user_factors_) @ jnp.asarray(self.item_factors_).T
-        _, idx = jax.lax.top_k(scores, num_items)
-        return np.asarray(idx)
+    def recommend_for_all_items(self, num_users: int) -> np.ndarray:
+        """Top-N user ids per item (~ ALSModel.recommendForAllItems)."""
+        return self._top_k_scores(self.item_factors_, self.user_factors_, num_users)
 
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
@@ -95,6 +103,7 @@ class ALS:
         implicit_prefs: bool = False,
         alpha: float = 1.0,
         seed: int = 0,
+        nonnegative: bool = False,
     ):
         if rank < 1:
             raise ValueError("rank must be >= 1")
@@ -110,6 +119,7 @@ class ALS:
         self.implicit_prefs = implicit_prefs
         self.alpha = alpha
         self.seed = seed
+        self.nonnegative = nonnegative
 
     def fit(
         self,
@@ -142,13 +152,21 @@ class ALS:
                 f"item id {int(items.max())} out of range for n_items={n_items}"
             )
 
-        accelerated = should_accelerate("ALS", True)
+        # nonnegative uses the NNLS fallback path (the reference likewise
+        # accelerates only the unconstrained implicit solver, ALS.scala:925)
+        accelerated = should_accelerate(
+            "ALS", guard_ok=not self.nonnegative, reason="nonnegative=True"
+        )
         timings = Timings()
         if init is not None:
             x0, y0 = np.array(init[0], np.float32), np.array(init[1], np.float32)
         else:
             x0 = als_np.init_factors(n_users, self.rank, self.seed)
             y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
+        if self.nonnegative:
+            # the nonnegative contract must hold even at max_iter=0 or with
+            # a user-supplied signed init
+            x0, y0 = np.abs(x0), np.abs(y0)
 
         if not accelerated:
             with phase_timer(timings, "als_np"):
@@ -156,6 +174,7 @@ class ALS:
                     users, items, ratings, n_users, n_items, self.rank,
                     self.max_iter, self.reg_param, self.alpha,
                     self.implicit_prefs, self.seed, init=(x0, y0),
+                    nonnegative=self.nonnegative,
                 )
             return ALSModel(x, y, {"timings": timings, "accelerated": False})
 

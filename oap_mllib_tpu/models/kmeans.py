@@ -36,13 +36,16 @@ INIT_PARALLEL = "k-means||"
 
 class KMeansSummary:
     """Training summary (~ KMeansSummary + KMeansResult,
-    reference KMeansResult.java / KMeans.scala:359-368)."""
+    reference KMeansResult.java / KMeans.scala:359-368).  ``cluster_sizes``
+    mirrors Spark's KMeansSummary.clusterSizes."""
 
-    def __init__(self, training_cost: float, num_iter: int, timings: Timings, accelerated: bool):
+    def __init__(self, training_cost: float, num_iter: int, timings: Timings,
+                 accelerated: bool, cluster_sizes: Optional[np.ndarray] = None):
         self.training_cost = training_cost
         self.num_iter = num_iter
         self.timings = timings
         self.accelerated = accelerated
+        self.cluster_sizes = cluster_sizes
 
     def __repr__(self) -> str:
         return (
@@ -83,6 +86,50 @@ class KMeansModel:
             return float(np.sum(np.min(d, axis=1)))
         d2 = kmeans_ops.pairwise_sq_dists(jnp.asarray(x), jnp.asarray(self.cluster_centers_))
         return float(jnp.sum(jnp.min(d2, axis=1)))
+
+    def to_pmml(self, path: str) -> None:
+        """Export as a PMML 4.3 ClusteringModel (~ Spark's
+        KMeansModel.write.format("pmml"), exercised by the reference's
+        IntelKMeansSuite "pmml export" test)."""
+        import xml.etree.ElementTree as ET
+
+        d = self.cluster_centers_.shape[1]
+        root = ET.Element(
+            "PMML",
+            {"version": "4.3", "xmlns": "http://www.dmg.org/PMML-4_3"},
+        )
+        header = ET.SubElement(root, "Header", {"description": "k-means clustering"})
+        ET.SubElement(header, "Application", {"name": "oap-mllib-tpu"})
+        dd = ET.SubElement(root, "DataDictionary", {"numberOfFields": str(d)})
+        for j in range(d):
+            ET.SubElement(
+                dd, "DataField",
+                {"name": f"field_{j}", "optype": "continuous", "dataType": "double"},
+            )
+        cm = ET.SubElement(
+            root, "ClusteringModel",
+            {
+                "modelName": "k-means",
+                "functionName": "clustering",
+                "modelClass": "centerBased",
+                "numberOfClusters": str(self.k),
+            },
+        )
+        ms = ET.SubElement(cm, "MiningSchema")
+        for j in range(d):
+            ET.SubElement(ms, "MiningField", {"name": f"field_{j}"})
+        ET.SubElement(
+            cm, "ComparisonMeasure", {"kind": "distance"}
+        ).append(ET.Element("squaredEuclidean"))
+        for j in range(d):
+            ET.SubElement(
+                cm, "ClusteringField", {"field": f"field_{j}", "compareFunction": "absDiff"}
+            )
+        for i, center in enumerate(self.cluster_centers_):
+            cl = ET.SubElement(cm, "Cluster", {"name": f"cluster_{i}", "id": str(i)})
+            arr = ET.SubElement(cl, "Array", {"n": str(d), "type": "real"})
+            arr.text = " ".join(repr(float(v)) for v in center)
+        ET.ElementTree(root).write(path, xml_declaration=True, encoding="utf-8")
 
     # -- persistence (~ Spark ML read/write, tested in IntelKMeansSuite) -----
     def save(self, path: str) -> None:
@@ -196,7 +243,7 @@ class KMeans:
                     table.data, weights, table.n_rows, self.k, self.seed, self.init_steps
                 ).astype(dtype)
         with phase_timer(timings, "lloyd_loop"):
-            centers, n_iter, cost = kmeans_ops.lloyd_run(
+            centers, n_iter, cost, counts = kmeans_ops.lloyd_run(
                 table.data,
                 weights,
                 jnp.asarray(centers0),
@@ -207,7 +254,10 @@ class KMeans:
             centers = np.asarray(centers)
             n_iter = int(n_iter)
             cost = float(cost)
-        summary = KMeansSummary(cost, n_iter, timings, accelerated=True)
+        summary = KMeansSummary(
+            cost, n_iter, timings, accelerated=True,
+            cluster_sizes=np.asarray(counts),
+        )
         return KMeansModel(centers, self.distance_measure, summary)
 
     # -- fallback path (~ trainWithML, KMeans.scala:355) ---------------------
@@ -226,5 +276,11 @@ class KMeans:
             centers, n_iter, cost = lloyd_np(
                 x, centers0, self.max_iter, self.tol, sample_weight, self.distance_measure
             )
-        summary = KMeansSummary(cost, n_iter, timings, accelerated=False)
+        assign = predict_np(x, centers, self.distance_measure)
+        w = np.ones(len(x)) if sample_weight is None else np.asarray(sample_weight)
+        sizes = np.zeros(self.k)
+        np.add.at(sizes, assign, w)
+        summary = KMeansSummary(
+            cost, n_iter, timings, accelerated=False, cluster_sizes=sizes
+        )
         return KMeansModel(centers, self.distance_measure, summary)

@@ -218,3 +218,21 @@ class TestBlockParallel:
             u, i, r, n_users=3, n_items=3)
         assert model.user_factors_.shape == (3, 3)
         assert np.isfinite(model.user_factors_).all()
+
+
+class TestNonnegative:
+    def test_nonnegative_factors(self, rng):
+        u, i, r, nu, ni = _ratings(rng)
+        m = ALS(rank=4, max_iter=5, reg_param=0.1, nonnegative=True).fit(u, i, r)
+        assert not m.summary["accelerated"]  # NNLS runs on the fallback path
+        assert (m.user_factors_ >= 0).all()
+        assert (m.item_factors_ >= 0).all()
+        # still fits: predictions correlate with ratings
+        pred = m.predict(u, i)
+        assert np.corrcoef(pred, r)[0, 1] > 0.3
+
+    def test_nonnegative_implicit(self, rng):
+        u, i, r, nu, ni = _ratings(rng, density=0.2)
+        m = ALS(rank=4, max_iter=4, implicit_prefs=True, alpha=2.0,
+                nonnegative=True).fit(u, i, r)
+        assert (m.user_factors_ >= 0).all() and (m.item_factors_ >= 0).all()

@@ -1,0 +1,145 @@
+"""Spark-ML-style compat API tests: the builder/DataFrame surface a
+reference (Spark ML / PySpark) user migrates to — modeled on how the
+reference's suites drive estimators through the Spark API
+(IntelKMeansSuite "default params" / "fit & transform" patterns)."""
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu.compat import ALS, KMeans, PCA
+
+
+def _df(rng, n=300, d=6, k=3):
+    centers = rng.normal(size=(k, d)) * 5
+    x = centers[rng.integers(k, size=n)] + rng.normal(size=(n, d)) * 0.05
+    return {"features": x}
+
+
+class TestKMeansCompat:
+    def test_default_params(self):
+        km = KMeans()
+        assert km.getK() == 2
+        assert km.getMaxIter() == 20
+        assert km.getInitMode() == "k-means||"
+        assert km.getDistanceMeasure() == "euclidean"
+        assert km.getFeaturesCol() == "features"
+        assert km.getPredictionCol() == "prediction"
+
+    def test_builder_chain_fit_transform(self, rng):
+        df = _df(rng)
+        model = (
+            KMeans().setK(3).setMaxIter(30).setTol(1e-6).setSeed(7).fit(df)
+        )
+        assert model.clusterCenters().shape == (3, 6)
+        out = model.transform(df)
+        assert "prediction" in out and out["prediction"].shape == (300,)
+        assert "features" in out  # input column preserved
+        assert "prediction" not in df  # input not mutated
+        assert model.summary.num_iter >= 1
+
+    def test_custom_columns_and_weights(self, rng):
+        x = np.array([[0.0, 0.0], [10.0, 10.0]])
+        df = {"f": x, "w": np.array([3.0, 1.0])}
+        model = (
+            KMeans().setK(1).setMaxIter(5).setFeaturesCol("f")
+            .setWeightCol("w").setPredictionCol("cluster").fit(df)
+        )
+        np.testing.assert_allclose(model.clusterCenters()[0], [2.5, 2.5], atol=1e-4)
+        out = model.transform(df)
+        assert "cluster" in out
+
+    def test_single_vector_predict(self, rng):
+        df = _df(rng)
+        model = KMeans().setK(3).setSeed(1).fit(df)
+        p = model.predict(df["features"][0])
+        assert isinstance(p, int) and 0 <= p < 3
+
+    def test_missing_column_raises(self, rng):
+        with pytest.raises(KeyError):
+            KMeans().setFeaturesCol("nope").fit(_df(rng))
+
+    def test_save_load(self, tmp_path, rng):
+        df = _df(rng)
+        model = KMeans().setK(3).setSeed(1).fit(df)
+        model.save(str(tmp_path / "m"))
+        from oap_mllib_tpu.compat.spark import KMeansModel
+
+        loaded = KMeansModel.load(str(tmp_path / "m"))
+        np.testing.assert_array_equal(loaded.clusterCenters(), model.clusterCenters())
+
+
+class TestPCACompat:
+    def test_fit_transform(self, rng):
+        df = _df(rng, d=8)
+        model = PCA().setK(3).setOutputCol("pca").fit(df)
+        assert model.pc.shape == (8, 3)
+        assert model.explainedVariance.shape == (3,)
+        out = model.transform(df)
+        assert out["pca"].shape == (300, 3)
+
+    def test_unset_k_raises(self, rng):
+        with pytest.raises(ValueError):
+            PCA().fit(_df(rng))
+
+
+class TestALSCompat:
+    def _ratings_df(self, rng):
+        mask = rng.random((30, 20)) < 0.3
+        u, i = np.nonzero(mask)
+        return {
+            "user": u, "item": i,
+            "rating": rng.integers(1, 6, len(u)).astype(np.float32),
+        }
+
+    def test_implicit_fit_transform(self, rng):
+        df = self._ratings_df(rng)
+        model = (
+            ALS().setRank(6).setMaxIter(4).setRegParam(0.1).setAlpha(2.0)
+            .setImplicitPrefs(True).fit(df)
+        )
+        assert model.rank == 6
+        assert model.userFactors.shape[1] == 6
+        out = model.transform(df)
+        assert "prediction" in out and len(out["prediction"]) == len(df["user"])
+
+    def test_recommend_both_directions(self, rng):
+        df = self._ratings_df(rng)
+        model = ALS().setRank(4).setMaxIter(2).setImplicitPrefs(True).fit(df)
+        ru = model.recommendForAllUsers(5)
+        ri = model.recommendForAllItems(5)
+        assert ru.shape[1] == 5 and ri.shape[1] == 5
+        assert ru.max() < model.itemFactors.shape[0]
+        assert ri.max() < model.userFactors.shape[0]
+
+    def test_ndarray_input_rejected(self):
+        with pytest.raises(TypeError):
+            ALS().fit(np.zeros((3, 3)))
+
+
+class TestReviewRegressions:
+    def test_batch_predict_raises(self, rng):
+        df = _df(rng)
+        model = KMeans().setK(3).setSeed(1).fit(df)
+        with pytest.raises(TypeError):
+            model.predict(df["features"][:5])
+
+    def test_weightcol_with_ndarray_raises(self, rng):
+        with pytest.raises(ValueError):
+            KMeans().setK(2).setWeightCol("w").fit(np.zeros((10, 2)))
+
+    def test_nonnegative_builder(self, rng):
+        mask = rng.random((20, 15)) < 0.3
+        u, i = np.nonzero(mask)
+        df = {"user": u, "item": i,
+              "rating": rng.integers(1, 6, len(u)).astype(np.float32)}
+        model = ALS().setRank(3).setMaxIter(3).setNonnegative(True).fit(df)
+        assert (model.userFactors >= 0).all()
+
+    def test_nonnegative_max_iter_zero_contract(self, rng):
+        """nonnegative must hold even at max_iter=0 (abs-projected init)."""
+        from oap_mllib_tpu import ALS as CoreALS
+
+        u = np.array([0, 1]); i = np.array([0, 1])
+        r = np.array([1.0, 2.0], np.float32)
+        m = CoreALS(rank=3, max_iter=0, nonnegative=True).fit(u, i, r)
+        assert (m.user_factors_ >= 0).all() and (m.item_factors_ >= 0).all()

@@ -74,7 +74,7 @@ class TestParity:
 
         xj = jnp.asarray(x, jnp.float32)
         w = jnp.ones((len(x),), jnp.float32)
-        centers, n_iter, cost = lloyd_run(
+        centers, n_iter, cost, _ = lloyd_run(
             xj, w, jnp.asarray(init, jnp.float32), 50, jnp.asarray(1e-6, jnp.float32)
         )
         oc, ocost = _oracle_lloyd(x, init)
@@ -213,8 +213,8 @@ class TestRegressions:
         w = jnp.ones((len(x),), jnp.float32)
         cj = jnp.asarray(init, jnp.float32)
         tol = jnp.asarray(1e-6, jnp.float32)
-        c1, i1, cost1 = lloyd_run(xj, w, cj, 20, tol)
-        c2, i2, cost2 = lloyd_run(xj, w, cj, 20, tol, 8)
+        c1, i1, cost1, _ = lloyd_run(xj, w, cj, 20, tol)
+        c2, i2, cost2, _ = lloyd_run(xj, w, cj, 20, tol, 8)
         assert int(i1) == int(i2)
         np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), atol=1e-4, rtol=1e-5)
         # f32 cost sums reassociate across chunk boundaries -> ~1e-4 rel drift
@@ -238,3 +238,30 @@ class TestRegressions:
         w = jnp.ones((8,), jnp.float32)
         with pytest.raises(ValueError):
             lloyd_run(x, w, x[:2], 2, jnp.asarray(0.0, jnp.float32), 1, "Highest")
+
+    def test_cluster_sizes_in_summary(self, rng):
+        x, _, assign = _blobs(rng, n=400, k=4)
+        m = KMeans(k=4, max_iter=30, tol=1e-6, seed=7).fit(x)
+        sizes = m.summary.cluster_sizes
+        assert sizes is not None and sizes.shape == (4,)
+        assert int(sizes.sum()) == 400
+        # blob sizes recovered (order-insensitive)
+        np.testing.assert_array_equal(
+            np.sort(sizes.astype(int)), np.sort(np.bincount(assign)))
+
+    def test_pmml_export(self, tmp_path, rng):
+        import xml.etree.ElementTree as ET
+
+        x, _, _ = _blobs(rng, k=3)
+        m = KMeans(k=3, seed=1).fit(x)
+        p = str(tmp_path / "model.pmml")
+        m.to_pmml(p)
+        tree = ET.parse(p)
+        ns = {"p": "http://www.dmg.org/PMML-4_3"}
+        cm = tree.getroot().find("p:ClusteringModel", ns)
+        assert cm is not None and cm.get("numberOfClusters") == "3"
+        clusters = cm.findall("p:Cluster", ns)
+        assert len(clusters) == 3
+        arr = clusters[0].find("p:Array", ns)
+        vals = [float(v) for v in arr.text.split()]
+        np.testing.assert_allclose(vals, m.cluster_centers_[0])

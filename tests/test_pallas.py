@@ -55,7 +55,7 @@ class TestFusedLloydLoop:
         xj, wj = jnp.asarray(x), jnp.ones((n,), jnp.float32)
         cj = jnp.asarray(init)
         tol = jnp.asarray(1e-6, jnp.float32)
-        c1, i1, t1 = lloyd_run(xj, wj, cj, 25, tol)
+        c1, i1, t1, _ = lloyd_run(xj, wj, cj, 25, tol)
         c2, i2, t2 = lloyd_run_pallas(xj, wj, cj, 25, tol, interpret=True)
         assert int(i1) == int(i2)
         np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), atol=1e-3)

@@ -45,7 +45,7 @@ _SCRIPT = textwrap.dedent("""
     blobs = rng.normal(size=(4, 6)) * 5
     data = blobs[rng.integers(4, size=500)] + rng.normal(size=(500, 6)) * 0.05
     init = data[rng.choice(500, 4, replace=False)]
-    c, it, cost = lloyd_run(
+    c, it, cost, _ = lloyd_run(
         jnp.asarray(data), jnp.ones(500), jnp.asarray(init), 60,
         jnp.asarray(1e-12))
     cc = init.copy()
