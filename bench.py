@@ -1,20 +1,28 @@
 #!/usr/bin/env python
-"""Headline benchmark: K-Means iterations/second on TPU.
+"""Benchmarks: K-Means / PCA / ALS on the accelerated path.
 
-Config follows the BASELINE.md north star (K-Means iters/sec, large dense
-matrix, k=1000) scaled to one chip's HBM: 1M x 256 float32, k=1000,
-row-chunked Lloyd so the (n, k) distance matrix never materializes.
+Default (driver mode) prints ONE JSON line — the headline metric from
+BASELINE.md's north star (K-Means iters/sec, 1M x 256 f32, k=1000,
+row-chunked Lloyd so the (n, k) distance matrix never materializes):
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "iters/sec", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "iters/sec", "vs_baseline": N, ...}
 
-``vs_baseline`` is the speedup over the CPU reference path (the vanilla
-NumPy Lloyd this framework falls back to — the analog of the reference
-project's vanilla Spark MLlib baseline, whose repo publishes no numbers,
-BASELINE.md), measured live on a subsample and scaled linearly to the full
-row count.
+``python bench.py --all`` regenerates EVERY number in BASELINE.md — one
+JSON line per metric (K-Means both precision tiers, PCA 1M x 128 plus the
+largest-d single-chip proxy, ALS at MovieLens-1M scale) — the analog of
+the reference's per-phase timing printouts (PCADALImpl.cpp:71-159,
+ALSDALImpl.cpp:429-436), but recorded instead of scrolled away.
+
+K-Means/PCA lines report achieved TFLOP/s and MFU against the chip's bf16
+peak.  Timings are best-of-3: the device tunnel used in this environment
+adds run-to-run jitter of up to ~30%, and the max over repeats is the
+honest kernel speed.  ``vs_baseline`` is the speedup over this framework's
+own CPU/NumPy reference path (the vanilla-Spark-MLlib analog; the
+reference repo publishes no numbers, BASELINE.md), measured live on a
+subsample and scaled linearly to the full size.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -24,15 +32,60 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
+# bf16 peak FLOP/s by device kind (the MFU denominator)
+_PEAK = {
+    "TPU v6": 918e12,
+    "TPU v5p": 459e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+}
 
-def main():
+
+def _peak_flops():
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for key, val in _PEAK.items():
+        if kind.startswith(key):
+            return val
+    return 197e12  # conservative default
+
+
+def _best_of(fn, reps=3):
+    """Best wall time over reps (see module docstring on tunnel jitter)."""
+    fn()  # warm-up/compile of the exact timed variant
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _emit(metric, value, unit, vs_baseline, **extra):
+    line = {
+        "metric": metric,
+        "value": round(value, 4),
+        "unit": unit,
+        "vs_baseline": round(vs_baseline, 2),
+    }
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# K-Means (headline)
+# ---------------------------------------------------------------------------
+
+
+def bench_kmeans(precision="highest", cpu_ips=None):
     import jax
     import jax.numpy as jnp
 
     from oap_mllib_tpu.ops import kmeans_ops
 
     n, d, k = 1 << 20, 256, 1000
-    row_chunks = 16
     iters = 10
     rng = np.random.default_rng(0)
     # blob-ish data so assignments are non-degenerate
@@ -45,47 +98,164 @@ def main():
     wj = jnp.asarray(w)
     cj = jnp.asarray(init)
     tol = jnp.asarray(0.0, jnp.float32)  # tol=0: never converge early
+    chunks = kmeans_ops.auto_row_chunks(n, k)
+
+    def run():
+        c, it, cost, _ = kmeans_ops.lloyd_run(xj, wj, cj, iters, tol, chunks, precision)
+        # fetch centers: on remote-execution backends block_until_ready can
+        # be a no-op, so only a host transfer truly synchronizes
+        return np.asarray(c)
+
+    dt = _best_of(run)
+    iters_per_sec = iters / dt
+    flops = 2 * 2 * n * k * d  # two n*k*d matmuls per iteration
+    tflops = flops * iters_per_sec / 1e12
+
+    if cpu_ips is None:
+        # CPU reference baseline: one Lloyd pass on a subsample, scaled to n
+        sub = 1 << 14
+        from oap_mllib_tpu.fallback.kmeans_np import lloyd_np
+
+        t0 = time.perf_counter()
+        lloyd_np(x[:sub].astype(np.float64), init.astype(np.float64), 1, 0.0, w[:sub])
+        t_cpu_sub = time.perf_counter() - t0
+        cpu_ips = 1.0 / (t_cpu_sub * (n / sub))
+
+    suffix = "" if precision == "highest" else f"_{precision}"
+    _emit(
+        f"kmeans_1Mx256_k1000_iters_per_sec{suffix}",
+        iters_per_sec,
+        "iters/sec",
+        iters_per_sec / cpu_ips,
+        tflops=round(tflops, 1),
+        mfu=round(tflops * 1e12 / _peak_flops(), 3),
+        precision=precision,
+    )
+    return iters_per_sec, cpu_ips
+
+
+# ---------------------------------------------------------------------------
+# PCA
+# ---------------------------------------------------------------------------
+
+
+def bench_pca(n=1 << 20, d=128):
+    import jax
+    import jax.numpy as jnp
+
+    from oap_mllib_tpu.ops import pca_ops
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    xj = jax.device_put(jnp.asarray(x))
+    mask = jnp.ones((n,), jnp.float32)
+    n_rows = jnp.asarray(float(n), jnp.float32)
+
+    def run():
+        cov, _ = pca_ops.covariance(xj, mask, n_rows)
+        vals, _ = pca_ops.eigh_descending(cov)
+        return np.asarray(vals)  # host fetch = sync
+
+    dt = _best_of(run)
+    flops = 2 * n * d * d  # Gram matmul dominates
+    tflops = flops / dt / 1e12
+
+    # NumPy f64 covariance+eigh on a subsample, scaled linearly in n
+    sub = min(n, 1 << 16)
+    t0 = time.perf_counter()
+    xs = x[:sub].astype(np.float64)
+    mu = xs.mean(axis=0)
+    cov_np = (xs.T @ xs - sub * np.outer(mu, mu)) / (sub - 1)
+    np.linalg.eigh(cov_np)
+    t_cpu = (time.perf_counter() - t0) * (n / sub)
+
+    size = f"{n >> 20}M" if n >= (1 << 20) else f"{n >> 10}k"
+    _emit(
+        f"pca_{size}x{d}_cov_eigh_sec",
+        dt,
+        "sec",
+        t_cpu / dt,
+        tflops=round(tflops, 1),
+        mfu=round(tflops * 1e12 / _peak_flops(), 3),
+    )
+    return dt
+
+
+# ---------------------------------------------------------------------------
+# ALS
+# ---------------------------------------------------------------------------
+
+
+def bench_als():
+    """MovieLens-1M scale: 6040 users x 3706 items, 1M ratings, rank 10,
+    implicit, alpha=40 (the reference examples' DAL-path config,
+    examples/als-pyspark/als-pyspark.py:52-54)."""
+    import jax
+    import jax.numpy as jnp
+
+    from oap_mllib_tpu.fallback import als_np
+    from oap_mllib_tpu.ops import als_ops
+
+    n_users, n_items, nnz, rank = 6040, 3706, 1_000_000, 10
+    iters = 5
+    rng = np.random.default_rng(2)
+    users = rng.integers(n_users, size=nnz).astype(np.int32)
+    items = rng.integers(n_items, size=nnz).astype(np.int32)
+    ratings = (rng.random(nnz) * 4 + 1).astype(np.float32)
+    x0 = als_np.init_factors(n_users, rank, 0)
+    y0 = als_np.init_factors(n_items, rank, 1)
+
+    uj = jax.device_put(jnp.asarray(users))
+    ij = jax.device_put(jnp.asarray(items))
+    rj = jax.device_put(jnp.asarray(ratings))
+    valid = jnp.ones((nnz,), jnp.float32)
+    x0j, y0j = jnp.asarray(x0), jnp.asarray(y0)
+
+    def run():
+        x, y = als_ops.als_implicit_run(
+            uj, ij, rj, valid, x0j, y0j, n_users, n_items, iters, 0.1, 40.0
+        )
+        return np.asarray(x)
+
+    dt = _best_of(run)
+    sec_per_iter = dt / iters
+
+    # NumPy fallback: one full-size iteration (no subsample scaling — the
+    # per-user/item solve cost is independent of nnz, so scaling a
+    # subsample time would overstate the baseline)
+    t0 = time.perf_counter()
+    als_np.als_np(
+        users, items, ratings, n_users, n_items, rank,
+        max_iter=1, reg=0.1, alpha=40.0, implicit=True, seed=0, init=(x0, y0),
+    )
+    t_cpu_iter = time.perf_counter() - t0
+
+    _emit(
+        "als_ml1m_implicit_sec_per_iter",
+        sec_per_iter,
+        "sec/iter",
+        t_cpu_iter / sec_per_iter,
+    )
+    return sec_per_iter
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--all", action="store_true",
+                    help="emit every BASELINE.md metric (one JSON line each)")
+    args = ap.parse_args()
 
     from oap_mllib_tpu.config import get_config
 
     precision = get_config().matmul_precision  # env-overridable via config
-
-    def run(max_iter):
-        c, it, cost, _ = kmeans_ops.lloyd_run(
-            xj, wj, cj, max_iter, tol, row_chunks, precision
-        )
-        # fetch scalars: on remote-execution backends block_until_ready can
-        # be a no-op, so only a host transfer truly synchronizes
-        return np.asarray(c), int(it), float(cost)
-
-    # Warm up the SAME static-arg variant that gets timed: max_iter is a
-    # static jit arg, so run(1) and run(iters) are different compilations.
-    run(iters)
-    t0 = time.perf_counter()
-    _, it, cost = run(iters)
-    dt = time.perf_counter() - t0
-    iters_per_sec = it / dt
-
-    # CPU reference baseline: one Lloyd pass on a subsample, scaled to n.
-    sub = 1 << 14
-    xs, ws = x[:sub], w[:sub]
-    from oap_mllib_tpu.fallback.kmeans_np import lloyd_np
-
-    t0 = time.perf_counter()
-    lloyd_np(xs.astype(np.float64), init.astype(np.float64), 1, 0.0, ws)
-    t_cpu_sub = time.perf_counter() - t0
-    cpu_iters_per_sec = 1.0 / (t_cpu_sub * (n / sub))
-
-    print(
-        json.dumps(
-            {
-                "metric": "kmeans_1Mx256_k1000_iters_per_sec",
-                "value": round(iters_per_sec, 4),
-                "unit": "iters/sec",
-                "vs_baseline": round(iters_per_sec / cpu_iters_per_sec, 2),
-            }
-        )
-    )
+    if args.all:
+        _, cpu_ips = bench_kmeans("highest")
+        bench_kmeans("high", cpu_ips=cpu_ips)  # same CPU denominator
+        bench_pca(n=1 << 20, d=128)
+        bench_pca(n=1 << 17, d=2048)  # largest-d single-chip proxy
+        bench_als()
+    else:
+        bench_kmeans(precision)
 
 
 if __name__ == "__main__":

@@ -12,6 +12,13 @@ make -C oap_mllib_tpu/native -j4
 echo "== test suite (8-device CPU pseudo-cluster) =="
 python -m pytest tests/ -q
 
+echo "== compiled-mode TPU suite (skipped unless a TPU backend is present) =="
+if python -c "import jax, sys; sys.exit(0 if jax.default_backend() == 'tpu' else 1)" 2>/dev/null; then
+  python -m pytest tests_tpu/ -q
+else
+  echo "no TPU backend - skipping tests_tpu/"
+fi
+
 echo "== examples (CPU fallback path) =="
 bash examples/run_all.sh --device cpu
 

@@ -243,13 +243,8 @@ class KMeans:
                     table.data, weights, table.n_rows, self.k, self.seed, self.init_steps
                 ).astype(dtype)
         with phase_timer(timings, "lloyd_loop"):
-            centers, n_iter, cost, counts = kmeans_ops.lloyd_run(
-                table.data,
-                weights,
-                jnp.asarray(centers0),
-                self.max_iter,
-                jnp.asarray(self.tol, dtype),
-                precision=cfg.matmul_precision,
+            centers, n_iter, cost, counts = self._run_lloyd(
+                table, weights, centers0, dtype, cfg, jax
             )
             centers = np.asarray(centers)
             n_iter = int(n_iter)
@@ -259,6 +254,51 @@ class KMeans:
             cluster_sizes=np.asarray(counts),
         )
         return KMeansModel(centers, self.distance_measure, summary)
+
+    def _run_lloyd(self, table, weights, centers0, dtype, cfg, jax):
+        """Dispatch the hot loop to the configured kernel.
+
+        ``auto`` -> chunked XLA Lloyd (fastest measured on v5e at every
+        profiled shape, BASELINE.md kernel table); ``pallas`` -> the fused
+        single-chip kernel when its preconditions hold (TPU backend, one
+        device, f32), else the XLA path.  Chunking only applies on a single
+        device: the scan reshape conflicts with GSPMD row sharding.
+        """
+        single_device = len(jax.devices()) == 1 and jax.process_count() == 1
+        kernel = cfg.kmeans_kernel
+        if kernel not in ("auto", "xla", "pallas"):
+            raise ValueError(f"kmeans_kernel must be auto|xla|pallas, got {kernel!r}")
+        use_pallas = (
+            kernel == "pallas"
+            and jax.default_backend() == "tpu"
+            and single_device
+            and dtype == np.float32
+        )
+        if use_pallas:
+            from oap_mllib_tpu.ops.pallas.kmeans_kernel import lloyd_run_pallas
+
+            return lloyd_run_pallas(
+                table.data,
+                weights,
+                jnp.asarray(centers0),
+                self.max_iter,
+                self.tol,
+                mode=cfg.matmul_precision,
+            )
+        row_chunks = (
+            kmeans_ops.auto_row_chunks(table.n_padded, self.k)
+            if single_device
+            else 1
+        )
+        return kmeans_ops.lloyd_run(
+            table.data,
+            weights,
+            jnp.asarray(centers0),
+            self.max_iter,
+            jnp.asarray(self.tol, dtype),
+            row_chunks=row_chunks,
+            precision=cfg.matmul_precision,
+        )
 
     # -- fallback path (~ trainWithML, KMeans.scala:355) ---------------------
     def _fit_fallback(self, x: np.ndarray, sample_weight: Optional[np.ndarray]) -> KMeansModel:

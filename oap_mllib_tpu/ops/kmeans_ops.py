@@ -124,6 +124,19 @@ def _accumulate_chunked(x, weights, centers, row_chunks: int, precision: str = "
     return sums, counts, cost
 
 
+def auto_row_chunks(n: int, k: int, budget_elems: int = 1 << 25) -> int:
+    """Pick a chunk count dividing ``n`` so the live (chunk, k) distance
+    buffer stays under ``budget_elems`` (default 32M f32 = 128 MB HBM).
+
+    Single-chip sizing for ``_accumulate_chunked``; the bench shape
+    (1M x 256, k=1000) gets 32 chunks, small fits get 1 (no scan overhead).
+    """
+    chunks = 1
+    while (n // chunks) * k > budget_elems and n % (chunks * 2) == 0:
+        chunks *= 2
+    return chunks
+
+
 @functools.partial(jax.jit, static_argnames=("max_iter", "row_chunks", "precision"))
 def lloyd_run(
     x: jax.Array,
@@ -143,10 +156,10 @@ def lloyd_run(
     """
     tol_sq = tol * tol
 
-    def accum(centers):
+    def accum(centers, prec=precision):
         if row_chunks > 1:
-            return _accumulate_chunked(x, weights, centers, row_chunks, precision)
-        return _accumulate(x, weights, centers, precision)
+            return _accumulate_chunked(x, weights, centers, row_chunks, prec)
+        return _accumulate(x, weights, centers, prec)
 
     def cond(state):
         _, it, converged, _ = state
@@ -170,8 +183,11 @@ def lloyd_run(
     centers, n_iter, _, _ = lax.while_loop(cond, body, init_state)
     # cost + weighted cluster sizes w.r.t. final centers (the reference
     # reports the master-step objective for the last completed iteration,
-    # KMeansDALImpl.cpp:120-131; counts feed KMeansSummary.cluster_sizes)
-    _, counts, cost = accum(centers)
+    # KMeansDALImpl.cpp:120-131; counts feed KMeansSummary.cluster_sizes).
+    # Always at full precision: the fast tiers' distance error is amplified
+    # by cancellation when clusters are tight, and the user-facing
+    # objective must not carry it (centers themselves stay ~1e-6 accurate).
+    _, counts, cost = accum(centers, "highest")
     return centers, n_iter, cost, counts
 
 

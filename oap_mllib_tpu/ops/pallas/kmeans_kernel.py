@@ -10,6 +10,18 @@ sequential execution for safe read-modify-write accumulation (the pallas
 accumulate pattern).  HBM traffic per iteration drops from
 O(n*d + 2*n*k) to O(n*d + k*d).
 
+Precision tiers (``mode``) — Mosaic only lowers Precision.HIGHEST/DEFAULT,
+so the 3-pass tier is implemented by hand with bf16 hi/lo splits:
+
+- ``highest``: both matmuls f32 Precision.HIGHEST.  Parity default.
+- ``high``: distance cross-term via manual bf16_3x (hi@hi + hi@lo + lo@hi);
+  cluster sums via an *exact-split* trick: the unweighted one-hot is 0/1 —
+  exactly representable in bf16 — so ``one_hot.T @ (w*x)`` with (w*x)
+  split into bf16 hi+lo needs only TWO bf16 passes and is accurate to
+  ~f32.  Matches the XLA "high" (bf16_3x) tier's error envelope.
+- ``default``: distance cross-term single-pass bf16, sums still exact-split
+  (2 passes).  Assignment flips only on near-ties; sums stay ~f32-exact.
+
 Caller contract (see ``lloyd_accumulate_pallas``): rows padded to the block
 size with weight 0; k and d padded to lane multiples (128) by the wrapper —
 dummy centers get +inf-like coordinates so no row ever selects them.
@@ -24,66 +36,108 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-import numpy as np
 
 _BLOCK_ROWS = 512
 _LANE = 128
+_MODES = ("highest", "high", "default")
 
 
-def _kernel(x_ref, w_ref, c_ref, sums_ref, counts_ref, cost_ref):
-    """One grid step: process a (bn, d) row block against all k centers."""
-    # zero accumulators on the first block (sequential TPU grid)
-    @pl.when(pl.program_id(0) == 0)
-    def _init():
-        sums_ref[:] = jnp.zeros_like(sums_ref)
-        counts_ref[:] = jnp.zeros_like(counts_ref)
-        cost_ref[0, 0] = jnp.float32(0.0)
+def _split_bf16(a):
+    """f32 -> (hi, lo) bf16 pair with a ~= hi + lo."""
+    hi = a.astype(jnp.bfloat16)
+    lo = (a - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
 
-    x = x_ref[:]  # (bn, d)
-    w = w_ref[:]  # (bn, 1)
-    c = c_ref[:]  # (k, d)
 
-    # squared distances via the matmul identity (MXU)
-    x_sq = jnp.sum(x * x, axis=1, keepdims=True)  # (bn, 1)
-    c_sq = jnp.sum(c * c, axis=1)[None, :]  # (1, k)
-    cross = jax.lax.dot_general(
-        x, c,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )  # (bn, k)
-    d2 = jnp.maximum(x_sq + c_sq - 2.0 * cross, 0.0)
-
-    assign = jnp.argmin(d2, axis=1)  # (bn,)
-    min_d2 = jnp.min(d2, axis=1, keepdims=True)  # (bn, 1)
-
-    # block one-hot weighted by row weights (VPU compare against 2-D iota)
-    k = c.shape[0]
-    col_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
-    one_hot = jnp.where(col_ids == assign[:, None], w, 0.0)  # (bn, k)
-
-    # accumulate cluster sums on the MXU: (k, bn) @ (bn, d)
-    sums_ref[:] += jax.lax.dot_general(
-        one_hot, x,
-        dimension_numbers=(((0,), (0,)), ((), ())),
+def _dot_f32(a, b, dn):
+    return jax.lax.dot_general(
+        a, b, dimension_numbers=dn,
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     )
-    counts_ref[:] += jnp.sum(one_hot, axis=0, keepdims=True)  # (1, k)
-    cost_ref[0, 0] += jnp.sum(min_d2 * w)
+
+
+def _dot_bf16(a, b, dn):
+    return jax.lax.dot_general(
+        a, b, dimension_numbers=dn, preferred_element_type=jnp.float32
+    )
+
+
+def _cross_term(x, c, mode):
+    """x @ c.T (bn, k) at the requested precision tier."""
+    dn = (((1,), (1,)), ((), ()))
+    if mode == "highest":
+        return _dot_f32(x, c, dn)
+    if mode == "high":  # manual bf16_3x
+        x_hi, x_lo = _split_bf16(x)
+        c_hi, c_lo = _split_bf16(c)
+        return (
+            _dot_bf16(x_hi, c_hi, dn)
+            + _dot_bf16(x_hi, c_lo, dn)
+            + _dot_bf16(x_lo, c_hi, dn)
+        )
+    # default: single-pass bf16 — argmin only flips on near-ties
+    return _dot_bf16(x.astype(jnp.bfloat16), c.astype(jnp.bfloat16), dn)
+
+
+def _cluster_sums(one_hot01, wx, mode):
+    """one_hot.T @ (w*x) (k, d).  one_hot is exactly 0/1 in bf16, so the
+    split tiers lose nothing on it; wx is hi/lo-split for ~f32 accuracy."""
+    dn = (((0,), (0,)), ((), ()))
+    if mode == "highest":
+        return _dot_f32(one_hot01, wx, dn)
+    oh = one_hot01.astype(jnp.bfloat16)  # exact
+    wx_hi, wx_lo = _split_bf16(wx)
+    return _dot_bf16(oh, wx_hi, dn) + _dot_bf16(oh, wx_lo, dn)
+
+
+def _make_kernel(mode):
+    def _kernel(x_ref, w_ref, c_ref, sums_ref, counts_ref, cost_ref):
+        """One grid step: process a (bn, d) row block against all k centers."""
+        # zero accumulators on the first block (sequential TPU grid)
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            sums_ref[:] = jnp.zeros_like(sums_ref)
+            counts_ref[:] = jnp.zeros_like(counts_ref)
+            cost_ref[0, 0] = jnp.float32(0.0)
+
+        x = x_ref[:]  # (bn, d)
+        w = w_ref[:]  # (bn, 1)
+        c = c_ref[:]  # (k, d)
+
+        # squared distances via the matmul identity (MXU)
+        x_sq = jnp.sum(x * x, axis=1, keepdims=True)  # (bn, 1)
+        c_sq = jnp.sum(c * c, axis=1)[None, :]  # (1, k)
+        cross = _cross_term(x, c, mode)  # (bn, k)  <- MXU
+        d2 = jnp.maximum(x_sq + c_sq - 2.0 * cross, 0.0)
+
+        assign = jnp.argmin(d2, axis=1)  # (bn,)
+        min_d2 = jnp.min(d2, axis=1, keepdims=True)  # (bn, 1)
+
+        # unweighted 0/1 one-hot (VPU compare against 2-D iota); weights fold
+        # into w*x so the one-hot stays exactly representable in bf16
+        k = c.shape[0]
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
+        one_hot = jnp.where(col_ids == assign[:, None], 1.0, 0.0)  # (bn, k)
+
+        sums_ref[:] += _cluster_sums(one_hot, w * x, mode)
+        counts_ref[:] += jnp.sum(one_hot * w, axis=0, keepdims=True)  # (1, k)
+        cost_ref[0, 0] += jnp.sum(min_d2 * w)
+
+    return _kernel
 
 
 def _pad_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _call(x, w, centers, interpret=False):
+@functools.partial(jax.jit, static_argnames=("mode", "interpret"))
+def _call(x, w, centers, mode="highest", interpret=False):
     n, d = x.shape
     k = centers.shape[0]
     grid = (n // _BLOCK_ROWS,)
     sums, counts, cost = pl.pallas_call(
-        _kernel,
+        _make_kernel(mode),
         grid=grid,
         in_specs=[
             pl.BlockSpec((_BLOCK_ROWS, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -105,10 +159,16 @@ def _call(x, w, centers, interpret=False):
     return sums, counts, cost
 
 
+def _check_mode(mode: str) -> None:
+    if mode not in _MODES:
+        raise ValueError(f"mode must be one of {_MODES}, got {mode!r}")
+
+
 def lloyd_accumulate_pallas(
     x: jax.Array,
     weights: jax.Array,
     centers: jax.Array,
+    mode: str = "highest",
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Drop-in replacement for ops.kmeans_ops._accumulate (f32 only).
@@ -117,12 +177,20 @@ def lloyd_accumulate_pallas(
     centers are placed at 1e15 so no real row selects them; their
     counts/sums come back zero and are sliced off.
     """
+    _check_mode(mode)
+    n, d = x.shape
+    k = centers.shape[0]
+    x_p, w_p, c_p = _pad_operands(x, weights, centers)
+    sums, counts, cost = _call(x_p, w_p, c_p, mode=mode, interpret=interpret)
+    return sums[:k, :d], counts[0, :k], cost[0, 0]
+
+
+def _pad_operands(x, weights, centers):
     n, d = x.shape
     k = centers.shape[0]
     n_pad = _pad_to(max(n, _BLOCK_ROWS), _BLOCK_ROWS)
     d_pad = _pad_to(d, _LANE)
     k_pad = _pad_to(k, _LANE)
-
     x_p = jnp.zeros((n_pad, d_pad), jnp.float32).at[:n, :d].set(x.astype(jnp.float32))
     w_p = jnp.zeros((n_pad, 1), jnp.float32).at[:n, 0].set(weights.astype(jnp.float32))
     c_p = jnp.full((k_pad, d_pad), 1e15, jnp.float32).at[:k, :d].set(
@@ -130,13 +198,11 @@ def lloyd_accumulate_pallas(
     )
     # dummy feature columns of real centers must be 0 (match padded x cols)
     c_p = c_p.at[:k, d:].set(0.0)
-
-    sums, counts, cost = _call(x_p, w_p, c_p, interpret=interpret)
-    return sums[:k, :d], counts[0, :k], cost[0, 0]
+    return x_p, w_p, c_p
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "interpret"))
-def _lloyd_loop_padded(x_p, w_p, c_p, max_iter, tol, interpret=False):
+@functools.partial(jax.jit, static_argnames=("max_iter", "mode", "interpret"))
+def _lloyd_loop_padded(x_p, w_p, c_p, max_iter, tol, mode="highest", interpret=False):
     """while_loop over the fused kernel on pre-padded operands."""
     tol_sq = tol * tol
 
@@ -146,7 +212,7 @@ def _lloyd_loop_padded(x_p, w_p, c_p, max_iter, tol, interpret=False):
 
     def body(state):
         centers, it, _, _ = state
-        sums, counts, cost = _call(x_p, w_p, centers, interpret=interpret)
+        sums, counts, cost = _call(x_p, w_p, centers, mode=mode, interpret=interpret)
         counts_col = counts[0][:, None]  # (k_pad, 1)
         new_centers = jnp.where(
             counts_col > 0, sums / jnp.maximum(counts_col, 1e-30), centers
@@ -157,25 +223,23 @@ def _lloyd_loop_padded(x_p, w_p, c_p, max_iter, tol, interpret=False):
 
     state = (c_p, jnp.asarray(0, jnp.int32), jnp.asarray(False), jnp.float32(0))
     centers, n_iter, _, _ = jax.lax.while_loop(cond, body, state)
-    _, _, cost = _call(x_p, w_p, centers, interpret=interpret)
-    return centers, n_iter, cost[0, 0]
+    # final cost + counts w.r.t. the returned centers, always at full
+    # precision — the user-facing objective should not carry the fast
+    # tiers' distance error
+    _, counts, cost = _call(x_p, w_p, centers, mode="highest", interpret=interpret)
+    return centers, n_iter, cost[0, 0], counts[0]
 
 
-def lloyd_run_pallas(x, weights, init_centers, max_iter, tol, interpret=False):
+def lloyd_run_pallas(x, weights, init_centers, max_iter, tol,
+                     mode: str = "highest", interpret: bool = False):
     """Fused-kernel Lloyd loop; same contract as ops.kmeans_ops.lloyd_run
-    (f32). Pads once outside the loop, slices the result back."""
-    n, d = x.shape
+    (f32, adds per-cluster counts). Pads once outside the loop, slices the
+    result back."""
+    _check_mode(mode)
+    d = x.shape[1]
     k = init_centers.shape[0]
-    n_pad = _pad_to(max(n, _BLOCK_ROWS), _BLOCK_ROWS)
-    d_pad = _pad_to(d, _LANE)
-    k_pad = _pad_to(k, _LANE)
-    x_p = jnp.zeros((n_pad, d_pad), jnp.float32).at[:n, :d].set(x.astype(jnp.float32))
-    w_p = jnp.zeros((n_pad, 1), jnp.float32).at[:n, 0].set(weights.astype(jnp.float32))
-    c_p = jnp.full((k_pad, d_pad), 1e15, jnp.float32).at[:k, :d].set(
-        init_centers.astype(jnp.float32)
+    x_p, w_p, c_p = _pad_operands(x, weights, init_centers)
+    centers, n_iter, cost, counts = _lloyd_loop_padded(
+        x_p, w_p, c_p, max_iter, jnp.asarray(tol, jnp.float32), mode, interpret
     )
-    c_p = c_p.at[:k, d:].set(0.0)
-    centers, n_iter, cost = _lloyd_loop_padded(
-        x_p, w_p, c_p, max_iter, jnp.asarray(tol, jnp.float32), interpret
-    )
-    return centers[:k, :d], n_iter, cost
+    return centers[:k, :d], n_iter, cost, counts[:k]

@@ -1,5 +1,9 @@
-"""Pallas fused-kernel tests (interpret mode on the CPU pseudo-cluster;
-the same kernels were validated on real TPU hardware against the XLA path)."""
+"""Pallas fused-kernel tests (interpret mode on the CPU pseudo-cluster).
+
+Compiled-mode (non-interpret) coverage on real TPU hardware lives in
+``tests_tpu/`` — run by dev/ci.sh whenever a TPU backend is present — so a
+Mosaic lowering regression cannot ship green on the CPU suite alone.
+"""
 
 import numpy as np
 import pytest
@@ -35,6 +39,28 @@ class TestFusedAccumulate:
         np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), atol=1e-4)
         np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), atol=1e-5)
 
+    @pytest.mark.parametrize("mode", ["high", "default"])
+    def test_fast_tiers_close(self, rng, mode):
+        """bf16 tiers: sums stay ~f32-exact via the hi/lo split (the one-hot
+        is exactly representable), distances may flip near-ties only."""
+        n, d, k = 640, 24, 9
+        x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        w = jnp.asarray((rng.random(n) + 0.5).astype(np.float32))
+        c = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32))
+        s1, c1, t1 = _accumulate(x, w, c)
+        s2, c2, t2 = lloyd_accumulate_pallas(x, w, c, mode=mode, interpret=True)
+        # well-separated random clusters: assignments identical, sums ~exact
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), atol=1e-3)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), atol=5e-3)
+        np.testing.assert_allclose(float(t1), float(t2), rtol=1e-3)
+
+    def test_bad_mode_raises(self, rng):
+        x = jnp.zeros((8, 4), jnp.float32)
+        w = jnp.ones((8,), jnp.float32)
+        c = jnp.zeros((2, 4), jnp.float32)
+        with pytest.raises(ValueError, match="mode"):
+            lloyd_accumulate_pallas(x, w, c, mode="fast", interpret=True)
+
     def test_unaligned_shapes_padded(self, rng):
         """n, k, d all unaligned to blocks/lanes: padding must be invisible."""
         n, d, k = 333, 5, 3
@@ -55,8 +81,9 @@ class TestFusedLloydLoop:
         xj, wj = jnp.asarray(x), jnp.ones((n,), jnp.float32)
         cj = jnp.asarray(init)
         tol = jnp.asarray(1e-6, jnp.float32)
-        c1, i1, t1, _ = lloyd_run(xj, wj, cj, 25, tol)
-        c2, i2, t2 = lloyd_run_pallas(xj, wj, cj, 25, tol, interpret=True)
+        c1, i1, t1, n1 = lloyd_run(xj, wj, cj, 25, tol)
+        c2, i2, t2, n2 = lloyd_run_pallas(xj, wj, cj, 25, tol, interpret=True)
         assert int(i1) == int(i2)
         np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), atol=1e-3)
         np.testing.assert_allclose(float(t1), float(t2), rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(n1), np.asarray(n2), atol=1e-5)

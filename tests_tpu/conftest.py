@@ -1,0 +1,24 @@
+"""Real-hardware suite: compiled (non-interpret) kernels on an actual TPU.
+
+Unlike ``tests/`` (which pins JAX to the 8-device virtual CPU pseudo-cluster),
+this suite uses whatever backend the session has.  Every test is skipped
+unless that backend is a TPU — dev/ci.sh invokes it only when one is present,
+so a Mosaic lowering or precision regression cannot ship green.
+"""
+
+import numpy as np
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    import jax
+
+    if jax.default_backend() != "tpu":
+        skip = pytest.mark.skip(reason="requires a real TPU backend")
+        for item in items:
+            item.add_marker(skip)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
