@@ -27,6 +27,9 @@ import numpy as np
 
 from oap_mllib_tpu.parallel.mesh import data_sharding, pad_rows
 
+# rows are padded per shard to this multiple (cheap: padding is masked)
+_ROW_MULTIPLE = 256
+
 
 @dataclasses.dataclass
 class DenseTable:
@@ -40,6 +43,12 @@ class DenseTable:
     data: jax.Array
     mask: jax.Array
     n_rows: int  # valid rows
+    # multi-host bookkeeping (None for single-process tables): this
+    # process's valid-row count, and every process's valid-row counts —
+    # recorded so per-row vectors (sample weights) can be aligned to the
+    # per-process padding layout and valid-row indices mapped into it
+    local_valid: Optional[int] = None
+    per_process_valid: Optional[np.ndarray] = None
 
     @property
     def n_padded(self) -> int:
@@ -56,9 +65,12 @@ class DenseTable:
             raise ValueError(f"expected 2-D data, got shape {x.shape}")
         if dtype is not None:
             x = x.astype(dtype)
-        # pad so every data-axis shard has equal rows
+        # pad so every data-axis shard has equal rows AND the row count has
+        # power-of-two chunk factors (the chunked Lloyd needs a divisor;
+        # an odd row count would silently lose chunking and rematerialize
+        # the (n, k) buffer chunking exists to avoid)
         n_data = mesh.shape[mesh.axis_names[0]]
-        padded, n_valid = pad_rows(x, n_data)
+        padded, n_valid = pad_rows(x, n_data * _ROW_MULTIPLE)
         mask = np.zeros((padded.shape[0],), dtype=padded.dtype)
         mask[:n_valid] = 1.0
         sharding2 = data_sharding(mesh, 2)
@@ -95,7 +107,7 @@ class DenseTable:
         from oap_mllib_tpu.parallel.mesh import data_sharding
 
         local_devices = max(1, n_data // n_proc)
-        padded, n_valid_local = pad_rows(x_local, local_devices)
+        padded, n_valid_local = pad_rows(x_local, local_devices * _ROW_MULTIPLE)
         mask_local = np.zeros((padded.shape[0],), dtype=padded.dtype)
         mask_local[:n_valid_local] = 1.0
         data = jax.make_array_from_process_local_data(
@@ -108,10 +120,63 @@ class DenseTable:
         # (summing the f32 mask on device loses integers past 2^24)
         from jax.experimental import multihost_utils
 
-        n_rows = int(
-            multihost_utils.process_allgather(np.int64(n_valid_local)).sum()
+        counts = np.asarray(
+            multihost_utils.process_allgather(np.int64(n_valid_local))
+        ).reshape(-1)
+        return cls(
+            data=data,
+            mask=mask,
+            n_rows=int(counts.sum()),
+            local_valid=n_valid_local,
+            per_process_valid=counts,
         )
-        return cls(data=data, mask=mask, n_rows=n_rows)
+
+    def valid_to_padded(self, idx):
+        """Map valid-row indices [0, n_rows) to padded-layout row indices.
+
+        Single-process tables store valid rows contiguously (identity).
+        Multi-host tables pad per process, so zero rows sit mid-array —
+        sampling initial centers by global valid index must skip them
+        (otherwise an all-zero padding row can become a centroid).
+        """
+        idx = np.asarray(idx)
+        if self.per_process_valid is None:
+            return idx
+        local_padded = self.n_padded // len(self.per_process_valid)
+        prefix = np.concatenate([[0], np.cumsum(self.per_process_valid)])
+        proc = np.searchsorted(prefix, idx, side="right") - 1
+        return proc * local_padded + (idx - prefix[proc])
+
+    def align_weights(self, w: np.ndarray, mesh) -> jax.Array:
+        """Per-row weights aligned to this table's padding layout.
+
+        Single-process tables: ``w`` covers all ``n_rows`` valid rows and is
+        padded with zeros to ``n_padded``.  Multi-host tables (built by
+        ``from_process_local``): ``w`` is this process's LOCAL weights — the
+        per-process zero padding sits in the middle of the global array, so
+        weights must be stitched collectively with the same layout as the
+        mask (they cannot be placed from a global vector).
+        """
+        w = np.asarray(w, dtype=np.dtype(self.mask.dtype))
+        if self.local_valid is None:
+            if w.shape[0] != self.n_rows:
+                raise ValueError(
+                    f"sample_weight has {w.shape[0]} rows, data has {self.n_rows}"
+                )
+            padded = np.zeros((self.n_padded,), dtype=w.dtype)
+            padded[: self.n_rows] = w
+            return jax.device_put(padded, data_sharding(mesh, 1))
+        if w.shape[0] != self.local_valid:
+            raise ValueError(
+                f"sample_weight has {w.shape[0]} rows, this process's local "
+                f"shard has {self.local_valid}"
+            )
+        local_padded = self.n_padded // jax.process_count()
+        padded = np.zeros((local_padded,), dtype=w.dtype)
+        padded[: self.local_valid] = w
+        return jax.make_array_from_process_local_data(
+            data_sharding(mesh, 1), padded
+        )
 
     def to_numpy(self) -> np.ndarray:
         """Gather valid rows back to host (reverse data plane,

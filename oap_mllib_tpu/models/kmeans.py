@@ -19,6 +19,7 @@ import json
 import os
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -206,16 +207,14 @@ class KMeans:
 
     # -- accelerated path (~ KMeansDALImpl.train, KMeansDALImpl.scala:35) ----
     def _fit_tpu(self, x: np.ndarray, sample_weight: Optional[np.ndarray]) -> KMeansModel:
-        import jax
-
         from oap_mllib_tpu.utils.timing import x64_scope
 
         cfg = get_config()
         dtype = np.float64 if cfg.enable_x64 else np.float32
         with x64_scope(cfg.enable_x64):
-            return self._fit_tpu_inner(x, sample_weight, dtype, jax)
+            return self._fit_tpu_inner(x, sample_weight, dtype)
 
-    def _fit_tpu_inner(self, x, sample_weight, dtype, jax) -> KMeansModel:
+    def _fit_tpu_inner(self, x, sample_weight, dtype) -> KMeansModel:
         cfg = get_config()
         timings = Timings()
         mesh = get_mesh()
@@ -230,21 +229,23 @@ class KMeans:
             table = make(x.astype(dtype), mesh)
             weights = table.mask
             if sample_weight is not None:
-                w = np.zeros((table.n_padded,), dtype=dtype)
-                w[: table.n_rows] = np.asarray(sample_weight, dtype=dtype)
-                weights = jnp.asarray(w)
+                # collective path: multi-host shards pad per process, so the
+                # weights must be stitched with the mask's exact layout
+                weights = table.align_weights(sample_weight, mesh)
         with phase_timer(timings, "init_centers"):
             if self.init_mode == INIT_RANDOM:
                 centers0 = kmeans_ops.init_random(
-                    table.data, table.n_rows, self.k, self.seed
+                    table.data, table.n_rows, self.k, self.seed,
+                    index_map=table.valid_to_padded,
                 ).astype(dtype)
             else:
                 centers0 = kmeans_ops.init_kmeans_parallel(
-                    table.data, weights, table.n_rows, self.k, self.seed, self.init_steps
+                    table.data, weights, table.n_rows, self.k, self.seed,
+                    self.init_steps, index_map=table.valid_to_padded,
                 ).astype(dtype)
         with phase_timer(timings, "lloyd_loop"):
             centers, n_iter, cost, counts = self._run_lloyd(
-                table, weights, centers0, dtype, cfg, jax
+                table, weights, centers0, dtype, cfg
             )
             centers = np.asarray(centers)
             n_iter = int(n_iter)
@@ -255,7 +256,7 @@ class KMeans:
         )
         return KMeansModel(centers, self.distance_measure, summary)
 
-    def _run_lloyd(self, table, weights, centers0, dtype, cfg, jax):
+    def _run_lloyd(self, table, weights, centers0, dtype, cfg):
         """Dispatch the hot loop to the configured kernel.
 
         ``auto`` -> chunked XLA Lloyd (fastest measured on v5e at every

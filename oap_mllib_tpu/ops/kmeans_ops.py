@@ -212,17 +212,128 @@ def min_sq_dists(x: jax.Array, centers: jax.Array) -> jax.Array:
 # centers (survey §7.3).
 
 
-def init_random(x, n_valid: int, k: int, seed: int) -> np.ndarray:
+def _to_host(a) -> np.ndarray:
+    """Fetch a (possibly multi-host sharded) array to host.
+
+    An unconstrained jit output on a multi-process mesh may come back
+    sharded (not fully addressable), in which case np.asarray would raise —
+    re-run it through an identity jit with an explicitly replicated output
+    first (every process executes the same fetch collectively).
+    """
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        a = jax.jit(
+            lambda v: v,
+            out_shardings=NamedSharding(a.sharding.mesh, PartitionSpec()),
+        )(a)
+    return np.asarray(a)
+
+
+def _gather_rows(x, idx: np.ndarray) -> np.ndarray:
+    """Fetch x[idx] to host; collective for multi-host global arrays.
+
+    A multi-host sharded jax.Array is not fully addressable, so plain
+    indexing cannot run on one host — every process executes the same
+    jitted gather with a replicated output instead (all processes call
+    init with the same seed, so the gathers agree).
+    """
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = x.sharding.mesh
+        gathered = jax.jit(
+            lambda a, i: a[i],
+            out_shardings=NamedSharding(mesh, PartitionSpec()),
+        )(x, jnp.asarray(idx))
+        return np.asarray(gathered)
+    return np.asarray(x[idx])
+
+
+def init_random(
+    x, n_valid: int, k: int, seed: int, index_map=None
+) -> np.ndarray:
     """Sample k distinct valid rows uniformly (Spark's initRandom analog).
 
     ``x`` may be a (sharded) jax.Array or ndarray; only the k selected rows
-    are gathered/transferred, never the full table.
+    are gathered/transferred, never the full table.  ``index_map`` converts
+    valid-row indices to padded-layout indices (DenseTable.valid_to_padded)
+    — without it, multi-host tables would sample mid-array padding rows.
     """
     rng = np.random.default_rng(seed)
     idx = rng.choice(n_valid, size=min(k, n_valid), replace=False)
     if len(idx) < k:  # fewer points than clusters: duplicate (degenerate case)
         idx = np.resize(idx, k)
-    return np.asarray(x[idx])
+    if index_map is not None:
+        idx = index_map(idx)
+    return _gather_rows(x, idx)
+
+
+def _slot_chunk_size(cap: int, target: int = 1024) -> int:
+    """Largest divisor of ``cap`` that is <= target (slot-chunking the
+    min-distance update bounds the live (n, chunk) buffer)."""
+    best = 1
+    for c in range(1, cap + 1):
+        if cap % c == 0 and c <= target:
+            best = c
+    return best
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "chunk"))
+def _pll_round(x, w, dmin, amin, base_id, key, l, cap, chunk):
+    """One k-means|| sampling round, entirely on device.
+
+    Samples each row with probability min(l * cost / phi, 1) (Bahmani
+    oversampling; padded rows have w=0 so cost=0 and are never picked),
+    scatters the picked rows into a fixed ``cap``-slot buffer via their
+    picked-prefix position (overflow beyond cap is dropped — cap is 2x the
+    expected pick count), then folds the new slots into the running
+    (min-distance, nearest-candidate) state chunk-by-chunk so no (n, cap)
+    buffer ever materializes.  All reductions/scatters are global: under a
+    row-sharded mesh GSPMD lowers them to psums, so the round is
+    multi-host-safe with zero O(n) host transfers (round-1 pulled all n
+    distances AND weights to host each round).
+
+    Returns (slots, slot_valid, new_dmin, new_amin, phi).
+    """
+    cost = dmin * w
+    phi = jnp.sum(cost)
+    prob = jnp.minimum(l * cost / jnp.maximum(phi, 1e-30), 1.0)
+    draws = jax.random.uniform(key, dmin.shape, dtype=dmin.dtype)
+    picked = draws < prob
+    pos = jnp.cumsum(picked.astype(jnp.int32)) - 1  # global prefix position
+    slot_of = jnp.where(picked, pos, cap)  # cap = out-of-bounds -> dropped
+    slots = jnp.zeros((cap, x.shape[1]), x.dtype).at[slot_of].add(
+        x * picked[:, None].astype(x.dtype), mode="drop"
+    )
+    slot_valid = jnp.zeros((cap,), x.dtype).at[slot_of].add(
+        picked.astype(x.dtype), mode="drop"
+    )
+
+    # fold new candidates into (dmin, amin) without an (n, cap) buffer
+    q = cap // chunk
+    slots_c = slots.reshape(q, chunk, x.shape[1])
+    valid_c = slot_valid.reshape(q, chunk)
+    bases = base_id + chunk * jnp.arange(q, dtype=jnp.int32)
+
+    def fold(carry, sl):
+        dm, am = carry
+        s, v, b = sl
+        d2 = pairwise_sq_dists(x, s)
+        d2 = jnp.where(v[None, :] > 0, d2, jnp.inf)
+        cm = jnp.min(d2, axis=1)
+        ca = jnp.argmin(d2, axis=1).astype(jnp.int32) + b
+        better = cm < dm
+        return (jnp.where(better, cm, dm), jnp.where(better, ca, am)), None
+
+    (dmin, amin), _ = lax.scan(fold, (dmin, amin), (slots_c, valid_c, bases))
+    return slots, slot_valid, dmin, amin, phi
+
+
+@functools.partial(jax.jit, static_argnames=("n_cand",))
+def _candidate_weights(w, amin, n_cand: int):
+    """Total row weight owned by each candidate (global segment-sum)."""
+    return jnp.zeros((n_cand,), w.dtype).at[amin].add(w)
 
 
 def init_kmeans_parallel(
@@ -232,49 +343,74 @@ def init_kmeans_parallel(
     k: int,
     seed: int,
     init_steps: int = 2,
+    index_map=None,
 ) -> np.ndarray:
     """k-means|| (Bahmani et al.) with oversampling l = 2k, Spark defaults.
 
-    The candidate set grows dynamically, which XLA cannot express with
-    static shapes — so the round structure runs on host while each round's
-    O(n * |C|) distance pass is the jitted device kernel.  The final
-    weighted reduction of <= 1 + 2k*steps candidates runs as host-side
-    k-means++ (Spark runs the same reduction on the driver,
-    mllib/clustering/KMeans.scala initKMeansParallel).
+    Device-side redesign (round-1 round-tripped all n distances + weights
+    to host per round): the candidate set lives in a static-shape device
+    buffer (1 + 4k*steps slots — 2x the expected 2k picks per round, so
+    overflow-dropping is vanishingly rare), per-round sampling/prefix
+    -scatter/min-fold run in one jitted program, and only the <=1+4k*steps
+    candidates plus their ownership weights are fetched for the host-side
+    weighted k-means++ reduction (Spark runs the same reduction on the
+    driver, mllib/clustering/KMeans.scala initKMeansParallel).  Every
+    device op is GSPMD-global, so the same code serves multi-host meshes.
     """
     rng = np.random.default_rng(seed)
-    # pick the first center uniformly among valid rows
-    first = int(rng.integers(n_valid))
-    centers = np.asarray(x_dev[first])[None, :]
+    n, d = x_dev.shape
 
-    l = 2.0 * k  # Spark's oversampling factor
+    # first center: uniform valid row (index_map: valid -> padded layout)
+    first = np.asarray([rng.integers(n_valid)])
+    if index_map is not None:
+        first = np.asarray(index_map(first))
+    c0 = _gather_rows(x_dev, first)  # (1, d)
 
-    for _ in range(init_steps):
-        d2 = np.asarray(min_sq_dists(x_dev, jnp.asarray(centers)))
-        w = np.asarray(weights_dev)
-        d2 = d2 * w  # padded rows have weight 0 -> never sampled
-        phi = float(d2.sum())
-        if phi <= 0.0:
+    l = jnp.asarray(2.0 * k, jnp.float32)  # Spark's oversampling factor
+    cap = 4 * k  # per-round slot buffer
+    chunk = _slot_chunk_size(cap)
+    key = jax.random.PRNGKey(seed)
+
+    # running state: distances/assignments vs candidate 0
+    d2_0 = pairwise_sq_dists(x_dev, jnp.asarray(c0))[:, 0]
+    dmin = d2_0
+    amin = jnp.zeros((n,), jnp.int32)
+
+    all_slots = [np.asarray(c0)]
+    all_valid = [np.ones((1,), np.float32)]
+    base = 1
+    for step in range(init_steps):
+        slots, slot_valid, dmin, amin, phi = _pll_round(
+            x_dev, weights_dev, dmin, amin,
+            jnp.asarray(base, jnp.int32),
+            jax.random.fold_in(key, step), l, cap, chunk,
+        )
+        if float(phi) <= 0.0:
             break
-        prob = np.minimum(l * d2 / phi, 1.0)
-        draws = rng.random(d2.shape[0])
-        picked = np.nonzero(draws < prob)[0]
-        picked = picked[picked < n_valid]
-        if picked.size:
-            centers = np.concatenate([centers, np.asarray(x_dev[picked])], axis=0)
+        # small host fetch, re-replicated if GSPMD left the output sharded
+        all_slots.append(_to_host(slots))
+        all_valid.append(_to_host(slot_valid))
+        base += cap
 
-    if centers.shape[0] <= k:
+    cand = np.concatenate(all_slots, axis=0)
+    valid = np.concatenate(all_valid, axis=0) > 0
+    cand_w = _to_host(_candidate_weights(weights_dev, amin, base))[: len(cand)]
+    cand, cand_w = cand[valid], cand_w[valid]
+
+    if cand.shape[0] <= k:
         # not enough candidates: top up with random rows
-        extra = init_random(x_dev, n_valid, k - centers.shape[0] + 1, seed + 1)
-        centers = np.concatenate([centers, extra], axis=0)[: max(k, 1)]
-        return centers[:k] if centers.shape[0] >= k else np.resize(centers, (k, centers.shape[1]))
+        extra = init_random(
+            x_dev, n_valid, k - cand.shape[0] + 1, seed + 1, index_map
+        )
+        cand = np.concatenate([cand, extra], axis=0)[: max(k, 1)]
+        return (
+            cand[:k]
+            if cand.shape[0] >= k
+            else np.resize(cand, (k, cand.shape[1]))
+        )
 
-    # weight candidates by how many points they own, then k-means++ reduce
-    assign = np.asarray(assign_clusters(x_dev, jnp.asarray(centers)))
-    w = np.asarray(weights_dev)
-    cand_w = np.zeros(centers.shape[0])
-    np.add.at(cand_w, assign, w)
-    return _weighted_kmeans_pp(centers, cand_w, k, rng)
+    # weight candidates by how much row weight they own, k-means++ reduce
+    return _weighted_kmeans_pp(cand, cand_w, k, rng)
 
 
 def _weighted_kmeans_pp(points: np.ndarray, weights: np.ndarray, k: int, rng) -> np.ndarray:

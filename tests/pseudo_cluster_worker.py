@@ -1,0 +1,81 @@
+"""Worker process for the 2-process pseudo-cluster test.
+
+Each worker is one rank of a real ``jax.distributed`` world over
+127.0.0.1 — the analog of one Spark executor in the reference's only
+multi-rank test, the 2-executor pseudo-YARN cluster
+(reference dev/ci-test.sh:60-62, dev/test-cluster/setup-cluster.sh).
+
+Invoked as:  python pseudo_cluster_worker.py RANK NPROC COORD LOCAL_DEVICES
+
+Prints one JSON line of results for the parent test to compare against
+the single-process oracle.
+"""
+
+import json
+import sys
+
+rank, nproc = int(sys.argv[1]), int(sys.argv[2])
+coord, local_dev = sys.argv[3], int(sys.argv[4])
+
+import jax
+
+# must run before any backend initializes (env vars alone are ignored when
+# a site hook pins the platform — see tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", local_dev)
+
+import numpy as np
+
+from oap_mllib_tpu.parallel import bootstrap
+
+ran = bootstrap.initialize_distributed(coord, nproc, rank)
+assert ran, "initialize_distributed returned False for a multi-process world"
+assert jax.process_count() == nproc, jax.process_count()
+assert len(jax.devices()) == nproc * local_dev, len(jax.devices())
+
+from oap_mllib_tpu.models.kmeans import KMeans
+from oap_mllib_tpu.models.pca import PCA
+
+# deterministic global dataset; each rank holds only its half (the
+# "no host ever holding the full table" contract, data/table.py)
+rng = np.random.default_rng(123)
+proto = rng.normal(size=(5, 12)).astype(np.float32) * 3.0
+x = (proto[rng.integers(5, size=4000)]
+     + rng.normal(size=(4000, 12)).astype(np.float32) * 0.25)
+half = x[rank * 2000 : (rank + 1) * 2000]
+
+# default init = k-means||: the device-side rounds must run multi-host
+# (round 1 crashed here — host indexing on a non-addressable array)
+m = KMeans(k=5, seed=7, max_iter=30).fit(half)
+assert m.summary.accelerated
+
+# weighted fit exercises the collective sample_weight path
+w_local = np.ones((2000,), np.float32)
+w_local[:100] = 2.5
+mw = KMeans(k=5, seed=7, init_mode="random", max_iter=10).fit(
+    half, sample_weight=w_local
+)
+
+# UNEVEN shards: rank 0 holds 1999 valid rows (padded to 2000 mid-array),
+# rank 1 holds 2000 — random init must never sample the padding row and
+# must reach every valid row (valid->padded index mapping)
+uneven = x[:1999] if rank == 0 else x[1999:3999]
+mu = KMeans(k=5, seed=11, init_mode="random", max_iter=15).fit(uneven)
+
+p = PCA(k=4).fit(half)
+
+print(
+    "RESULT "
+    + json.dumps(
+        {
+            "rank": rank,
+            "kmeans_cost": float(m.summary.training_cost),
+            "kmeans_iters": int(m.summary.num_iter),
+            "weighted_cost": float(mw.summary.training_cost),
+            "uneven_cost": float(mu.summary.training_cost),
+            "pca_var": np.asarray(p.explained_variance_).tolist(),
+            "pca_pc0_abs": np.abs(np.asarray(p.components_)[:, 0]).tolist(),
+        }
+    ),
+    flush=True,
+)
