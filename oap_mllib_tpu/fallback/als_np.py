@@ -64,7 +64,7 @@ def _solve_side(
     nonnegative: bool = False,
 ) -> np.ndarray:
     out = np.zeros((dst_n, rank), dtype=np.float32)
-    eye = np.eye(rank, dtype=np.float64) * reg
+    eye = np.eye(rank, dtype=np.float64)
     gram = src_factors.astype(np.float64).T @ src_factors.astype(np.float64) if implicit else None
     order = np.argsort(dst_idx, kind="stable")
     dst_sorted = dst_idx[order]
@@ -75,11 +75,22 @@ def _solve_side(
             continue
         ys = src_factors[src_idx[sel]].astype(np.float64)  # (m, r)
         rs = ratings[sel].astype(np.float64)  # (m,)
+        # Spark parity (reference ALS.scala:1781-1795): implicit uses
+        # c1 = alpha*|r| for A (PSD even for non-positive ratings), adds b
+        # only for r > 0, and ALS-WR scales lambda by the per-row rating
+        # count (numExplicits * regParam) — r > 0 count for implicit,
+        # all-ratings count for explicit
         if implicit:
-            a = gram + ys.T @ (ys * (alpha * rs)[:, None]) + eye
-            b = ((1.0 + alpha * rs)[:, None] * ys).sum(axis=0)
+            c1 = alpha * np.abs(rs)
+            pos = rs > 0
+            n_reg = float(pos.sum())
+            a = gram + ys.T @ (ys * c1[:, None]) + reg * n_reg * eye
+            b = ((1.0 + c1)[:, None] * ys)[pos].sum(axis=0)
+            if n_reg == 0.0:
+                continue  # no positive ratings: zero factors (b == 0)
         else:
-            a = ys.T @ ys + eye
+            n_reg = float(len(sel))
+            a = ys.T @ ys + reg * n_reg * eye
             b = (rs[:, None] * ys).sum(axis=0)
         if nonnegative:
             out[u] = _nnls_spd(a, b).astype(np.float32)

@@ -130,6 +130,18 @@ class ALS:
         n_items: Optional[int] = None,
         init: Optional[tuple] = None,
     ) -> ALSModel:
+        """Fit factors from (user, item, rating) triples.
+
+        Regularization follows Spark's ALS-WR convention (reference
+        ALS.scala:1794-1795): lambda is scaled by each row's rating count
+        — r>0 count for implicit (whose confidence weights also follow
+        Spark: alpha*|r| in A, b only for r>0), all ratings for explicit.
+
+        Multi-host: when ``jax.process_count() > 1`` the triples are this
+        process's LOCAL shard (the per-rank partitions of the reference's
+        shuffle, ALSDALImpl.scala:95-109); n_users/n_items are resolved
+        globally via allgathered maxima when not passed.
+        """
         users = np.asarray(users, dtype=np.int64)
         items = np.asarray(items, dtype=np.int64)
         ratings = np.asarray(ratings, dtype=np.float32)
@@ -139,6 +151,22 @@ class ALS:
             raise ValueError("empty ratings")
         if users.min() < 0 or items.min() < 0:
             raise ValueError("ids must be non-negative")
+        import jax as _jax
+
+        if _jax.process_count() > 1:
+            # global id space = allgathered max (the reference computes
+            # nUsers/nItems via RDD max jobs, ALSDALImpl.scala:62-70)
+            from jax.experimental import multihost_utils
+
+            maxes = np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray([users.max(), items.max()], np.int64)
+                )
+            ).reshape(-1, 2)
+            if n_users is None:
+                n_users = int(maxes[:, 0].max()) + 1
+            if n_items is None:
+                n_items = int(maxes[:, 1].max()) + 1
         if n_users is None:
             n_users = int(users.max()) + 1
         elif int(users.max()) >= n_users:
@@ -185,18 +213,25 @@ class ALS:
 
         mesh = get_mesh()
         world = mesh.shape[mesh.axis_names[0]]
-        if self.implicit_prefs and world > 1:
-            # distributed 2-D block layout: ratings shuffled by user block,
-            # X block-sharded, Y replicated (~ the reference's full
-            # cShuffleData + 4-step pipeline, survey §3.3)
+        if world > 1 or jax.process_count() > 1:
+            # distributed 2-D block layout for BOTH modes: ratings shuffled
+            # by user block, X block-sharded, Y replicated (~ the
+            # reference's full cShuffleData + 4-step pipeline, survey §3.3;
+            # round 1 left explicit ALS on the unsharded global program)
             return self._fit_block_parallel(
                 users, items, ratings, n_users, n_items, x0, y0, mesh, timings
             )
         with phase_timer(timings, "table_convert"):
-            u = jnp.asarray(users.astype(np.int32))
-            i = jnp.asarray(items.astype(np.int32))
-            c = jnp.asarray(ratings)
-            valid = jnp.ones_like(c)
+            # pad edges so the chunked normal-equation scan always has a
+            # power-of-two chunk factor (padded edges carry valid=0)
+            nnz = len(users)
+            pad = (-nnz) % 2048
+            u = jnp.asarray(np.pad(users, (0, pad)).astype(np.int32))
+            i = jnp.asarray(np.pad(items, (0, pad)).astype(np.int32))
+            c = jnp.asarray(np.pad(ratings, (0, pad)))
+            valid = jnp.asarray(
+                np.pad(np.ones(nnz, np.float32), (0, pad))
+            )
         from oap_mllib_tpu.utils.profiling import maybe_trace
 
         with phase_timer(timings, "als_iterations"), maybe_trace():

@@ -38,10 +38,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 from oap_mllib_tpu.config import get_config
 # shared normal-equation math — the block path only inserts psums between
 # partials and solve, so the two paths cannot diverge in the weighting
-from oap_mllib_tpu.ops.als_ops import implicit_partials, masked_solve
+from oap_mllib_tpu.ops.als_ops import masked_solve, normal_eq_partials
 
 
-def als_implicit_block(
+def als_block_run(
     u_local: jax.Array,  # (world * epr,) int32, LOCAL user ids, block-sharded
     i_global: jax.Array,  # (world * epr,) int32 global item ids
     conf: jax.Array,
@@ -52,11 +52,15 @@ def als_implicit_block(
     reg: float,
     alpha: float,
     mesh: Mesh,
+    implicit: bool = True,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Run block-parallel implicit ALS over the mesh; returns (X, Y).
+    """Run block-parallel ALS (implicit or explicit) over the mesh.
 
-    Shapes: every rank holds ``epr`` edges and ``upb`` user rows (padded —
-    the shuffle guarantees equal shapes; invalid edges carry valid=0).
+    Returns (X, Y).  Shapes: every rank holds ``epr`` edges and ``upb``
+    user rows (padded — the shuffle guarantees equal shapes; invalid edges
+    carry valid=0).  The explicit mode drops the Gram term and uses rating
+    b-weights; both modes apply ALS-WR lambda scaling (Spark parity,
+    reference ALS.scala:1794-1795) via the shared normal_eq_partials.
     """
     cfg = get_config()
     axis = cfg.data_axis
@@ -70,23 +74,30 @@ def als_implicit_block(
         def body(carry, _):
             x_blk, y = carry
             # ---- user update: fully local (reference step3/4Local) ----
-            gram_y = jnp.matmul(y.T, y, precision=lax.Precision.HIGHEST)
-            a_u, b_u, deg_u = implicit_partials(u_loc, i_glob, cf, vl, y, upb, alpha)
-            a_u = gram_y[None] + a_u + reg * eye[None]
-            x_blk = masked_solve(a_u, b_u, deg_u).astype(y.dtype)
+            a_u, b_u, n_u = normal_eq_partials(
+                u_loc, i_glob, cf, vl, y, upb, alpha, implicit
+            )
+            a_u = a_u + reg * n_u[:, None, None] * eye[None]
+            if implicit:
+                gram_y = jnp.matmul(y.T, y, precision=lax.Precision.HIGHEST)
+                a_u = gram_y[None] + a_u
+            x_blk = masked_solve(a_u, b_u, n_u).astype(y.dtype)
             # ---- item update: partials + ONE psum (replaces the
             #      gather/step2Master/bcast/all2all chain) ----
-            gram_x = lax.psum(
-                jnp.matmul(x_blk.T, x_blk, precision=lax.Precision.HIGHEST), axis
-            )
-            a_i, b_i, deg_i = implicit_partials(
-                i_glob, u_loc, cf, vl, x_blk, n_items, alpha
+            a_i, b_i, n_i = normal_eq_partials(
+                i_glob, u_loc, cf, vl, x_blk, n_items, alpha, implicit
             )
             a_i = lax.psum(a_i, axis)
             b_i = lax.psum(b_i, axis)
-            deg_i = lax.psum(deg_i, axis)
-            a_i = gram_x[None] + a_i + reg * eye[None]
-            y = masked_solve(a_i, b_i, deg_i).astype(y.dtype)
+            n_i = lax.psum(n_i, axis)
+            a_i = a_i + reg * n_i[:, None, None] * eye[None]
+            if implicit:
+                gram_x = lax.psum(
+                    jnp.matmul(x_blk.T, x_blk, precision=lax.Precision.HIGHEST),
+                    axis,
+                )
+                a_i = gram_x[None] + a_i
+            y = masked_solve(a_i, b_i, n_i).astype(y.dtype)
             return (x_blk, y), None
 
         (x_blk, y), _ = lax.scan(body, (x_blk, y), None, length=max_iter)
@@ -104,6 +115,15 @@ def als_implicit_block(
         )
     )
     return fn(u_local, i_global, conf, valid, x0, y0)
+
+
+def als_implicit_block(u_local, i_global, conf, valid, x0, y0,
+                       max_iter, reg, alpha, mesh):
+    """Back-compat wrapper: implicit-mode als_block_run."""
+    return als_block_run(
+        u_local, i_global, conf, valid, x0, y0, max_iter, reg, alpha, mesh,
+        implicit=True,
+    )
 
 
 def prepare_block_inputs(

@@ -39,7 +39,21 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def implicit_partials(
+def _edge_chunks(nnz: int, r: int, budget_elems: int = 1 << 24) -> int:
+    """Chunk count for the (chunk, r, r) per-edge outer-product buffer.
+
+    Power-of-two divisors of nnz so the live intermediate stays under
+    ``budget_elems`` (peak memory O(chunk * r^2 + n_dst * r^2) instead of
+    O(nnz * r^2) — at MovieLens-25M scale the unchunked buffer would blow
+    HBM).  Callers pad nnz to a power-of-two-friendly multiple.
+    """
+    chunks = 1
+    while (nnz // chunks) * r * r > budget_elems and nnz % (chunks * 2) == 0:
+        chunks *= 2
+    return chunks
+
+
+def normal_eq_partials(
     dst_idx: jax.Array,  # (nnz,) int32 — side being solved (e.g. users)
     src_idx: jax.Array,  # (nnz,) int32 — fixed side (e.g. items)
     conf: jax.Array,  # (nnz,) f32 ratings/confidences
@@ -47,31 +61,79 @@ def implicit_partials(
     src_factors: jax.Array,  # (n_src, r)
     n_dst: int,
     alpha: float,
+    implicit: bool,
 ):
-    """Per-edge implicit normal-equation partials grouped by dst id.
+    """Per-edge normal-equation partials grouped by dst id — Spark parity.
 
-    Returns (a_part (n_dst, r, r), b (n_dst, r), deg (n_dst,)).  Shared by
-    the global-program path (this file) and the block-parallel path
+    Implicit (reference ALS.scala:1781-1795): with c1 = alpha * |r|,
+    A += c1 * y y^T for EVERY rating (|r| keeps A PSD for non-positive
+    ratings), b += (1 + c1) * y only when r > 0 (preference 0 otherwise),
+    and the regularization count n_reg counts only r > 0 ratings.
+    Explicit: A += y y^T, b += r * y, n_reg counts all ratings.  The
+    returned n_reg feeds both ALS-WR lambda scaling (Spark scales reg by
+    the per-row rating count: solve(ne, numExplicits * regParam)) and the
+    empty-row factor masking.
+
+    Returns (a_part (n_dst, r, r), b (n_dst, r), n_reg (n_dst,)).  Shared
+    by the global-program path (this file) and the block-parallel path
     (als_block.py, which psums these across the mesh) so the two can never
-    diverge in the weighting math.
+    diverge in the weighting math.  Edge-chunked via lax.scan so the
+    (chunk, r, r) outer-product intermediate never scales with nnz.
     """
-    ys = src_factors[src_idx]  # (nnz, r) gather
-    w = alpha * conf * valid  # (nnz,)
-    # A contributions: sum_e w_e * y_e y_e^T, grouped by dst id
-    outer = jnp.einsum("er,es->ers", ys * w[:, None], ys,
-                       precision=lax.Precision.HIGHEST)  # (nnz, r, r)
-    a_part = jax.ops.segment_sum(outer, dst_idx, num_segments=n_dst)
-    # b contributions: sum_e (1 + alpha c_e) y_e
-    b_w = (1.0 + alpha * conf) * valid
-    b = jax.ops.segment_sum(ys * b_w[:, None], dst_idx, num_segments=n_dst)
-    deg = jax.ops.segment_sum(valid, dst_idx, num_segments=n_dst)
-    return a_part, b, deg
+    nnz = dst_idx.shape[0]
+    r = src_factors.shape[1]
+    chunks = _edge_chunks(nnz, r)
+
+    def partial_chunk(dst_c, src_c, conf_c, valid_c):
+        ys = src_factors[src_c]  # (cs, r) gather
+        if implicit:
+            a_w = alpha * jnp.abs(conf_c) * valid_c
+            pos = (conf_c > 0).astype(conf_c.dtype) * valid_c
+            b_w = (1.0 + alpha * jnp.abs(conf_c)) * pos
+            n_w = pos
+        else:
+            a_w = valid_c
+            b_w = conf_c * valid_c
+            n_w = valid_c
+        outer = jnp.einsum("er,es->ers", ys * a_w[:, None], ys,
+                           precision=lax.Precision.HIGHEST)  # (cs, r, r)
+        a_c = jax.ops.segment_sum(outer, dst_c, num_segments=n_dst)
+        b_c = jax.ops.segment_sum(ys * b_w[:, None], dst_c, num_segments=n_dst)
+        n_c = jax.ops.segment_sum(n_w, dst_c, num_segments=n_dst)
+        return a_c, b_c, n_c
+
+    if chunks == 1:
+        return partial_chunk(dst_idx, src_idx, conf, valid)
+
+    cs = nnz // chunks
+    def step(carry, chunk):
+        a0, b0, n0 = carry
+        a_c, b_c, n_c = partial_chunk(*chunk)
+        return (a0 + a_c, b0 + b_c, n0 + n_c), None
+
+    zero = (
+        jnp.zeros((n_dst, r, r), src_factors.dtype),
+        jnp.zeros((n_dst, r), src_factors.dtype),
+        jnp.zeros((n_dst,), src_factors.dtype),
+    )
+    chunked = tuple(
+        a.reshape(chunks, cs) for a in (dst_idx, src_idx, conf, valid)
+    )
+    (a_part, b, n_reg), _ = lax.scan(step, zero, chunked)
+    return a_part, b, n_reg
+
+
+def implicit_partials(dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha):
+    """Back-compat wrapper: implicit-mode normal_eq_partials."""
+    return normal_eq_partials(
+        dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha, True
+    )
 
 
 def masked_solve(a: jax.Array, b: jax.Array, deg: jax.Array) -> jax.Array:
-    """Batched SPD solve; rows with no ratings get zero factors
-    (fallback-path semantics) — also shields against NaN from a singular A
-    when reg == 0."""
+    """Batched SPD solve; rows with no (reg-counted) ratings get zero
+    factors (fallback-path semantics) — also shields against NaN from a
+    singular A when reg == 0."""
     factors = jnp.linalg.solve(a, b[:, :, None])[:, :, 0]
     return jnp.where(deg[:, None] > 0, jnp.nan_to_num(factors), 0.0)
 
@@ -89,12 +151,13 @@ def _half_update(
     """Solve one side's factors given the other side's. Returns (n_dst, r)."""
     r = src_factors.shape[1]
     gram = jnp.matmul(src_factors.T, src_factors, precision=lax.Precision.HIGHEST)  # (r, r) <- MXU, psum over mesh
-    a_part, b, deg = implicit_partials(
-        dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha
+    a_part, b, n_reg = normal_eq_partials(
+        dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha, True
     )
     eye = jnp.eye(r, dtype=src_factors.dtype)
-    a = gram[None, :, :] + a_part + reg * eye[None, :, :]
-    return masked_solve(a, b, deg).astype(src_factors.dtype)
+    # ALS-WR: lambda scaled by the per-row rating count (Spark parity)
+    a = gram[None, :, :] + a_part + reg * n_reg[:, None, None] * eye[None, :, :]
+    return masked_solve(a, b, n_reg).astype(src_factors.dtype)
 
 
 @functools.partial(
@@ -146,20 +209,13 @@ def als_explicit_run(
 
     def half(dst_idx, src_idx, src_factors, n_dst):
         r = src_factors.shape[1]
-        ys = src_factors[src_idx]
-        w = valid
-        outer = jnp.einsum("er,es->ers", ys * w[:, None], ys,
-                           precision=lax.Precision.HIGHEST)
-        a_part = jax.ops.segment_sum(outer, dst_idx, num_segments=n_dst)
-        b = jax.ops.segment_sum(ys * (rating * w)[:, None], dst_idx, num_segments=n_dst)
+        a_part, b, n_reg = normal_eq_partials(
+            dst_idx, src_idx, rating, valid, src_factors, n_dst, 0.0, False
+        )
         eye = jnp.eye(r, dtype=src_factors.dtype)
-        a = a_part + reg * eye[None, :, :]
-        sol = jnp.linalg.solve(a, b[:, :, None])[:, :, 0]
-        # rows with no ratings (or singular A at reg == 0) -> zero factors,
-        # matching the NumPy fallback's skip-empty-row semantics
-        deg = jax.ops.segment_sum(valid, dst_idx, num_segments=n_dst)
-        sol = jnp.where(deg[:, None] > 0, jnp.nan_to_num(sol), 0.0)
-        return sol.astype(src_factors.dtype)
+        # ALS-WR lambda scaling (Spark parity)
+        a = a_part + reg * n_reg[:, None, None] * eye[None, :, :]
+        return masked_solve(a, b, n_reg).astype(src_factors.dtype)
 
     def body(carry, _):
         x, y = carry
