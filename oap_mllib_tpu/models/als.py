@@ -278,9 +278,10 @@ class ALS:
         from oap_mllib_tpu.utils.profiling import maybe_trace
 
         with phase_timer(timings, "als_iterations"), maybe_trace():
-            x_blocks, y = als_block.als_implicit_block(
+            x_blocks, y = als_block.als_block_run(
                 u_loc, i_glob, conf, valid, x0_dev, y0_dev,
                 self.max_iter, self.reg_param, self.alpha, mesh,
+                implicit=self.implicit_prefs,
             )
             xb = np.asarray(x_blocks)
             y = np.asarray(y)

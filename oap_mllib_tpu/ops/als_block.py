@@ -52,7 +52,8 @@ def als_block_run(
     reg: float,
     alpha: float,
     mesh: Mesh,
-    implicit: bool = True,
+    *,
+    implicit: bool,
 ) -> Tuple[jax.Array, jax.Array]:
     """Run block-parallel ALS (implicit or explicit) over the mesh.
 
@@ -115,15 +116,6 @@ def als_block_run(
         )
     )
     return fn(u_local, i_global, conf, valid, x0, y0)
-
-
-def als_implicit_block(u_local, i_global, conf, valid, x0, y0,
-                       max_iter, reg, alpha, mesh):
-    """Back-compat wrapper: implicit-mode als_block_run."""
-    return als_block_run(
-        u_local, i_global, conf, valid, x0, y0, max_iter, reg, alpha, mesh,
-        implicit=True,
-    )
 
 
 def prepare_block_inputs(

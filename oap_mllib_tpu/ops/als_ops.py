@@ -123,13 +123,6 @@ def normal_eq_partials(
     return a_part, b, n_reg
 
 
-def implicit_partials(dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha):
-    """Back-compat wrapper: implicit-mode normal_eq_partials."""
-    return normal_eq_partials(
-        dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha, True
-    )
-
-
 def masked_solve(a: jax.Array, b: jax.Array, deg: jax.Array) -> jax.Array:
     """Batched SPD solve; rows with no (reg-counted) ratings get zero
     factors (fallback-path semantics) — also shields against NaN from a

@@ -23,7 +23,14 @@ def _ratings(rng, n_users=40, n_items=30, density=0.3):
 
 
 def _oracle_half(dst_n, dst_idx, src_idx, rating, src, reg, alpha, implicit):
-    """Independent per-row normal-equation solve (test-local oracle)."""
+    """Independent per-row normal-equation solve (test-local oracle).
+
+    Spark ALS-WR convention (reference spark-3.1.1/ml/recommendation/
+    ALS.scala:1781-1795): lambda is scaled by the per-row rating count
+    (r>0 count for implicit, all ratings for explicit); implicit uses
+    c1 = alpha*|r| in A for every rating and adds b only when r > 0.
+    Rows with no reg-counted ratings get zero factors.
+    """
     rank = src.shape[1]
     out = np.zeros((dst_n, rank))
     gram = src.T @ src
@@ -32,11 +39,19 @@ def _oracle_half(dst_n, dst_idx, src_idx, rating, src, reg, alpha, implicit):
         ys = src[src_idx[sel]]
         rs = rating[sel].astype(np.float64)
         if implicit:
-            a = gram + ys.T @ (ys * (alpha * rs)[:, None]) + reg * np.eye(rank)
-            b = ((1 + alpha * rs)[:, None] * ys).sum(0) if len(rs) else np.zeros(rank)
+            c1 = alpha * np.abs(rs)
+            pos = rs > 0
+            n_reg = float(pos.sum())
+            if n_reg == 0.0:
+                continue
+            a = gram + ys.T @ (ys * c1[:, None]) + reg * n_reg * np.eye(rank)
+            b = ((1.0 + c1)[:, None] * ys)[pos].sum(0)
         else:
-            a = ys.T @ ys + reg * np.eye(rank)
-            b = (rs[:, None] * ys).sum(0) if len(rs) else np.zeros(rank)
+            n_reg = float(len(rs))
+            if n_reg == 0.0:
+                continue
+            a = ys.T @ ys + reg * n_reg * np.eye(rank)
+            b = (rs[:, None] * ys).sum(0)
         out[d] = np.linalg.solve(a, b)
     return out
 
@@ -92,6 +107,25 @@ class TestParity:
         pred = model.predict(u, i)
         rmse = np.sqrt(np.mean((pred - r) ** 2))
         assert rmse < 0.1 * np.std(r)
+
+    def test_implicit_nonpositive_ratings_match_oracle(self, rng):
+        """Zero/negative ratings exercise the Spark nonpositive-rating
+        semantics: c1 = alpha*|r| keeps A PSD, b/n_reg count only r > 0
+        (reference ALS.scala:1781-1795)."""
+        u, i, r, nu, ni = _ratings(rng, n_users=30, n_items=20)
+        signs = rng.choice([-1.0, 0.0, 1.0], size=len(r), p=[0.2, 0.1, 0.7])
+        r = (r * signs).astype(np.float32)
+        rank, iters, reg, alpha = 5, 3, 0.1, 0.8
+        x0 = init_factors(nu, rank, 1)
+        y0 = init_factors(ni, rank, 2)
+        model = ALS(
+            rank=rank, max_iter=iters, reg_param=reg, alpha=alpha,
+            implicit_prefs=True,
+        ).fit(u, i, r, n_users=nu, n_items=ni, init=(x0, y0))
+        assert model.summary["accelerated"]
+        ox, oy = _oracle_als(u, i, r, nu, ni, rank, iters, reg, alpha, True, x0, y0)
+        np.testing.assert_allclose(model.user_factors_, ox, atol=2e-3, rtol=2e-3)
+        np.testing.assert_allclose(model.item_factors_, oy, atol=2e-3, rtol=2e-3)
 
     def test_implicit_preference_ordering(self, rng):
         """Implicit model scores observed items above unobserved ones
@@ -175,17 +209,18 @@ class TestBlockParallel:
     """The distributed 2-D block path (shuffle + shard_map) must agree with
     the single-program path and the NumPy oracle. Runs 8-way SPMD."""
 
-    def test_block_path_used_and_matches_oracle(self, rng):
+    @pytest.mark.parametrize("implicit", [True, False])
+    def test_block_path_used_and_matches_oracle(self, rng, implicit):
         u, i, r, nu, ni = _ratings(rng, n_users=50, n_items=30)
         rank, iters, reg, alpha = 5, 3, 0.1, 1.5
         x0 = init_factors(nu, rank, 1)
         y0 = init_factors(ni, rank, 2)
         model = ALS(
             rank=rank, max_iter=iters, reg_param=reg, alpha=alpha,
-            implicit_prefs=True,
+            implicit_prefs=implicit,
         ).fit(u, i, r, n_users=nu, n_items=ni, init=(x0, y0))
         assert model.summary.get("block_parallel"), "block path not taken on multi-device mesh"
-        ox, oy = _oracle_als(u, i, r, nu, ni, rank, iters, reg, alpha, True, x0, y0)
+        ox, oy = _oracle_als(u, i, r, nu, ni, rank, iters, reg, alpha, implicit, x0, y0)
         np.testing.assert_allclose(model.user_factors_, ox, atol=2e-3, rtol=2e-3)
         np.testing.assert_allclose(model.item_factors_, oy, atol=2e-3, rtol=2e-3)
 
