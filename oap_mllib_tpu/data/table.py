@@ -108,6 +108,29 @@ class DenseTable:
 
         local_devices = max(1, n_data // n_proc)
         padded, n_valid_local = pad_rows(x_local, local_devices * _ROW_MULTIPLE)
+        # Per-process shards pad independently, so valid-row counts landing
+        # in different padding buckets (e.g. 100 vs 1100 rows) would yield
+        # UNEQUAL local shapes — breaking both the global-shape inference of
+        # make_array_from_process_local_data and the n_padded // nproc
+        # layout math in valid_to_padded/align_weights.  Allgather the
+        # actual padded sizes (alongside the exact valid counts — summing
+        # the f32 mask on device loses integers past 2^24) and re-pad every
+        # shard to the common max.
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray([n_valid_local, padded.shape[0]], np.int64)
+            )
+        ).reshape(-1, 2)
+        counts = gathered[:, 0]
+        target = int(gathered[:, 1].max())
+        if padded.shape[0] < target:
+            padded = np.concatenate(
+                [padded,
+                 np.zeros((target - padded.shape[0], padded.shape[1]),
+                          padded.dtype)]
+            )
         mask_local = np.zeros((padded.shape[0],), dtype=padded.dtype)
         mask_local[:n_valid_local] = 1.0
         data = jax.make_array_from_process_local_data(
@@ -116,13 +139,6 @@ class DenseTable:
         mask = jax.make_array_from_process_local_data(
             data_sharding(mesh, 1), mask_local
         )
-        # global valid count: exact int allgather of per-process counts
-        # (summing the f32 mask on device loses integers past 2^24)
-        from jax.experimental import multihost_utils
-
-        counts = np.asarray(
-            multihost_utils.process_allgather(np.int64(n_valid_local))
-        ).reshape(-1)
         return cls(
             data=data,
             mask=mask,

@@ -20,14 +20,47 @@ from typing import Tuple
 import numpy as np
 
 
-def init_factors(n: int, rank: int, seed: int) -> np.ndarray:
-    """Spark-style init (ALS.initialize): signed gaussian rows, each
-    normalized to unit L2 norm.  (All-positive init is a trap: it sits in
-    a positive-orthant local minimum for signed low-rank data.)"""
-    rng = np.random.default_rng(seed)
-    f = rng.normal(size=(n, rank)).astype(np.float32)
+_U64 = np.uint64
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 hash on uint64 arrays (wraps mod 2^64)."""
+    x = (x + _U64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> _U64(30))) * _U64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> _U64(27))) * _U64(0x94D049BB133111EB)
+    return x ^ (x >> _U64(31))
+
+
+def init_factors_rows(lo: int, hi: int, rank: int, seed: int) -> np.ndarray:
+    """Rows [lo, hi) of the deterministic factor init, position-addressable.
+
+    Counter-based (splitmix64 per element + Box-Muller), so a process can
+    generate ONLY its block's rows and get bit-identical values to the
+    global ``init_factors`` — the sharded multi-host ALS init never
+    materializes (n_users, rank) on any host (the per-rank init the
+    reference gets from per-rank seed offsets, ALSDALImpl.cpp:165-169,
+    but reproducible across world sizes).  Rows are signed gaussian,
+    normalized to unit L2 norm (Spark ALS.initialize style; all-positive
+    init is a trap — it sits in a positive-orthant local minimum for
+    signed low-rank data).
+    """
+    rows = np.arange(lo, hi, dtype=np.uint64)[:, None]
+    cols = np.arange(rank, dtype=np.uint64)[None, :]
+    idx = rows * _U64(rank) + cols
+    base = _splitmix64(np.uint64(np.int64(seed)).reshape(1, 1))
+    h1 = _splitmix64(idx ^ base)
+    h2 = _splitmix64(h1)
+    # 53-bit mantissa uniforms in (0, 1]; Box-Muller to gaussians
+    u1 = ((h1 >> _U64(11)).astype(np.float64) + 1.0) * (2.0 ** -53)
+    u2 = (h2 >> _U64(11)).astype(np.float64) * (2.0 ** -53)
+    f = np.sqrt(-2.0 * np.log(u1)) * np.cos(2.0 * np.pi * u2)
     norms = np.linalg.norm(f, axis=1, keepdims=True)
     return (f / np.maximum(norms, 1e-12)).astype(np.float32)
+
+
+def init_factors(n: int, rank: int, seed: int) -> np.ndarray:
+    """Deterministic factor init for rows [0, n) — see init_factors_rows."""
+    return init_factors_rows(0, n, rank, seed)
 
 
 def _nnls_spd(a: np.ndarray, b: np.ndarray) -> np.ndarray:

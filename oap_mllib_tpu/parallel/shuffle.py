@@ -81,7 +81,7 @@ def _local_bucket_build(users, items, ratings, kpb, world, local_sources):
     return buckets, out_counts
 
 
-def _pack_records(u, i, r, valid_count, cap):
+def _pack_records(u, i, r, cap):
     """(cap, 4) int32 records: user, item, rating bits, valid flag."""
     rec = np.zeros((cap, 4), np.int32)
     c = len(u)
@@ -148,7 +148,7 @@ def exchange_ratings(
     # pack this process's buckets: (local_sources * world * max_bucket, 4)
     local_rec = np.concatenate(
         [
-            _pack_records(*buckets[s][b], counts_local[s, b], max_bucket)
+            _pack_records(*buckets[s][b], max_bucket)
             for s in range(local_sources)
             for b in range(world)
         ],
@@ -169,9 +169,17 @@ def exchange_ratings(
     # device-side compaction: rank b's true edge count is sum_s counts[s,b];
     # keep valid-first rows so padded memory is O(max block nnz)
     per_block = counts.sum(axis=0)
-    cap = int(np.max(per_block))
-    cap = max(_EDGE_MULTIPLE, -(-cap // _EDGE_MULTIPLE) * _EDGE_MULTIPLE)
-    cap = min(cap, world * max_bucket)
+    per_block_max = int(np.max(per_block))
+    cap = max(_EDGE_MULTIPLE, -(-per_block_max // _EDGE_MULTIPLE) * _EDGE_MULTIPLE)
+    total = world * max_bucket
+    if total < cap:
+        # can't take more rows than physically exist; keep the
+        # _EDGE_MULTIPLE alignment (power-of-two chunk factors for the
+        # normal-equation scan) by rounding the physical size down to the
+        # multiple — unless that would drop valid edges, in which case
+        # alignment yields to correctness
+        aligned_total = (total // _EDGE_MULTIPLE) * _EDGE_MULTIPLE
+        cap = aligned_total if aligned_total >= per_block_max else total
 
     def compact(rows):  # (world * max_bucket, 4) per rank
         order = jnp.argsort(1 - rows[:, 3], stable=True)
