@@ -31,15 +31,62 @@ from oap_mllib_tpu.utils.timing import Timings, phase_timer
 
 
 class ALSModel:
-    def __init__(self, user_factors: np.ndarray, item_factors: np.ndarray,
-                 summary: Optional[dict] = None):
-        self.user_factors_ = np.asarray(user_factors)
+    """Trained ALS factors.
+
+    User factors may be held as rank-local device shards (block-sharded
+    over the mesh with per-block offsets — the ALSResult.cUserOffset
+    bookkeeping of the reference, ALSDALImpl.cpp:529-575) and are only
+    gathered to host on first access of ``user_factors_``.  In a
+    multi-process world that gather is a COLLECTIVE: every process must
+    touch ``user_factors_`` (or predict/save) together, mirroring how the
+    reference reassembles factor RDDs with a cluster-wide job
+    (ALSDALImpl.scala:124-164).
+    """
+
+    def __init__(self, user_factors: Optional[np.ndarray],
+                 item_factors: np.ndarray,
+                 summary: Optional[dict] = None, *,
+                 sharded_user: Optional[tuple] = None):
+        if (user_factors is None) == (sharded_user is None):
+            raise ValueError("pass exactly one of user_factors / sharded_user")
+        self._user_factors = (
+            None if user_factors is None else np.asarray(user_factors)
+        )
+        # (x_blocks jax.Array (world*upb, r) block-sharded, offsets, upb)
+        self._sharded_user = sharded_user
         self.item_factors_ = np.asarray(item_factors)
         self.summary = summary or {}
 
     @property
+    def user_factors_(self) -> np.ndarray:
+        if self._user_factors is None:
+            self._user_factors = self._gather_user_factors()
+        return self._user_factors
+
+    def _gather_user_factors(self) -> np.ndarray:
+        """On-demand gather of the block-sharded user factors (collective
+        when the blocks span processes)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        xb, offsets, upb = self._sharded_user
+        if not xb.is_fully_addressable:
+            mesh = xb.sharding.mesh
+            xb = jax.jit(
+                lambda a: a, out_shardings=NamedSharding(mesh, P())
+            )(xb)
+        xb = np.asarray(xb)
+        rank = xb.shape[1]
+        n = int(offsets[-1])
+        x = np.zeros((n, rank), np.float32)
+        for b in range(len(offsets) - 1):
+            lo, hi = int(offsets[b]), int(offsets[b + 1])
+            x[lo:hi] = xb[b * upb : b * upb + (hi - lo)]
+        return x
+
+    @property
     def rank(self) -> int:
-        return self.user_factors_.shape[1]
+        return self.item_factors_.shape[1]
 
     def predict(self, users: np.ndarray, items: np.ndarray) -> np.ndarray:
         """Predicted preference/rating for (user, item) pairs
@@ -189,14 +236,19 @@ class ALS:
         if init is not None:
             x0, y0 = np.array(init[0], np.float32), np.array(init[1], np.float32)
         else:
-            x0 = als_np.init_factors(n_users, self.rank, self.seed)
-            y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
-        if self.nonnegative:
-            # the nonnegative contract must hold even at max_iter=0 or with
-            # a user-supplied signed init
-            x0, y0 = np.abs(x0), np.abs(y0)
+            # deferred: the block-parallel path inits its user blocks
+            # per-process (counter-based init_factors_rows) so no host
+            # ever materializes (n_users, rank)
+            x0 = y0 = None
 
         if not accelerated:
+            if x0 is None:
+                x0 = als_np.init_factors(n_users, self.rank, self.seed)
+                y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
+            if self.nonnegative:
+                # the nonnegative contract must hold even at max_iter=0 or
+                # with a user-supplied signed init
+                x0, y0 = np.abs(x0), np.abs(y0)
             with phase_timer(timings, "als_np"):
                 x, y = als_np.als_np(
                     users, items, ratings, n_users, n_items, self.rank,
@@ -221,6 +273,9 @@ class ALS:
             return self._fit_block_parallel(
                 users, items, ratings, n_users, n_items, x0, y0, mesh, timings
             )
+        if x0 is None:
+            x0 = als_np.init_factors(n_users, self.rank, self.seed)
+            y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
         with phase_timer(timings, "table_convert"):
             # pad edges so the chunked normal-equation scan always has a
             # power-of-two chunk factor (padded edges carry valid=0)
@@ -266,15 +321,37 @@ class ALS:
                 users, items, ratings, mesh, n_users
             )
         with phase_timer(timings, "table_convert"):
-            # block-pad X: rank b's rows = x0[offsets[b]:offsets[b+1]] + pad
-            x0_blocks = np.zeros((world * upb, self.rank), np.float32)
-            for b in range(world):
+            # block X init stays rank-local: each device's callback builds
+            # ONLY its block's rows — from the user init if given, else
+            # from the counter-based position-addressable generator, which
+            # is bit-identical to the global init_factors(n_users) rows
+            # (the per-rank init the reference seeds with rank offsets,
+            # ALSDALImpl.cpp:165-169).  No host materializes (n_users, r).
+            sharding = NamedSharding(mesh, P(axis, None))
+
+            def x0_block(idx):
+                b = (idx[0].start or 0) // upb
                 lo, hi = int(offsets[b]), int(offsets[b + 1])
-                x0_blocks[b * upb : b * upb + (hi - lo)] = x0[lo:hi]
-            x0_dev = jax.device_put(
-                jnp.asarray(x0_blocks), NamedSharding(mesh, P(axis, None))
+                blk = np.zeros((upb, self.rank), np.float32)
+                if x0 is not None:
+                    blk[: hi - lo] = x0[lo:hi]
+                else:
+                    blk[: hi - lo] = als_np.init_factors_rows(
+                        lo, hi, self.rank, self.seed
+                    )
+                return blk
+
+            x0_dev = jax.make_array_from_callback(
+                (world * upb, self.rank), sharding, x0_block
             )
-            y0_dev = jax.device_put(jnp.asarray(y0), NamedSharding(mesh, P()))
+            y0_host = (
+                y0 if y0 is not None
+                else als_np.init_factors(n_items, self.rank, self.seed + 1)
+            )
+            y0_dev = jax.make_array_from_callback(
+                (n_items, self.rank), NamedSharding(mesh, P()),
+                lambda idx: y0_host[idx],
+            )
         from oap_mllib_tpu.utils.profiling import maybe_trace
 
         with phase_timer(timings, "als_iterations"), maybe_trace():
@@ -283,13 +360,14 @@ class ALS:
                 self.max_iter, self.reg_param, self.alpha, mesh,
                 implicit=self.implicit_prefs,
             )
-            xb = np.asarray(x_blocks)
-            y = np.asarray(y)
-        # reassemble global X from blocks (offset bookkeeping ~ ALSResult
-        # cUserOffset, ALSDALImpl.cpp:529-575)
-        x = np.zeros((n_users, self.rank), np.float32)
-        for b in range(world):
-            lo, hi = int(offsets[b]), int(offsets[b + 1])
-            x[lo:hi] = xb[b * upb : b * upb + (hi - lo)]
-        return ALSModel(x, y, {"timings": timings, "accelerated": True,
-                               "block_parallel": True})
+            jax.block_until_ready((x_blocks, y))
+        # X stays block-sharded on device; the model gathers on demand
+        # (offset bookkeeping ~ ALSResult cUserOffset/cItemOffset,
+        # ALSDALImpl.cpp:529-575). Y is replicated (np.asarray of a fully
+        # replicated array reads the local copy on every process).
+        return ALSModel(
+            None, np.asarray(y),
+            {"timings": timings, "accelerated": True,
+             "block_parallel": True, "sharded_factors": True},
+            sharded_user=(x_blocks, np.asarray(offsets), upb),
+        )

@@ -64,6 +64,36 @@ mu = KMeans(k=5, seed=11, init_mode="random", max_iter=15).fit(uneven)
 
 p = PCA(k=4).fit(half)
 
+# --- ALS: each rank contributes its LOCAL ratings shard (the per-rank
+# partitions of the reference's shuffle, ALSDALImpl.scala:95-109).  This
+# exercises the multi-process branches of exchange_ratings (allgathered
+# bucket counts + make_array_from_process_local_data), the allgathered
+# id-maxima resolution in ALS.fit, and the rank-local sharded factor path
+# (no host materializes (n_users, rank); gather is on-demand collective).
+from oap_mllib_tpu.models.als import ALS
+
+rng_als = np.random.default_rng(77)
+NU, NI, RANK = 60, 40, 3
+xt = rng_als.normal(size=(NU, RANK)).astype(np.float32)
+yt = rng_als.normal(size=(NI, RANK)).astype(np.float32)
+au = rng_als.integers(NU, size=1200).astype(np.int64)
+ai = rng_als.integers(NI, size=1200).astype(np.int64)
+au[0], ai[0] = NU - 1, NI - 1  # pin the id maxima deterministically
+ar = ((xt[au] * yt[ai]).sum(1)
+      + rng_als.normal(size=1200).astype(np.float32) * 0.1).astype(np.float32)
+# UNEVEN split: 590 vs 610 edges
+cut = 590
+sl = slice(0, cut) if rank == 0 else slice(cut, None)
+
+als_out = {}
+for implicit, tag in ((True, "imp"), (False, "exp")):
+    m_als = ALS(rank=RANK, max_iter=3, reg_param=0.1, alpha=0.8,
+                implicit_prefs=implicit, seed=3).fit(au[sl], ai[sl], ar[sl])
+    assert m_als.summary["accelerated"]
+    assert m_als.summary.get("sharded_factors"), "factors not kept sharded"
+    als_out[f"als_{tag}_uf"] = np.asarray(m_als.user_factors_).tolist()
+    als_out[f"als_{tag}_if"] = np.asarray(m_als.item_factors_).tolist()
+
 print(
     "RESULT "
     + json.dumps(
@@ -75,6 +105,7 @@ print(
             "uneven_cost": float(mu.summary.training_cost),
             "pca_var": np.asarray(p.explained_variance_).tolist(),
             "pca_pc0_abs": np.abs(np.asarray(p.components_)[:, 0]).tolist(),
+            **als_out,
         }
     ),
     flush=True,

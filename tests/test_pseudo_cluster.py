@@ -85,6 +85,19 @@ def _oracle_data():
     return x
 
 
+def _als_oracle_ratings():
+    rng = np.random.default_rng(77)  # must match pseudo_cluster_worker.py
+    nu, ni, rank = 60, 40, 3
+    xt = rng.normal(size=(nu, rank)).astype(np.float32)
+    yt = rng.normal(size=(ni, rank)).astype(np.float32)
+    u = rng.integers(nu, size=1200).astype(np.int64)
+    i = rng.integers(ni, size=1200).astype(np.int64)
+    u[0], i[0] = nu - 1, ni - 1
+    r = ((xt[u] * yt[i]).sum(1)
+         + rng.normal(size=1200).astype(np.float32) * 0.1).astype(np.float32)
+    return u, i, r
+
+
 class TestPseudoCluster:
     def test_kmeans_matches_single_process(self, world_results):
         """Default (k-means||) init: the device-side rounds run multi-host
@@ -152,7 +165,31 @@ class TestPseudoCluster:
                 atol=1e-4,
             )
 
+    @pytest.mark.parametrize("tag,implicit", [("imp", True), ("exp", False)])
+    def test_als_matches_single_process(self, world_results, tag, implicit):
+        """Each rank fed only its local ratings shard (590/610 uneven
+        split); factors must match the single-process fit.  Exercises the
+        multi-process branches of exchange_ratings, the allgathered
+        id-maxima, and the rank-local sharded-factor gather.  Tolerance is
+        2x the block-vs-oracle bar since both sides carry f32 error."""
+        from oap_mllib_tpu.models.als import ALS
+
+        u, i, r = _als_oracle_ratings()
+        oracle = ALS(rank=3, max_iter=3, reg_param=0.1, alpha=0.8,
+                     implicit_prefs=implicit, seed=3).fit(u, i, r)
+        for rank in (0, 1):
+            res = world_results[rank]
+            np.testing.assert_allclose(
+                res[f"als_{tag}_uf"], oracle.user_factors_,
+                atol=4e-3, rtol=4e-3,
+            )
+            np.testing.assert_allclose(
+                res[f"als_{tag}_if"], oracle.item_factors_,
+                atol=4e-3, rtol=4e-3,
+            )
+
     def test_ranks_agree(self, world_results):
         """Replicated results must be bitwise-identical across ranks."""
         assert world_results[0]["kmeans_cost"] == world_results[1]["kmeans_cost"]
         assert world_results[0]["pca_var"] == world_results[1]["pca_var"]
+        assert world_results[0]["als_imp_if"] == world_results[1]["als_imp_if"]
