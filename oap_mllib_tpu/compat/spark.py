@@ -7,8 +7,12 @@ Parity map (reference shims, survey §2.2):
 - ``ml.feature.PCA``: setK/setInputCol/setOutputCol; model: pc,
   explainedVariance, transform.
 - ``ml.recommendation.ALS``: setRank/setMaxIter/setRegParam/setAlpha/
-  setImplicitPrefs/setSeed/setUserCol/setItemCol/setRatingCol; model:
-  userFactors, itemFactors, transform (appends "prediction"),
+  setImplicitPrefs/setSeed/setUserCol/setItemCol/setRatingCol/
+  setPredictionCol/setNumUserBlocks/setNumItemBlocks/setNumBlocks/
+  setColdStartStrategy/setCheckpointInterval (full param surface of
+  reference spark-3.1.1/ml/recommendation/ALS.scala:241-245); model:
+  userFactors, itemFactors, transform (appends the prediction column,
+  honoring coldStartStrategy nan/drop),
   recommendForAllUsers/recommendForAllItems.
 
 Input "DataFrames" are dicts of numpy columns; transform returns a new
@@ -190,6 +194,8 @@ class PCAModel:
 class ALS:
     """Spark-ML-style ALS builder (reference shim: ml.recommendation.ALS)."""
 
+    _supportedColdStartStrategies = ("nan", "drop")
+
     def __init__(self):
         self._rank = 10
         self._maxIter = 10
@@ -201,6 +207,13 @@ class ALS:
         self._userCol = "user"
         self._itemCol = "item"
         self._ratingCol = "rating"
+        self._predictionCol = "prediction"
+        # Spark defaults (reference ALS.scala:241-245): numUserBlocks=10,
+        # numItemBlocks=10, checkpointInterval=10, coldStartStrategy="nan"
+        self._numUserBlocks = 10
+        self._numItemBlocks = 10
+        self._checkpointInterval = 10
+        self._coldStartStrategy = "nan"
 
     def setRank(self, v):           self._rank = v; return self
     def setMaxIter(self, v):        self._maxIter = v; return self
@@ -212,6 +225,45 @@ class ALS:
     def setUserCol(self, v):        self._userCol = v; return self
     def setItemCol(self, v):        self._itemCol = v; return self
     def setRatingCol(self, v):      self._ratingCol = v; return self
+    def setPredictionCol(self, v):  self._predictionCol = v; return self
+
+    def setNumUserBlocks(self, v):
+        if v < 1:
+            raise ValueError("numUserBlocks must be >= 1")
+        self._numUserBlocks = v
+        return self
+
+    def setNumItemBlocks(self, v):
+        if v < 1:
+            raise ValueError("numItemBlocks must be >= 1")
+        self._numItemBlocks = v
+        return self
+
+    def setNumBlocks(self, v):
+        """Set both numUserBlocks and numItemBlocks (ALS.scala:679-683)."""
+        return self.setNumUserBlocks(v).setNumItemBlocks(v)
+
+    def setColdStartStrategy(self, v):
+        """"nan" keeps NaN predictions for ids unseen in training; "drop"
+        removes those rows from transform output (ALS.scala:119-128).
+        Validation is case-insensitive, matching the Spark param validator."""
+        if str(v).lower() not in self._supportedColdStartStrategies:
+            raise ValueError(
+                f"coldStartStrategy must be one of "
+                f"{self._supportedColdStartStrategies}, got {v!r}"
+            )
+        self._coldStartStrategy = v
+        return self
+
+    def setCheckpointInterval(self, v):
+        """Accepted for API parity but a no-op, exactly like the reference:
+        ALSDALImpl ignores checkpointInterval (survey §5 — the accelerated
+        path has no intermediate RDD lineage to truncate; here the whole
+        fit is one compiled program).  -1 disables, like Spark."""
+        if v != -1 and v < 1:
+            raise ValueError("checkpointInterval must be >= 1 or -1")
+        self._checkpointInterval = v
+        return self
 
     def getRank(self):          return self._rank
     def getMaxIter(self):       return self._maxIter
@@ -222,6 +274,14 @@ class ALS:
     def getUserCol(self):       return self._userCol
     def getItemCol(self):       return self._itemCol
     def getRatingCol(self):     return self._ratingCol
+    def getPredictionCol(self): return self._predictionCol
+    def getNumUserBlocks(self): return self._numUserBlocks
+    def getNumItemBlocks(self): return self._numItemBlocks
+    def getCheckpointInterval(self): return self._checkpointInterval
+
+    def getColdStartStrategy(self):
+        # Spark lowercases on read (ALS.scala:128)
+        return self._coldStartStrategy.lower()
 
     def fit(self, data: DataFrame) -> "ALSModel":
         if not isinstance(data, dict):
@@ -230,20 +290,28 @@ class ALS:
             rank=self._rank, max_iter=self._maxIter, reg_param=self._regParam,
             implicit_prefs=self._implicitPrefs, alpha=self._alpha, seed=self._seed,
             nonnegative=self._nonnegative,
+            num_user_blocks=self._numUserBlocks,
+            num_item_blocks=self._numItemBlocks,
         )
         inner = est.fit(
             np.asarray(data[self._userCol]),
             np.asarray(data[self._itemCol]),
             np.asarray(data[self._ratingCol]),
         )
-        return ALSModel(inner, self._userCol, self._itemCol)
+        return ALSModel(inner, self._userCol, self._itemCol,
+                        prediction_col=self._predictionCol,
+                        cold_start_strategy=self.getColdStartStrategy())
 
 
 class ALSModel:
-    def __init__(self, inner: _als.ALSModel, user_col: str, item_col: str):
+    def __init__(self, inner: _als.ALSModel, user_col: str, item_col: str,
+                 prediction_col: str = "prediction",
+                 cold_start_strategy: str = "nan"):
         self._inner = inner
         self._userCol = user_col
         self._itemCol = item_col
+        self._predictionCol = prediction_col
+        self._coldStartStrategy = cold_start_strategy
 
     @property
     def rank(self) -> int:
@@ -258,11 +326,31 @@ class ALSModel:
         return self._inner.item_factors_
 
     def transform(self, data: DataFrame) -> DataFrame:
-        """Append a "prediction" column for (user, item) pairs."""
+        """Append the prediction column for (user, item) pairs.
+
+        Cold-start handling mirrors Spark (ALS.scala:119-128, ALSModel
+        .transform): ids with no trained factor row get NaN predictions
+        under "nan" (the default), or their rows removed from every column
+        under "drop" — the mode cross-validation needs to avoid NaN
+        metrics."""
+        users = np.asarray(data[self._userCol])
+        items = np.asarray(data[self._itemCol])
+        n_u = self._inner.user_factors_.shape[0]
+        n_i = self._inner.item_factors_.shape[0]
+        seen = (users >= 0) & (users < n_u) & (items >= 0) & (items < n_i)
+        # clip before the gather so device-side indexing never reads out of
+        # range, then mask the cold rows
+        pred = self._inner.predict(
+            np.clip(users, 0, max(n_u - 1, 0)),
+            np.clip(items, 0, max(n_i - 1, 0)),
+        ).astype(np.float32)
+        pred[~seen] = np.nan
         out = dict(data)
-        out["prediction"] = self._inner.predict(
-            np.asarray(data[self._userCol]), np.asarray(data[self._itemCol])
-        )
+        if self._coldStartStrategy == "drop":
+            out = {k: np.asarray(v)[seen] for k, v in out.items()}
+            out[self._predictionCol] = pred[seen]
+        else:
+            out[self._predictionCol] = pred
         return out
 
     def recommendForAllUsers(self, numItems: int) -> np.ndarray:

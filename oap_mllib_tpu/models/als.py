@@ -151,6 +151,8 @@ class ALS:
         alpha: float = 1.0,
         seed: int = 0,
         nonnegative: bool = False,
+        num_user_blocks: Optional[int] = None,
+        num_item_blocks: Optional[int] = None,
     ):
         if rank < 1:
             raise ValueError("rank must be >= 1")
@@ -160,6 +162,10 @@ class ALS:
             raise ValueError("reg_param must be >= 0")
         if alpha < 0:
             raise ValueError("alpha must be >= 0")
+        if num_user_blocks is not None and num_user_blocks < 1:
+            raise ValueError("num_user_blocks must be >= 1")
+        if num_item_blocks is not None and num_item_blocks < 1:
+            raise ValueError("num_item_blocks must be >= 1")
         self.rank = rank
         self.max_iter = max_iter
         self.reg_param = reg_param
@@ -167,6 +173,14 @@ class ALS:
         self.alpha = alpha
         self.seed = seed
         self.nonnegative = nonnegative
+        # Block-layout hints (Spark ALS numUserBlocks/numItemBlocks,
+        # reference ALS.scala:154-169).  Here the user-block count is the
+        # mesh data-axis size (one block per device); num_user_blocks CAPS
+        # it in single-process worlds.  Item factors are replicated across
+        # the mesh (survey §2.5), so num_item_blocks has no layout effect;
+        # both requested values are recorded in the fit summary.
+        self.num_user_blocks = num_user_blocks
+        self.num_item_blocks = num_item_blocks
 
     def fit(
         self,
@@ -256,7 +270,11 @@ class ALS:
                     self.implicit_prefs, self.seed, init=(x0, y0),
                     nonnegative=self.nonnegative,
                 )
-            return ALSModel(x, y, {"timings": timings, "accelerated": False})
+            return ALSModel(
+                x, y,
+                {"timings": timings, "accelerated": False,
+                 **self._block_summary(1)},
+            )
 
         # accelerated path (~ ALSDALImpl.train, ALSDALImpl.scala:58)
         import jax
@@ -265,6 +283,17 @@ class ALS:
 
         mesh = get_mesh()
         world = mesh.shape[mesh.axis_names[0]]
+        if (
+            self.num_user_blocks is not None
+            and jax.process_count() == 1
+            and self.num_user_blocks < world
+        ):
+            # honor the numUserBlocks cap: fewer user blocks = fewer mesh
+            # devices (one block per device).  Multi-process worlds keep
+            # one block per global device — restricting the device set
+            # there would strand processes.
+            mesh = get_mesh(n_devices=self.num_user_blocks)
+            world = mesh.shape[mesh.axis_names[0]]
         if world > 1 or jax.process_count() > 1:
             # distributed 2-D block layout for BOTH modes: ratings shuffled
             # by user block, X block-sharded, Y replicated (~ the
@@ -302,7 +331,19 @@ class ALS:
                 )
             x = np.asarray(x)
             y = np.asarray(y)
-        return ALSModel(x, y, {"timings": timings, "accelerated": True})
+        return ALSModel(
+            x, y,
+            {"timings": timings, "accelerated": True, **self._block_summary(1)},
+        )
+
+    def _block_summary(self, effective_user_blocks: int) -> dict:
+        """Requested vs effective block layout for the fit summary."""
+        out = {"num_user_blocks": effective_user_blocks}
+        if self.num_user_blocks is not None:
+            out["num_user_blocks_requested"] = self.num_user_blocks
+        if self.num_item_blocks is not None:
+            out["num_item_blocks_requested"] = self.num_item_blocks
+        return out
 
     def _fit_block_parallel(
         self, users, items, ratings, n_users, n_items, x0, y0, mesh, timings
@@ -368,6 +409,7 @@ class ALS:
         return ALSModel(
             None, np.asarray(y),
             {"timings": timings, "accelerated": True,
-             "block_parallel": True, "sharded_factors": True},
+             "block_parallel": True, "sharded_factors": True,
+             **self._block_summary(world)},
             sharded_user=(x_blocks, np.asarray(offsets), upb),
         )

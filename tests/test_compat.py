@@ -115,6 +115,94 @@ class TestALSCompat:
         with pytest.raises(TypeError):
             ALS().fit(np.zeros((3, 3)))
 
+    def test_default_params_match_spark(self):
+        """Spark's setDefault block (reference ALS.scala:241-245)."""
+        als = ALS()
+        assert als.getRank() == 10
+        assert als.getMaxIter() == 10
+        assert als.getRegParam() == 0.1
+        assert als.getNumUserBlocks() == 10
+        assert als.getNumItemBlocks() == 10
+        assert als.getImplicitPrefs() is False
+        assert als.getAlpha() == 1.0
+        assert als.getNonnegative() is False
+        assert als.getCheckpointInterval() == 10
+        assert als.getColdStartStrategy() == "nan"
+        assert als.getPredictionCol() == "prediction"
+
+    def test_num_blocks_params(self, rng):
+        als = ALS().setNumUserBlocks(3).setNumItemBlocks(5)
+        assert als.getNumUserBlocks() == 3 and als.getNumItemBlocks() == 5
+        als.setNumBlocks(2)  # sets both (ALS.scala:679-683)
+        assert als.getNumUserBlocks() == 2 and als.getNumItemBlocks() == 2
+        with pytest.raises(ValueError):
+            ALS().setNumUserBlocks(0)
+        with pytest.raises(ValueError):
+            ALS().setNumItemBlocks(-1)
+        df = self._ratings_df(rng)
+        model = als.setRank(3).setMaxIter(2).setImplicitPrefs(True).fit(df)
+        # the requested hint is recorded and the effective user-block
+        # count (mesh data-axis size) is capped by it
+        summary = model._inner.summary
+        assert summary["num_user_blocks_requested"] == 2
+        assert summary["num_item_blocks_requested"] == 2
+        assert summary["num_user_blocks"] <= 2
+
+    def test_cold_start_nan(self, rng):
+        df = self._ratings_df(rng)
+        model = ALS().setRank(3).setMaxIter(2).setImplicitPrefs(True).fit(df)
+        n_users = model.userFactors.shape[0]
+        test = {"user": np.array([0, n_users + 7]), "item": np.array([0, 1]),
+                "rating": np.array([1.0, 1.0], np.float32)}
+        out = model.transform(test)
+        assert len(out["prediction"]) == 2
+        assert np.isfinite(out["prediction"][0])
+        assert np.isnan(out["prediction"][1])
+
+    def test_cold_start_drop(self, rng):
+        df = self._ratings_df(rng)
+        model = (
+            ALS().setRank(3).setMaxIter(2).setImplicitPrefs(True)
+            .setColdStartStrategy("drop").fit(df)
+        )
+        n_items = model.itemFactors.shape[0]
+        test = {"user": np.array([0, 1, 2]),
+                "item": np.array([0, n_items + 3, 1]),
+                "rating": np.array([1.0, 2.0, 3.0], np.float32)}
+        out = model.transform(test)
+        # cold row removed from EVERY column, predictions all finite
+        assert len(out["prediction"]) == 2
+        assert np.isfinite(out["prediction"]).all()
+        np.testing.assert_array_equal(out["user"], [0, 2])
+        np.testing.assert_array_equal(out["rating"], [1.0, 3.0])
+
+    def test_cold_start_validation(self):
+        with pytest.raises(ValueError):
+            ALS().setColdStartStrategy("bogus")
+        # case-insensitive like the Spark param validator (ALS.scala:125-128)
+        assert ALS().setColdStartStrategy("DROP").getColdStartStrategy() == "drop"
+
+    def test_checkpoint_interval_accepted_noop(self, rng):
+        """checkpointInterval is API-parity only: the reference's DAL path
+        ignores it too (survey §5)."""
+        als = ALS().setCheckpointInterval(5)
+        assert als.getCheckpointInterval() == 5
+        assert ALS().setCheckpointInterval(-1).getCheckpointInterval() == -1
+        with pytest.raises(ValueError):
+            ALS().setCheckpointInterval(0)
+        df = self._ratings_df(rng)
+        model = als.setRank(3).setMaxIter(2).setImplicitPrefs(True).fit(df)
+        assert model.userFactors.shape[1] == 3
+
+    def test_prediction_col(self, rng):
+        df = self._ratings_df(rng)
+        model = (
+            ALS().setRank(3).setMaxIter(2).setImplicitPrefs(True)
+            .setPredictionCol("score").fit(df)
+        )
+        out = model.transform(df)
+        assert "score" in out and "prediction" not in out
+
 
 class TestReviewRegressions:
     def test_batch_predict_raises(self, rng):
