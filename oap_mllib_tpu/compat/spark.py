@@ -209,9 +209,13 @@ class ALS:
         self._ratingCol = "rating"
         self._predictionCol = "prediction"
         # Spark defaults (reference ALS.scala:241-245): numUserBlocks=10,
-        # numItemBlocks=10, checkpointInterval=10, coldStartStrategy="nan"
+        # numItemBlocks=10, checkpointInterval=10, coldStartStrategy="nan".
+        # The block counts are only FORWARDED to the estimator when the
+        # user sets them — Spark's 10 is a partitioning default, not a
+        # device cap, and the mesh layout is the better default here.
         self._numUserBlocks = 10
         self._numItemBlocks = 10
+        self._numBlocksSet = False
         self._checkpointInterval = 10
         self._coldStartStrategy = "nan"
 
@@ -231,12 +235,14 @@ class ALS:
         if v < 1:
             raise ValueError("numUserBlocks must be >= 1")
         self._numUserBlocks = v
+        self._numBlocksSet = True
         return self
 
     def setNumItemBlocks(self, v):
         if v < 1:
             raise ValueError("numItemBlocks must be >= 1")
         self._numItemBlocks = v
+        self._numBlocksSet = True
         return self
 
     def setNumBlocks(self, v):
@@ -290,28 +296,35 @@ class ALS:
             rank=self._rank, max_iter=self._maxIter, reg_param=self._regParam,
             implicit_prefs=self._implicitPrefs, alpha=self._alpha, seed=self._seed,
             nonnegative=self._nonnegative,
-            num_user_blocks=self._numUserBlocks,
-            num_item_blocks=self._numItemBlocks,
+            num_user_blocks=self._numUserBlocks if self._numBlocksSet else None,
+            num_item_blocks=self._numItemBlocks if self._numBlocksSet else None,
         )
-        inner = est.fit(
-            np.asarray(data[self._userCol]),
-            np.asarray(data[self._itemCol]),
-            np.asarray(data[self._ratingCol]),
-        )
+        users = np.asarray(data[self._userCol])
+        items = np.asarray(data[self._itemCol])
+        inner = est.fit(users, items, np.asarray(data[self._ratingCol]))
         return ALSModel(inner, self._userCol, self._itemCol,
                         prediction_col=self._predictionCol,
-                        cold_start_strategy=self.getColdStartStrategy())
+                        cold_start_strategy=self.getColdStartStrategy(),
+                        seen_users=np.unique(users), seen_items=np.unique(items))
 
 
 class ALSModel:
     def __init__(self, inner: _als.ALSModel, user_col: str, item_col: str,
                  prediction_col: str = "prediction",
-                 cold_start_strategy: str = "nan"):
+                 cold_start_strategy: str = "nan",
+                 seen_users: Optional[np.ndarray] = None,
+                 seen_items: Optional[np.ndarray] = None):
         self._inner = inner
         self._userCol = user_col
         self._itemCol = item_col
         self._predictionCol = prediction_col
         self._coldStartStrategy = cold_start_strategy
+        # ids that actually appeared in training — Spark's cold-start set
+        # is "unseen in training", which in a dense id space also covers
+        # in-range ids whose every rating landed outside the training
+        # split.  None (e.g. a loaded model) degrades to range checks.
+        self._seenUsers = seen_users
+        self._seenItems = seen_items
 
     @property
     def rank(self) -> int:
@@ -338,6 +351,10 @@ class ALSModel:
         n_u = self._inner.user_factors_.shape[0]
         n_i = self._inner.item_factors_.shape[0]
         seen = (users >= 0) & (users < n_u) & (items >= 0) & (items < n_i)
+        if self._seenUsers is not None:
+            seen &= np.isin(users, self._seenUsers)
+        if self._seenItems is not None:
+            seen &= np.isin(items, self._seenItems)
         # clip before the gather so device-side indexing never reads out of
         # range, then mask the cold rows
         pred = self._inner.predict(

@@ -42,6 +42,12 @@ class Config:
       executor-count / rank pair (reference OneCCL.scala:32-42).
     - ``data_axis`` / ``model_axis``: mesh axis names for row sharding and
       feature/factor sharding.
+    - ``model_parallel``: size of the model axis in meshes built by
+      :func:`~oap_mllib_tpu.parallel.mesh.get_mesh` (devices are arranged
+      (n // model_parallel, model_parallel)).  >1 enables mesh-sharded
+      linalg — PCA shards its Gram/covariance rows over the model axis so
+      the (d, d) accumulation outgrows one chip's HBM (survey §5; the
+      reference has no analog because oneDAL kernels are single-node).
     - ``enable_x64``: run K-Means/PCA accumulation in float64 for parity with
       the reference's double kernels (KMeansDALImpl.cpp:32); ALS uses float32
       like the reference (ALSDALImpl.cpp:35).
@@ -59,6 +65,7 @@ class Config:
     process_id: int = 0
     data_axis: str = "data"
     model_axis: str = "model"
+    model_parallel: int = 1
     enable_x64: bool = False
     fallback: bool = True
     timing: bool = False

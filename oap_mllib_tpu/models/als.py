@@ -288,11 +288,13 @@ class ALS:
             and jax.process_count() == 1
             and self.num_user_blocks < world
         ):
-            # honor the numUserBlocks cap: fewer user blocks = fewer mesh
-            # devices (one block per device).  Multi-process worlds keep
+            # honor the numUserBlocks cap: fewer user blocks = a smaller
+            # DATA axis (one block per data-axis slot), so the device
+            # budget is blocks x model_parallel.  Multi-process worlds keep
             # one block per global device — restricting the device set
             # there would strand processes.
-            mesh = get_mesh(n_devices=self.num_user_blocks)
+            mp = mesh.shape[mesh.axis_names[1]] if len(mesh.axis_names) > 1 else 1
+            mesh = get_mesh(n_devices=self.num_user_blocks * mp)
             world = mesh.shape[mesh.axis_names[0]]
         if world > 1 or jax.process_count() > 1:
             # distributed 2-D block layout for BOTH modes: ratings shuffled

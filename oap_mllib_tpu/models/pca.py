@@ -102,7 +102,15 @@ class PCA:
 
     def _fit_tpu_inner(self, x, dtype, jax) -> PCAModel:
         timings = Timings()
+        cfg = get_config()
         mesh = get_mesh()
+        mp = mesh.shape[cfg.model_axis]
+        d = x.shape[1]
+        if mp > 1:
+            # model-sharded Gram needs d % model == 0; zero-pad feature
+            # columns (they yield zero eigenvalues, which sort last) and
+            # slice the component rows back after eigh
+            x = np.pad(x, ((0, 0), (0, (-d) % mp)))
         with phase_timer(timings, "table_convert"):
             make = (
                 DenseTable.from_process_local
@@ -111,17 +119,30 @@ class PCA:
             )
             table = make(x.astype(dtype), mesh)
         with phase_timer(timings, "covariance"):
-            cov, _ = pca_ops.covariance(
-                table.data, table.mask, jnp.asarray(float(table.n_rows), dtype)
-            )
+            n_rows = jnp.asarray(float(table.n_rows), dtype)
+            if mp > 1:
+                cov, _ = pca_ops.covariance_model_sharded(
+                    table.data, table.mask, n_rows, mesh
+                )
+            else:
+                cov, _ = pca_ops.covariance(table.data, table.mask, n_rows)
         with phase_timer(timings, "eigh"):
+            if cov.shape[0] > d:
+                # padded feature dims: demote their eigenvalues below any
+                # genuine one so ties at zero can't surface a padded basis
+                # vector in the top-k
+                cov = pca_ops.mark_padded_features(cov, d)
             vals, vecs = pca_ops.eigh_descending(cov)
-            vals = np.asarray(vals)
+            vals = np.asarray(vals)[:d]  # genuine spectrum only
             vecs = np.asarray(vecs)
         total = float(vals.sum())
         ratio = vals[: self.k] / total if total > 0 else np.zeros(self.k)
-        summary = {"timings": timings, "accelerated": True}
-        return PCAModel(vecs[:, : self.k], ratio, summary)
+        summary = {
+            "timings": timings,
+            "accelerated": True,
+            "mesh_shape": dict(mesh.shape),
+        }
+        return PCAModel(vecs[:d, : self.k], ratio, summary)
 
     # -- fallback path (~ vanilla mllib.feature.PCA, PCA.scala:110-116) ------
     def _fit_fallback(self, x: np.ndarray) -> PCAModel:

@@ -17,9 +17,12 @@ One jitted program, no serialization, no master rank.  The d < 65535 guard
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
 from typing import Tuple
 
 
@@ -38,6 +41,82 @@ def covariance(x: jax.Array, mask: jax.Array, n_rows: jax.Array) -> Tuple[jax.Ar
     cov = (gram - n_rows * jnp.outer(mean, mean)) / jnp.maximum(n_rows - 1.0, 1.0)
     # numerical symmetry guard before eigh
     return 0.5 * (cov + cov.T), mean
+
+
+@functools.lru_cache(maxsize=8)
+def _model_sharded_cov_fn(mesh, dax: str, max_: str):
+    """Compiled model-sharded covariance program, cached per mesh (a fresh
+    jit(shard_map) closure per fit would retrace/recompile every time)."""
+
+    def tile_program(x_blk, mask_blk, n):
+        xm = x_blk * mask_blk[:, None]
+        col_sum = lax.psum(jnp.sum(xm, axis=0), dax)  # (d_loc,)
+        mean_loc = col_sum / n
+        mean_full = lax.all_gather(mean_loc, max_, tiled=True)  # (d,)
+        x_full = lax.all_gather(xm, max_, axis=1, tiled=True)  # (n_loc, d)
+        gram_rows = lax.psum(
+            jnp.matmul(xm.T, x_full, precision=lax.Precision.HIGHEST), dax
+        )  # (d_loc, d)
+        cov_rows = (gram_rows - n * jnp.outer(mean_loc, mean_full)) / jnp.maximum(
+            n - 1.0, 1.0
+        )
+        return cov_rows, mean_loc
+
+    sharded = jax.shard_map(
+        tile_program,
+        mesh=mesh,
+        in_specs=(P(dax, max_), P(dax), P()),
+        out_specs=(P(max_, None), P(max_)),
+        check_vma=False,
+    )
+
+    def run(x, mask, n):
+        cov, mean = sharded(x, mask, n)
+        # numerical symmetry guard before eigh (cross-tile roundoff)
+        return 0.5 * (cov + cov.T), mean
+
+    return jax.jit(run)
+
+
+def covariance_model_sharded(
+    x: jax.Array, mask: jax.Array, n_rows: jax.Array, mesh
+) -> Tuple[jax.Array, jax.Array]:
+    """Covariance with the (d, d) accumulation sharded over the MODEL axis.
+
+    Mesh-sharded linalg (survey §5): on a (data, model) mesh each device
+    holds a (rows/data, d/model) tile.  Per device: all_gather the column
+    tiles along the model axis (ICI), one (d_loc, n_loc) x (n_loc, d) MXU
+    matmul for this device's Gram ROWS, then psum over the data axis — so
+    no device ever materializes more than (d/model, d) of the Gram.  The
+    reference cannot shard this dimension at all (oneDAL's step2Master
+    holds the full d x d on one node, PCADALImpl.cpp:122-153).
+
+    ``d`` must be a multiple of the model-axis size (callers pad feature
+    columns with zeros and demote them with :func:`mark_padded_features`
+    before eigh).  Returns (cov (d, d) sharded (model, None), mean (d,)).
+    """
+    from oap_mllib_tpu.config import get_config
+
+    cfg = get_config()
+    return _model_sharded_cov_fn(mesh, cfg.data_axis, cfg.model_axis)(
+        x, mask, n_rows
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def mark_padded_features(cov: jax.Array, d_valid: int) -> jax.Array:
+    """Set the diagonal of padded feature dims to -1 so their eigenvalues
+    sort strictly BELOW any genuine (>= 0, up to roundoff) eigenvalue.
+
+    Without this, a padded column's zero eigenvalue ties with a genuine
+    null-space eigenvalue and eigh may order the padded basis vector into
+    the top-k, which would slice to an all-zero component column.  cov is
+    block-diagonal afterwards, so genuine eigenvectors keep exact zeros in
+    the padded rows.
+    """
+    d_pad = cov.shape[0]
+    idx = jnp.arange(d_valid, d_pad)
+    return cov.at[idx, idx].set(-1.0)
 
 
 @jax.jit

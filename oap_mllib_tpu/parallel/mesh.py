@@ -28,16 +28,18 @@ from oap_mllib_tpu.config import get_config
 
 def get_mesh(
     n_devices: Optional[int] = None,
-    model_parallel: int = 1,
+    model_parallel: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Build a (data, model) mesh over available devices.
 
     ``model_parallel`` splits the device pool into a second axis used to
-    shard feature/factor dimensions; default 1 (pure data parallel, the
-    reference's only mode — survey §2.5).
+    shard feature/factor dimensions; defaults to ``Config.model_parallel``
+    (1 = pure data parallel, the reference's only mode — survey §2.5).
     """
     cfg = get_config()
+    if model_parallel is None:
+        model_parallel = cfg.model_parallel
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:

@@ -148,6 +148,49 @@ class TestALSCompat:
         assert summary["num_item_blocks_requested"] == 2
         assert summary["num_user_blocks"] <= 2
 
+    def test_default_num_blocks_not_forwarded(self, rng):
+        """Spark's numUserBlocks=10 default is a partitioning default, not
+        a device cap — an untouched builder must not cap the mesh."""
+        df = self._ratings_df(rng)
+        model = ALS().setRank(3).setMaxIter(2).setImplicitPrefs(True).fit(df)
+        summary = model._inner.summary
+        assert "num_user_blocks_requested" not in summary
+
+    def test_num_user_blocks_with_model_parallel(self, rng):
+        """The cap counts user blocks (data-axis slots), not raw devices:
+        with model_parallel=2 a 3-block cap needs 6 devices."""
+        from oap_mllib_tpu.config import set_config
+
+        set_config(model_parallel=2)
+        from oap_mllib_tpu import ALS as CoreALS
+
+        df = self._ratings_df(rng)
+        m = CoreALS(rank=3, max_iter=2, implicit_prefs=True,
+                    num_user_blocks=3).fit(df["user"], df["item"], df["rating"])
+        assert m.summary["num_user_blocks"] == 3
+
+    def test_cold_start_in_range_unseen_id(self, rng):
+        """Ids inside the dense id range whose every rating fell outside
+        the training split are still cold (Spark: unseen-in-training)."""
+        df = self._ratings_df(rng)
+        # remove every rating of user 3 from training
+        keep = df["user"] != 3
+        train = {k: v[keep] for k, v in df.items()}
+        model = (
+            ALS().setRank(3).setMaxIter(2).setImplicitPrefs(True)
+            .fit(train)
+        )
+        test = {"user": np.array([0, 3]), "item": np.array([0, 0]),
+                "rating": np.array([1.0, 1.0], np.float32)}
+        out = model.transform(test)
+        assert np.isfinite(out["prediction"][0])
+        assert np.isnan(out["prediction"][1])
+        dropped = (
+            ALS().setRank(3).setMaxIter(2).setImplicitPrefs(True)
+            .setColdStartStrategy("drop").fit(train).transform(test)
+        )
+        np.testing.assert_array_equal(dropped["user"], [0])
+
     def test_cold_start_nan(self, rng):
         df = self._ratings_df(rng)
         model = ALS().setRank(3).setMaxIter(2).setImplicitPrefs(True).fit(df)

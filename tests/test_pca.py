@@ -68,6 +68,70 @@ class TestParity:
         assert np.all(np.diff(model.explained_variance_) <= 1e-9)
 
 
+class TestModelParallel:
+    """Mesh-sharded linalg: the Gram/covariance rows sharded over the
+    MODEL axis of a 2-D (data=4, model=2) mesh (survey §5's "mesh-sharded
+    linalg" scope — a real estimator path, not just the driver dryrun)."""
+
+    def test_2d_mesh_matches_oracle(self, rng):
+        x = _data(rng, n=400, d=12)
+        k = 5
+        set_config(model_parallel=2)
+        model = PCA(k=k).fit(x)
+        assert model.summary["accelerated"]
+        # the fit really ran on a (4, 2) mesh
+        assert model.summary["mesh_shape"] == {"data": 4, "model": 2}
+        pc_ref, ev_ref = _oracle(x, k)
+        for j in range(k):
+            if ev_ref[j] > 1e-5:
+                np.testing.assert_allclose(
+                    np.abs(model.components_[:, j]), np.abs(pc_ref[:, j]),
+                    atol=1e-3,
+                )
+        np.testing.assert_allclose(model.explained_variance_, ev_ref, atol=1e-4)
+
+    def test_2d_mesh_feature_padding(self, rng):
+        """d=11 does not divide model=2: zero-padded feature columns must
+        not perturb the components or the variance ratios."""
+        x = _data(rng, n=300, d=11)
+        set_config(model_parallel=2)
+        model = PCA(k=3).fit(x)
+        assert model.components_.shape == (11, 3)
+        pc_ref, ev_ref = _oracle(x, 3)
+        np.testing.assert_allclose(
+            np.abs(model.components_), np.abs(pc_ref), atol=1e-3
+        )
+        np.testing.assert_allclose(model.explained_variance_, ev_ref, atol=1e-4)
+
+    def test_2d_mesh_rank_deficient_padding_tie(self, rng):
+        """Rank-deficient data + padded columns: the genuine null-space
+        eigenvector must win the tie at eigenvalue 0, never a padded basis
+        vector (which would slice to a zero component column)."""
+        # d=3 padded to 4 under model=2; data spans only 2 directions
+        base = rng.normal(size=(200, 2))
+        x = np.concatenate([base, (base[:, :1] + base[:, 1:])], axis=1)  # col3 = col1+col2
+        set_config(model_parallel=2)
+        model = PCA(k=3).fit(x)
+        norms = np.linalg.norm(model.components_, axis=0)
+        np.testing.assert_allclose(norms, 1.0, atol=1e-4)  # no zero column
+        # the k=3 component is the true null direction (1,1,-1)/sqrt(3)
+        np.testing.assert_allclose(
+            np.abs(model.components_[:, 2]), np.abs(np.array([1, 1, -1]) / np.sqrt(3)),
+            atol=1e-3,
+        )
+
+    def test_2d_matches_1d(self, rng):
+        x = _data(rng, n=256, d=8)
+        m1 = PCA(k=4).fit(x)
+        set_config(model_parallel=2)
+        m2 = PCA(k=4).fit(x)
+        assert m2.summary["mesh_shape"]["model"] == 2
+        assert m1.summary["mesh_shape"]["model"] == 1
+        np.testing.assert_allclose(
+            np.abs(m1.components_), np.abs(m2.components_), atol=1e-4
+        )
+
+
 class TestBehavior:
     def test_shapes(self, rng):
         x = _data(rng, n=100, d=7)
