@@ -6,6 +6,19 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== lint (style gate — failures fail the build, like the reference's scalastyle) =="
+python dev/lint.py
+if command -v ruff >/dev/null 2>&1; then
+  ruff check .
+else
+  echo "ruff not installed - stdlib gate only"
+fi
+if command -v clang-format >/dev/null 2>&1; then
+  clang-format --dry-run -Werror oap_mllib_tpu/native/src/*.cpp
+else
+  echo "clang-format not installed - stdlib gate only"
+fi
+
 echo "== build native =="
 make -C oap_mllib_tpu/native -j4
 

@@ -68,5 +68,7 @@ def lloyd_np(
     return centers, n_iter, cost
 
 
-def predict_np(x: np.ndarray, centers: np.ndarray, distance_measure: str = "euclidean") -> np.ndarray:
+def predict_np(
+    x: np.ndarray, centers: np.ndarray, distance_measure: str = "euclidean"
+) -> np.ndarray:
     return np.argmin(_sq_dists(x, centers, distance_measure), axis=1)

@@ -176,7 +176,9 @@ def parse_csv(path: str, delimiter: str = ",") -> Optional[np.ndarray]:
         lib.oap_table_free(h)
 
 
-def parse_ratings(path: str, sep: str = "::") -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+def parse_ratings(
+    path: str, sep: str = "::"
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     lib = _load()
     if lib is None:
         return None

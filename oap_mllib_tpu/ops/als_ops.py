@@ -143,7 +143,8 @@ def _half_update(
 ) -> jax.Array:
     """Solve one side's factors given the other side's. Returns (n_dst, r)."""
     r = src_factors.shape[1]
-    gram = jnp.matmul(src_factors.T, src_factors, precision=lax.Precision.HIGHEST)  # (r, r) <- MXU, psum over mesh
+    # (r, r) <- MXU, psum over mesh
+    gram = jnp.matmul(src_factors.T, src_factors, precision=lax.Precision.HIGHEST)
     a_part, b, n_reg = normal_eq_partials(
         dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha, True
     )

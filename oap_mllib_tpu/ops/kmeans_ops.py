@@ -29,7 +29,7 @@ Spark when a weight column is set, spark-3.1.1/ml/clustering/KMeans.scala:349-35
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -17,9 +17,8 @@ annotations, or explicitly via `lax.psum` etc. under `shard_map`.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from oap_mllib_tpu.config import get_config
 

@@ -16,7 +16,6 @@ unchanged.  When fallback is disabled, failing the predicate raises.
 from __future__ import annotations
 
 import logging
-from typing import Optional
 
 import jax
 

@@ -1,8 +1,6 @@
 """Data IO tests: native and pure-Python parsers agree on all formats."""
 
 import os
-import subprocess
-import sys
 
 import numpy as np
 

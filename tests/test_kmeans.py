@@ -145,7 +145,10 @@ class TestBehavior:
     def test_random_init_mode(self, rng):
         x, _, _ = _blobs(rng)
         model = KMeans(k=4, init_mode="random", seed=2, max_iter=50, tol=1e-6).fit(x)
-        assert model.summary.training_cost < KMeans(k=4, max_iter=0, init_mode="random", seed=2).fit(x).summary.training_cost + 1e-6
+        rand_cost = KMeans(
+            k=4, max_iter=0, init_mode="random", seed=2
+        ).fit(x).summary.training_cost
+        assert model.summary.training_cost < rand_cost + 1e-6
 
     def test_weighted_fit(self, rng):
         """Row weights shift the k=1 center to the weighted mean."""
@@ -197,7 +200,8 @@ class TestRegressions:
         x = np.abs(rng.normal(size=(60, 5))) + 0.1
         m = KMeans(k=3, distance_measure="cosine", seed=1, max_iter=30, tol=1e-6).fit(x)
         # recomputed cost on training data should match training cost closely
-        assert abs(m.compute_cost(x) - m.summary.training_cost) < 1e-6 + 0.05 * m.summary.training_cost
+        tc = m.summary.training_cost
+        assert abs(m.compute_cost(x) - tc) < 1e-6 + 0.05 * tc
         # and must be on the cosine scale (bounded by n since 1-cos <= 2)
         assert m.compute_cost(x) < 2 * len(x)
 

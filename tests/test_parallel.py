@@ -8,7 +8,6 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from oap_mllib_tpu.parallel import (
     allgather_rows,
