@@ -19,6 +19,12 @@ else
   echo "clang-format not installed - stdlib gate only"
 fi
 
+echo "== docs (samples executed, config coverage, links; mkdocs when present) =="
+python dev/check_docs.py
+if command -v mkdocs >/dev/null 2>&1; then
+  mkdocs build --strict --site-dir /tmp/oap-mllib-tpu-site
+fi
+
 echo "== build native =="
 make -C oap_mllib_tpu/native -j4
 
