@@ -47,10 +47,14 @@ def check_samples() -> list:
             if "no-run" in marker:
                 print(f"  {label}: syntax-checked (no-run)")
                 continue
-            proc = subprocess.run(
-                [sys.executable, "-c", code], env=env, cwd=ROOT,
-                capture_output=True, text=True, timeout=600,
-            )
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c", code], env=env, cwd=ROOT,
+                    capture_output=True, text=True, timeout=600,
+                )
+            except subprocess.TimeoutExpired:
+                failures.append(f"{label}: timed out after 600s")
+                continue
             if proc.returncode != 0:
                 failures.append(f"{label}: exit {proc.returncode}\n{proc.stderr[-2000:]}")
             else:

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""K-Means kernel shoot-out: chunked-XLA Lloyd vs fused Pallas, per shape
+and precision tier, on the current backend.
+
+Emits one JSON line per (shape, tier, kernel) plus a markdown table —
+the evidence behind Config.kmeans_kernel="auto" picking the XLA path
+(config.py cites this table in BASELINE.md; regenerate with
+``python dev/profile_kernels.py`` on TPU).
+
+Timing method: per-iteration SLOPE between a short and a long jitted
+Lloyd run (the remote-device tunnel adds tens of ms of per-call dispatch
+latency; the slope cancels it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+SHAPES = [
+    # (n, d, k) — bench headline, smaller-k, high-d, small
+    (1 << 20, 256, 1000),
+    (1 << 20, 64, 128),
+    (1 << 18, 1024, 256),
+    (1 << 16, 64, 64),
+]
+TIERS = ["highest", "high", "default"]
+
+
+def _iter_window(flops_per_iter: float) -> tuple:
+    """(short, long) iteration counts sized so the slope window holds >= ~2s
+    of assumed-30TFLOP/s work — small shapes at 4..16 iters complete in
+    tens of ms and the tunnel's per-call jitter (±50 ms) swamps the slope."""
+    long = int(max(16, min(1024, 2.0 * 30e12 / flops_per_iter)))
+    return max(4, long // 4), long
+
+
+def _time_run(fn):
+    fn()  # compile + warm the exact variant
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def profile():
+    import jax.numpy as jnp
+
+    from oap_mllib_tpu.ops import kmeans_ops
+    from oap_mllib_tpu.ops.pallas.kmeans_kernel import lloyd_run_pallas
+
+    rows = []
+    for n, d, k in SHAPES:
+        # UNIFORM random data + random init: Lloyd must not converge inside
+        # the timed window, or the short/long runs do identical work and
+        # the slope is noise (blob data converges in a handful of iters)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        w = jnp.ones((n,), jnp.float32)
+        c0 = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32))
+        tol = jnp.asarray(0.0, jnp.float32)
+        chunks = kmeans_ops.auto_row_chunks(n, k)
+        flops = 2 * 2 * n * k * d
+        window = _iter_window(flops)
+
+        for tier in TIERS:
+            per = {}
+            for kernel in ("xla", "pallas"):
+                ts = {}
+                win = window
+                for attempt in range(3):
+                    ok = True
+                    for iters in win:
+                        if kernel == "xla":
+                            run = lambda it=iters: kmeans_ops.lloyd_run(
+                                x, w, c0, it, tol, chunks, tier
+                            )
+                        else:
+                            run = lambda it=iters: lloyd_run_pallas(
+                                x, w, c0, it, tol, mode=tier
+                            )
+                        n_iter = int(run()[1])
+                        if n_iter != iters:
+                            # Lloyd hit an exact fixed point before the
+                            # window closed (zero moves satisfy tol=0):
+                            # shrink the window below the convergence
+                            # point and retry instead of aborting
+                            win = (max(2, n_iter // 8), max(8, n_iter // 2))
+                            ok = False
+                            break
+                        fn = lambda r=run, it=iters: np.asarray(r(it)[0])
+                        ts[iters] = _time_run(fn)
+                    if ok:
+                        break
+                else:
+                    print(f"# skip {n}x{d} k={k} {tier} {kernel}: converges "
+                          "too fast for a stable slope", flush=True)
+                    continue
+                per[kernel] = (ts[win[1]] - ts[win[0]]) / (win[1] - win[0])
+                rows.append({
+                    "shape": f"{n}x{d} k={k}", "tier": tier, "kernel": kernel,
+                    "ms_per_iter": round(per[kernel] * 1e3, 2),
+                    "iters_per_sec": round(1 / per[kernel], 1),
+                    "tflops": round(flops / per[kernel] / 1e12, 1),
+                })
+                print(json.dumps(rows[-1]), flush=True)
+    return rows
+
+
+def markdown(rows) -> str:
+    out = [
+        "| shape | tier | XLA ms/iter | Pallas ms/iter | winner |",
+        "|---|---|---|---|---|",
+    ]
+    by = {}
+    for r in rows:
+        by.setdefault((r["shape"], r["tier"]), {})[r["kernel"]] = r["ms_per_iter"]
+    for (shape, tier), d in by.items():
+        if "xla" in d and "pallas" in d:
+            win = "xla" if d["xla"] <= d["pallas"] else "pallas"
+            out.append(
+                f"| {shape} | {tier} | {d['xla']} | {d['pallas']} | {win} |"
+            )
+    return "\n".join(out)
+
+
+if __name__ == "__main__":
+    rows = profile()
+    print()
+    print(markdown(rows))

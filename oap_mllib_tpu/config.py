@@ -71,15 +71,17 @@ class Config:
     timing: bool = False
     seed: int = 0
     # "highest" = full f32 on the MXU (multi-pass) — required for the 1e-4
-    # numerical-parity contract.  "high" = bf16_3x (measured 6.6e-5 cost
-    # error on TPU — inside the 1e-4 bar, ~1.4x faster).  "default" = bf16
-    # inputs; opt-in for throughput-first workloads.
+    # numerical-parity contract.  "high" = bf16_3x centroid sums + bf16
+    # assignment matmul (argmin is decision-only; see kmeans_ops
+    # ._assign_prec) — measured within 1e-5 of highest on the TPU parity
+    # suite at ~3x the throughput.  "default" = bf16 everywhere; opt-in
+    # for throughput-first workloads.
     matmul_precision: str = "highest"
-    # K-Means hot-loop kernel: "auto" picks the fastest measured path for
-    # the backend (the chunked XLA Lloyd — on v5e it reaches ~94% of the
-    # per-precision MXU envelope and beats the fused Pallas kernel at every
-    # shape profiled; see BASELINE.md), "xla"/"pallas" force a path.
-    # "pallas" requires TPU + single-device + f32 and falls back otherwise.
+    # K-Means hot-loop kernel: "auto" picks the fastest measured path per
+    # shape/tier (BASELINE.md kernel table, v5e): the fused Pallas kernel
+    # for MXU-deep features (d >= 256) at the f32-accurate tiers, the
+    # chunked XLA Lloyd otherwise.  "xla"/"pallas" force a path; "pallas"
+    # requires TPU + single-device + f32 and falls back otherwise.
     kmeans_kernel: str = "auto"
 
     @classmethod

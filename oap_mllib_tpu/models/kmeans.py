@@ -259,18 +259,28 @@ class KMeans:
     def _run_lloyd(self, table, weights, centers0, dtype, cfg):
         """Dispatch the hot loop to the configured kernel.
 
-        ``auto`` -> chunked XLA Lloyd (fastest measured on v5e at every
-        profiled shape, BASELINE.md kernel table); ``pallas`` -> the fused
-        single-chip kernel when its preconditions hold (TPU backend, one
-        device, f32), else the XLA path.  Chunking only applies on a single
-        device: the scan reshape conflicts with GSPMD row sharding.
+        ``auto`` picks the fastest measured path for the shape/tier
+        (BASELINE.md kernel table, v5e; rule in
+        kmeans_ops.pallas_preferred): the fused Pallas kernel when the
+        feature dim is MXU-deep and (k, d) fits its VMEM blocks — its
+        exact-split cluster sums cut the per-iteration MXU passes —
+        else the chunked XLA Lloyd.  ``xla``/``pallas`` force a path;
+        ``pallas`` requires TPU + single device + f32 and falls back
+        otherwise.  Chunking only applies on a single device: the scan
+        reshape conflicts with GSPMD row sharding.
         """
         single_device = len(jax.devices()) == 1 and jax.process_count() == 1
         kernel = cfg.kmeans_kernel
         if kernel not in ("auto", "xla", "pallas"):
             raise ValueError(f"kmeans_kernel must be auto|xla|pallas, got {kernel!r}")
+        want_pallas = kernel == "pallas" or (
+            kernel == "auto"
+            and kmeans_ops.pallas_preferred(
+                table.data.shape[1], self.k, cfg.matmul_precision
+            )
+        )
         use_pallas = (
-            kernel == "pallas"
+            want_pallas
             and jax.default_backend() == "tpu"
             and single_device
             and dtype == np.float32

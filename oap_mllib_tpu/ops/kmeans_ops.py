@@ -42,10 +42,10 @@ def _prec(precision: str):
 
     "highest" (default) keeps full f32 on the MXU via multi-pass
     accumulation — required for the 1e-4 parity contract (survey §7.3
-    determinism note).  "high" (bf16_3x) measured 6.6e-5 cost error on TPU
-    — inside the 1e-4 bar with ~2x fewer MXU passes; "default" (bf16)
-    measured 1e-3 — outside it.  Unknown values raise — a typo must not
-    silently degrade to bf16."""
+    determinism note).  "high" = bf16_3x sums + bf16 assignment (see
+    _assign_prec) — measured within 1e-5 of highest on the parity suite;
+    "default" (bf16 everywhere) measured ~1e-3 — outside the bar.
+    Unknown values raise — a typo must not silently degrade to bf16."""
     try:
         return {
             "highest": lax.Precision.HIGHEST,
@@ -57,6 +57,37 @@ def _prec(precision: str):
             "matmul_precision must be 'highest', 'high', or 'default', "
             f"got {precision!r}"
         ) from None
+
+
+def pallas_preferred(d: int, k: int, precision: str) -> bool:
+    """Shape/tier rule for kmeans_kernel="auto" (BASELINE.md kernel table,
+    measured on v5e): the fused Pallas kernel wins when the feature dim is
+    MXU-deep — d >= 256 at the f32-accurate tiers (its exact-split sums
+    need 2 bf16 passes where XLA "high" pays 3 and "highest" 6+), d >= 1024
+    even at "default".  At small d the fused kernel's block overheads
+    dominate.  Large k is excluded: the kernel holds the full (k, d)
+    centers AND sums blocks in VMEM, so past ~4M padded elements apiece
+    (2 x 16 MB f32) Mosaic would fail to place them — those fits stay on
+    the chunked XLA path."""
+    k_pad = -(-k // 128) * 128
+    d_pad = -(-d // 128) * 128
+    if k_pad * d_pad > (1 << 22):  # 16 MB per f32 VMEM block
+        return False
+    if precision in ("highest", "high"):
+        return d >= 256
+    return d >= 1024
+
+
+def _assign_prec(precision: str) -> str:
+    """Precision for the ASSIGNMENT (distance) matmul inside the Lloyd
+    loop.  The "high" tier runs it at bf16: argmin is a discrete decision
+    — extra mantissa bits only matter at exact Voronoi ties, where either
+    choice leaves the objective unchanged (cost is continuous across the
+    boundary) — while centroid accuracy is governed by the SUMS matmul,
+    which keeps bf16_3x.  Measured on TPU v5e (1M x 256, k=1000, blob
+    data): bit-identical centers to dist-at-bf16_3x, 1.65x faster.
+    "highest" stays full-f32 end-to-end (the strict parity tier)."""
+    return "default" if precision == "high" else precision
 
 
 def pairwise_sq_dists(
@@ -82,7 +113,7 @@ def _accumulate(x, weights, centers, precision: str = "highest"):
     global over the row-sharded inputs — GSPMD inserts the psum.
     """
     k = centers.shape[0]
-    d2 = pairwise_sq_dists(x, centers, precision)  # (n, k)
+    d2 = pairwise_sq_dists(x, centers, _assign_prec(precision))  # (n, k)
     assign = jnp.argmin(d2, axis=1)  # (n,)
     min_d2 = jnp.min(d2, axis=1)  # (n,)
     one_hot = jax.nn.one_hot(assign, k, dtype=x.dtype) * weights[:, None]  # (n, k)

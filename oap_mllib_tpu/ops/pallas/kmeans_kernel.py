@@ -11,16 +11,16 @@ accumulate pattern).  HBM traffic per iteration drops from
 O(n*d + 2*n*k) to O(n*d + k*d).
 
 Precision tiers (``mode``) — Mosaic only lowers Precision.HIGHEST/DEFAULT,
-so the 3-pass tier is implemented by hand with bf16 hi/lo splits:
+so split tiers are implemented by hand with bf16 hi/lo splits:
 
 - ``highest``: both matmuls f32 Precision.HIGHEST.  Parity default.
-- ``high``: distance cross-term via manual bf16_3x (hi@hi + hi@lo + lo@hi);
-  cluster sums via an *exact-split* trick: the unweighted one-hot is 0/1 —
-  exactly representable in bf16 — so ``one_hot.T @ (w*x)`` with (w*x)
-  split into bf16 hi+lo needs only TWO bf16 passes and is accurate to
-  ~f32.  Matches the XLA "high" (bf16_3x) tier's error envelope.
-- ``default``: distance cross-term single-pass bf16, sums still exact-split
-  (2 passes).  Assignment flips only on near-ties; sums stay ~f32-exact.
+- ``high`` / ``default``: distance cross-term single-pass bf16 (the tier
+  contract — kmeans_ops._assign_prec — runs the assignment matmul at bf16
+  for both: argmin is decision-only); cluster sums via an *exact-split*
+  trick: the unweighted one-hot is 0/1 — exactly representable in bf16 —
+  so ``one_hot.T @ (w*x)`` with (w*x) split into bf16 hi+lo needs only
+  TWO bf16 passes and is accurate to ~f32, meeting the XLA "high" tier's
+  error envelope (and exceeding XLA "default"'s).
 
 Caller contract (see ``lloyd_accumulate_pallas``): rows padded to the block
 size with weight 0; k and d padded to lane multiples (128) by the wrapper —
@@ -64,19 +64,17 @@ def _dot_bf16(a, b, dn):
 
 
 def _cross_term(x, c, mode):
-    """x @ c.T (bn, k) at the requested precision tier."""
+    """x @ c.T (bn, k) at the requested precision tier.
+
+    "high" and "default" share the single-pass bf16 path: the tier
+    definition (kmeans_ops._assign_prec) runs the ASSIGNMENT matmul at
+    bf16 for both — argmin is a discrete decision, and the tiers differ
+    only in the cluster-sums accuracy (which this kernel's exact-split
+    sums exceed in both modes)."""
     dn = (((1,), (1,)), ((), ()))
     if mode == "highest":
         return _dot_f32(x, c, dn)
-    if mode == "high":  # manual bf16_3x
-        x_hi, x_lo = _split_bf16(x)
-        c_hi, c_lo = _split_bf16(c)
-        return (
-            _dot_bf16(x_hi, c_hi, dn)
-            + _dot_bf16(x_hi, c_lo, dn)
-            + _dot_bf16(x_lo, c_hi, dn)
-        )
-    # default: single-pass bf16 — argmin only flips on near-ties
+    # high/default: single-pass bf16 — argmin only flips on near-ties
     return _dot_bf16(x.astype(jnp.bfloat16), c.astype(jnp.bfloat16), dn)
 
 

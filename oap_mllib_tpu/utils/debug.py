@@ -1,0 +1,85 @@
+"""Debug table printers — the Service.java helpers (reference
+ml/util/Service.java:377-578 printNumericTable family), off the hot path.
+
+The reference ships Intel-sample pretty-printers used when debugging the
+JNI data plane.  The analogs here format (sharded) device tables without
+forcing a full-table transfer: only the printed head is fetched.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _fetch_head(arr, n: int) -> np.ndarray:
+    """First ``n`` rows of a host or device array; device transfers are
+    bounded to the head (a sharded array is gathered via one jitted slice
+    so multi-host tables print without materializing everywhere)."""
+    try:
+        import jax
+
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            head = jax.jit(
+                lambda a: a[:n],
+                out_shardings=NamedSharding(arr.sharding.mesh, PartitionSpec()),
+            )(arr)
+            return np.asarray(head)
+    except ImportError:
+        pass
+    return np.asarray(arr[:n])
+
+
+def format_table(data, title: str = "", max_rows: int = 10,
+                 max_cols: int = 20, precision: int = 6) -> str:
+    """Format a 2-D table like Service.printNumericTable: a title line with
+    shape, then the first rows/cols with aligned fixed-point values."""
+    head = _fetch_head(data, max_rows)
+    if head.ndim == 1:
+        head = head[:, None]
+    full_shape = tuple(getattr(data, "shape", head.shape))
+    n_rows = full_shape[0] if full_shape else 0
+    n_cols = full_shape[1] if len(full_shape) > 1 else 1
+    lines = [f"{title or 'table'} ({n_rows} x {n_cols})"]
+    shown = head[:, :max_cols]
+    for r in shown:
+        lines.append("  " + " ".join(f"{v: .{precision}f}" for v in r))
+    trailer = []
+    if head.shape[0] < n_rows:
+        trailer.append(f"{n_rows - head.shape[0]} more rows")
+    if head.shape[1] > max_cols:
+        trailer.append(f"{head.shape[1] - max_cols} more cols")
+    if trailer:
+        lines.append(f"  ... ({', '.join(trailer)})")
+    return "\n".join(lines)
+
+
+def print_table(data, title: str = "", max_rows: int = 10,
+                max_cols: int = 20, precision: int = 6) -> None:
+    print(format_table(data, title, max_rows, max_cols, precision))
+
+
+def format_csr(table, title: str = "", max_rows: int = 10) -> str:
+    """Format a CSRTable row-wise (Service.printCSRNumericTable analog):
+    one line per row with its (col, value) pairs from the CSR offsets.
+    Transfers are bounded to the printed head: only max_rows+1 offsets and
+    the nnz they span are fetched (so device/sharded tables print cheaply)."""
+    offsets = _fetch_head(table.row_offsets, min(max_rows, table.n_rows) + 1)
+    head_nnz = int(offsets[-1])
+    cols = _fetch_head(table.cols, head_nnz)
+    vals = _fetch_head(table.values, head_nnz)
+    lines = [
+        f"{title or 'csr'} ({table.n_rows} x {table.n_cols}, nnz={table.nnz})"
+    ]
+    for r in range(min(max_rows, table.n_rows)):
+        lo, hi = int(offsets[r]), int(offsets[r + 1])
+        pairs = " ".join(f"{int(c)}:{v:.4f}" for c, v in zip(cols[lo:hi], vals[lo:hi]))
+        lines.append(f"  [{r}] {pairs}")
+    if table.n_rows > max_rows:
+        lines.append(f"  ... ({table.n_rows - max_rows} more rows)")
+    return "\n".join(lines)
+
+
+def print_csr(table, title: str = "", max_rows: int = 10) -> None:
+    print(format_csr(table, title, max_rows))

@@ -1,0 +1,51 @@
+"""Debug printer tests (Service.java printNumericTable analogs)."""
+
+import numpy as np
+
+from oap_mllib_tpu.data.table import CSRTable
+from oap_mllib_tpu.utils.debug import format_csr, format_table
+
+
+class TestFormatTable:
+    def test_dense_head_and_shape(self, rng):
+        x = rng.normal(size=(100, 5))
+        out = format_table(x, "features", max_rows=3)
+        assert out.splitlines()[0] == "features (100 x 5)"
+        assert len(out.splitlines()) == 5  # title + 3 rows + ellipsis
+        assert "more rows" in out
+
+    def test_1d_and_col_truncation(self, rng):
+        out = format_table(np.arange(4.0), "v")
+        assert "(4 x 1)" in out
+        wide = format_table(rng.normal(size=(2, 30)), max_cols=4)
+        # truncation note lives on its own summary line, not glued to data
+        assert wide.splitlines()[-1] == "  ... (26 more cols)"
+        both = format_table(rng.normal(size=(9, 30)), max_rows=2, max_cols=4)
+        assert both.splitlines()[-1] == "  ... (7 more rows, 26 more cols)"
+
+    def test_sharded_device_table(self, rng):
+        import jax
+
+        from oap_mllib_tpu.parallel.mesh import get_mesh, shard_rows
+
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        data = shard_rows(x, get_mesh())
+        assert isinstance(data, jax.Array)
+        out = format_table(data, "sharded", max_rows=2)
+        assert "(64 x 4)" in out
+        # printed head matches the host rows
+        assert f"{x[0, 0]: .6f}".strip() in out
+
+
+class TestFormatCsr:
+    def test_rows_and_pairs(self):
+        t = CSRTable.from_coo(
+            np.array([0, 0, 2]), np.array([1, 3, 0]),
+            np.array([1.5, 2.5, 3.5], np.float32), n_rows=3, n_cols=4,
+        )
+        out = format_csr(t, "ratings")
+        lines = out.splitlines()
+        assert "ratings (3 x 4, nnz=3)" == lines[0]
+        assert lines[1].startswith("  [0]") and "1:1.5000" in lines[1]
+        assert lines[2] == "  [1] "  # empty row
+        assert "0:3.5000" in lines[3]

@@ -82,6 +82,31 @@ class TestXlaPrecisionTiers:
         assert float(jnp.max(jnp.abs(c1 - c2))) / scale < 1e-4
         assert abs(float(t1) - float(t2)) / float(t1) < 1e-4
 
+    def test_auto_picks_pallas_for_deep_features(self, rng, monkeypatch):
+        """kmeans_kernel=auto routes d>=256 at the f32-accurate tiers to
+        the fused kernel (BASELINE.md kernel-table rule) — verified by
+        counting calls, not inferred."""
+        if len(jax.devices()) != 1:
+            pytest.skip("pallas estimator path requires a single device")
+        import oap_mllib_tpu.ops.pallas.kmeans_kernel as pk
+        from oap_mllib_tpu.config import set_config
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        calls = []
+        real = pk.lloyd_run_pallas
+        monkeypatch.setattr(
+            pk, "lloyd_run_pallas",
+            lambda *a, **kw: (calls.append(1), real(*a, **kw))[1],
+        )
+        set_config(kmeans_kernel="auto", matmul_precision="high")
+        try:
+            x = rng.normal(size=(2048, 256)).astype(np.float32)
+            m = KMeans(k=8, max_iter=5, seed=1).fit(x)
+            assert m.summary.accelerated
+            assert calls, "auto did not pick pallas for d=256 at high tier"
+        finally:
+            set_config(matmul_precision="highest")
+
     def test_estimator_pallas_kernel_config(self, rng, monkeypatch):
         """KMeans(kmeans_kernel=pallas) runs the fused kernel end-to-end —
         verified by counting calls into the pallas module, not inferred."""
