@@ -112,12 +112,8 @@ def bench_kmeans(precision="highest", cpu_ips=None, extra=None):
     tol = jnp.asarray(0.0, jnp.float32)
     chunks = kmeans_ops.auto_row_chunks(n, k)
 
-    # same kernel choice the estimator's "auto" makes for this shape/tier
-    use_pallas = (
-        kmeans_ops.pallas_preferred(d, k, precision)
-        and jax.default_backend() == "tpu"
-        and len(jax.devices()) == 1
-    )
+    # the estimator's own dispatch rule — one shared helper, cannot diverge
+    use_pallas = kmeans_ops.use_pallas_path("auto", d, k, precision, np.float32)
 
     def run():
         if use_pallas:
@@ -239,15 +235,15 @@ def bench_als():
     x0 = als_np.init_factors(n_users, rank, 0)
     y0 = als_np.init_factors(n_items, rank, 1)
 
-    uj = jax.device_put(jnp.asarray(users))
-    ij = jax.device_put(jnp.asarray(items))
-    rj = jax.device_put(jnp.asarray(ratings))
-    valid = jnp.ones((nnz,), jnp.float32)
+    # grouped-edge layout — the estimator's actual single-device hot path
+    by_user = als_ops.build_grouped_edges(users, items, ratings, n_users)
+    by_item = als_ops.build_grouped_edges(items, users, ratings, n_items)
+    dev = tuple(jax.device_put(jnp.asarray(a)) for a in (*by_user, *by_item))
     x0j, y0j = jnp.asarray(x0), jnp.asarray(y0)
 
     def run():
-        x, y = als_ops.als_implicit_run(
-            uj, ij, rj, valid, x0j, y0j, n_users, n_items, iters, 0.1, 40.0
+        x, y = als_ops.als_run_grouped(
+            *dev, x0j, y0j, n_users, n_items, iters, 0.1, 40.0, True
         )
         return np.asarray(x)
 

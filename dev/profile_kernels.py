@@ -105,6 +105,13 @@ def profile():
                           "too fast for a stable slope", flush=True)
                     continue
                 per[kernel] = (ts[win[1]] - ts[win[0]]) / (win[1] - win[0])
+                if per[kernel] <= 0:
+                    # long run timed faster than short: per-iteration cost
+                    # is below the tunnel's jitter floor — unreportable
+                    print(f"# skip {n}x{d} k={k} {tier} {kernel}: below "
+                          "slope resolution", flush=True)
+                    del per[kernel]
+                    continue
                 rows.append({
                     "shape": f"{n}x{d} k={k}", "tier": tier, "kernel": kernel,
                     "ms_per_iter": round(per[kernel] * 1e3, 2),

@@ -308,20 +308,36 @@ class ALS:
             x0 = als_np.init_factors(n_users, self.rank, self.seed)
             y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
         with phase_timer(timings, "table_convert"):
-            # pad edges so the chunked normal-equation scan always has a
-            # power-of-two chunk factor (padded edges carry valid=0)
+            # grouped-edge layout, one copy per update direction (the
+            # reference's per-rank CSR + transposed CSR, ALSDALImpl.scala
+            # :184-230 / .cpp:209-213, rebuilt for batched MXU matmuls —
+            # see als_ops grouped-path notes); edge indices are static
+            # across iterations so the sort/pad runs once per fit
+            by_user = als_ops.build_grouped_edges(users, items, ratings, n_users)
+            by_item = als_ops.build_grouped_edges(items, users, ratings, n_items)
             nnz = len(users)
-            pad = (-nnz) % 2048
-            u = jnp.asarray(np.pad(users, (0, pad)).astype(np.int32))
-            i = jnp.asarray(np.pad(items, (0, pad)).astype(np.int32))
-            c = jnp.asarray(np.pad(ratings, (0, pad)))
-            valid = jnp.asarray(
-                np.pad(np.ones(nnz, np.float32), (0, pad))
-            )
+            padded_total = by_user[0].size + by_item[0].size
+            grouped_ok = padded_total <= 6 * nnz  # blowup guard (adaptive
+            # group sizing keeps typical data under 2x; extreme long-tail
+            # degree splits fall back to the COO programs below)
+            if grouped_ok:
+                dev = tuple(jnp.asarray(a) for a in (*by_user, *by_item))
+            else:
+                pad = (-nnz) % 2048
+                u = jnp.asarray(np.pad(users, (0, pad)).astype(np.int32))
+                i = jnp.asarray(np.pad(items, (0, pad)).astype(np.int32))
+                c = jnp.asarray(np.pad(ratings, (0, pad)))
+                valid = jnp.asarray(np.pad(np.ones(nnz, np.float32), (0, pad)))
         from oap_mllib_tpu.utils.profiling import maybe_trace
 
         with phase_timer(timings, "als_iterations"), maybe_trace():
-            if self.implicit_prefs:
+            if grouped_ok:
+                x, y = als_ops.als_run_grouped(
+                    *dev, jnp.asarray(x0), jnp.asarray(y0),
+                    n_users, n_items, self.max_iter, self.reg_param,
+                    self.alpha, self.implicit_prefs,
+                )
+            elif self.implicit_prefs:
                 x, y = als_ops.als_implicit_run(
                     u, i, c, valid, jnp.asarray(x0), jnp.asarray(y0),
                     n_users, n_items, self.max_iter, self.reg_param, self.alpha,

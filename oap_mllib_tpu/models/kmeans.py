@@ -270,20 +270,9 @@ class KMeans:
         reshape conflicts with GSPMD row sharding.
         """
         single_device = len(jax.devices()) == 1 and jax.process_count() == 1
-        kernel = cfg.kmeans_kernel
-        if kernel not in ("auto", "xla", "pallas"):
-            raise ValueError(f"kmeans_kernel must be auto|xla|pallas, got {kernel!r}")
-        want_pallas = kernel == "pallas" or (
-            kernel == "auto"
-            and kmeans_ops.pallas_preferred(
-                table.data.shape[1], self.k, cfg.matmul_precision
-            )
-        )
-        use_pallas = (
-            want_pallas
-            and jax.default_backend() == "tpu"
-            and single_device
-            and dtype == np.float32
+        use_pallas = kmeans_ops.use_pallas_path(
+            cfg.kmeans_kernel, table.data.shape[1], self.k,
+            cfg.matmul_precision, dtype,
         )
         if use_pallas:
             from oap_mllib_tpu.ops.pallas.kmeans_kernel import lloyd_run_pallas

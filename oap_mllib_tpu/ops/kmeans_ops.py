@@ -78,6 +78,28 @@ def pallas_preferred(d: int, k: int, precision: str) -> bool:
     return d >= 1024
 
 
+def use_pallas_path(kernel_cfg: str, d: int, k: int, precision: str, dtype) -> bool:
+    """Single source of truth for the kernel dispatch (estimator AND
+    bench): the fused Pallas kernel runs only when configured/preferred
+    AND its preconditions hold — TPU backend, one device, one process,
+    f32.  Keeping this in one place prevents the two call sites from
+    silently diverging."""
+    if kernel_cfg not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"kmeans_kernel must be auto|xla|pallas, got {kernel_cfg!r}"
+        )
+    want = kernel_cfg == "pallas" or (
+        kernel_cfg == "auto" and pallas_preferred(d, k, precision)
+    )
+    return (
+        want
+        and jax.default_backend() == "tpu"
+        and len(jax.devices()) == 1
+        and jax.process_count() == 1
+        and np.dtype(dtype) == np.float32
+    )
+
+
 def _assign_prec(precision: str) -> str:
     """Precision for the ASSIGNMENT (distance) matmul inside the Lloyd
     loop.  The "high" tier runs it at bf16: argmin is a discrete decision

@@ -21,9 +21,19 @@ def _fetch_head(arr, n: int) -> np.ndarray:
         if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
             from jax.sharding import NamedSharding, PartitionSpec
 
+            mesh = getattr(arr.sharding, "mesh", None)
+            if mesh is None:
+                # non-named shardings (GSPMD/positional) carry no mesh to
+                # express a replicated gather on — fail with a usable
+                # message instead of an AttributeError deep in jax
+                raise TypeError(
+                    "cannot fetch the head of a non-addressable array "
+                    f"with {type(arr.sharding).__name__}; pass a "
+                    "NamedSharding array or a host array"
+                )
             head = jax.jit(
                 lambda a: a[:n],
-                out_shardings=NamedSharding(arr.sharding.mesh, PartitionSpec()),
+                out_shardings=NamedSharding(mesh, PartitionSpec()),
             )(arr)
             return np.asarray(head)
     except ImportError:

@@ -244,6 +244,97 @@ class TestBlockParallel:
         np.testing.assert_allclose(model.user_factors_, np.asarray(xg), atol=2e-3, rtol=2e-3)
         np.testing.assert_allclose(model.item_factors_, np.asarray(yg), atol=2e-3, rtol=2e-3)
 
+    def test_grouped_partials_match_coo(self, rng):
+        """The scatter-free grouped layout and the COO segment-sum path
+        compute identical normal-equation partials (both modes)."""
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.ops import als_ops
+
+        u, i, r, nu, ni = _ratings(rng, n_users=23, n_items=11, density=0.5)
+        src = rng.normal(size=(ni, 4)).astype(np.float32)
+        for implicit in (True, False):
+            a1, b1, n1 = als_ops.normal_eq_partials(
+                jnp.asarray(u.astype(np.int32)), jnp.asarray(i.astype(np.int32)),
+                jnp.asarray(r), jnp.ones(len(r), np.float32),
+                jnp.asarray(src), nu, 7.0, implicit,
+            )
+            sg, cg, vg, gd = als_ops.build_grouped_edges(u, i, r, nu, group_size=8)
+            a2, b2, n2 = als_ops.normal_eq_partials_grouped(
+                jnp.asarray(sg), jnp.asarray(cg), jnp.asarray(vg),
+                jnp.asarray(gd), jnp.asarray(src), nu, 7.0, implicit,
+            )
+            np.testing.assert_allclose(np.asarray(a1), np.asarray(a2), atol=1e-4)
+            np.testing.assert_allclose(np.asarray(b1), np.asarray(b2), atol=1e-4)
+            np.testing.assert_allclose(np.asarray(n1), np.asarray(n2), atol=1e-5)
+
+    def test_grouped_run_matches_coo_programs(self, rng):
+        """Full grouped training loop vs the COO reference programs."""
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.ops import als_ops
+
+        u, i, r, nu, ni = _ratings(rng, n_users=19, n_items=13, density=0.4)
+        rank, iters = 4, 3
+        x0 = jnp.asarray(init_factors(nu, rank, 5))
+        y0 = jnp.asarray(init_factors(ni, rank, 6))
+        by_u = tuple(jnp.asarray(a) for a in als_ops.build_grouped_edges(u, i, r, nu))
+        by_i = tuple(jnp.asarray(a) for a in als_ops.build_grouped_edges(i, u, r, ni))
+        uj = jnp.asarray(u.astype(np.int32)); ij = jnp.asarray(i.astype(np.int32))
+        rj = jnp.asarray(r); vj = jnp.ones(len(r), np.float32)
+        # implicit
+        xg, yg = als_ops.als_run_grouped(
+            *by_u, *by_i, x0, y0, nu, ni, iters, 0.15, 3.0, True)
+        xc, yc = als_ops.als_implicit_run(
+            uj, ij, rj, vj, x0, y0, nu, ni, iters, 0.15, 3.0)
+        np.testing.assert_allclose(np.asarray(xg), np.asarray(xc), atol=2e-4, rtol=2e-4)
+        np.testing.assert_allclose(np.asarray(yg), np.asarray(yc), atol=2e-4, rtol=2e-4)
+        # explicit
+        xg, yg = als_ops.als_run_grouped(
+            *by_u, *by_i, x0, y0, nu, ni, iters, 0.15, 0.0, False)
+        xc, yc = als_ops.als_explicit_run(
+            uj, ij, rj, vj, x0, y0, nu, ni, iters, 0.15)
+        np.testing.assert_allclose(np.asarray(xg), np.asarray(xc), atol=2e-4, rtol=2e-4)
+        np.testing.assert_allclose(np.asarray(yg), np.asarray(yc), atol=2e-4, rtol=2e-4)
+
+    @pytest.mark.parametrize("implicit", [True, False])
+    def test_single_device_grouped_estimator_matches_oracle(self, rng, implicit):
+        """ALS with num_user_blocks=1 takes the single-device grouped path
+        (even on the 8-device suite mesh) and matches the oracle."""
+        u, i, r, nu, ni = _ratings(rng)
+        rank, iters, reg, alpha = 4, 3, 0.2, 2.0
+        x0 = init_factors(nu, rank, 1)
+        y0 = init_factors(ni, rank, 2)
+        model = ALS(
+            rank=rank, max_iter=iters, reg_param=reg, alpha=alpha,
+            implicit_prefs=implicit, num_user_blocks=1,
+        ).fit(u, i, r, n_users=nu, n_items=ni, init=(x0, y0))
+        assert not model.summary.get("block_parallel")
+        ox = _oracle_als(u, i, r, nu, ni, rank, iters, reg, alpha, implicit, x0, y0)
+        np.testing.assert_allclose(model.user_factors_, ox[0], atol=2e-3, rtol=2e-3)
+        np.testing.assert_allclose(model.item_factors_, ox[1], atol=2e-3, rtol=2e-3)
+
+    def test_long_tail_falls_back_to_coo(self, rng):
+        """Degree ~1 everywhere: grouped padding would blow past the 6x
+        guard, so the single-device fit must route to the COO programs
+        and still match the oracle."""
+        from oap_mllib_tpu.ops import als_ops
+
+        nu = ni = 120
+        u = np.arange(nu, dtype=np.int64)
+        i = rng.permutation(ni).astype(np.int64)
+        r = rng.integers(1, 6, size=nu).astype(np.float32)
+        assert als_ops.auto_group_size(len(u), nu) == 8
+        by_u = als_ops.build_grouped_edges(u, i, r, nu)
+        by_i = als_ops.build_grouped_edges(i, u, r, ni)
+        assert by_u[0].size + by_i[0].size > 6 * len(u)  # guard trips
+        x0 = init_factors(nu, 3, 1)
+        y0 = init_factors(ni, 3, 2)
+        model = ALS(rank=3, max_iter=2, reg_param=0.1, num_user_blocks=1).fit(
+            u, i, r, n_users=nu, n_items=ni, init=(x0, y0))
+        ox, oy = _oracle_als(u, i, r, nu, ni, 3, 2, 0.1, 1.0, False, x0, y0)
+        np.testing.assert_allclose(model.user_factors_, ox, atol=2e-3, rtol=2e-3)
+
     def test_users_fewer_than_ranks(self, rng):
         """Degenerate: fewer users than mesh ranks (empty blocks)."""
         u = np.array([0, 1, 2, 0, 1])
