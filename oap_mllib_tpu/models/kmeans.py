@@ -218,6 +218,15 @@ class KMeans:
         cfg = get_config()
         timings = Timings()
         mesh = get_mesh()
+        mp = mesh.shape[cfg.model_axis]
+        d_orig = x.shape[1]
+        if mp > 1 and cfg.kmeans_kernel != "xla" and d_orig % mp:
+            # model-sharded Lloyd needs d % model == 0; zero-pad feature
+            # columns (zero in data AND centroids — no distance or move
+            # contribution) and slice them back off the final centers.
+            # Skipped when no padding is needed or when "xla" forces the
+            # GSPMD route — np.pad would copy the whole dataset.
+            x = np.pad(x, ((0, 0), (0, (-d_orig) % mp)))
         with phase_timer(timings, "table_convert"):
             # multi-process: each host contributes its local shard
             # (README multi-host flow); single-process: the full table
@@ -245,9 +254,9 @@ class KMeans:
                 ).astype(dtype)
         with phase_timer(timings, "lloyd_loop"):
             centers, n_iter, cost, counts = self._run_lloyd(
-                table, weights, centers0, dtype, cfg
+                table, weights, centers0, dtype, cfg, mesh
             )
-            centers = np.asarray(centers)
+            centers = np.asarray(centers)[:, :d_orig]
             n_iter = int(n_iter)
             cost = float(cost)
         summary = KMeansSummary(
@@ -256,7 +265,7 @@ class KMeans:
         )
         return KMeansModel(centers, self.distance_measure, summary)
 
-    def _run_lloyd(self, table, weights, centers0, dtype, cfg):
+    def _run_lloyd(self, table, weights, centers0, dtype, cfg, mesh):
         """Dispatch the hot loop to the configured kernel.
 
         ``auto`` picks the fastest measured path for the shape/tier
@@ -267,13 +276,31 @@ class KMeans:
         else the chunked XLA Lloyd.  ``xla``/``pallas`` force a path;
         ``pallas`` requires TPU + single device + f32 and falls back
         otherwise.  Chunking only applies on a single device: the scan
-        reshape conflicts with GSPMD row sharding.
+        reshape conflicts with GSPMD row sharding.  A mesh with a model
+        axis > 1 routes to the feature-sharded shard_map Lloyd — unless
+        ``xla`` is forced, which keeps the GSPMD data-parallel program
+        (centroids replicated) so the two can be A/B'd on the same mesh.
         """
-        single_device = len(jax.devices()) == 1 and jax.process_count() == 1
+        # use_pallas_path is the single kmeans_kernel validation point and
+        # must run on EVERY accelerated fit — a typo'd value raises even
+        # when the model-sharded route below makes its answer moot
         use_pallas = kmeans_ops.use_pallas_path(
             cfg.kmeans_kernel, table.data.shape[1], self.k,
             cfg.matmul_precision, dtype,
         )
+        if mesh.shape[cfg.model_axis] > 1 and cfg.kmeans_kernel != "xla":
+            return kmeans_ops.lloyd_run_model_sharded(
+                table.data,
+                weights,
+                centers0,
+                self.max_iter,
+                jnp.asarray(self.tol, dtype),
+                mesh,
+                cfg.data_axis,
+                cfg.model_axis,
+                precision=cfg.matmul_precision,
+            )
+        single_device = len(jax.devices()) == 1 and jax.process_count() == 1
         if use_pallas:
             from oap_mllib_tpu.ops.pallas.kmeans_kernel import lloyd_run_pallas
 

@@ -190,6 +190,48 @@ def auto_row_chunks(n: int, k: int, budget_elems: int = 1 << 25) -> int:
     return chunks
 
 
+def _lloyd_loop(accum, moved_reduce, init_centers, max_iter, tol_sq, dtype):
+    """Shared Lloyd loop skeleton (single-program AND model-sharded paths
+    — one definition so convergence/empty-cluster semantics cannot drift).
+
+    Reference semantics (KMeansDALImpl.cpp:135-168): stop when every
+    center's squared L2 move <= tol^2, or at max_iter.  Empty clusters
+    keep their previous center (Spark MLlib behavior).  ``accum(centers,
+    prec)`` returns (sums, counts, cost) for whichever layout the caller
+    closed over; ``moved_reduce`` completes the per-center move norm
+    (identity, or a psum over the model axis for feature-sharded centers).
+    The final cost/counts are re-computed against the returned centers at
+    full precision: the fast tiers' distance error is amplified by
+    cancellation when clusters are tight, and the user-facing objective
+    must not carry it (centers themselves stay ~1e-6 accurate).
+    """
+
+    def cond(state):
+        _, it, converged, _ = state
+        return jnp.logical_and(it < max_iter, jnp.logical_not(converged))
+
+    def body(state):
+        centers, it, _, _ = state
+        sums, counts, cost = accum(centers, None)
+        safe = counts[:, None] > 0
+        new_centers = jnp.where(
+            safe, sums / jnp.maximum(counts[:, None], 1e-30), centers
+        )
+        moved_sq = moved_reduce(jnp.sum((new_centers - centers) ** 2, axis=1))
+        converged = jnp.all(moved_sq <= tol_sq)
+        return new_centers, it + 1, converged, cost
+
+    init_state = (
+        init_centers,
+        jnp.asarray(0, jnp.int32),
+        jnp.asarray(False),
+        jnp.asarray(0.0, dtype),
+    )
+    centers, n_iter, _, _ = lax.while_loop(cond, body, init_state)
+    _, counts, cost = accum(centers, "highest")
+    return centers, n_iter, cost, counts
+
+
 @functools.partial(jax.jit, static_argnames=("max_iter", "row_chunks", "precision"))
 def lloyd_run(
     x: jax.Array,
@@ -202,46 +244,108 @@ def lloyd_run(
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Full Lloyd optimization: returns (centers, n_iter, cost, counts).
 
-    Convergence follows the reference semantics (KMeansDALImpl.cpp:135-168):
-    stop when every center's squared L2 move <= tol^2, or at max_iter.
-    Empty clusters keep their previous center (Spark MLlib behavior).
-    The final cost is computed against the returned centers.
+    Semantics in :func:`_lloyd_loop` (the reference's convergence contract,
+    KMeansDALImpl.cpp:135-168).
     """
-    tol_sq = tol * tol
 
-    def accum(centers, prec=precision):
+    def accum(centers, prec):
+        p = prec or precision
         if row_chunks > 1:
-            return _accumulate_chunked(x, weights, centers, row_chunks, prec)
-        return _accumulate(x, weights, centers, prec)
+            return _accumulate_chunked(x, weights, centers, row_chunks, p)
+        return _accumulate(x, weights, centers, p)
 
-    def cond(state):
-        _, it, converged, _ = state
-        return jnp.logical_and(it < max_iter, jnp.logical_not(converged))
-
-    def body(state):
-        centers, it, _, _ = state
-        sums, counts, cost = accum(centers)
-        safe = counts[:, None] > 0
-        new_centers = jnp.where(safe, sums / jnp.maximum(counts[:, None], 1e-30), centers)
-        moved_sq = jnp.sum((new_centers - centers) ** 2, axis=1)
-        converged = jnp.all(moved_sq <= tol_sq)
-        return new_centers, it + 1, converged, cost
-
-    init_state = (
-        init_centers,
-        jnp.asarray(0, jnp.int32),
-        jnp.asarray(False),
-        jnp.asarray(0.0, x.dtype),
+    return _lloyd_loop(
+        accum, lambda m: m, init_centers, max_iter, tol * tol, x.dtype
     )
-    centers, n_iter, _, _ = lax.while_loop(cond, body, init_state)
-    # cost + weighted cluster sizes w.r.t. final centers (the reference
-    # reports the master-step objective for the last completed iteration,
-    # KMeansDALImpl.cpp:120-131; counts feed KMeansSummary.cluster_sizes).
-    # Always at full precision: the fast tiers' distance error is amplified
-    # by cancellation when clusters are tight, and the user-facing
-    # objective must not carry it (centers themselves stay ~1e-6 accurate).
-    _, counts, cost = accum(centers, "highest")
-    return centers, n_iter, cost, counts
+
+
+@functools.lru_cache(maxsize=8)
+def _lloyd_model_sharded_fn(mesh, dax: str, max_: str, max_iter: int,
+                            precision: str):
+    """Compiled model-sharded Lloyd program, cached per (mesh, shape-free
+    statics) — a fresh jit(shard_map) closure per fit would recompile.
+
+    Mesh-sharded linalg (survey §5): on a (data, model) mesh each device
+    holds a (rows/data, d/model) tile of X and a (k, d/model) tile of the
+    centroids — the feature axis is split exactly like the model-sharded
+    PCA Gram (pca_ops.covariance_model_sharded), so centroid blocks whose
+    (k, d) outgrows one chip's HBM spread over the model axis.  Squared
+    distances decompose additively over feature blocks, so the assignment
+    needs ONE psum of the (n_loc, k) partial distances over the model axis;
+    the centroid-sum matmul then stays entirely feature-local (each model
+    shard updates its own slice) with a psum over data only.  The reference
+    cannot shard this dimension at all (oneDAL centroids are single-node,
+    KMeansDALImpl.cpp:101-131).
+    """
+    a_prec = _prec(_assign_prec(precision))
+    s_prec = _prec(precision)
+    h_prec = _prec("highest")
+
+    def accum(x_blk, w_blk, c_blk, aprec, sprec):
+        k = c_blk.shape[0]
+        x_sq = jnp.sum(x_blk * x_blk, axis=1, keepdims=True)  # (n_loc, 1)
+        c_sq = jnp.sum(c_blk * c_blk, axis=1)  # (k,)
+        cross = jnp.matmul(x_blk, c_blk.T, precision=aprec)  # <- MXU
+        # one psum carries all three feature-block partials at once
+        d2 = lax.psum(x_sq + c_sq[None, :] - 2.0 * cross, max_)
+        d2 = jnp.maximum(d2, 0.0)
+        assign = jnp.argmin(d2, axis=1)
+        min_d2 = jnp.min(d2, axis=1)
+        one_hot = jax.nn.one_hot(assign, k, dtype=x_blk.dtype) * w_blk[:, None]
+        sums_blk = lax.psum(
+            jnp.matmul(one_hot.T, x_blk, precision=sprec), dax
+        )  # (k, d_loc) — stays feature-local
+        counts = lax.psum(jnp.sum(one_hot, axis=0), dax)
+        cost = lax.psum(jnp.sum(min_d2 * w_blk), dax)
+        return sums_blk, counts, cost
+
+    def rank_program(x_blk, w_blk, c0_blk, tol_sq):
+        def tile_accum(c_blk, prec):
+            if prec == "highest":
+                return accum(x_blk, w_blk, c_blk, h_prec, h_prec)
+            return accum(x_blk, w_blk, c_blk, a_prec, s_prec)
+
+        # per-center move norms are partial over the local feature block —
+        # complete them over the model axis before the convergence test
+        return _lloyd_loop(
+            tile_accum, lambda m: lax.psum(m, max_), c0_blk, max_iter,
+            tol_sq, x_blk.dtype,
+        )
+
+    from jax.sharding import PartitionSpec as P
+
+    return jax.jit(
+        jax.shard_map(
+            rank_program,
+            mesh=mesh,
+            in_specs=(P(dax, max_), P(dax), P(None, max_), P()),
+            out_specs=(P(None, max_), P(), P(), P()),
+            check_vma=False,
+        )
+    )
+
+
+def lloyd_run_model_sharded(
+    x: jax.Array,
+    weights: jax.Array,
+    init_centers: jax.Array,
+    max_iter: int,
+    tol: jax.Array,
+    mesh,
+    data_axis: str,
+    model_axis: str,
+    precision: str = "highest",
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Lloyd loop with centroids feature-sharded over the MODEL axis.
+
+    Same semantics and return contract as :func:`lloyd_run`.  ``d`` must be
+    a multiple of the model-axis size (the estimator zero-pads feature
+    columns; zero columns contribute nothing to distances or moves, and
+    their centroid entries stay exactly zero).
+    """
+    fn = _lloyd_model_sharded_fn(mesh, data_axis, model_axis, max_iter,
+                                 precision)
+    return fn(x, weights, jnp.asarray(init_centers), tol * tol)
 
 
 @jax.jit

@@ -193,6 +193,103 @@ class TestSharding:
             assert abs(model.summary.training_cost - direct) / max(direct, 1e-9) < 1e-4
 
 
+class TestModelParallel:
+    """Mesh-sharded linalg for K-Means: centroids feature-sharded over the
+    MODEL axis of a (data=4, model=2) mesh (survey §5 scope; the shard_map
+    program in kmeans_ops.lloyd_run_model_sharded)."""
+
+    def test_2d_mesh_matches_1d(self, rng):
+        x, _, _ = _blobs(rng, n=512, d=8, k=4)
+        m1 = KMeans(k=4, max_iter=25, seed=3, init_mode="random").fit(x)
+        set_config(model_parallel=2)
+        m2 = KMeans(k=4, max_iter=25, seed=3, init_mode="random").fit(x)
+        # same host-side RNG -> same init -> identical Lloyd trajectory
+        assert m1.summary.num_iter == m2.summary.num_iter
+        np.testing.assert_allclose(
+            m1.cluster_centers_, m2.cluster_centers_, atol=1e-5
+        )
+        # cost tolerance is loose: the f32 distance identity |x|^2+|c|^2-2xc
+        # cancels ~4 decades on tight blobs (|x|^2 ~ 200 vs min-dist ~ 0.02),
+        # and the model-sharded path sums feature-block partials in a
+        # different order — centers are exact, the summed objective wobbles
+        np.testing.assert_allclose(
+            m1.summary.training_cost, m2.summary.training_cost, rtol=5e-3
+        )
+        np.testing.assert_allclose(
+            m1.summary.cluster_sizes, m2.summary.cluster_sizes, atol=1e-6
+        )
+
+    def test_2d_mesh_feature_padding(self, rng):
+        """d=7 does not divide model=2: zero-padded feature columns must
+        not perturb centers, cost, or the returned center shape."""
+        x, _, _ = _blobs(rng, n=300, d=7, k=3)
+        set_config(model_parallel=2)
+        model = KMeans(k=3, max_iter=30, seed=1, init_mode="random").fit(x)
+        assert model.cluster_centers_.shape == (3, 7)
+        ref_c, ref_cost = _oracle_lloyd(
+            x, model.cluster_centers_.copy(), max_iter=1, tol=1e30
+        )
+        # a converged fit is a Lloyd fixed point: one more oracle step
+        # cannot move the centers
+        np.testing.assert_allclose(model.cluster_centers_, ref_c, atol=1e-4)
+        d2 = ((x[:, None, :] - model.cluster_centers_[None, :, :]) ** 2).sum(-1)
+        assert abs(model.summary.training_cost - d2.min(1).sum()) < 1e-4 * max(
+            d2.min(1).sum(), 1.0
+        )
+
+    def test_2d_mesh_matches_oracle(self, rng):
+        x, true_c, _ = _blobs(rng, n=640, d=8, k=4, spread=0.02)
+        set_config(model_parallel=2)
+        model = KMeans(k=4, max_iter=40, seed=0).fit(x)
+        # well-separated blobs: recovered centers match the generators
+        got = model.cluster_centers_
+        for c in true_c:
+            assert np.min(np.sum((got - c) ** 2, axis=1)) < 0.01
+
+    def test_forced_xla_honored_on_model_mesh(self, rng):
+        """kmeans_kernel="xla" must force the GSPMD data-parallel Lloyd
+        even when model_parallel > 1 (the A/B knob), and agree with the
+        model-sharded program."""
+        import oap_mllib_tpu.ops.kmeans_ops as ko
+
+        x, _, _ = _blobs(rng, n=256, d=8, k=3)
+        set_config(model_parallel=2, kmeans_kernel="xla")
+        before = ko._lloyd_model_sharded_fn.cache_info().currsize
+        m1 = KMeans(k=3, max_iter=20, seed=4, init_mode="random").fit(x)
+        assert ko._lloyd_model_sharded_fn.cache_info().currsize == before
+        set_config(kmeans_kernel="auto")
+        m2 = KMeans(k=3, max_iter=20, seed=4, init_mode="random").fit(x)
+        np.testing.assert_allclose(
+            m1.cluster_centers_, m2.cluster_centers_, atol=1e-5
+        )
+
+    def test_invalid_kernel_raises_on_model_sharded_route(self, rng):
+        """kmeans_kernel validation must run even when the model axis
+        routes the fit away from the pallas/xla dispatch."""
+        x, _, _ = _blobs(rng, n=64, d=8, k=2)
+        set_config(model_parallel=2, kmeans_kernel="typo")
+        with pytest.raises(ValueError, match="kmeans_kernel"):
+            KMeans(k=2, max_iter=2, init_mode="random").fit(x)
+
+    def test_weighted_2d_mesh(self, rng):
+        """Row weights thread through the model-sharded path unchanged."""
+        x, _, _ = _blobs(rng, n=256, d=8, k=3)
+        w = (rng.random(256) + 0.5).astype(np.float64)
+        m1 = KMeans(k=3, max_iter=20, seed=5, init_mode="random").fit(
+            x, sample_weight=w
+        )
+        set_config(model_parallel=2)
+        m2 = KMeans(k=3, max_iter=20, seed=5, init_mode="random").fit(
+            x, sample_weight=w
+        )
+        np.testing.assert_allclose(
+            m1.cluster_centers_, m2.cluster_centers_, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            m1.summary.cluster_sizes, m2.summary.cluster_sizes, atol=1e-5
+        )
+
+
 class TestRegressions:
     def test_cosine_compute_cost_consistent_with_training(self, rng):
         """compute_cost must use the model's distance measure (cosine models
