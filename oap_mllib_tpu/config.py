@@ -83,6 +83,13 @@ class Config:
     # chunked XLA Lloyd otherwise.  "xla"/"pallas" force a path; "pallas"
     # requires TPU + single-device + f32 and falls back otherwise.
     kmeans_kernel: str = "auto"
+    # ALS normal-equation layout: "auto" uses the scatter-free grouped-edge
+    # programs (12x the COO path at MovieLens-1M scale on v5e, BASELINE.md)
+    # unless the degree distribution's padding blowup exceeds the guard, in
+    # which case the COO segment-sum programs run; "grouped"/"coo" force a
+    # layout.  Applies to both the single-device and the block-parallel
+    # paths.
+    als_kernel: str = "auto"
 
     @classmethod
     def from_env(cls) -> "Config":

@@ -138,6 +138,20 @@ class ALSModel:
         )
 
 
+def _als_kernel_cfg() -> str:
+    """Validated Config.als_kernel — every dispatch site (single-device AND
+    block-parallel) goes through this so a typo can never silently fall
+    back to the auto heuristic."""
+    from oap_mllib_tpu.config import get_config
+
+    kernel = get_config().als_kernel
+    if kernel not in ("auto", "grouped", "coo"):
+        raise ValueError(
+            f"als_kernel must be auto|grouped|coo, got {kernel!r}"
+        )
+    return kernel
+
+
 class ALS:
     """ALS estimator. Param parity with Spark ML ALS defaults:
     rank=10, max_iter=10, reg_param=0.1, implicit_prefs=False, alpha=1.0."""
@@ -312,15 +326,29 @@ class ALS:
             # reference's per-rank CSR + transposed CSR, ALSDALImpl.scala
             # :184-230 / .cpp:209-213, rebuilt for batched MXU matmuls —
             # see als_ops grouped-path notes); edge indices are static
-            # across iterations so the sort/pad runs once per fit
-            by_user = als_ops.build_grouped_edges(users, items, ratings, n_users)
-            by_item = als_ops.build_grouped_edges(items, users, ratings, n_items)
+            # across iterations so the sort/pad runs once per fit.  The
+            # blowup guard runs on bincounts BEFORE any (G, P) layout is
+            # materialized (adaptive group sizing keeps typical data under
+            # 2x; extreme long-tail degree splits would pad up to 8x nnz,
+            # so a "coo" decision must not pay for the build).
             nnz = len(users)
-            padded_total = by_user[0].size + by_item[0].size
-            grouped_ok = padded_total <= 6 * nnz  # blowup guard (adaptive
-            # group sizing keeps typical data under 2x; extreme long-tail
-            # degree splits fall back to the COO programs below)
+            kernel = _als_kernel_cfg()
+            if kernel == "auto":
+                padded_total = als_ops.grouped_padded_edges(
+                    users, n_users
+                ) + als_ops.grouped_padded_edges(items, n_items)
+                grouped_ok = (
+                    padded_total <= als_ops.GROUPED_MAX_BLOWUP * max(nnz, 1)
+                )
+            else:
+                grouped_ok = kernel == "grouped"
             if grouped_ok:
+                by_user = als_ops.build_grouped_edges(
+                    users, items, ratings, n_users
+                )
+                by_item = als_ops.build_grouped_edges(
+                    items, users, ratings, n_items
+                )
                 dev = tuple(jnp.asarray(a) for a in (*by_user, *by_item))
             else:
                 pad = (-nnz) % 2048
@@ -351,7 +379,9 @@ class ALS:
             y = np.asarray(y)
         return ALSModel(
             x, y,
-            {"timings": timings, "accelerated": True, **self._block_summary(1)},
+            {"timings": timings, "accelerated": True,
+             "als_kernel": "grouped" if grouped_ok else "coo",
+             **self._block_summary(1)},
         )
 
     def _block_summary(self, effective_user_blocks: int) -> dict:
@@ -375,10 +405,30 @@ class ALS:
         cfg = get_config()
         axis = cfg.data_axis
         world = mesh.shape[axis]
+        # grouped-vs-COO decided BEFORE the shuffle, from host bincounts of
+        # the pre-shuffle edges: a COO decision pays neither the grouped
+        # build nor the device->host pull of the shuffled blocks
+        kernel = _als_kernel_cfg()
+        sizes = None
+        if kernel == "auto":
+            use_grouped, sizes = als_block.block_grouped_guard(
+                users, items, n_users, n_items, world
+            )
+        else:
+            use_grouped = kernel == "grouped"
         with phase_timer(timings, "ratings_shuffle"):
             u_loc, i_glob, conf, valid, offsets, upb = als_block.prepare_block_inputs(
                 users, items, ratings, mesh, n_users
             )
+            grouped = None
+            if use_grouped:
+                # scatter-free grouped-edge layouts per rank (the one-time
+                # device->host pull of the shuffled blocks happens only on
+                # this branch; see als_ops grouped notes)
+                grouped = als_block.prepare_grouped_inputs(
+                    u_loc, i_glob, conf, valid, mesh, upb, n_items,
+                    sizes=sizes,
+                )
         with phase_timer(timings, "table_convert"):
             # block X init stays rank-local: each device's callback builds
             # ONLY its block's rows — from the user init if given, else
@@ -414,11 +464,18 @@ class ALS:
         from oap_mllib_tpu.utils.profiling import maybe_trace
 
         with phase_timer(timings, "als_iterations"), maybe_trace():
-            x_blocks, y = als_block.als_block_run(
-                u_loc, i_glob, conf, valid, x0_dev, y0_dev,
-                self.max_iter, self.reg_param, self.alpha, mesh,
-                implicit=self.implicit_prefs,
-            )
+            if grouped is not None:
+                x_blocks, y = als_block.als_block_run_grouped(
+                    grouped, x0_dev, y0_dev,
+                    self.max_iter, self.reg_param, self.alpha, mesh,
+                    implicit=self.implicit_prefs,
+                )
+            else:
+                x_blocks, y = als_block.als_block_run(
+                    u_loc, i_glob, conf, valid, x0_dev, y0_dev,
+                    self.max_iter, self.reg_param, self.alpha, mesh,
+                    implicit=self.implicit_prefs,
+                )
             jax.block_until_ready((x_blocks, y))
         # X stays block-sharded on device; the model gathers on demand
         # (offset bookkeeping ~ ALSResult cUserOffset/cItemOffset,
@@ -428,6 +485,7 @@ class ALS:
             None, np.asarray(y),
             {"timings": timings, "accelerated": True,
              "block_parallel": True, "sharded_factors": True,
+             "als_kernel": "grouped" if grouped is not None else "coo",
              **self._block_summary(world)},
             sharded_user=(x_blocks, np.asarray(offsets), upb),
         )

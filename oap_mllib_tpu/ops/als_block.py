@@ -27,18 +27,57 @@ serialized through a root rank; here it rides ICI as one fused collective).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from oap_mllib_tpu.config import get_config
 # shared normal-equation math — the block path only inserts psums between
 # partials and solve, so the two paths cannot diverge in the weighting
-from oap_mllib_tpu.ops.als_ops import masked_solve, normal_eq_partials
+from oap_mllib_tpu.ops.als_ops import (
+    GROUPED_MAX_BLOWUP,
+    masked_solve,
+    normal_eq_partials,
+    normal_eq_partials_grouped,
+)
+
+
+def _block_body(user_partials, item_partials, reg, implicit, axis, eye):
+    """One alternating iteration of the block layout, shared by the COO and
+    grouped-edge programs: user update fully local, item update partials +
+    ONE psum (replacing the reference's gather/step2Master/bcast/all2all
+    chain, ALSDALImpl.cpp:336-431).  ``user_partials(y)`` /
+    ``item_partials(x_blk)`` return (A, b, n_reg) from whichever edge
+    layout the caller closed over."""
+
+    def body(carry, _):
+        x_blk, y = carry
+        a_u, b_u, n_u = user_partials(y)
+        a_u = a_u + reg * n_u[:, None, None] * eye[None]
+        if implicit:
+            gram_y = jnp.matmul(y.T, y, precision=lax.Precision.HIGHEST)
+            a_u = gram_y[None] + a_u
+        x_blk = masked_solve(a_u, b_u, n_u).astype(y.dtype)
+        a_i, b_i, n_i = item_partials(x_blk)
+        a_i = lax.psum(a_i, axis)
+        b_i = lax.psum(b_i, axis)
+        n_i = lax.psum(n_i, axis)
+        a_i = a_i + reg * n_i[:, None, None] * eye[None]
+        if implicit:
+            gram_x = lax.psum(
+                jnp.matmul(x_blk.T, x_blk, precision=lax.Precision.HIGHEST),
+                axis,
+            )
+            a_i = gram_x[None] + a_i
+        y = masked_solve(a_i, b_i, n_i).astype(y.dtype)
+        return (x_blk, y), None
+
+    return body
 
 
 def als_block_run(
@@ -72,35 +111,15 @@ def als_block_run(
 
     def rank_program(u_loc, i_glob, cf, vl, x_blk, y):
         # x_blk: (upb, r) this rank's users; y: (n_items, r) replicated
-        def body(carry, _):
-            x_blk, y = carry
-            # ---- user update: fully local (reference step3/4Local) ----
-            a_u, b_u, n_u = normal_eq_partials(
-                u_loc, i_glob, cf, vl, y, upb, alpha, implicit
-            )
-            a_u = a_u + reg * n_u[:, None, None] * eye[None]
-            if implicit:
-                gram_y = jnp.matmul(y.T, y, precision=lax.Precision.HIGHEST)
-                a_u = gram_y[None] + a_u
-            x_blk = masked_solve(a_u, b_u, n_u).astype(y.dtype)
-            # ---- item update: partials + ONE psum (replaces the
-            #      gather/step2Master/bcast/all2all chain) ----
-            a_i, b_i, n_i = normal_eq_partials(
-                i_glob, u_loc, cf, vl, x_blk, n_items, alpha, implicit
-            )
-            a_i = lax.psum(a_i, axis)
-            b_i = lax.psum(b_i, axis)
-            n_i = lax.psum(n_i, axis)
-            a_i = a_i + reg * n_i[:, None, None] * eye[None]
-            if implicit:
-                gram_x = lax.psum(
-                    jnp.matmul(x_blk.T, x_blk, precision=lax.Precision.HIGHEST),
-                    axis,
-                )
-                a_i = gram_x[None] + a_i
-            y = masked_solve(a_i, b_i, n_i).astype(y.dtype)
-            return (x_blk, y), None
-
+        body = _block_body(
+            lambda y_: normal_eq_partials(
+                u_loc, i_glob, cf, vl, y_, upb, alpha, implicit
+            ),
+            lambda x_: normal_eq_partials(
+                i_glob, u_loc, cf, vl, x_, n_items, alpha, implicit
+            ),
+            reg, implicit, axis, eye,
+        )
         (x_blk, y), _ = lax.scan(body, (x_blk, y), None, length=max_iter)
         return x_blk, y
 
@@ -116,6 +135,275 @@ def als_block_run(
         )
     )
     return fn(u_local, i_global, conf, valid, x0, y0)
+
+
+# ---------------------------------------------------------------------------
+# Grouped-edge block path: the scatter-free layout (als_ops grouped-path
+# notes) applied per rank.  Each rank's local edges are sorted/padded by
+# destination ONCE on the host — by local user for the user update, by
+# global item for the item update (the reference's per-rank CSR + transposed
+# CSR pair, ALSDALImpl.cpp:192-214, as two grouped layouts) — then every
+# iteration's normal-equation build is batched MXU matmuls with zero
+# scatters.  Ranks pad their group counts to the global maxima so the
+# shard_map program keeps equal shapes.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GroupedBlocks:
+    """Device-resident grouped-edge layouts, block-sharded over the mesh."""
+
+    u_src: jax.Array  # (world * Gu, Pu) item ids grouped by local user
+    u_conf: jax.Array
+    u_valid: jax.Array
+    u_dst: jax.Array  # (world * Gu,) local user id per group (sorted/rank)
+    i_src: jax.Array  # (world * Hi, Pi) user ids grouped by global item
+    i_conf: jax.Array
+    i_valid: jax.Array
+    i_dst: jax.Array  # (world * Hi,) global item id per group (sorted/rank)
+
+
+def _global_sum(arr) -> np.ndarray:
+    """Elementwise int64 sum of a host array across processes (identity in
+    single-process worlds) — the one definition every cross-process
+    reduction in this module goes through."""
+    arr = np.asarray(arr, np.int64)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        g = np.asarray(multihost_utils.process_allgather(arr))
+        return g.reshape((-1,) + arr.shape).sum(axis=0)
+    return arr
+
+
+def _global_max(arr) -> np.ndarray:
+    """Elementwise int64 max across processes (identity single-process)."""
+    arr = np.asarray(arr, np.int64)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        g = np.asarray(multihost_utils.process_allgather(arr))
+        return g.reshape((-1,) + arr.shape).max(axis=0)
+    return arr
+
+
+def _group_sizes(nnz_global: int, world: int, users_per_block: int,
+                 n_items: int):
+    """(p_u, p_i) — ONE derivation shared by the pre-shuffle guard and the
+    layout build, so they can never size different layouts."""
+    from oap_mllib_tpu.ops.als_ops import auto_group_size
+
+    p_u = auto_group_size(max(1, nnz_global), world * users_per_block)
+    p_i = auto_group_size(max(1, nnz_global // world), n_items)
+    return p_u, p_i
+
+
+def block_grouped_guard(
+    users: np.ndarray,
+    items: np.ndarray,
+    n_users: int,
+    n_items: int,
+    world: int,
+    max_blowup: float = GROUPED_MAX_BLOWUP,
+):
+    """Grouped-vs-COO decision for the block path, BEFORE the shuffle and
+    from host degree counts alone — a COO decision must pay neither the
+    grouped build nor the device->host pull of the shuffled blocks.
+
+    Returns ``(use_grouped, (p_u, p_i, nnz_global))``; the sizes tuple is
+    threaded into :func:`prepare_grouped_inputs` so the build uses exactly
+    the layout the guard priced.
+
+    Accounting matches what the build REALIZES: every rank is padded to
+    the global max group counts, so the estimate is ``world * (max_b
+    padded_u_b + max_b padded_i_b)`` over per-block padded totals — a
+    sum over blocks would undercount skewed splits by up to ``world``x.
+    The per-block totals are computable pre-shuffle because the shuffle
+    routes every edge to block ``min(u // kpb, world - 1)``.
+    Multi-process worlds sum per-block totals across processes (degrees
+    split across processes pad per process — an overestimate, so
+    borderline datasets conservatively take COO).
+    """
+    nnz_global = int(_global_sum([len(users)])[0])
+    kpb = max(1, -(-n_users // world))
+    p_u, p_i = _group_sizes(nnz_global, world, kpb, n_items)
+    u = np.asarray(users, np.int64)
+    it = np.asarray(items, np.int64)
+    pu_b = np.zeros((world,), np.int64)
+    pi_b = np.zeros((world,), np.int64)
+    ku, cu = np.unique(u, return_counts=True)  # a user's edges: one block
+    np.add.at(pu_b, np.minimum(ku // kpb, world - 1), (-(cu // -p_u)) * p_u)
+    block = np.minimum(u // kpb, world - 1)
+    ki, ci = np.unique(block * n_items + it, return_counts=True)
+    np.add.at(pi_b, ki // n_items, (-(ci // -p_i)) * p_i)
+    pu_b = _global_sum(pu_b)
+    pi_b = _global_sum(pi_b)
+    total = world * (int(pu_b.max()) + int(pi_b.max()))
+    return total <= max_blowup * max(nnz_global, 1), (p_u, p_i, nnz_global)
+
+
+def _host_blocks(arr: jax.Array, world: int) -> dict:
+    """Per-rank host views of a block-sharded device array ({rank: rows}).
+    Multi-process worlds see only their addressable blocks."""
+    per = arr.shape[0] // world
+    if arr.is_fully_addressable:
+        h = np.asarray(arr)
+        return {b: h[b * per : (b + 1) * per] for b in range(world)}
+    out = {}
+    for sh in arr.addressable_shards:
+        start = sh.index[0].start or 0
+        out[start // per] = np.asarray(sh.data)  # model-axis dupes collapse
+    return out
+
+
+def _pad_groups(grouped, g_max: int, n_dst: int):
+    """Pad a rank's grouped arrays to ``g_max`` groups.  Padding groups
+    carry valid=0 and dst = n_dst - 1 (keeps group_dst sorted, so the
+    segment-sum's indices_are_sorted contract holds)."""
+    src_g, conf_g, valid_g, gdst = grouped
+    pad = g_max - src_g.shape[0]
+    if pad > 0:
+        p = src_g.shape[1]
+        src_g = np.concatenate([src_g, np.zeros((pad, p), np.int32)])
+        conf_g = np.concatenate([conf_g, np.zeros((pad, p), np.float32)])
+        valid_g = np.concatenate([valid_g, np.zeros((pad, p), np.float32)])
+        gdst = np.concatenate(
+            [gdst, np.full((pad,), n_dst - 1, np.int32)]
+        )
+    return src_g, conf_g, valid_g, gdst
+
+
+def prepare_grouped_inputs(
+    u_local: jax.Array,
+    i_global: jax.Array,
+    conf: jax.Array,
+    valid: jax.Array,
+    mesh: Mesh,
+    upb: int,
+    n_items: int,
+    *,
+    sizes=None,
+):
+    """Build per-rank grouped-edge layouts from the shuffled block arrays.
+
+    Returns a :class:`GroupedBlocks`.  The grouped-vs-COO decision is NOT
+    made here — :func:`block_grouped_guard` is the single decision point
+    (it runs pre-shuffle so a COO decision pays nothing); ``sizes`` is its
+    ``(p_u, p_i, nnz_global)`` tuple, threaded through so the build uses
+    exactly the layout the guard priced (and skips a redundant allgather
+    round).  Host cost is one sort of each rank's local edges — indices
+    are static across iterations, so this runs once per fit (same
+    contract as the single-device grouped prep).
+    """
+    from oap_mllib_tpu.ops.als_ops import build_grouped_edges
+
+    cfg = get_config()
+    axis = cfg.data_axis
+    world = mesh.shape[axis]
+    ub = _host_blocks(u_local, world)
+    ib = _host_blocks(i_global, world)
+    cb = _host_blocks(conf, world)
+    vb = _host_blocks(valid, world)
+
+    if sizes is not None:
+        p_u, p_i, _ = sizes
+    else:
+        nnz_local = sum(int((vb[b] > 0).sum()) for b in vb)
+        nnz_global = int(_global_sum([nnz_local])[0])
+        # group sizes from GLOBAL stats so every process compiles
+        # identical static shapes
+        p_u, p_i = _group_sizes(nnz_global, world, upb, n_items)
+
+    by_user, by_item = {}, {}
+    for b in ub:
+        sel = vb[b] > 0
+        uu = ub[b][sel].astype(np.int64)
+        ii = ib[b][sel].astype(np.int64)
+        rr = cb[b][sel].astype(np.float32)
+        by_user[b] = build_grouped_edges(uu, ii, rr, upb, p_u)
+        by_item[b] = build_grouped_edges(ii, uu, rr, n_items, p_i)
+
+    gu_local = max(g[0].shape[0] for g in by_user.values())
+    hi_local = max(g[0].shape[0] for g in by_item.values())
+    gu, hi = (int(v) for v in _global_max([gu_local, hi_local]))
+
+    blocks = sorted(by_user)
+    u_pad = {b: _pad_groups(by_user[b], gu, upb) for b in blocks}
+    i_pad = {b: _pad_groups(by_item[b], hi, n_items) for b in blocks}
+    u_stack = [
+        np.concatenate([u_pad[b][j] for b in blocks]) for j in range(4)
+    ]
+    i_stack = [
+        np.concatenate([i_pad[b][j] for b in blocks]) for j in range(4)
+    ]
+
+    def place(local):
+        sharding = NamedSharding(mesh, P(axis, *([None] * (local.ndim - 1))))
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sharding, local)
+        return jax.device_put(local, sharding)
+
+    u_dev = [place(m) for m in u_stack]
+    i_dev = [place(m) for m in i_stack]
+    return GroupedBlocks(
+        u_src=u_dev[0], u_conf=u_dev[1], u_valid=u_dev[2], u_dst=u_dev[3],
+        i_src=i_dev[0], i_conf=i_dev[1], i_valid=i_dev[2], i_dst=i_dev[3],
+    )
+
+
+def als_block_run_grouped(
+    gb: GroupedBlocks,
+    x0: jax.Array,  # (world * upb, r) block-sharded user factors
+    y0: jax.Array,  # (n_items, r) replicated item factors
+    max_iter: int,
+    reg: float,
+    alpha: float,
+    mesh: Mesh,
+    *,
+    implicit: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Block-parallel ALS on the grouped-edge layout (both feedback modes).
+
+    Identical math and collective structure to :func:`als_block_run` (one
+    psum per item update) with the scatter-free partials — the multi-device
+    form of the 12x single-device win (BASELINE.md round 3)."""
+    cfg = get_config()
+    axis = cfg.data_axis
+    world = mesh.shape[axis]
+    upb = x0.shape[0] // world
+    n_items, r = y0.shape
+    eye = jnp.eye(r, dtype=y0.dtype)
+
+    def rank_program(su, cu, vu, gu, si, ci, vi, gi, x_blk, y):
+        body = _block_body(
+            lambda y_: normal_eq_partials_grouped(
+                su, cu, vu, gu, y_, upb, alpha, implicit
+            ),
+            lambda x_: normal_eq_partials_grouped(
+                si, ci, vi, gi, x_, n_items, alpha, implicit
+            ),
+            reg, implicit, axis, eye,
+        )
+        (x_blk, y), _ = lax.scan(body, (x_blk, y), None, length=max_iter)
+        return x_blk, y
+
+    sh2 = P(axis, None)
+    sh1 = P(axis)
+    rep = P()
+    fn = jax.jit(
+        jax.shard_map(
+            rank_program,
+            mesh=mesh,
+            in_specs=(sh2, sh2, sh2, sh1, sh2, sh2, sh2, sh1, sh2, rep),
+            out_specs=(sh2, rep),
+            check_vma=False,
+        )
+    )
+    return fn(
+        gb.u_src, gb.u_conf, gb.u_valid, gb.u_dst,
+        gb.i_src, gb.i_conf, gb.i_valid, gb.i_dst,
+        x0, y0,
+    )
 
 
 def prepare_block_inputs(

@@ -161,6 +161,27 @@ def masked_solve(a: jax.Array, b: jax.Array, deg: jax.Array) -> jax.Array:
 # batch row solves) rebuilt for the MXU.
 
 
+# Guard shared by the single-device and block-parallel dispatchers: the
+# grouped layout is taken only while its padded edge total stays within
+# this factor of the true edge count (extreme long-tail degree splits fall
+# back to the COO programs).  One definition so the two paths cannot
+# silently route the same dataset to different kernels.
+GROUPED_MAX_BLOWUP = 6.0
+
+
+def grouped_padded_edges(dst, n_dst: int, group_size: int = 0) -> int:
+    """Padded edge count the grouped layout WOULD produce for one side —
+    the blowup-guard input, from per-destination counts alone (no sort of
+    payloads, no (G, P) materialization).  Destinations with zero edges
+    pad to zero, so counting only the present ones (memory O(nnz), never
+    O(n_dst)) gives the exact total build_grouped_edges would realize."""
+    import numpy as np
+
+    p = group_size or auto_group_size(len(dst), n_dst)
+    _, counts = np.unique(np.asarray(dst, np.int64), return_counts=True)
+    return int((-(counts // -p) * p).sum())
+
+
 def auto_group_size(nnz: int, n_dst: int) -> int:
     """Group size adapted to the mean degree so padding stays bounded:
     with P <= mean degree, total padded edges <= nnz + n_dst*P <= 2*nnz.

@@ -335,6 +335,77 @@ class TestBlockParallel:
         ox, oy = _oracle_als(u, i, r, nu, ni, 3, 2, 0.1, 1.0, False, x0, y0)
         np.testing.assert_allclose(model.user_factors_, ox, atol=2e-3, rtol=2e-3)
 
+    @pytest.mark.parametrize("implicit", [True, False])
+    def test_block_grouped_matches_block_coo(self, rng, implicit):
+        """The grouped-edge block path (scatter-free per-rank layouts) and
+        the COO block path produce the same factors on the 8-way mesh."""
+        u, i, r, nu, ni = _ratings(rng, n_users=50, n_items=30)
+        x0 = init_factors(nu, 4, 5)
+        y0 = init_factors(ni, 4, 6)
+        kw = dict(rank=4, max_iter=3, reg_param=0.1, alpha=1.2,
+                  implicit_prefs=implicit)
+        set_config(als_kernel="grouped")
+        mg = ALS(**kw).fit(u, i, r, n_users=nu, n_items=ni, init=(x0, y0))
+        assert mg.summary.get("block_parallel")
+        assert mg.summary["als_kernel"] == "grouped"
+        set_config(als_kernel="coo")
+        mc = ALS(**kw).fit(u, i, r, n_users=nu, n_items=ni, init=(x0, y0))
+        assert mc.summary["als_kernel"] == "coo"
+        np.testing.assert_allclose(
+            mg.user_factors_, mc.user_factors_, atol=2e-3, rtol=2e-3
+        )
+        np.testing.assert_allclose(
+            mg.item_factors_, mc.item_factors_, atol=2e-3, rtol=2e-3
+        )
+        # and both agree with the independent oracle
+        ox, oy = _oracle_als(u, i, r, nu, ni, 4, 3, 0.1, 1.2, implicit, x0, y0)
+        np.testing.assert_allclose(mg.user_factors_, ox, atol=2e-3, rtol=2e-3)
+        np.testing.assert_allclose(mg.item_factors_, oy, atol=2e-3, rtol=2e-3)
+
+    def test_block_long_tail_falls_back_to_coo(self, rng):
+        """Degree ~1 everywhere on the multi-device mesh: the pre-shuffle
+        block_grouped_guard must decide COO and the fit must route to the
+        COO block program — and still match the oracle."""
+        nu = ni = 120
+        u = np.arange(nu, dtype=np.int64)
+        i = rng.permutation(ni).astype(np.int64)
+        r = rng.integers(1, 6, size=nu).astype(np.float32)
+        x0 = init_factors(nu, 3, 1)
+        y0 = init_factors(ni, 3, 2)
+        model = ALS(rank=3, max_iter=2, reg_param=0.1).fit(
+            u, i, r, n_users=nu, n_items=ni, init=(x0, y0)
+        )
+        assert model.summary.get("block_parallel")
+        assert model.summary["als_kernel"] == "coo"
+        ox, _ = _oracle_als(u, i, r, nu, ni, 3, 2, 0.1, 1.0, False, x0, y0)
+        np.testing.assert_allclose(model.user_factors_, ox, atol=2e-3, rtol=2e-3)
+
+    def test_block_skewed_head_falls_back_to_coo(self, rng):
+        """Power-law head concentrated in ONE user block: the guard must
+        price the REALIZED layout (every rank padded to the global max
+        group counts, world * max_b) — a sum over blocks would approve
+        this dataset and then materialize ~8x its estimate."""
+        from oap_mllib_tpu.ops.als_block import block_grouped_guard
+
+        nu, ni = 80, 600
+        u = rng.integers(0, 10, 2000).astype(np.int64)  # all in block 0
+        i = rng.integers(0, ni, 2000).astype(np.int64)
+        r = rng.integers(1, 6, 2000).astype(np.float32)
+        ok, _ = block_grouped_guard(u, i, nu, ni, 8)
+        assert not ok
+        model = ALS(rank=3, max_iter=1, implicit_prefs=True).fit(
+            u, i, r, n_users=nu, n_items=ni
+        )
+        assert model.summary["als_kernel"] == "coo"
+
+    def test_invalid_als_kernel_raises_on_block_path(self, rng):
+        """A typo'd als_kernel must raise on the multi-device mesh too,
+        never silently fall back to the auto heuristic."""
+        u, i, r, nu, ni = _ratings(rng, n_users=20, n_items=10)
+        set_config(als_kernel="groupd")
+        with pytest.raises(ValueError, match="als_kernel"):
+            ALS(rank=3, max_iter=1).fit(u, i, r, n_users=nu, n_items=ni)
+
     def test_users_fewer_than_ranks(self, rng):
         """Degenerate: fewer users than mesh ranks (empty blocks)."""
         u = np.array([0, 1, 2, 0, 1])
