@@ -120,12 +120,17 @@ class PCA:
             table = make(x.astype(dtype), mesh)
         with phase_timer(timings, "covariance"):
             n_rows = jnp.asarray(float(table.n_rows), dtype)
+            # x64 lane pins the Gram to HIGHEST regardless of tier
+            # (f64 has no bf16 fast path to buy anything with)
+            tier = "highest" if cfg.enable_x64 else cfg.matmul_precision
             if mp > 1:
                 cov, _ = pca_ops.covariance_model_sharded(
-                    table.data, table.mask, n_rows, mesh
+                    table.data, table.mask, n_rows, mesh, tier
                 )
             else:
-                cov, _ = pca_ops.covariance(table.data, table.mask, n_rows)
+                cov, _ = pca_ops.covariance(
+                    table.data, table.mask, n_rows, tier
+                )
         with phase_timer(timings, "eigh"):
             if cov.shape[0] > d:
                 # padded feature dims: demote their eigenvalues below any

@@ -68,6 +68,54 @@ class TestParity:
         assert np.all(np.diff(model.explained_variance_) <= 1e-9)
 
 
+class TestPrecisionTiers:
+    """Tier threading through the estimator (every tier runs the centered
+    two-pass Gram; on CPU all tiers are full f32, so these check the
+    plumbing + oracle parity; the per-tier bf16 error bounds are pinned
+    on tests_tpu)."""
+
+    def test_high_tier_matches_highest(self, rng):
+        x = _data(rng, n=400, d=12) + 25.0  # large means: worst case
+        m_hi = PCA(k=4).fit(x)
+        set_config(matmul_precision="high")
+        m_fast = PCA(k=4).fit(x)
+        np.testing.assert_allclose(
+            m_fast.explained_variance_, m_hi.explained_variance_, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.abs(m_fast.components_), np.abs(m_hi.components_), atol=1e-4
+        )
+
+    def test_high_tier_model_sharded(self, rng):
+        x = _data(rng, n=256, d=8) + 10.0
+        set_config(matmul_precision="high", model_parallel=2)
+        m = PCA(k=3).fit(x)
+        assert m.summary["mesh_shape"]["model"] == 2
+        pc_ref, ev_ref = _oracle(x, 3)
+        np.testing.assert_allclose(m.explained_variance_, ev_ref, atol=1e-4)
+        np.testing.assert_allclose(
+            np.abs(m.components_), np.abs(pc_ref), atol=1e-3
+        )
+
+    def test_invalid_tier_raises(self, rng):
+        x = _data(rng, n=64, d=6)
+        set_config(matmul_precision="typo")
+        with pytest.raises(ValueError, match="matmul_precision"):
+            PCA(k=2).fit(x)
+
+    def test_large_mean_cancellation_regression(self, rng):
+        """mean >> stddev data at f32: the retired raw-moment form lost
+        ~4e-3 relative through the gram ~ n*mu*mu^T cancellation; the
+        centered form must stay on the oracle."""
+        x = rng.normal(size=(2000, 8)) + 100.0
+        model = PCA(k=3).fit(x.astype(np.float32))
+        pc_ref, ev_ref = _oracle(x, 3)
+        np.testing.assert_allclose(model.explained_variance_, ev_ref, atol=1e-4)
+        np.testing.assert_allclose(
+            np.abs(model.components_), np.abs(pc_ref), atol=1e-3
+        )
+
+
 class TestModelParallel:
     """Mesh-sharded linalg: the Gram/covariance rows sharded over the
     MODEL axis of a 2-D (data=4, model=2) mesh (survey §5's "mesh-sharded

@@ -59,6 +59,24 @@ class TestPcaCompiled:
                     np.abs(vecs[:, j]), np.abs(vecs_o[:, j]), atol=1e-3
                 )
 
+    def test_precision_tiers_large_mean(self, rng):
+        """Per-tier covariance error vs the f64 oracle on LARGE-MEAN data
+        (mean=50, unit variance) — the case that killed the one-pass
+        raw-moment form (4.6e-3 at f32-HIGHEST via the gram ~ n*mu*mu^T
+        cancellation; v5e, round 3).  The centered two-pass form must hold
+        every tier to its documented bound."""
+        n, d = 16384, 256
+        x = (rng.normal(size=(n, d)) + 50.0).astype(np.float32)
+        cov_o, _, _, _ = _np_oracle(x.astype(np.float64))
+        scale = np.max(np.abs(cov_o))
+        ones = jnp.ones((n,), jnp.float32)
+        nr = jnp.asarray(float(n), jnp.float32)
+        bounds = {"highest": 1e-5, "high": 1e-4, "default": 1e-3}
+        for tier, bound in bounds.items():
+            cov, _ = covariance(jnp.asarray(x), ones, nr, tier)
+            err = float(np.max(np.abs(np.asarray(cov) - cov_o))) / scale
+            assert err < bound, (tier, err)
+
     def test_project_matches_oracle(self, rng):
         n, d, k = 2048, 32, 4
         x = rng.normal(size=(n, d)).astype(np.float32)
