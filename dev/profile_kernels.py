@@ -139,7 +139,95 @@ def markdown(rows) -> str:
     return "\n".join(out)
 
 
+ALS_SHAPES = [
+    # (n_users, n_items, nnz, rank) — MovieLens-1M scale + a small shape
+    (6040, 3706, 1 << 20, 10),
+    (1000, 800, 1 << 17, 10),
+]
+
+
+def profile_als():
+    """ALS normal-equation shoot-out: grouped-edge vs COO per-iteration
+    slope (implicit mode, the reference's accelerated surface) — the
+    evidence behind Config.als_kernel="auto" preferring the grouped
+    layout."""
+    import jax.numpy as jnp
+
+    from oap_mllib_tpu.ops import als_ops
+
+    rows = []
+    for nu, ni, nnz, rank in ALS_SHAPES:
+        rng = np.random.default_rng(0)
+        u = rng.integers(0, nu, nnz).astype(np.int32)
+        i = rng.integers(0, ni, nnz).astype(np.int32)
+        r = (rng.random(nnz) * 4 + 1).astype(np.float32)
+        x0 = jnp.asarray((rng.normal(size=(nu, rank)) * 0.1).astype(np.float32))
+        y0 = jnp.asarray((rng.normal(size=(ni, rank)) * 0.1).astype(np.float32))
+        pad = (-nnz) % 2048
+        uj = jnp.asarray(np.pad(u, (0, pad)))
+        ij = jnp.asarray(np.pad(i, (0, pad)))
+        rj = jnp.asarray(np.pad(r, (0, pad)))
+        vj = jnp.asarray(np.pad(np.ones(nnz, np.float32), (0, pad)))
+        by_u = tuple(jnp.asarray(a) for a in als_ops.build_grouped_edges(u, i, r, nu))
+        by_i = tuple(jnp.asarray(a) for a in als_ops.build_grouped_edges(i, u, r, ni))
+        win = (4, 16)
+
+        def run_grouped(iters):
+            return als_ops.als_run_grouped(
+                *by_u, *by_i, x0, y0, nu, ni, iters, 0.1, 40.0, True
+            )
+
+        def run_coo(iters):
+            return als_ops.als_implicit_run(
+                uj, ij, rj, vj, x0, y0, nu, ni, iters, 0.1, 40.0
+            )
+
+        for kernel, run in (("grouped", run_grouped), ("coo", run_coo)):
+            ts = {}
+            for iters in win:
+                fn = lambda it=iters, r_=run: np.asarray(r_(it)[0])
+                ts[iters] = _time_run(fn)
+            slope = (ts[win[1]] - ts[win[0]]) / (win[1] - win[0])
+            if slope <= 0:
+                print(f"# skip als {nu}x{ni} nnz={nnz} {kernel}: below "
+                      "slope resolution", flush=True)
+                continue
+            rows.append({
+                "shape": f"{nu}x{ni} nnz={nnz} r={rank}",
+                "kernel": kernel,
+                "ms_per_iter": round(slope * 1e3, 2),
+            })
+            print(json.dumps(rows[-1]), flush=True)
+    return rows
+
+
+def markdown_als(rows) -> str:
+    out = [
+        "| shape | grouped ms/iter | COO ms/iter | speedup |",
+        "|---|---|---|---|",
+    ]
+    by = {}
+    for r in rows:
+        by.setdefault(r["shape"], {})[r["kernel"]] = r["ms_per_iter"]
+    for shape, d in by.items():
+        if "grouped" in d and "coo" in d:
+            # a positive slope can still round to 0.00 ms; don't let the
+            # speedup column kill the table after a multi-minute bench
+            ratio = (
+                f"{d['coo'] / d['grouped']:.1f}×" if d["grouped"] > 0 else "—"
+            )
+            out.append(
+                f"| {shape} | **{d['grouped']}** | {d['coo']} | {ratio} |"
+            )
+    return "\n".join(out)
+
+
 if __name__ == "__main__":
-    rows = profile()
-    print()
-    print(markdown(rows))
+    if "--als" in sys.argv:
+        rows = profile_als()
+        print()
+        print(markdown_als(rows))
+    else:
+        rows = profile()
+        print()
+        print(markdown(rows))

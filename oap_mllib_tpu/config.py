@@ -70,12 +70,15 @@ class Config:
     fallback: bool = True
     timing: bool = False
     seed: int = 0
-    # "highest" = full f32 on the MXU (multi-pass) — required for the 1e-4
-    # numerical-parity contract.  "high" = bf16_3x centroid sums + bf16
-    # assignment matmul (argmin is decision-only; see kmeans_ops
-    # ._assign_prec) — measured within 1e-5 of highest on the TPU parity
-    # suite at ~3x the throughput.  "default" = bf16 everywhere; opt-in
-    # for throughput-first workloads.
+    # MXU precision tier for the K-Means hot loop AND the PCA covariance
+    # Gram.  "highest" = full f32 (multi-pass) — the 1e-4 numerical-parity
+    # contract.  "high" = bf16_3x: K-Means runs bf16_3x centroid sums +
+    # bf16 assignment (within 1e-5 of highest, ~3x throughput; see
+    # kmeans_ops._assign_prec), PCA holds <=1e-4 on the centered Gram.
+    # "default" = bf16 everywhere (K-Means ~1e-2, PCA ~1e-3); opt-in for
+    # throughput-first workloads.  The x64 lane pins PCA to highest.
+    # Per-tier bounds pinned on tests_tpu/; docs/configuration.md has the
+    # full table.
     matmul_precision: str = "highest"
     # K-Means hot-loop kernel: "auto" picks the fastest measured path per
     # shape/tier (BASELINE.md kernel table, v5e): the fused Pallas kernel

@@ -64,6 +64,20 @@ mu = KMeans(k=5, seed=11, init_mode="random", max_iter=15).fit(uneven)
 
 p = PCA(k=4).fit(half)
 
+# model-axis fits: model_parallel=2 arranges the 4 global devices as a
+# (data=2, model=2) mesh whose DATA axis crosses the process boundary —
+# the feature-sharded K-Means Lloyd (kmeans_ops.lloyd_run_model_sharded)
+# and the model-sharded PCA Gram run their psums/all_gathers across a
+# real 2-process world, not just the single-host virtual mesh
+from oap_mllib_tpu.config import set_config
+
+set_config(model_parallel=2)
+m_mp = KMeans(k=5, seed=7, init_mode="random", max_iter=15).fit(half)
+assert m_mp.summary.accelerated
+p_mp = PCA(k=4).fit(half)
+assert p_mp.summary["mesh_shape"] == {"data": 2, "model": 2}
+set_config(model_parallel=1)
+
 # --- ALS: each rank contributes its LOCAL ratings shard (the per-rank
 # partitions of the reference's shuffle, ALSDALImpl.scala:95-109).  This
 # exercises the multi-process branches of exchange_ratings (allgathered
@@ -105,6 +119,9 @@ print(
             "uneven_cost": float(mu.summary.training_cost),
             "pca_var": np.asarray(p.explained_variance_).tolist(),
             "pca_pc0_abs": np.abs(np.asarray(p.components_)[:, 0]).tolist(),
+            "kmeans_mp_cost": float(m_mp.summary.training_cost),
+            "kmeans_mp_iters": int(m_mp.summary.num_iter),
+            "pca_mp_var": np.asarray(p_mp.explained_variance_).tolist(),
             **als_out,
         }
     ),

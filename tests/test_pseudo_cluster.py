@@ -147,6 +147,26 @@ class TestPseudoCluster:
                 rtol=1e-4,
             )
 
+    def test_model_axis_matches_single_process(self, world_results):
+        """model_parallel=2 across the 2-process world: the feature-sharded
+        K-Means Lloyd and model-sharded PCA Gram agree with single-process
+        model_parallel=1 oracles."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+        from oap_mllib_tpu.models.pca import PCA
+
+        x = _oracle_data()
+        km = KMeans(k=5, seed=7, init_mode="random", max_iter=15).fit(x)
+        pc = PCA(k=4).fit(x)
+        for rank in (0, 1):
+            r = world_results[rank]
+            assert r["kmeans_mp_iters"] == km.summary.num_iter
+            np.testing.assert_allclose(
+                r["kmeans_mp_cost"], km.summary.training_cost, rtol=1e-3
+            )
+            np.testing.assert_allclose(
+                r["pca_mp_var"], np.asarray(pc.explained_variance_), rtol=1e-3
+            )
+
     def test_pca_matches_single_process(self, world_results):
         from oap_mllib_tpu.models.pca import PCA
 
