@@ -170,7 +170,6 @@ def profile_als():
         vj = jnp.asarray(np.pad(np.ones(nnz, np.float32), (0, pad)))
         by_u = tuple(jnp.asarray(a) for a in als_ops.build_grouped_edges(u, i, r, nu))
         by_i = tuple(jnp.asarray(a) for a in als_ops.build_grouped_edges(i, u, r, ni))
-        win = (4, 16)
 
         def run_grouped(iters):
             return als_ops.als_run_grouped(
@@ -183,6 +182,13 @@ def profile_als():
             )
 
         for kernel, run in (("grouped", run_grouped), ("coo", run_coo)):
+            # calibrate the slope window to >= ~2s of work (same rationale
+            # as _iter_window: a hardcoded short window leaves fast shapes
+            # at the tunnel's tens-of-ms dispatch-jitter floor)
+            fn4 = lambda r_=run: np.asarray(r_(4)[0])
+            est = max(_time_run(fn4) / 4, 1e-4)
+            long = int(max(16, min(1024, 2.0 / est)))
+            win = (max(4, long // 4), long)
             ts = {}
             for iters in win:
                 fn = lambda it=iters, r_=run: np.asarray(r_(it)[0])

@@ -73,8 +73,9 @@ class Config:
     # MXU precision tier for the K-Means hot loop AND the PCA covariance
     # Gram.  "highest" = full f32 (multi-pass) — the 1e-4 numerical-parity
     # contract.  "high" = bf16_3x: K-Means runs bf16_3x centroid sums +
-    # bf16 assignment (within 1e-5 of highest, ~3x throughput; see
-    # kmeans_ops._assign_prec), PCA holds <=1e-4 on the centered Gram.
+    # bf16 assignment (within 1e-5 of highest; ~3x kernel steady-state,
+    # ~2.6x end-to-end — BASELINE.md; see kmeans_ops._assign_prec), PCA
+    # holds <=1e-4 on the centered Gram.
     # "default" = bf16 everywhere (K-Means ~1e-2, PCA ~1e-3); opt-in for
     # throughput-first workloads.  The x64 lane pins PCA to highest.
     # Per-tier bounds pinned on tests_tpu/; docs/configuration.md has the
