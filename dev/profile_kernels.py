@@ -184,10 +184,15 @@ def profile_als():
         for kernel, run in (("grouped", run_grouped), ("coo", run_coo)):
             # calibrate the slope window to >= ~2s of work (same rationale
             # as _iter_window: a hardcoded short window leaves fast shapes
-            # at the tunnel's tens-of-ms dispatch-jitter floor)
+            # at the tunnel's tens-of-ms dispatch-jitter floor).  The
+            # estimate is itself a SLOPE — whole-call time divided by
+            # iterations would fold the fixed per-call dispatch overhead
+            # into the per-iteration cost and undershoot the window on
+            # exactly the fast shapes this calibration exists for.
             fn4 = lambda r_=run: np.asarray(r_(4)[0])
-            est = max(_time_run(fn4) / 4, 1e-4)
-            long = int(max(16, min(1024, 2.0 / est)))
+            fn16 = lambda r_=run: np.asarray(r_(16)[0])
+            est = max((_time_run(fn16) - _time_run(fn4)) / 12, 1e-4)
+            long = int(max(16, min(2048, 2.0 / est)))
             win = (max(4, long // 4), long)
             ts = {}
             for iters in win:
