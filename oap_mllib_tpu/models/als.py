@@ -17,10 +17,12 @@ max+1 and may be passed explicitly.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -28,6 +30,15 @@ from oap_mllib_tpu.fallback import als_np
 from oap_mllib_tpu.ops import als_ops
 from oap_mllib_tpu.utils.dispatch import should_accelerate
 from oap_mllib_tpu.utils.timing import Timings, phase_timer
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _top_k_ids(q: jax.Array, targets: jax.Array, n: int) -> jax.Array:
+    """Top-n target ids for a block of query rows — module-level so the
+    compiled program caches across recommend_for_all_* calls (a per-call
+    jit lambda would recompile every time AND constant-fold the whole
+    factor matrix into the executable)."""
+    return jax.lax.top_k(jnp.matmul(q, targets.T), n)[1]
 
 
 class ALSModel:
@@ -103,12 +114,23 @@ class ALSModel:
         )
 
     @staticmethod
-    def _top_k_scores(query: np.ndarray, targets: np.ndarray, n: int) -> np.ndarray:
-        import jax
-
-        scores = jnp.asarray(query) @ jnp.asarray(targets).T
-        _, idx = jax.lax.top_k(scores, n)
-        return np.asarray(idx)
+    def _top_k_scores(query: np.ndarray, targets: np.ndarray, n: int,
+                      row_chunk: int = 8192) -> np.ndarray:
+        """Top-n target ids per query row, chunked over query rows so the
+        (n_query, n_targets) score matrix never materializes (the
+        reference blocks its recommendForAll the same way —
+        ALS.scala:383-401 blockify — because the full cross product is
+        quadratic in memory)."""
+        if query.shape[0] == 0:
+            return np.zeros((0, n), np.int32)
+        tj = jnp.asarray(targets)
+        out = [
+            np.asarray(
+                _top_k_ids(jnp.asarray(query[lo : lo + row_chunk]), tj, n)
+            )
+            for lo in range(0, query.shape[0], row_chunk)
+        ]
+        return np.concatenate(out, axis=0)
 
     def recommend_for_all_users(self, num_items: int) -> np.ndarray:
         """Top-N item ids per user — one (n_users, r)x(r, n_items) MXU

@@ -66,13 +66,26 @@ class KMeansModel:
     def k(self) -> int:
         return self.cluster_centers_.shape[0]
 
+    # rows per scoring chunk: bounds the live (chunk, k) distance matrix
+    # so predict/cost on huge inputs never materialize (n, k) — the same
+    # blocking the training loop gets from auto_row_chunks
+    _PREDICT_CHUNK = 1 << 20
+
     def predict(self, x: np.ndarray) -> np.ndarray:
         """Nearest-center assignment (the shim's transform/predict surface)."""
         x = np.asarray(x, dtype=self.cluster_centers_.dtype)
         if self.distance_measure == "euclidean" and x.shape[0] >= 1:
-            return np.asarray(
-                kmeans_ops.assign_clusters(jnp.asarray(x), jnp.asarray(self.cluster_centers_))
-            )
+            c = jnp.asarray(self.cluster_centers_)
+            # fixed-size slices (not array_split): every full chunk shares
+            # one compiled shape, only the tail adds a second
+            return np.concatenate([
+                np.asarray(
+                    kmeans_ops.assign_clusters(
+                        jnp.asarray(x[lo : lo + self._PREDICT_CHUNK]), c
+                    )
+                )
+                for lo in range(0, len(x), self._PREDICT_CHUNK)
+            ])
         return predict_np(x, self.cluster_centers_, self.distance_measure)
 
     def transform(self, x: np.ndarray) -> np.ndarray:
@@ -85,8 +98,15 @@ class KMeansModel:
 
             d = _sq_dists(x, self.cluster_centers_, self.distance_measure)
             return float(np.sum(np.min(d, axis=1)))
-        d2 = kmeans_ops.pairwise_sq_dists(jnp.asarray(x), jnp.asarray(self.cluster_centers_))
-        return float(jnp.sum(jnp.min(d2, axis=1)))
+        c = jnp.asarray(self.cluster_centers_)
+        return float(sum(
+            float(jnp.sum(jnp.min(
+                kmeans_ops.pairwise_sq_dists(
+                    jnp.asarray(x[lo : lo + self._PREDICT_CHUNK]), c
+                ), axis=1
+            )))
+            for lo in range(0, len(x), self._PREDICT_CHUNK)
+        ))
 
     def to_pmml(self, path: str) -> None:
         """Export as a PMML 4.3 ClusteringModel (~ Spark's

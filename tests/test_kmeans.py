@@ -193,6 +193,19 @@ class TestSharding:
             assert abs(model.summary.training_cost - direct) / max(direct, 1e-9) < 1e-4
 
 
+class TestChunkedScoring:
+    def test_predict_and_cost_chunked_exact(self, rng, monkeypatch):
+        """Row-chunked predict/compute_cost (incl. a ragged tail) match the
+        unchunked results exactly."""
+        x, _, _ = _blobs(rng, n=257, d=6, k=3)
+        model = KMeans(k=3, max_iter=10, seed=0, init_mode="random").fit(x)
+        full_pred = model.predict(x)
+        full_cost = model.compute_cost(x)
+        monkeypatch.setattr(KMeansModel, "_PREDICT_CHUNK", 100)
+        np.testing.assert_array_equal(model.predict(x), full_pred)
+        np.testing.assert_allclose(model.compute_cost(x), full_cost, rtol=1e-6)
+
+
 class TestModelParallel:
     """Mesh-sharded linalg for K-Means: centroids feature-sharded over the
     MODEL axis of a (data=4, model=2) mesh (survey §5 scope; the shard_map
