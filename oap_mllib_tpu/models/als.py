@@ -115,20 +115,29 @@ class ALSModel:
 
     @staticmethod
     def _top_k_scores(query: np.ndarray, targets: np.ndarray, n: int,
-                      row_chunk: int = 8192) -> np.ndarray:
+                      row_chunk: int = 0) -> np.ndarray:
         """Top-n target ids per query row, chunked over query rows so the
         (n_query, n_targets) score matrix never materializes (the
         reference blocks its recommendForAll the same way —
         ALS.scala:383-401 blockify — because the full cross product is
-        quadratic in memory)."""
+        quadratic in memory).  ``row_chunk`` 0 sizes chunks from the
+        shared live-buffer budget over the score block AND the query
+        chunk (kmeans_ops.rows_per_chunk) — a fixed row count would blow
+        up against a huge target side, and a score-only bound against a
+        wide query side."""
+        from oap_mllib_tpu.ops.kmeans_ops import rows_per_chunk
+
         if query.shape[0] == 0:
             return np.zeros((0, n), np.int32)
+        rows = row_chunk or rows_per_chunk(
+            targets.shape[0], query.shape[1]
+        )
         tj = jnp.asarray(targets)
         out = [
             np.asarray(
-                _top_k_ids(jnp.asarray(query[lo : lo + row_chunk]), tj, n)
+                _top_k_ids(jnp.asarray(query[lo : lo + rows]), tj, n)
             )
-            for lo in range(0, query.shape[0], row_chunk)
+            for lo in range(0, query.shape[0], rows)
         ]
         return np.concatenate(out, axis=0)
 

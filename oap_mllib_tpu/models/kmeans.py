@@ -66,25 +66,33 @@ class KMeansModel:
     def k(self) -> int:
         return self.cluster_centers_.shape[0]
 
-    # rows per scoring chunk: bounds the live (chunk, k) distance matrix
-    # so predict/cost on huge inputs never materialize (n, k) — the same
-    # blocking the training loop gets from auto_row_chunks
-    _PREDICT_CHUNK = 1 << 20
+    # element budget for the live buffers in predict/cost — the (chunk, k)
+    # distance matrix AND the (chunk, d) input chunk (a fixed ROW count
+    # would blow up at large k; bounding only k would blow up at large d);
+    # the same bound the training loop gets from auto_row_chunks
+    _PREDICT_BUDGET = kmeans_ops.SCORE_BUDGET_ELEMS
+
+    def _score_chunk_rows(self) -> int:
+        return kmeans_ops.rows_per_chunk(
+            self.k, self.cluster_centers_.shape[1],
+            budget=self._PREDICT_BUDGET,
+        )
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         """Nearest-center assignment (the shim's transform/predict surface)."""
         x = np.asarray(x, dtype=self.cluster_centers_.dtype)
         if self.distance_measure == "euclidean" and x.shape[0] >= 1:
             c = jnp.asarray(self.cluster_centers_)
+            rows = self._score_chunk_rows()
             # fixed-size slices (not array_split): every full chunk shares
             # one compiled shape, only the tail adds a second
             return np.concatenate([
                 np.asarray(
                     kmeans_ops.assign_clusters(
-                        jnp.asarray(x[lo : lo + self._PREDICT_CHUNK]), c
+                        jnp.asarray(x[lo : lo + rows]), c
                     )
                 )
-                for lo in range(0, len(x), self._PREDICT_CHUNK)
+                for lo in range(0, len(x), rows)
             ])
         return predict_np(x, self.cluster_centers_, self.distance_measure)
 
@@ -99,13 +107,14 @@ class KMeansModel:
             d = _sq_dists(x, self.cluster_centers_, self.distance_measure)
             return float(np.sum(np.min(d, axis=1)))
         c = jnp.asarray(self.cluster_centers_)
+        rows = self._score_chunk_rows()
         return float(sum(
             float(jnp.sum(jnp.min(
                 kmeans_ops.pairwise_sq_dists(
-                    jnp.asarray(x[lo : lo + self._PREDICT_CHUNK]), c
+                    jnp.asarray(x[lo : lo + rows]), c
                 ), axis=1
             )))
-            for lo in range(0, len(x), self._PREDICT_CHUNK)
+            for lo in range(0, len(x), rows)
         ))
 
     def to_pmml(self, path: str) -> None:

@@ -177,7 +177,22 @@ def _accumulate_chunked(x, weights, centers, row_chunks: int, precision: str = "
     return sums, counts, cost
 
 
-def auto_row_chunks(n: int, k: int, budget_elems: int = 1 << 25) -> int:
+# live-buffer element budget shared by every row-chunking site (training
+# accumulate, predict/cost scoring, ALS recommend top-k): 32M f32 = 128 MB
+# HBM.  One constant so a device-tier retune cannot leave the inference
+# side inconsistent with training.
+SCORE_BUDGET_ELEMS = 1 << 25
+
+
+def rows_per_chunk(*widths: int, budget: int = SCORE_BUDGET_ELEMS) -> int:
+    """Rows per scoring chunk such that the SUM of live (rows, width)
+    buffers — input chunk + score/distance block — stays within budget.
+    Bounding only the widest buffer would let the other grow unbounded
+    (e.g. a (rows, d) input chunk at tiny k)."""
+    return max(1, budget // max(1, sum(widths)))
+
+
+def auto_row_chunks(n: int, k: int, budget_elems: int = SCORE_BUDGET_ELEMS) -> int:
     """Pick a chunk count dividing ``n`` so the live (chunk, k) distance
     buffer stays under ``budget_elems`` (default 32M f32 = 128 MB HBM).
 

@@ -201,7 +201,8 @@ class TestChunkedScoring:
         model = KMeans(k=3, max_iter=10, seed=0, init_mode="random").fit(x)
         full_pred = model.predict(x)
         full_cost = model.compute_cost(x)
-        monkeypatch.setattr(KMeansModel, "_PREDICT_CHUNK", 100)
+        # budget of 300 elems at k=3, d=6 -> 33-row chunks (+ ragged tail)
+        monkeypatch.setattr(KMeansModel, "_PREDICT_BUDGET", 300)
         np.testing.assert_array_equal(model.predict(x), full_pred)
         np.testing.assert_allclose(model.compute_cost(x), full_cost, rtol=1e-6)
 
