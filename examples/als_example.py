@@ -28,6 +28,11 @@ def main():
                    help="explicit feedback (default implicit, like the reference example)")
     p.add_argument("--device", default=None)
     p.add_argument("--timing", action="store_true")
+    p.add_argument("--als-kernel", default=None,
+                   choices=["auto", "grouped", "coo"],
+                   help="normal-equation layout (default auto: grouped "
+                        "unless the degree distribution's padding blowup "
+                        "trips the guard; fit summary records the choice)")
     args = p.parse_args()
 
     from oap_mllib_tpu import ALS
@@ -36,6 +41,8 @@ def main():
 
     if args.device:
         set_config(device=args.device)
+    if args.als_kernel:
+        set_config(als_kernel=args.als_kernel)
     if args.timing:
         import logging
 

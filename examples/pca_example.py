@@ -18,6 +18,11 @@ def main():
     p.add_argument("--k", type=int, default=3)
     p.add_argument("--device", default=None)
     p.add_argument("--timing", action="store_true")
+    p.add_argument("--model-parallel", type=int, default=None,
+                   help="model-axis size: >1 shards the (d, d) Gram rows "
+                        "over a (data, model) mesh (device count must be "
+                        "divisible by it); an explicit 1 forces pure data "
+                        "parallelism even if the env sets otherwise")
     args = p.parse_args()
 
     from oap_mllib_tpu import PCA
@@ -26,6 +31,8 @@ def main():
 
     if args.device:
         set_config(device=args.device)
+    if args.model_parallel is not None:
+        set_config(model_parallel=args.model_parallel)
     if args.timing:
         import logging
 
