@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""K-Means via the Spark-ML compat surface — the reference's PySpark twin
+(examples/kmeans-pyspark/kmeans-pyspark.py:47-67): load libsvm data, fit
+KMeans().setK(2).setSeed(1), transform, score the clustering with the
+squared-euclidean silhouette (Spark's ClusteringEvaluator default), and
+print the cluster centers.
+
+Where the reference builds a SparkSession DataFrame from libsvm, the
+compat surface takes a dict of numpy columns.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def silhouette_squared_euclidean(x: np.ndarray, labels: np.ndarray) -> float:
+    """Mean silhouette with squared-euclidean distance (ClusteringEvaluator's
+    default metric).  Per Spark's formulation the point-to-cluster distance
+    is the MEAN squared distance to the cluster's points, computable from
+    cluster means and second moments without pairwise distances."""
+    uniq = np.unique(labels)
+    if len(uniq) < 2:
+        return float("nan")
+    sq = np.einsum("ij,ij->i", x, x)
+    means = np.stack([x[labels == c].mean(axis=0) for c in uniq])
+    mean_sq = np.asarray([sq[labels == c].mean() for c in uniq])
+    counts = np.asarray([(labels == c).sum() for c in uniq])
+    # mean squared distance from point i to cluster c:
+    #   E||p - x_i||^2 = E||p||^2 - 2 x_i . mean_c + ||x_i||^2
+    d = mean_sq[None, :] - 2.0 * x @ means.T + sq[:, None]
+    own = np.searchsorted(uniq, labels)
+    n_own = counts[own]
+    scores = np.zeros(len(x))
+    valid = n_own > 1
+    # a(i): exclude the point itself from its own cluster's mean distance
+    a = d[np.arange(len(x)), own] * n_own / np.maximum(n_own - 1, 1)
+    d_other = d.copy()
+    d_other[np.arange(len(x)), own] = np.inf
+    b = d_other.min(axis=1)
+    scores[valid] = ((b - a) / np.maximum(a, b))[valid]
+    return float(scores.mean())
+
+
+def main():
+    p = argparse.ArgumentParser(description="oap-mllib-tpu K-Means compat example")
+    p.add_argument("--data", default=os.path.join(HERE, "data", "sample_kmeans_data.txt"))
+    p.add_argument("--k", type=int, default=2)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--device", default=None)
+    p.add_argument("--timing", action="store_true")
+    args = p.parse_args()
+
+    from oap_mllib_tpu.compat.spark import KMeans
+    from oap_mllib_tpu.config import set_config
+    from oap_mllib_tpu.data.io import read_libsvm
+
+    if args.device:
+        set_config(device=args.device)
+    if args.timing:
+        set_config(timing=True)
+
+    # spark.read.format("libsvm").load(path)
+    _, x = read_libsvm(args.data)
+    dataset = {"features": x}
+
+    # KMeans().setK(2).setSeed(1); model = kmeans.fit(dataset)
+    kmeans = KMeans().setK(args.k).setSeed(args.seed)
+    model = kmeans.fit(dataset)
+
+    # predictions = model.transform(dataset)
+    predictions = model.transform(dataset)
+
+    # ClusteringEvaluator().evaluate(predictions)
+    silhouette = silhouette_squared_euclidean(x, predictions["prediction"])
+    print("Silhouette with squared euclidean distance = " + str(silhouette))
+
+    print("Cluster Centers: ")
+    for center in model.clusterCenters():
+        print(center)
+
+
+if __name__ == "__main__":
+    main()

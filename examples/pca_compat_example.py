@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""PCA via the Spark-ML compat surface — the reference's PySpark twin
+(examples/pca-pyspark/pca-pyspark.py:30-46): read a headerless CSV,
+assemble all columns into a features vector, fit PCA(k=K), print the
+principal components and the explained-variance ratios.
+
+Where the reference uses VectorAssembler on a SparkSession DataFrame,
+the compat surface takes a dict of numpy columns.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    p = argparse.ArgumentParser(description="oap-mllib-tpu PCA compat example")
+    p.add_argument("--data", default=os.path.join(HERE, "data", "pca_data.csv"))
+    p.add_argument("--k", type=int, default=3)
+    p.add_argument("--device", default=None)
+    p.add_argument("--timing", action="store_true")
+    args = p.parse_args()
+
+    from oap_mllib_tpu.compat.spark import PCA
+    from oap_mllib_tpu.config import set_config
+    from oap_mllib_tpu.data.io import read_csv
+
+    if args.device:
+        set_config(device=args.device)
+    if args.timing:
+        set_config(timing=True)
+
+    # spark.read.load(csv) + VectorAssembler(inputCols=..., outputCol="features")
+    x = read_csv(args.data)
+    dataset = {"features": x}
+    print(f"dataset: {x.shape[0]} rows x {x.shape[1]} cols")
+
+    # PCA(k=K, inputCol="features", outputCol="pcaFeatures")
+    pca = PCA().setK(args.k).setInputCol("features").setOutputCol("pcaFeatures")
+    model = pca.fit(dataset)
+
+    print("Principal Components: ", model.pc, sep="\n")
+    print("Explained Variance: ", model.explainedVariance, sep="\n")
+
+    projected = model.transform(dataset)
+    print("pcaFeatures (first 3 rows): ", projected["pcaFeatures"][:3], sep="\n")
+
+
+if __name__ == "__main__":
+    main()

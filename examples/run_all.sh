@@ -4,7 +4,8 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-for ex in kmeans_example.py pca_example.py als_example.py als_compat_example.py; do
+for ex in kmeans_example.py pca_example.py als_example.py \
+          kmeans_compat_example.py pca_compat_example.py als_compat_example.py; do
   echo "=== $ex ==="
   python "$ex" "$@"
   echo
