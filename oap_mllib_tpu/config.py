@@ -83,9 +83,11 @@ class Config:
     matmul_precision: str = "highest"
     # K-Means hot-loop kernel: "auto" picks the fastest measured path per
     # shape/tier (BASELINE.md kernel table, v5e): the fused Pallas kernel
-    # for MXU-deep features (d >= 256) at the f32-accurate tiers, the
-    # chunked XLA Lloyd otherwise.  "xla"/"pallas" force a path; "pallas"
-    # requires TPU + single-device + f32 and falls back otherwise.
+    # at the f32-accurate tiers (it won every profiled shape once the
+    # loop-mode assignment landed), the chunked XLA Lloyd at "default" or
+    # when (k, d) overflows the kernel's VMEM blocks.  "xla"/"pallas"
+    # force a path; "pallas" requires TPU + single-device + f32 and falls
+    # back otherwise.
     kmeans_kernel: str = "auto"
     # ALS normal-equation layout: "auto" uses the scatter-free grouped-edge
     # programs (12x the COO path at MovieLens-1M scale on v5e, BASELINE.md)

@@ -299,10 +299,11 @@ class KMeans:
 
         ``auto`` picks the fastest measured path for the shape/tier
         (BASELINE.md kernel table, v5e; rule in
-        kmeans_ops.pallas_preferred): the fused Pallas kernel when the
-        feature dim is MXU-deep and (k, d) fits its VMEM blocks — its
-        exact-split cluster sums cut the per-iteration MXU passes —
-        else the chunked XLA Lloyd.  ``xla``/``pallas`` force a path;
+        kmeans_ops.pallas_preferred): the fused Pallas kernel at the
+        f32-accurate tiers when (k, d) fits its VMEM blocks — its
+        loop-mode assignment + exact-split cluster sums cut the
+        per-iteration MXU/VPU passes — else the chunked XLA Lloyd
+        (which wins the all-bf16 "default" tier).  ``xla``/``pallas`` force a path;
         ``pallas`` requires TPU + single device + f32 and falls back
         otherwise.  Chunking only applies on a single device: the scan
         reshape conflicts with GSPMD row sharding.  A mesh with a model

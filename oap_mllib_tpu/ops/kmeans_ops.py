@@ -61,21 +61,19 @@ def _prec(precision: str):
 
 def pallas_preferred(d: int, k: int, precision: str) -> bool:
     """Shape/tier rule for kmeans_kernel="auto" (BASELINE.md kernel table,
-    measured on v5e): the fused Pallas kernel wins when the feature dim is
-    MXU-deep — d >= 256 at the f32-accurate tiers (its exact-split sums
-    need 2 bf16 passes where XLA "high" pays 3 and "highest" 6+), d >= 1024
-    even at "default".  At small d the fused kernel's block overheads
-    dominate.  Large k is excluded: the kernel holds the full (k, d)
-    centers AND sums blocks in VMEM, so past ~4M padded elements apiece
-    (2 x 16 MB f32) Mosaic would fail to place them — those fits stay on
-    the chunked XLA path."""
+    measured on v5e): the fused Pallas kernel wins EVERY profiled shape at
+    the f32-accurate tiers (its loop-mode half-score assignment + exact
+    -split sums pay 1+2 bf16 passes where XLA "high" pays 3+3, "highest"
+    6+6); at "default" XLA's all-bf16 single-pass pipeline wins instead.
+    Large k is excluded: the kernel holds the full (k, d) centers AND sums
+    blocks in VMEM, so past ~4M padded elements apiece (2 x 16 MB f32)
+    Mosaic would fail to place them — those fits stay on the chunked XLA
+    path."""
     k_pad = -(-k // 128) * 128
     d_pad = -(-d // 128) * 128
     if k_pad * d_pad > (1 << 22):  # 16 MB per f32 VMEM block
         return False
-    if precision in ("highest", "high"):
-        return d >= 256
-    return d >= 1024
+    return precision in ("highest", "high")
 
 
 def use_pallas_path(kernel_cfg: str, d: int, k: int, precision: str, dtype) -> bool:
@@ -128,24 +126,38 @@ def assign_clusters(x: jax.Array, centers: jax.Array) -> jax.Array:
     return jnp.argmin(pairwise_sq_dists(x, centers), axis=1)
 
 
-def _accumulate(x, weights, centers, precision: str = "highest"):
+def _accumulate(x, weights, centers, precision: str = "highest",
+                need_cost: bool = True):
     """One assignment pass: per-cluster weighted sums, counts, and cost.
 
     Returns (sums (k,d), counts (k,), cost scalar).  All reductions are
     global over the row-sharded inputs — GSPMD inserts the psum.
+
+    ``need_cost=False`` is the Lloyd-loop-body mode: cost is dead inside
+    the loop (the caller recomputes it at "highest" after convergence), so
+    the assignment ranks on the half-score ``|c|^2/2 - x.c`` — argmin is
+    invariant to the per-row |x|^2 term — skipping the d2 assembly and the
+    min reduction entirely.
     """
     k = centers.shape[0]
-    d2 = pairwise_sq_dists(x, centers, _assign_prec(precision))  # (n, k)
-    assign = jnp.argmin(d2, axis=1)  # (n,)
-    min_d2 = jnp.min(d2, axis=1)  # (n,)
+    if need_cost:
+        d2 = pairwise_sq_dists(x, centers, _assign_prec(precision))  # (n, k)
+        assign = jnp.argmin(d2, axis=1)  # (n,)
+        min_d2 = jnp.min(d2, axis=1)  # (n,)
+        cost = jnp.sum(min_d2 * weights)
+    else:
+        c_sq = jnp.sum(centers * centers, axis=1)  # (k,)
+        cross = jnp.matmul(x, centers.T, precision=_prec(_assign_prec(precision)))
+        assign = jnp.argmin(0.5 * c_sq[None, :] - cross, axis=1)  # (n,)
+        cost = jnp.asarray(0.0, x.dtype)
     one_hot = jax.nn.one_hot(assign, k, dtype=x.dtype) * weights[:, None]  # (n, k)
     sums = jnp.matmul(one_hot.T, x, precision=_prec(precision))  # (k, d)  <- MXU
     counts = jnp.sum(one_hot, axis=0)  # (k,)
-    cost = jnp.sum(min_d2 * weights)
     return sums, counts, cost
 
 
-def _accumulate_chunked(x, weights, centers, row_chunks: int, precision: str = "highest"):
+def _accumulate_chunked(x, weights, centers, row_chunks: int,
+                        precision: str = "highest", need_cost: bool = True):
     """Chunked assignment pass: bounds the live (chunk, k) distance/one-hot
     buffers so n*k never materializes in HBM (needed for bench-scale runs
     like 1M x 256 with k=1000, where (n, k) f32 alone is 4 GB).
@@ -164,7 +176,7 @@ def _accumulate_chunked(x, weights, centers, row_chunks: int, precision: str = "
     def step(carry, chunk):
         sums, counts, cost = carry
         xi, wi = chunk
-        s, c, t = _accumulate(xi, wi, centers, precision)
+        s, c, t = _accumulate(xi, wi, centers, precision, need_cost)
         return (sums + s, counts + c, cost + t), None
 
     k, d = centers.shape[0], x.shape[1]
@@ -205,7 +217,7 @@ def auto_row_chunks(n: int, k: int, budget_elems: int = SCORE_BUDGET_ELEMS) -> i
     return chunks
 
 
-def _lloyd_loop(accum, moved_reduce, init_centers, max_iter, tol_sq, dtype):
+def _lloyd_loop(accum, moved_reduce, init_centers, max_iter, tol_sq):
     """Shared Lloyd loop skeleton (single-program AND model-sharded paths
     — one definition so convergence/empty-cluster semantics cannot drift).
 
@@ -222,27 +234,22 @@ def _lloyd_loop(accum, moved_reduce, init_centers, max_iter, tol_sq, dtype):
     """
 
     def cond(state):
-        _, it, converged, _ = state
+        _, it, converged = state
         return jnp.logical_and(it < max_iter, jnp.logical_not(converged))
 
     def body(state):
-        centers, it, _, _ = state
-        sums, counts, cost = accum(centers, None)
+        centers, it, _ = state
+        sums, counts, _ = accum(centers, None)
         safe = counts[:, None] > 0
         new_centers = jnp.where(
             safe, sums / jnp.maximum(counts[:, None], 1e-30), centers
         )
         moved_sq = moved_reduce(jnp.sum((new_centers - centers) ** 2, axis=1))
         converged = jnp.all(moved_sq <= tol_sq)
-        return new_centers, it + 1, converged, cost
+        return new_centers, it + 1, converged
 
-    init_state = (
-        init_centers,
-        jnp.asarray(0, jnp.int32),
-        jnp.asarray(False),
-        jnp.asarray(0.0, dtype),
-    )
-    centers, n_iter, _, _ = lax.while_loop(cond, body, init_state)
+    init_state = (init_centers, jnp.asarray(0, jnp.int32), jnp.asarray(False))
+    centers, n_iter, _ = lax.while_loop(cond, body, init_state)
     _, counts, cost = accum(centers, "highest")
     return centers, n_iter, cost, counts
 
@@ -264,13 +271,16 @@ def lloyd_run(
     """
 
     def accum(centers, prec):
+        # prec None = loop-body mode: no cost (recomputed at "highest" after
+        # convergence), half-score assignment
         p = prec or precision
+        need_cost = prec is not None
         if row_chunks > 1:
-            return _accumulate_chunked(x, weights, centers, row_chunks, p)
-        return _accumulate(x, weights, centers, p)
+            return _accumulate_chunked(x, weights, centers, row_chunks, p, need_cost)
+        return _accumulate(x, weights, centers, p, need_cost)
 
     return _lloyd_loop(
-        accum, lambda m: m, init_centers, max_iter, tol * tol, x.dtype
+        accum, lambda m: m, init_centers, max_iter, tol * tol
     )
 
 
@@ -296,35 +306,44 @@ def _lloyd_model_sharded_fn(mesh, dax: str, max_: str, max_iter: int,
     s_prec = _prec(precision)
     h_prec = _prec("highest")
 
-    def accum(x_blk, w_blk, c_blk, aprec, sprec):
+    def accum(x_blk, w_blk, c_blk, aprec, sprec, need_cost):
         k = c_blk.shape[0]
-        x_sq = jnp.sum(x_blk * x_blk, axis=1, keepdims=True)  # (n_loc, 1)
         c_sq = jnp.sum(c_blk * c_blk, axis=1)  # (k,)
         cross = jnp.matmul(x_blk, c_blk.T, precision=aprec)  # <- MXU
-        # one psum carries all three feature-block partials at once
-        d2 = lax.psum(x_sq + c_sq[None, :] - 2.0 * cross, max_)
-        d2 = jnp.maximum(d2, 0.0)
-        assign = jnp.argmin(d2, axis=1)
-        min_d2 = jnp.min(d2, axis=1)
+        if need_cost:
+            x_sq = jnp.sum(x_blk * x_blk, axis=1, keepdims=True)  # (n_loc, 1)
+            # one psum carries all three feature-block partials at once
+            d2 = lax.psum(x_sq + c_sq[None, :] - 2.0 * cross, max_)
+            d2 = jnp.maximum(d2, 0.0)
+            assign = jnp.argmin(d2, axis=1)
+            min_d2 = jnp.min(d2, axis=1)
+        else:
+            # loop-body mode: rank on the half-score (argmin-invariant to
+            # |x|^2); still ONE psum over the model axis, no d2/min passes
+            score = lax.psum(0.5 * c_sq[None, :] - cross, max_)
+            assign = jnp.argmin(score, axis=1)
         one_hot = jax.nn.one_hot(assign, k, dtype=x_blk.dtype) * w_blk[:, None]
         sums_blk = lax.psum(
             jnp.matmul(one_hot.T, x_blk, precision=sprec), dax
         )  # (k, d_loc) — stays feature-local
         counts = lax.psum(jnp.sum(one_hot, axis=0), dax)
-        cost = lax.psum(jnp.sum(min_d2 * w_blk), dax)
+        cost = (
+            lax.psum(jnp.sum(min_d2 * w_blk), dax)
+            if need_cost else jnp.asarray(0.0, x_blk.dtype)
+        )
         return sums_blk, counts, cost
 
     def rank_program(x_blk, w_blk, c0_blk, tol_sq):
         def tile_accum(c_blk, prec):
             if prec == "highest":
-                return accum(x_blk, w_blk, c_blk, h_prec, h_prec)
-            return accum(x_blk, w_blk, c_blk, a_prec, s_prec)
+                return accum(x_blk, w_blk, c_blk, h_prec, h_prec, True)
+            return accum(x_blk, w_blk, c_blk, a_prec, s_prec, False)
 
         # per-center move norms are partial over the local feature block —
         # complete them over the model axis before the convergence test
         return _lloyd_loop(
             tile_accum, lambda m: lax.psum(m, max_), c0_blk, max_iter,
-            tol_sq, x_blk.dtype,
+            tol_sq,
         )
 
     from jax.sharding import PartitionSpec as P

@@ -89,7 +89,7 @@ def _cluster_sums(one_hot01, wx, mode):
     return _dot_bf16(oh, wx_hi, dn) + _dot_bf16(oh, wx_lo, dn)
 
 
-def _make_kernel(mode):
+def _make_kernel(mode, need_cost=True):
     def _kernel(x_ref, w_ref, c_ref, sums_ref, counts_ref, cost_ref):
         """One grid step: process a (bn, d) row block against all k centers."""
         # zero accumulators on the first block (sequential TPU grid)
@@ -102,25 +102,38 @@ def _make_kernel(mode):
         x = x_ref[:]  # (bn, d)
         w = w_ref[:]  # (bn, 1)
         c = c_ref[:]  # (k, d)
+        k = c.shape[0]
 
-        # squared distances via the matmul identity (MXU)
-        x_sq = jnp.sum(x * x, axis=1, keepdims=True)  # (bn, 1)
         c_sq = jnp.sum(c * c, axis=1)[None, :]  # (1, k)
         cross = _cross_term(x, c, mode)  # (bn, k)  <- MXU
-        d2 = jnp.maximum(x_sq + c_sq - 2.0 * cross, 0.0)
 
-        assign = jnp.argmin(d2, axis=1)  # (bn,)
-        min_d2 = jnp.min(d2, axis=1, keepdims=True)  # (bn, 1)
+        if need_cost:
+            # squared distances via the matmul identity (MXU)
+            x_sq = jnp.sum(x * x, axis=1, keepdims=True)  # (bn, 1)
+            d2 = jnp.maximum(x_sq + c_sq - 2.0 * cross, 0.0)
+            assign = jnp.argmin(d2, axis=1)  # (bn,)
+            min_d2 = jnp.min(d2, axis=1, keepdims=True)  # (bn, 1)
+        else:
+            # loop mode: argmin is invariant to the per-row |x|^2 term, so
+            # rank on the half-score x.c - c_sq/2 (argMAX) — no d2 assembly,
+            # no maximum, no min pass (cost is dead inside the Lloyd loop:
+            # the caller recomputes it at "highest" after convergence).
+            # NB keep the (bn, k) term on the LEFT of the subtract: with the
+            # broadcast (1, k) operand first, Mosaic's lowering allocates a
+            # ~32 MB scoped-vmem temp and fails to compile (argmax of
+            # cross - c_sq/2 selects the same center, same first-index
+            # tie-break as argmin of the negation)
+            assign = jnp.argmax(cross - 0.5 * c_sq, axis=1)  # (bn,)
 
         # unweighted 0/1 one-hot (VPU compare against 2-D iota); weights fold
         # into w*x so the one-hot stays exactly representable in bf16
-        k = c.shape[0]
         col_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
         one_hot = jnp.where(col_ids == assign[:, None], 1.0, 0.0)  # (bn, k)
 
         sums_ref[:] += _cluster_sums(one_hot, w * x, mode)
         counts_ref[:] += jnp.sum(one_hot * w, axis=0, keepdims=True)  # (1, k)
-        cost_ref[0, 0] += jnp.sum(min_d2 * w)
+        if need_cost:
+            cost_ref[0, 0] += jnp.sum(min_d2 * w)
 
     return _kernel
 
@@ -129,13 +142,13 @@ def _pad_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-@functools.partial(jax.jit, static_argnames=("mode", "interpret"))
-def _call(x, w, centers, mode="highest", interpret=False):
+@functools.partial(jax.jit, static_argnames=("mode", "interpret", "need_cost"))
+def _call(x, w, centers, mode="highest", interpret=False, need_cost=True):
     n, d = x.shape
     k = centers.shape[0]
     grid = (n // _BLOCK_ROWS,)
     sums, counts, cost = pl.pallas_call(
-        _make_kernel(mode),
+        _make_kernel(mode, need_cost),
         grid=grid,
         in_specs=[
             pl.BlockSpec((_BLOCK_ROWS, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -205,22 +218,24 @@ def _lloyd_loop_padded(x_p, w_p, c_p, max_iter, tol, mode="highest", interpret=F
     tol_sq = tol * tol
 
     def cond(state):
-        _, it, converged, _ = state
+        _, it, converged = state
         return jnp.logical_and(it < max_iter, jnp.logical_not(converged))
 
     def body(state):
-        centers, it, _, _ = state
-        sums, counts, cost = _call(x_p, w_p, centers, mode=mode, interpret=interpret)
+        centers, it, _ = state
+        sums, counts, _ = _call(
+            x_p, w_p, centers, mode=mode, interpret=interpret, need_cost=False
+        )
         counts_col = counts[0][:, None]  # (k_pad, 1)
         new_centers = jnp.where(
             counts_col > 0, sums / jnp.maximum(counts_col, 1e-30), centers
         )
         moved_sq = jnp.sum((new_centers - centers) ** 2, axis=1)
         converged = jnp.all(moved_sq <= tol_sq)
-        return new_centers, it + 1, converged, cost[0, 0]
+        return new_centers, it + 1, converged
 
-    state = (c_p, jnp.asarray(0, jnp.int32), jnp.asarray(False), jnp.float32(0))
-    centers, n_iter, _, _ = jax.lax.while_loop(cond, body, state)
+    state = (c_p, jnp.asarray(0, jnp.int32), jnp.asarray(False))
+    centers, n_iter, _ = jax.lax.while_loop(cond, body, state)
     # final cost + counts w.r.t. the returned centers, always at full
     # precision — the user-facing objective should not carry the fast
     # tiers' distance error

@@ -83,9 +83,10 @@ class TestXlaPrecisionTiers:
         assert abs(float(t1) - float(t2)) / float(t1) < 1e-4
 
     def test_auto_picks_pallas_for_deep_features(self, rng, monkeypatch):
-        """kmeans_kernel=auto routes d>=256 at the f32-accurate tiers to
-        the fused kernel (BASELINE.md kernel-table rule) — verified by
-        counting calls, not inferred."""
+        """kmeans_kernel=auto routes the f32-accurate tiers to the fused
+        kernel (BASELINE.md kernel-table rule: pallas wins every profiled
+        shape at highest/high) — verified by counting calls, not
+        inferred."""
         if len(jax.devices()) != 1:
             pytest.skip("pallas estimator path requires a single device")
         import oap_mllib_tpu.ops.pallas.kmeans_kernel as pk
@@ -128,11 +129,14 @@ class TestXlaPrecisionTiers:
             m = KMeans(k=4, max_iter=10, seed=1).fit(x)
             assert m.summary.accelerated
             assert calls, "pallas kernel was configured but never invoked"
-            set_config(kmeans_kernel="auto")
+            # auto at the "default" tier routes to XLA (kernel-table rule:
+            # XLA's all-bf16 pipeline wins that tier) — no new pallas call
+            n_before = len(calls)
+            set_config(kmeans_kernel="auto", matmul_precision="default")
             m2 = KMeans(k=4, max_iter=10, seed=1).fit(x)
-            assert len(calls) == 1  # auto path did not re-enter pallas
+            assert len(calls) == n_before
             np.testing.assert_allclose(
-                m.summary.training_cost, m2.summary.training_cost, rtol=1e-4
+                m.summary.training_cost, m2.summary.training_cost, rtol=1e-2
             )
         finally:
-            set_config(kmeans_kernel="auto")
+            set_config(kmeans_kernel="auto", matmul_precision="highest")
