@@ -14,10 +14,12 @@ from oap_mllib_tpu.data.io import (
     read_csv,
     read_ratings,
 )
+from oap_mllib_tpu.data.stream import ChunkSource
 
 __all__ = [
     "DenseTable",
     "CSRTable",
+    "ChunkSource",
     "read_libsvm",
     "read_csv",
     "read_ratings",

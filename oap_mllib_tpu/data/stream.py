@@ -1,0 +1,183 @@
+"""Out-of-core row streaming: fit on tables larger than one chip's HBM.
+
+The reference never holds the full dataset in one process — Spark executors
+each hold a partition as a native table (OneDAL.scala:92-166) and total
+cluster RAM bounds the problem.  The mesh-sharded path here is the direct
+analog (HBM summed over chips).  This module adds the axis the reference
+does NOT have: a single host streaming a table through ONE chip's HBM in
+fixed-size row chunks, bounding device memory by O(chunk) while K-Means /
+PCA make full passes per iteration.  The chunk shape is static, so every
+pass reuses one compiled program (XLA static-shape contract, survey §2.6).
+
+``ChunkSource`` is a re-iterable sequence of equal-width row chunks.  The
+final partial chunk is padded with zero rows and reported via the per-chunk
+valid count — padded rows carry weight 0 through every kernel, the same
+masking contract as ``DenseTable``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+# default rows per chunk: 64k rows x 256 features x f32 = 64 MB host
+# buffer — big enough to keep the MXU busy, far under any HBM budget
+DEFAULT_CHUNK_ROWS = 1 << 16
+
+
+class ChunkSource:
+    """Re-iterable source of ``(chunk, n_valid)`` row blocks.
+
+    Every chunk has exactly ``(chunk_rows, n_features)`` shape at
+    ``dtype``; the last one is zero-padded and its ``n_valid <
+    chunk_rows`` says how many rows are real.  Sources must be
+    deterministic across passes (K-Means streaming re-walks the data every
+    Lloyd iteration; k-means|| relies on stable chunk order for its
+    distance state).
+    """
+
+    def __init__(
+        self,
+        make_iter: Callable[[], Iterator[np.ndarray]],
+        n_features: int,
+        chunk_rows: int = DEFAULT_CHUNK_ROWS,
+        n_rows: Optional[int] = None,
+        dtype=np.float32,
+    ):
+        if chunk_rows < 1:
+            raise ValueError("chunk_rows must be >= 1")
+        if n_features < 1:
+            raise ValueError("n_features must be >= 1")
+        self._make_iter = make_iter
+        self.n_features = int(n_features)
+        self.chunk_rows = int(chunk_rows)
+        self._n_rows = None if n_rows is None else int(n_rows)
+        # buffer at the source's own precision: re-buffering f32 data at
+        # f64 would triple host memory traffic on exactly the pass-heavy
+        # workloads this module exists for
+        self.dtype = np.dtype(dtype)
+
+    @property
+    def n_rows(self) -> Optional[int]:
+        """Total valid rows — known upfront for array sources, discovered
+        after the first full pass for file sources."""
+        return self._n_rows
+
+    def to_array(self) -> np.ndarray:
+        """Materialize the source to one host array (fallback paths; the
+        CPU reference semantics assume host-RAM-resident data)."""
+        return np.concatenate([c[:v] for c, v in self], axis=0)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, int]]:
+        """Yield (chunk (chunk_rows, d), n_valid) blocks; re-iterable."""
+        buf = np.zeros((self.chunk_rows, self.n_features), self.dtype)
+        fill = 0
+        total = 0
+        for piece in self._make_iter():
+            piece = np.atleast_2d(np.asarray(piece, self.dtype))
+            if piece.shape[1] != self.n_features:
+                raise ValueError(
+                    f"chunk width {piece.shape[1]} != n_features {self.n_features}"
+                )
+            off = 0
+            while off < piece.shape[0]:
+                take = min(self.chunk_rows - fill, piece.shape[0] - off)
+                buf[fill : fill + take] = piece[off : off + take]
+                fill += take
+                off += take
+                if fill == self.chunk_rows:
+                    total += fill
+                    yield buf, fill
+                    buf = np.zeros_like(buf)
+                    fill = 0
+        if fill:
+            total += fill
+            yield buf, fill
+        if self._n_rows is None:
+            self._n_rows = total
+        elif self._n_rows != total:
+            raise ValueError(
+                f"source yielded {total} rows this pass but {self._n_rows} "
+                "before — streamed fits require a deterministic source"
+            )
+
+    # -- constructors --------------------------------------------------------
+
+    @classmethod
+    def from_array(cls, x, chunk_rows: int = DEFAULT_CHUNK_ROWS) -> "ChunkSource":
+        """Wrap an in-memory array or np.memmap (zero-copy row slices)."""
+        x = np.asarray(x) if not isinstance(x, np.memmap) else x
+        if x.ndim != 2:
+            raise ValueError(f"expected 2-D data, got shape {x.shape}")
+
+        def gen():
+            for start in range(0, x.shape[0], chunk_rows):
+                yield x[start : start + chunk_rows]
+
+        return cls(gen, x.shape[1], chunk_rows, n_rows=x.shape[0], dtype=x.dtype)
+
+    @classmethod
+    def from_csv(
+        cls, path: str, chunk_rows: int = DEFAULT_CHUNK_ROWS,
+        delimiter: str = ",", n_features: Optional[int] = None,
+        dtype=np.float64,
+    ) -> "ChunkSource":
+        """Stream a headerless numeric CSV without loading it whole.
+        ``dtype`` defaults to f64 to match the eager read_csv reader."""
+        if n_features is None:
+            with open(path) as f:
+                first = f.readline()
+            n_features = len(first.strip().split(delimiter))
+
+        def gen():
+            rows = []
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rows.append([float(v) for v in line.split(delimiter)])
+                    if len(rows) == chunk_rows:
+                        yield np.asarray(rows)
+                        rows = []
+            if rows:
+                yield np.asarray(rows)
+
+        return cls(gen, n_features, chunk_rows, dtype=dtype)
+
+    @classmethod
+    def from_libsvm(
+        cls, path: str, n_features: int, chunk_rows: int = DEFAULT_CHUNK_ROWS,
+        dtype=np.float64,
+    ) -> "ChunkSource":
+        """Stream a libsvm file (1-based indices); labels are dropped, as in
+        the K-Means examples.  ``n_features`` must be given — a streaming
+        reader cannot discover the max index without a full pass.
+        ``dtype`` defaults to f64 to match the eager read_libsvm reader."""
+
+        def gen():
+            rows = np.zeros((chunk_rows, n_features), dtype)
+            fill = 0
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    for tok in line.split()[1:]:
+                        idx, val = tok.split(":")
+                        i = int(idx)
+                        if i > n_features:
+                            raise ValueError(
+                                f"libsvm index {i} exceeds n_features={n_features}"
+                            )
+                        rows[fill, i - 1] = float(val)
+                    fill += 1
+                    if fill == chunk_rows:
+                        yield rows
+                        rows = np.zeros_like(rows)
+                        fill = 0
+            if fill:
+                yield rows[:fill]
+
+        return cls(gen, n_features, chunk_rows, dtype=dtype)

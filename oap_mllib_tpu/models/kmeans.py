@@ -217,7 +217,11 @@ class KMeans:
         self.init_steps = init_steps
         self.distance_measure = distance_measure
 
-    def fit(self, x: np.ndarray, sample_weight: Optional[np.ndarray] = None) -> KMeansModel:
+    def fit(self, x, sample_weight: Optional[np.ndarray] = None) -> KMeansModel:
+        from oap_mllib_tpu.data.stream import ChunkSource
+
+        if isinstance(x, ChunkSource):
+            return self._fit_source(x, sample_weight)
         x = np.asarray(x)
         if x.ndim != 2:
             raise ValueError(f"expected 2-D data, got shape {x.shape}")
@@ -233,6 +237,60 @@ class KMeans:
             with maybe_trace():
                 return self._fit_tpu(x, sample_weight)
         return self._fit_fallback(x, sample_weight)
+
+    # -- streamed (out-of-core) path -----------------------------------------
+    def _fit_source(self, source, sample_weight) -> KMeansModel:
+        """Out-of-core fit from a ChunkSource (ops/stream_ops.py): device
+        memory bounded by O(chunk), one pass per Lloyd iteration.  Single
+        -process only (each multi-host process should shard rows and use
+        the in-memory mesh path); weighted rows are not streamable yet.
+        The fallback path materializes the source — the CPU reference
+        semantics assume host-RAM-resident data anyway."""
+        import jax
+
+        if sample_weight is not None:
+            raise ValueError("sample_weight is not supported with a ChunkSource")
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "streamed fit is single-process; shard rows per host and "
+                "use the in-memory mesh path instead"
+            )
+        guard_ok = self.distance_measure == "euclidean"
+        accelerated = should_accelerate(
+            "KMeans", guard_ok, reason=f"distance_measure={self.distance_measure}"
+        )
+        if not accelerated:
+            return self._fit_fallback(source.to_array(), None)
+        from oap_mllib_tpu.utils.profiling import maybe_trace
+        from oap_mllib_tpu.utils.timing import x64_scope
+
+        cfg = get_config()
+        dtype = np.float64 if cfg.enable_x64 else np.float32
+        with maybe_trace(), x64_scope(cfg.enable_x64):
+            return self._fit_stream_inner(source, dtype, cfg)
+
+    def _fit_stream_inner(self, source, dtype, cfg) -> KMeansModel:
+        from oap_mllib_tpu.ops import stream_ops
+
+        timings = Timings()
+        with phase_timer(timings, "init_centers"):
+            if self.init_mode == INIT_RANDOM:
+                centers0 = stream_ops.reservoir_sample(source, self.k, self.seed)
+            else:
+                centers0 = stream_ops.init_kmeans_parallel_streamed(
+                    source, self.k, self.seed, self.init_steps, dtype
+                )
+        with phase_timer(timings, "lloyd_loop"):
+            centers, n_iter, cost, counts = stream_ops.lloyd_run_streamed(
+                source, centers0, self.max_iter, self.tol, dtype,
+                cfg.matmul_precision,
+            )
+        summary = KMeansSummary(
+            float(cost), int(n_iter), timings, accelerated=True,
+            cluster_sizes=np.asarray(counts),
+        )
+        summary.streamed = True
+        return KMeansModel(np.asarray(centers), self.distance_measure, summary)
 
     # -- accelerated path (~ KMeansDALImpl.train, KMeansDALImpl.scala:35) ----
     def _fit_tpu(self, x: np.ndarray, sample_weight: Optional[np.ndarray]) -> KMeansModel:

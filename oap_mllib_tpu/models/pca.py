@@ -74,7 +74,11 @@ class PCA:
             raise ValueError("k must be >= 1")
         self.k = k
 
-    def fit(self, x: np.ndarray) -> PCAModel:
+    def fit(self, x) -> PCAModel:
+        from oap_mllib_tpu.data.stream import ChunkSource
+
+        if isinstance(x, ChunkSource):
+            return self._fit_source(x)
         x = np.asarray(x)
         if x.ndim != 2:
             raise ValueError(f"expected 2-D data, got shape {x.shape}")
@@ -88,6 +92,57 @@ class PCA:
             with maybe_trace():
                 return self._fit_tpu(x)
         return self._fit_fallback(x)
+
+    # -- streamed (out-of-core) path -----------------------------------------
+    def _fit_source(self, source) -> PCAModel:
+        """Out-of-core fit from a ChunkSource: two streamed passes (column
+        sums, centered Gram — ops/stream_ops.covariance_streamed), device
+        memory bounded by O(chunk + d^2).  Single-process only; the
+        fallback path materializes the source (CPU reference semantics
+        assume host-RAM-resident data anyway)."""
+        import jax
+
+        d = source.n_features
+        if self.k > d:
+            raise ValueError(f"k={self.k} exceeds n_features={d}")
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "streamed fit is single-process; shard rows per host and "
+                "use the in-memory mesh path instead"
+            )
+        guard_ok = d < MAX_PCA_FEATURES
+        if not should_accelerate("PCA", guard_ok, reason=f"n_features={d}"):
+            return self._fit_fallback(source.to_array())
+        from oap_mllib_tpu.utils.profiling import maybe_trace
+        from oap_mllib_tpu.utils.timing import x64_scope
+
+        cfg = get_config()
+        dtype = np.float64 if cfg.enable_x64 else np.float32
+        with maybe_trace(), x64_scope(cfg.enable_x64):
+            return self._fit_stream_inner(source, dtype, cfg)
+
+    def _fit_stream_inner(self, source, dtype, cfg) -> PCAModel:
+        from oap_mllib_tpu.ops import stream_ops
+
+        timings = Timings()
+        d = source.n_features
+        with phase_timer(timings, "covariance_streamed"):
+            tier = "highest" if cfg.enable_x64 else cfg.matmul_precision
+            cov, _, n = stream_ops.covariance_streamed(source, dtype, tier)
+        with phase_timer(timings, "eigh"):
+            # cov is exactly (d, d) here — no model-sharding feature pad
+            vals, vecs = pca_ops.eigh_descending(cov)
+            vals = np.asarray(vals)
+            vecs = np.asarray(vecs)
+        total = float(vals.sum())
+        ratio = vals[: self.k] / total if total > 0 else np.zeros(self.k)
+        summary = {
+            "timings": timings,
+            "accelerated": True,
+            "streamed": True,
+            "n_rows": n,
+        }
+        return PCAModel(vecs[:, : self.k], ratio, summary)
 
     # -- accelerated path (~ PCADALImpl.train, PCADALImpl.scala:35) ----------
     def _fit_tpu(self, x: np.ndarray) -> PCAModel:

@@ -1,0 +1,293 @@
+"""Streamed (out-of-core) kernels: full-pass K-Means / PCA over a ChunkSource.
+
+Device memory is bounded by O(chunk_rows x d) while the algorithms make
+whole-table passes: each pass walks the source once, pushing fixed-shape
+chunks through ONE compiled per-chunk program whose accumulators live on
+device (donated, so XLA updates them in place).  This is the capability the
+reference does not have — its executors must hold their whole partition as
+a native table in RAM (OneDAL.scala:92-166) — and it is what lets a single
+chip with 16 GB HBM fit the 100M x 256 north-star table (100 GB) streamed
+from host RAM / disk.
+
+Pass structure:
+- K-Means: one pass per Lloyd iteration (loop-body mode: half-score
+  assignment, no cost), one final pass at "highest" for cost/counts.
+- k-means|| init: 1 reservoir pass + 1 distance pass + init_steps sampling
+  passes + 1 ownership pass (the in-memory version's device state becomes a
+  host-resident per-chunk dmin, updated lazily one round behind — Bahmani's
+  oversampling is robust to the one-round-stale phi used for sampling).
+- PCA: one pass for the column sums (mean), one for the centered Gram —
+  the same two-pass mean-centered form as ops.pca_ops.covariance (the
+  one-pass raw-moment form cancels catastrophically; see that docstring).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oap_mllib_tpu.data.stream import ChunkSource
+from oap_mllib_tpu.ops import kmeans_ops
+from oap_mllib_tpu.ops.pca_ops import _cov_prec
+
+
+def _chunk_weights(n_valid: int, chunk_rows: int, dtype) -> np.ndarray:
+    w = np.zeros((chunk_rows,), dtype)
+    w[:n_valid] = 1.0
+    return w
+
+
+# ---------------------------------------------------------------------------
+# K-Means
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("precision", "need_cost"),
+    donate_argnums=(0, 1, 2),
+)
+def _kmeans_chunk_accum(sums, counts, cost, chunk, w, centers, precision, need_cost):
+    s, c, t = kmeans_ops._accumulate(chunk, w, centers, precision, need_cost)
+    return sums + s, counts + c, cost + t
+
+
+def streamed_accumulate(
+    source: ChunkSource, centers, dtype, precision: str, need_cost: bool
+):
+    """One full assignment pass: (sums (k,d), counts (k,), cost) on device."""
+    k, d = centers.shape
+    sums = jnp.zeros((k, d), dtype)
+    counts = jnp.zeros((k,), dtype)
+    cost = jnp.zeros((), dtype)
+    for chunk, n_valid in source:
+        cj = jnp.asarray(chunk.astype(dtype))
+        wj = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
+        sums, counts, cost = _kmeans_chunk_accum(
+            sums, counts, cost, cj, wj, centers, precision, need_cost
+        )
+    return sums, counts, cost
+
+
+@jax.jit
+def _center_update(centers, sums, counts):
+    safe = counts[:, None] > 0
+    new_centers = jnp.where(safe, sums / jnp.maximum(counts[:, None], 1e-30), centers)
+    moved_sq = jnp.sum((new_centers - centers) ** 2, axis=1)
+    return new_centers, jnp.max(moved_sq)
+
+
+def lloyd_run_streamed(
+    source: ChunkSource, init_centers: np.ndarray, max_iter: int, tol: float,
+    dtype, precision: str = "highest",
+):
+    """Streamed Lloyd loop; same return contract as kmeans_ops.lloyd_run:
+    (centers, n_iter, cost, counts).  Convergence semantics match
+    _lloyd_loop (every center's squared move <= tol^2, or max_iter); one
+    host sync per iteration (the converged flag) instead of zero — the
+    price of host-driven passes."""
+    centers = jnp.asarray(np.asarray(init_centers, dtype))
+    tol_sq = float(tol) ** 2
+    n_iter = 0
+    for _ in range(max_iter):
+        sums, counts, _ = streamed_accumulate(
+            source, centers, dtype, precision, need_cost=False
+        )
+        centers, max_moved = _center_update(centers, sums, counts)
+        n_iter += 1
+        if float(max_moved) <= tol_sq:
+            break
+    _, counts, cost = streamed_accumulate(
+        source, centers, dtype, "highest", need_cost=True
+    )
+    return centers, n_iter, cost, counts
+
+
+# ---------------------------------------------------------------------------
+# K-Means init
+# ---------------------------------------------------------------------------
+
+
+def reservoir_sample(source: ChunkSource, k: int, seed: int) -> np.ndarray:
+    """Uniform k-row sample in one pass (Algorithm R, vectorized per chunk:
+    one rng draw per chunk and a Python loop only over the expected
+    O(k log(n/k)) reservoir hits, never over all n rows)."""
+    rng = np.random.default_rng(seed)
+    sample: List[np.ndarray] = []
+    seen = 0
+    for chunk, n_valid in source:
+        start = 0
+        if len(sample) < k:  # head-fill straight into the reservoir
+            take = min(k - len(sample), n_valid)
+            sample.extend(chunk[i].copy() for i in range(take))
+            start = take
+        if start < n_valid:
+            # row at global index g replaces slot j ~ U[0, g] iff j < k
+            highs = np.arange(seen + start + 1, seen + n_valid + 1)
+            j = rng.integers(0, highs)  # vectorized per-row draws
+            for i in np.nonzero(j < k)[0]:  # sparse hits only
+                sample[j[i]] = chunk[start + i].copy()
+        seen += n_valid
+    if not sample:
+        raise ValueError("empty source")
+    while len(sample) < k:  # fewer rows than clusters: duplicate
+        sample.append(sample[len(sample) % max(1, seen)])
+    return np.stack(sample)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def _chunk_min_d2(chunk, dmin, cands, precision="highest"):
+    """Fold candidate distances into the chunk's running min."""
+    d2 = kmeans_ops.pairwise_sq_dists(chunk, cands, precision)
+    return jnp.minimum(dmin, jnp.min(d2, axis=1))
+
+
+@jax.jit
+def _chunk_ownership(chunk, w, cands):
+    """(n_cand,) row weight owned by each candidate (segment-sum)."""
+    d2 = kmeans_ops.pairwise_sq_dists(chunk, cands)
+    owner = jnp.argmin(d2, axis=1)
+    return jnp.zeros((cands.shape[0],), w.dtype).at[owner].add(w)
+
+
+def _pad_cands(cands: np.ndarray, cap: int, d: int) -> np.ndarray:
+    """Pad candidate blocks to a static cap with far-away dummies (1e15)
+    so per-round shapes stay constant and the fold compiles once."""
+    out = np.full((cap, d), 1e15, np.float64)
+    if len(cands):
+        out[: len(cands)] = cands
+    return out
+
+
+def init_kmeans_parallel_streamed(
+    source: ChunkSource, k: int, seed: int, init_steps: int, dtype,
+) -> np.ndarray:
+    """Streamed k-means|| (Bahmani), host-orchestrated.
+
+    Differences vs the in-memory device version (kmeans_ops
+    .init_kmeans_parallel): the per-row min-distance state lives on host
+    (one f32 per row — 400 MB at 100M rows, far under host RAM), and each
+    sampling round uses the cost total from the previous pass (one-round
+    -stale phi; the l=2k oversampling absorbs the drift — parity tests
+    compare converged cost, not centers, survey §7.3)."""
+    rng = np.random.default_rng(seed)
+    d = source.n_features
+    l = 2.0 * k
+    cap = 4 * k  # per-round candidate block (2x expected picks)
+
+    c0 = reservoir_sample(source, 1, seed)
+    cands = [c0[0]]
+    new_block: np.ndarray = _pad_cands(c0, cap, d)  # picks awaiting dmin fold
+
+    # One pass per round: fold the PREVIOUS round's picks into dmin while
+    # sampling this round's with the previous pass's phi (the one-round
+    # -stale phi of the docstring).  Round 0 is the distance-init pass —
+    # it folds c0 and records phi without sampling.
+    dmin_chunks: List[np.ndarray] = []
+    phi = 0.0
+    for rnd in range(init_steps + 1):
+        sampling = rnd > 0
+        if sampling and phi <= 0.0:
+            break
+        cands_dev = (
+            jnp.asarray(new_block.astype(dtype)) if len(new_block) else None
+        )
+        picks: List[np.ndarray] = []
+        new_phi = 0.0
+        for ci, (chunk, n_valid) in enumerate(source):
+            if cands_dev is not None:
+                prev = (
+                    jnp.asarray(dmin_chunks[ci])
+                    if rnd > 0
+                    else jnp.full((source.chunk_rows,), np.inf, dtype)
+                )
+                h = np.array(  # writable host copy
+                    _chunk_min_d2(jnp.asarray(chunk.astype(dtype)), prev, cands_dev)
+                )
+                h[n_valid:] = 0.0  # padded rows carry no cost
+                if rnd > 0:
+                    dmin_chunks[ci] = h
+                else:
+                    dmin_chunks.append(h)
+            else:
+                h = dmin_chunks[ci]
+            new_phi += float(h.sum())
+            if sampling:
+                prob = np.minimum(l * h / max(phi, 1e-300), 1.0)
+                hit = rng.random(source.chunk_rows) < prob
+                hit[n_valid:] = False
+                for i in np.nonzero(hit)[0]:
+                    picks.append(chunk[i].copy())
+        phi = new_phi
+        cands.extend(picks)
+        new_block = (
+            _pad_cands(
+                np.stack(picks), cap * ((len(picks) + cap - 1) // cap), d
+            )
+            if picks
+            else np.zeros((0, d))
+        )
+
+    cand_arr = np.stack(cands)
+    if cand_arr.shape[0] <= k:
+        extra = reservoir_sample(source, k - cand_arr.shape[0] + 1, seed + 1)
+        return np.concatenate([cand_arr, extra], axis=0)[:k]
+
+    # ownership pass: weight candidates, then host-side weighted k-means++
+    cands_dev = jnp.asarray(cand_arr.astype(dtype))
+    weights = np.zeros((cand_arr.shape[0],), np.float64)
+    for chunk, n_valid in source:
+        w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
+        weights += np.asarray(
+            _chunk_ownership(jnp.asarray(chunk.astype(dtype)), w, cands_dev)
+        )
+    return kmeans_ops._weighted_kmeans_pp(cand_arr, weights, k, rng)
+
+
+# ---------------------------------------------------------------------------
+# PCA
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _colsum_chunk(total, chunk, w):
+    return total + jnp.sum(chunk * w[:, None], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",), donate_argnums=(0,))
+def _gram_chunk(gram, chunk, w, mean, precision):
+    xc = (chunk - mean[None, :]) * w[:, None]
+    return gram + jnp.matmul(xc.T, xc, precision=_cov_prec(precision))
+
+
+def covariance_streamed(
+    source: ChunkSource, dtype, precision: str = "highest"
+) -> Tuple[jax.Array, jax.Array, int]:
+    """Two-pass streamed covariance: (cov (d,d), mean (d,), n_rows).
+
+    Pass 1 accumulates column sums (mean), pass 2 the mean-centered Gram —
+    identical numerics to ops.pca_ops.covariance, O(chunk) device memory.
+    """
+    d = source.n_features
+    total = jnp.zeros((d,), dtype)
+    n = 0
+    for chunk, n_valid in source:
+        w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
+        total = _colsum_chunk(total, jnp.asarray(chunk.astype(dtype)), w)
+        n += n_valid
+    if n < 1:
+        raise ValueError("empty source")
+    mean = total / n
+    gram = jnp.zeros((d, d), dtype)
+    for chunk, n_valid in source:
+        w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
+        gram = _gram_chunk(
+            gram, jnp.asarray(chunk.astype(dtype)), w, mean, precision
+        )
+    cov = gram / max(n - 1.0, 1.0)
+    cov = 0.5 * (cov + cov.T)
+    return cov, mean, n

@@ -1,0 +1,219 @@
+"""Out-of-core streaming tests: ChunkSource + streamed K-Means / PCA.
+
+The streamed paths must match the in-memory accelerated paths (same math,
+different pass structure) — ops-level parity is exact-ish (same init),
+estimator-level parity is blob-recovery/cost-based because the streamed
+init RNG (reservoir) legitimately differs from the in-memory one
+(survey §7.3: RNG-sensitive init is compared by cost, not centers).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu import KMeans, PCA
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.data.stream import ChunkSource
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DATA = os.path.join(HERE, "..", "examples", "data")
+
+
+def _reconstruct(source):
+    return source.to_array()
+
+
+class TestChunkSource:
+    def test_from_array_round_trip(self, rng):
+        x = rng.normal(size=(1000, 7))
+        src = ChunkSource.from_array(x, chunk_rows=128)
+        got = _reconstruct(src)
+        np.testing.assert_allclose(got, x)
+        assert src.n_rows == 1000
+        # every chunk has the static shape; the last one is padded
+        shapes = [(c.shape, v) for c, v in src]
+        assert all(s == (128, 7) for s, _ in shapes)
+        assert shapes[-1][1] == 1000 - 7 * 128
+
+    def test_reiterable(self, rng):
+        x = rng.normal(size=(300, 3))
+        src = ChunkSource.from_array(x, chunk_rows=100)
+        a = _reconstruct(src)
+        b = _reconstruct(src)
+        np.testing.assert_allclose(a, b)
+
+    def test_chunk_bigger_than_data(self, rng):
+        x = rng.normal(size=(10, 4))
+        src = ChunkSource.from_array(x, chunk_rows=64)
+        chunks = list(src)
+        assert len(chunks) == 1
+        assert chunks[0][0].shape == (64, 4)
+        assert chunks[0][1] == 10
+
+    def test_csv_matches_eager_reader(self):
+        from oap_mllib_tpu.data.io import read_csv
+
+        path = os.path.join(DATA, "pca_data.csv")
+        eager = read_csv(path)
+        src = ChunkSource.from_csv(path, chunk_rows=7)
+        np.testing.assert_allclose(_reconstruct(src), eager)
+        assert src.n_rows == eager.shape[0]
+
+    def test_libsvm_matches_eager_reader(self):
+        from oap_mllib_tpu.data.io import read_libsvm
+
+        path = os.path.join(DATA, "sample_kmeans_data.txt")
+        _, eager = read_libsvm(path)
+        src = ChunkSource.from_libsvm(path, eager.shape[1], chunk_rows=5)
+        np.testing.assert_allclose(_reconstruct(src), eager)
+
+    def test_width_mismatch_raises(self, rng):
+        src = ChunkSource(lambda: iter([np.zeros((4, 3))]), n_features=5)
+        with pytest.raises(ValueError, match="width"):
+            list(src)
+
+    def test_nondeterministic_source_raises(self):
+        counts = iter([10, 9])
+
+        def gen():
+            yield np.zeros((next(counts), 2))
+
+        src = ChunkSource(gen, n_features=2, chunk_rows=8)
+        list(src)
+        with pytest.raises(ValueError, match="deterministic"):
+            list(src)
+
+
+class TestStreamedOps:
+    def test_lloyd_streamed_matches_in_memory(self, rng):
+        """Same init, same data: streamed Lloyd == one-shot Lloyd."""
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.ops import kmeans_ops, stream_ops
+
+        x = rng.normal(size=(999, 12)).astype(np.float32)
+        init = x[rng.choice(999, 5, replace=False)]
+        c1, i1, t1, n1 = kmeans_ops.lloyd_run(
+            jnp.asarray(x), jnp.ones((999,), jnp.float32), jnp.asarray(init),
+            15, jnp.asarray(1e-6, jnp.float32),
+        )
+        src = ChunkSource.from_array(x, chunk_rows=256)
+        c2, i2, t2, n2 = stream_ops.lloyd_run_streamed(
+            src, init, 15, 1e-6, np.float32
+        )
+        assert int(i1) == int(i2)
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), atol=1e-4)
+        np.testing.assert_allclose(float(t1), float(t2), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(n1), np.asarray(n2), atol=1e-5)
+
+    def test_covariance_streamed_matches_in_memory(self, rng):
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.ops import pca_ops, stream_ops
+
+        x = rng.normal(size=(500, 9)).astype(np.float32) + 3.0
+        cov1, mean1 = pca_ops.covariance(
+            jnp.asarray(x), jnp.ones((500,), jnp.float32),
+            jnp.asarray(500.0, jnp.float32),
+        )
+        src = ChunkSource.from_array(x, chunk_rows=128)
+        cov2, mean2, n = stream_ops.covariance_streamed(src, np.float32)
+        assert n == 500
+        np.testing.assert_allclose(np.asarray(mean1), np.asarray(mean2), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cov1), np.asarray(cov2), atol=1e-4)
+
+    def test_reservoir_sample_uniformish(self, rng):
+        from oap_mllib_tpu.ops import stream_ops
+
+        x = np.arange(200, dtype=np.float64)[:, None]
+        src = ChunkSource.from_array(x, chunk_rows=64)
+        picks = stream_ops.reservoir_sample(src, 50, seed=7)
+        assert picks.shape == (50, 1)
+        assert len(np.unique(picks)) == 50  # sampled without replacement
+        assert picks.min() >= 0 and picks.max() < 200
+        # both halves represented: a biased sampler that only keeps the
+        # head or tail fails this
+        assert (picks < 100).any() and (picks >= 100).any()
+
+
+class TestStreamedEstimators:
+    def test_kmeans_streamed_recovers_blobs(self, rng):
+        k, d = 4, 6
+        protos = rng.normal(size=(k, d)) * 8.0
+        x = (protos[rng.integers(k, size=2000)]
+             + rng.normal(size=(2000, d)) * 0.05).astype(np.float32)
+        src = ChunkSource.from_array(x, chunk_rows=512)
+        m = KMeans(k=k, max_iter=30, seed=3).fit(src)
+        assert m.summary.accelerated
+        assert getattr(m.summary, "streamed", False)
+        # every blob center recovered
+        got = m.cluster_centers_
+        for p in protos:
+            assert np.min(np.linalg.norm(got - p, axis=1)) < 0.5
+        # cost comparable to the in-memory fit (RNG-sensitive init: compare
+        # cost, not centers — survey §7.3)
+        m2 = KMeans(k=k, max_iter=30, seed=3).fit(x)
+        assert m.summary.training_cost <= m2.summary.training_cost * 1.5 + 1e-6
+
+    def test_kmeans_streamed_random_init(self, rng):
+        x = rng.normal(size=(700, 5)).astype(np.float32)
+        src = ChunkSource.from_array(x, chunk_rows=256)
+        m = KMeans(k=3, max_iter=10, seed=1, init_mode="random").fit(src)
+        assert m.summary.num_iter >= 1
+        assert m.cluster_centers_.shape == (3, 5)
+        assert np.isfinite(m.summary.training_cost)
+
+    def test_kmeans_streamed_rejects_weights(self, rng):
+        src = ChunkSource.from_array(rng.normal(size=(50, 3)))
+        with pytest.raises(ValueError, match="sample_weight"):
+            KMeans(k=2).fit(src, sample_weight=np.ones(50))
+
+    def test_kmeans_streamed_fallback_materializes(self, rng):
+        set_config(device="cpu")
+        x = rng.normal(size=(200, 4))
+        src = ChunkSource.from_array(x, chunk_rows=64)
+        m = KMeans(k=2, seed=0).fit(src)
+        assert not m.summary.accelerated
+        m2 = KMeans(k=2, seed=0).fit(x)
+        np.testing.assert_allclose(
+            m.summary.training_cost, m2.summary.training_cost, rtol=1e-6
+        )
+
+    def test_pca_streamed_matches_in_memory(self, rng):
+        x = (rng.normal(size=(800, 10)) * rng.gamma(2.0, size=10)
+             + 5.0).astype(np.float32)
+        src = ChunkSource.from_array(x, chunk_rows=256)
+        m1 = PCA(k=4).fit(src)
+        m2 = PCA(k=4).fit(x)
+        assert m1.summary["streamed"] and m1.summary["n_rows"] == 800
+        # sign-insensitive component compare (reference
+        # IntelPCASuite.scala:80-86 pattern)
+        np.testing.assert_allclose(
+            np.abs(m1.components_), np.abs(m2.components_), atol=1e-4
+        )
+        np.testing.assert_allclose(
+            m1.explained_variance_, m2.explained_variance_, atol=1e-5
+        )
+
+    def test_pca_streamed_fallback_materializes(self, rng):
+        set_config(device="cpu")
+        x = rng.normal(size=(300, 6))
+        src = ChunkSource.from_array(x, chunk_rows=100)
+        m = PCA(k=2).fit(src)
+        assert not m.summary["accelerated"]
+        m2 = PCA(k=2).fit(x)
+        np.testing.assert_allclose(
+            np.abs(m.components_), np.abs(m2.components_), atol=1e-8
+        )
+
+    def test_pca_streamed_from_csv(self):
+        path = os.path.join(DATA, "pca_data.csv")
+        src = ChunkSource.from_csv(path, chunk_rows=8)
+        m = PCA(k=3).fit(src)
+        from oap_mllib_tpu.data.io import read_csv
+
+        m2 = PCA(k=3).fit(read_csv(path))
+        np.testing.assert_allclose(
+            np.abs(m.components_), np.abs(m2.components_), atol=1e-4
+        )
