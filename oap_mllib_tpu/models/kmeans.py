@@ -78,8 +78,17 @@ class KMeansModel:
             budget=self._PREDICT_BUDGET,
         )
 
-    def predict(self, x: np.ndarray) -> np.ndarray:
-        """Nearest-center assignment (the shim's transform/predict surface)."""
+    def predict(self, x) -> np.ndarray:
+        """Nearest-center assignment (the shim's transform/predict surface).
+        Accepts a ChunkSource for out-of-core scoring (labels are O(n)
+        host memory; at most two compiled chunk shapes)."""
+        from oap_mllib_tpu.data.stream import ChunkSource
+
+        if isinstance(x, ChunkSource):
+            parts = [self.predict(c[:v]) for c, v in x]
+            if not parts:  # empty source: same contract as an empty array
+                return self.predict(np.zeros((0, x.n_features)))
+            return np.concatenate(parts)
         x = np.asarray(x, dtype=self.cluster_centers_.dtype)
         if self.distance_measure == "euclidean" and x.shape[0] >= 1:
             c = jnp.asarray(self.cluster_centers_)
@@ -99,7 +108,11 @@ class KMeansModel:
     def transform(self, x: np.ndarray) -> np.ndarray:
         return self.predict(x)
 
-    def compute_cost(self, x: np.ndarray) -> float:
+    def compute_cost(self, x) -> float:
+        from oap_mllib_tpu.data.stream import ChunkSource
+
+        if isinstance(x, ChunkSource):
+            return float(sum(self.compute_cost(c[:v]) for c, v in x))
         x = np.asarray(x, dtype=self.cluster_centers_.dtype)
         if self.distance_measure != "euclidean":
             from oap_mllib_tpu.fallback.kmeans_np import _sq_dists
@@ -272,6 +285,13 @@ class KMeans:
     def _fit_stream_inner(self, source, dtype, cfg) -> KMeansModel:
         from oap_mllib_tpu.ops import stream_ops
 
+        # kmeans_kernel validation must run on EVERY accelerated fit (the
+        # _run_lloyd invariant): a typo'd value raises here too, even
+        # though the streamed path always runs the chunked XLA programs
+        kmeans_ops.use_pallas_path(
+            cfg.kmeans_kernel, source.n_features, self.k,
+            cfg.matmul_precision, dtype,
+        )
         timings = Timings()
         with phase_timer(timings, "init_centers"):
             if self.init_mode == INIT_RANDOM:

@@ -42,8 +42,17 @@ class PCAModel:
     def k(self) -> int:
         return self.components_.shape[1]
 
-    def transform(self, x: np.ndarray) -> np.ndarray:
-        """Project into the PC basis (no centering — Spark parity)."""
+    def transform(self, x) -> np.ndarray:
+        """Project into the PC basis (no centering — Spark parity).
+        Accepts a ChunkSource for out-of-core scoring (the (n, k)
+        projection is the caller's host memory)."""
+        from oap_mllib_tpu.data.stream import ChunkSource
+
+        if isinstance(x, ChunkSource):
+            parts = [self.transform(c[:v]) for c, v in x]
+            if not parts:  # empty source: same contract as an empty array
+                return self.transform(np.zeros((0, x.n_features)))
+            return np.concatenate(parts)
         x = np.asarray(x, dtype=self.components_.dtype)
         return np.asarray(pca_ops.project(jnp.asarray(x), jnp.asarray(self.components_)))
 

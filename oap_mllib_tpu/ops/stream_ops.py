@@ -65,7 +65,7 @@ def streamed_accumulate(
     counts = jnp.zeros((k,), dtype)
     cost = jnp.zeros((), dtype)
     for chunk, n_valid in source:
-        cj = jnp.asarray(chunk.astype(dtype))
+        cj = jnp.asarray(np.asarray(chunk, dtype))
         wj = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
         sums, counts, cost = _kmeans_chunk_accum(
             sums, counts, cost, cj, wj, centers, precision, need_cost
@@ -206,7 +206,7 @@ def init_kmeans_parallel_streamed(
                     else jnp.full((source.chunk_rows,), np.inf, dtype)
                 )
                 h = np.array(  # writable host copy
-                    _chunk_min_d2(jnp.asarray(chunk.astype(dtype)), prev, cands_dev)
+                    _chunk_min_d2(jnp.asarray(np.asarray(chunk, dtype)), prev, cands_dev)
                 )
                 h[n_valid:] = 0.0  # padded rows carry no cost
                 if rnd > 0:
@@ -243,7 +243,7 @@ def init_kmeans_parallel_streamed(
     for chunk, n_valid in source:
         w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
         weights += np.asarray(
-            _chunk_ownership(jnp.asarray(chunk.astype(dtype)), w, cands_dev)
+            _chunk_ownership(jnp.asarray(np.asarray(chunk, dtype)), w, cands_dev)
         )
     return kmeans_ops._weighted_kmeans_pp(cand_arr, weights, k, rng)
 
@@ -277,7 +277,7 @@ def covariance_streamed(
     n = 0
     for chunk, n_valid in source:
         w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
-        total = _colsum_chunk(total, jnp.asarray(chunk.astype(dtype)), w)
+        total = _colsum_chunk(total, jnp.asarray(np.asarray(chunk, dtype)), w)
         n += n_valid
     if n < 1:
         raise ValueError("empty source")
@@ -286,7 +286,7 @@ def covariance_streamed(
     for chunk, n_valid in source:
         w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
         gram = _gram_chunk(
-            gram, jnp.asarray(chunk.astype(dtype)), w, mean, precision
+            gram, jnp.asarray(np.asarray(chunk, dtype)), w, mean, precision
         )
     cov = gram / max(n - 1.0, 1.0)
     cov = 0.5 * (cov + cov.T)

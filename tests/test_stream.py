@@ -207,6 +207,21 @@ class TestStreamedEstimators:
             np.abs(m.components_), np.abs(m2.components_), atol=1e-8
         )
 
+    def test_streamed_scoring(self, rng):
+        """predict/compute_cost/transform accept a ChunkSource and match
+        the in-memory scores."""
+        x = rng.normal(size=(500, 8)).astype(np.float32)
+        src = ChunkSource.from_array(x, chunk_rows=128)
+        km = KMeans(k=3, max_iter=10, seed=2).fit(x)
+        np.testing.assert_array_equal(km.predict(src), km.predict(x))
+        np.testing.assert_allclose(
+            km.compute_cost(src), km.compute_cost(x), rtol=1e-5
+        )
+        pm = PCA(k=2).fit(x)
+        np.testing.assert_allclose(
+            pm.transform(src), pm.transform(x), atol=1e-5
+        )
+
     def test_pca_streamed_from_csv(self):
         path = os.path.join(DATA, "pca_data.csv")
         src = ChunkSource.from_csv(path, chunk_rows=8)
