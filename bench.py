@@ -227,7 +227,11 @@ def bench_als():
     from oap_mllib_tpu.ops import als_ops
 
     n_users, n_items, nnz, rank = 6040, 3706, 1_000_000, 10
-    iters = 5
+    # 25-iteration window: ALS runs its whole loop in ONE jitted call (no
+    # early exit — lax.scan over max_iter), so like the K-Means bench the
+    # window must be long enough that the device tunnel's per-call
+    # dispatch latency (~75 ms) doesn't dominate the per-iteration figure
+    iters = 25
     rng = np.random.default_rng(2)
     users = rng.integers(n_users, size=nnz).astype(np.int32)
     items = rng.integers(n_items, size=nnz).astype(np.int32)
