@@ -232,15 +232,19 @@ def grouped_padded_edges(dst, n_dst: int, group_size: int = 0) -> int:
 
 def auto_group_size(nnz: int, n_dst: int) -> int:
     """Group size adapted to the mean degree so padding stays bounded:
-    with P <= mean degree, total padded edges <= nnz + n_dst*P <= 2*nnz.
+    the next power of two ABOVE the mean degree keeps total padded edges
+    <= nnz + n_dst*P < 3*nnz, and larger P is measurably faster — fewer
+    groups shrink the (G, r+1, r+2) segment-sum and deepen the per-group
+    (P)-contraction on the MXU (ML-1M on v5e: 13.7 ms/iter at P=64 vs
+    10.3 at P=256, BASELINE.md ALS table).  Capped at 256: P=512 loses
+    the padding it adds (14.1 ms/iter), P=1024 doubles the iteration.
     Long-tail distributions (millions of destinations with ~2 ratings
-    each) would blow up 30x+ at a fixed P=64; tiny P only costs MXU
-    efficiency on the (P)-contraction, which the caller's COO fallback
-    guard handles anyway."""
+    each) still get small P; the caller's COO fallback guard handles the
+    blowup cases anyway."""
     import numpy as np
 
     mean_deg = max(1.0, nnz / max(1, n_dst))
-    return int(max(8, min(64, 2 ** int(np.log2(mean_deg)))))
+    return int(max(8, min(256, 2 ** int(np.ceil(np.log2(mean_deg))))))
 
 
 def build_grouped_edges(
