@@ -291,6 +291,30 @@ def build_grouped_edges(
     )
 
 
+# live-element budget for one grouped-partials block: the (r+..., Gc, P)
+# intermediates of a block stay near 256 MB f32 so ML-25M-scale sides
+# (40M+ padded edges) fit one chip — unchunked, XLA materialized a
+# (padded_nnz, r) gather fusion whose (8,128) lane padding alone was
+# 21 GB (measured OOM at 25M nnz, round 3)
+_GROUPED_BUDGET_ELEMS = 1 << 26
+
+
+def _grouped_block_count(G: int, P: int, r: int) -> int:
+    """Smallest power-of-two block count keeping a block under budget.
+
+    The per-block cost model charges XLA's (8, 128) lane padding — a
+    (…, Gb, P) buffer with P < 128 still occupies 128 lanes — and the ~3
+    concurrently-live (r+2)-deep intermediates (ys / lhs / rhs), so the
+    bound holds for small-P long-tail sides too, not just the aligned
+    P=128/256 layouts it was measured on.  Stops subdividing at one
+    group per block (a budget below a single padded row cannot hang)."""
+    lanes = max(P, 128)
+    n = 1
+    while n < G and (-(-G // n)) * lanes * (r + 2) * 3 > _GROUPED_BUDGET_ELEMS:
+        n *= 2
+    return n
+
+
 def normal_eq_partials_grouped(
     src_g: jax.Array,  # (G, P) int32
     conf_g: jax.Array,  # (G, P) f32
@@ -311,31 +335,77 @@ def normal_eq_partials_grouped(
     partials, round 3).  Hence the gather runs against the TRANSPOSED
     factor table and the batched matmul contracts the lane axis.
 
+    Sides whose (r, G, P) intermediates exceed ``_GROUPED_BUDGET_ELEMS``
+    are processed as a ``lax.scan`` over group blocks, accumulating the
+    per-destination moments in a flat (n_dst, (r+1)*(r+2)) carry (flat so
+    the carry pads to lane tiles once, not per (r+1, r+2) matrix).
+
     Returns (a_part (n_dst, r, r), b (n_dst, r), n_reg (n_dst,)).
     """
     r = src_factors.shape[1]
-    ys = src_factors.T[:, src_g]  # (r, G, P) transposed gather
-    if implicit:
-        a_w = alpha * jnp.abs(conf_g) * valid_g
-        pos = (conf_g > 0).astype(conf_g.dtype) * valid_g
-        b_w = (1.0 + alpha * jnp.abs(conf_g)) * pos
-        n_w = pos
-    else:
-        a_w = valid_g
-        b_w = conf_g * valid_g
-        n_w = valid_g
-    lhs = jnp.concatenate(
-        [ys, jnp.ones_like(conf_g)[None]], axis=0
-    )  # (r+1, G, P)
-    rhs = jnp.concatenate(
-        [ys * a_w[None], b_w[None], n_w[None]], axis=0
-    )  # (r+2, G, P)
-    m = jnp.einsum(
-        "agp,bgp->gab", lhs, rhs, precision=lax.Precision.HIGHEST
-    )  # (G, r+1, r+2)  <- batched MXU, P-lane contraction
-    M = jax.ops.segment_sum(
-        m, group_dst, num_segments=n_dst, indices_are_sorted=True
+    G, P = src_g.shape
+
+    def block_moments(src_b, conf_b, valid_b):
+        """(Gb, r+1, r+2) moment matrices for one group block."""
+        ys = src_factors.T[:, src_b]  # (r, Gb, P) transposed gather
+        if implicit:
+            a_w = alpha * jnp.abs(conf_b) * valid_b
+            pos = (conf_b > 0).astype(conf_b.dtype) * valid_b
+            b_w = (1.0 + alpha * jnp.abs(conf_b)) * pos
+            n_w = pos
+        else:
+            a_w = valid_b
+            b_w = conf_b * valid_b
+            n_w = valid_b
+        lhs = jnp.concatenate(
+            [ys, jnp.ones_like(conf_b)[None]], axis=0
+        )  # (r+1, Gb, P)
+        rhs = jnp.concatenate(
+            [ys * a_w[None], b_w[None], n_w[None]], axis=0
+        )  # (r+2, Gb, P)
+        return jnp.einsum(
+            "agp,bgp->gab", lhs, rhs, precision=lax.Precision.HIGHEST
+        )  # (Gb, r+1, r+2)  <- batched MXU, P-lane contraction
+
+    blocks = _grouped_block_count(G, P, r)
+    if blocks == 1:
+        M = jax.ops.segment_sum(
+            block_moments(src_g, conf_g, valid_g),
+            group_dst, num_segments=n_dst, indices_are_sorted=True,
+        )
+        return M[:, :r, :r], M[:, :r, r], M[:, r, r + 1]
+
+    gb = -(-G // blocks)
+    pad = blocks * gb - G
+    # dummy groups: valid=0 rows contribute exact zeros to dst n_dst-1
+    src_p = jnp.pad(src_g, ((0, pad), (0, 0)))
+    conf_p = jnp.pad(conf_g, ((0, pad), (0, 0)))
+    valid_p = jnp.pad(valid_g, ((0, pad), (0, 0)))
+    gd_p = jnp.pad(group_dst, (0, pad), constant_values=n_dst - 1)
+    width = (r + 1) * (r + 2)
+
+    def step(M_flat, blk):
+        src_b, conf_b, valid_b, gd_b = blk
+        m = block_moments(src_b, conf_b, valid_b).reshape(gb, width)
+        return (
+            M_flat
+            + jax.ops.segment_sum(
+                m, gd_b, num_segments=n_dst, indices_are_sorted=True
+            ),
+            None,
+        )
+
+    M_flat, _ = lax.scan(
+        step,
+        jnp.zeros((n_dst, width), src_factors.dtype),
+        (
+            src_p.reshape(blocks, gb, P),
+            conf_p.reshape(blocks, gb, P),
+            valid_p.reshape(blocks, gb, P),
+            gd_p.reshape(blocks, gb),
+        ),
     )
+    M = M_flat.reshape(n_dst, r + 1, r + 2)
     return M[:, :r, :r], M[:, :r, r], M[:, r, r + 1]
 
 
