@@ -28,7 +28,7 @@ def main():
     p.add_argument("--timing", action="store_true")
     args = p.parse_args()
 
-    from oap_mllib_tpu.compat.spark import ALS
+    from oap_mllib_tpu.compat.spark import ALS, RegressionEvaluator
     from oap_mllib_tpu.config import set_config
 
     if args.device:
@@ -67,15 +67,18 @@ def main():
     )
     model = als.fit(training)
 
-    # RegressionEvaluator(metricName="rmse"): implicit ALS predicts a
-    # preference/confidence score, so like the reference example this is a
-    # smoke metric, not a ratings-scale fit
+    # RegressionEvaluator(metricName="rmse", labelCol="rating",
+    # predictionCol="prediction") — reference als-pyspark.py:62; implicit
+    # ALS predicts a preference/confidence score, so like the reference
+    # example this is a smoke metric, not a ratings-scale fit
     predictions = model.transform(test)
     dropped = len(test["rating"]) - len(predictions["rating"])
     if dropped:
         print(f"coldStartStrategy=drop removed {dropped} cold test rows")
-    err = predictions["prediction"] - predictions["rating"]
-    rmse = float(np.sqrt(np.mean(err**2))) if len(err) else float("nan")
+    evaluator = RegressionEvaluator(
+        metricName="rmse", labelCol="rating", predictionCol="prediction"
+    )
+    rmse = evaluator.evaluate(predictions)
     print("Root-mean-square error = " + str(rmse))
 
 

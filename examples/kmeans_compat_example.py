@@ -1,9 +1,9 @@
 #!/usr/bin/env python
 """K-Means via the Spark-ML compat surface — the reference's PySpark twin
 (examples/kmeans-pyspark/kmeans-pyspark.py:47-67): load libsvm data, fit
-KMeans().setK(2).setSeed(1), transform, score the clustering with the
-squared-euclidean silhouette (Spark's ClusteringEvaluator default), and
-print the cluster centers.
+KMeans().setK(2).setSeed(1), transform, score the clustering with
+ClusteringEvaluator (squared-euclidean silhouette), and print the cluster
+centers.
 
 Where the reference builds a SparkSession DataFrame from libsvm, the
 compat surface takes a dict of numpy columns.
@@ -15,37 +15,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
-
 HERE = os.path.dirname(os.path.abspath(__file__))
-
-
-def silhouette_squared_euclidean(x: np.ndarray, labels: np.ndarray) -> float:
-    """Mean silhouette with squared-euclidean distance (ClusteringEvaluator's
-    default metric).  Per Spark's formulation the point-to-cluster distance
-    is the MEAN squared distance to the cluster's points, computable from
-    cluster means and second moments without pairwise distances."""
-    uniq = np.unique(labels)
-    if len(uniq) < 2:
-        return float("nan")
-    sq = np.einsum("ij,ij->i", x, x)
-    means = np.stack([x[labels == c].mean(axis=0) for c in uniq])
-    mean_sq = np.asarray([sq[labels == c].mean() for c in uniq])
-    counts = np.asarray([(labels == c).sum() for c in uniq])
-    # mean squared distance from point i to cluster c:
-    #   E||p - x_i||^2 = E||p||^2 - 2 x_i . mean_c + ||x_i||^2
-    d = mean_sq[None, :] - 2.0 * x @ means.T + sq[:, None]
-    own = np.searchsorted(uniq, labels)
-    n_own = counts[own]
-    scores = np.zeros(len(x))
-    valid = n_own > 1
-    # a(i): exclude the point itself from its own cluster's mean distance
-    a = d[np.arange(len(x)), own] * n_own / np.maximum(n_own - 1, 1)
-    d_other = d.copy()
-    d_other[np.arange(len(x)), own] = np.inf
-    b = d_other.min(axis=1)
-    scores[valid] = ((b - a) / np.maximum(a, b))[valid]
-    return float(scores.mean())
 
 
 def main():
@@ -57,7 +27,7 @@ def main():
     p.add_argument("--timing", action="store_true")
     args = p.parse_args()
 
-    from oap_mllib_tpu.compat.spark import KMeans
+    from oap_mllib_tpu.compat.spark import ClusteringEvaluator, KMeans
     from oap_mllib_tpu.config import set_config
     from oap_mllib_tpu.data.io import read_libsvm
 
@@ -77,8 +47,9 @@ def main():
     # predictions = model.transform(dataset)
     predictions = model.transform(dataset)
 
-    # ClusteringEvaluator().evaluate(predictions)
-    silhouette = silhouette_squared_euclidean(x, predictions["prediction"])
+    # evaluator = ClusteringEvaluator(); silhouette = evaluator.evaluate(...)
+    evaluator = ClusteringEvaluator()
+    silhouette = evaluator.evaluate(predictions)
     print("Silhouette with squared euclidean distance = " + str(silhouette))
 
     print("Cluster Centers: ")

@@ -12,6 +12,12 @@ column values) — the dependency-free stand-in; ``fit`` also accepts a bare
 ndarray for the features-only case.
 """
 
-from oap_mllib_tpu.compat.spark import ALS, KMeans, PCA
+from oap_mllib_tpu.compat.spark import (
+    ALS,
+    ClusteringEvaluator,
+    KMeans,
+    PCA,
+    RegressionEvaluator,
+)
 
-__all__ = ["KMeans", "PCA", "ALS"]
+__all__ = ["KMeans", "PCA", "ALS", "ClusteringEvaluator", "RegressionEvaluator"]

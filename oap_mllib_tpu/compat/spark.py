@@ -383,3 +383,131 @@ class ALSModel:
     @classmethod
     def load(cls, path: str) -> "ALSModel":
         return cls(_als.ALSModel.load(path), "user", "item")
+
+
+class ClusteringEvaluator:
+    """Silhouette evaluator (Spark ml.evaluation.ClusteringEvaluator —
+    used by the reference K-Means examples, examples/kmeans-pyspark/
+    kmeans-pyspark.py:57).  Metrics: silhouette with squaredEuclidean
+    (default) or cosine distance, computed via Spark's closed form —
+    point-to-cluster distances from cluster aggregates, never an (n, n)
+    pairwise matrix; rows stream in chunks so the live (chunk, k) block
+    is bounded."""
+
+    _CHUNK = 1 << 16
+
+    def __init__(self):
+        self._metricName = "silhouette"
+        self._distanceMeasure = "squaredEuclidean"
+        self._featuresCol = "features"
+        self._predictionCol = "prediction"
+
+    def setMetricName(self, v):       self._metricName = v; return self
+    def setDistanceMeasure(self, v):  self._distanceMeasure = v; return self
+    def setFeaturesCol(self, v):      self._featuresCol = v; return self
+    def setPredictionCol(self, v):    self._predictionCol = v; return self
+
+    def getMetricName(self):       return self._metricName
+    def getDistanceMeasure(self):  return self._distanceMeasure
+    def getFeaturesCol(self):      return self._featuresCol
+    def getPredictionCol(self):    return self._predictionCol
+
+    def isLargerBetter(self) -> bool:
+        return True
+
+    def evaluate(self, dataset: DataFrame) -> float:
+        if self._metricName != "silhouette":
+            raise ValueError(f"unknown metric {self._metricName!r}")
+        if self._distanceMeasure not in ("squaredEuclidean", "cosine"):
+            raise ValueError(
+                f"distanceMeasure must be squaredEuclidean or cosine, "
+                f"got {self._distanceMeasure!r}"
+            )
+        x = np.asarray(_features_from(dataset, self._featuresCol), np.float64)
+        labels = np.asarray(dataset[self._predictionCol])
+        uniq = np.unique(labels)
+        if len(uniq) < 2:
+            raise ValueError("silhouette needs at least 2 clusters")
+        own = np.searchsorted(uniq, labels)
+        counts = np.bincount(own, minlength=len(uniq)).astype(np.float64)
+        if self._distanceMeasure == "cosine":
+            # cosine distance = 1 - a^.b^; mean distance to a cluster is
+            # 1 - a^ . mean(normalized members)  (Spark CosineSilhouette)
+            x = x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), 1e-300)
+        sums = np.zeros((len(uniq), x.shape[1]))
+        np.add.at(sums, own, x)
+        means = sums / counts[:, None]
+        if self._distanceMeasure == "squaredEuclidean":
+            sq = np.einsum("ij,ij->i", x, x)
+            mean_sq = np.zeros(len(uniq))
+            np.add.at(mean_sq, own, sq)
+            mean_sq /= counts
+        total = 0.0
+        n = len(x)
+        for lo in range(0, n, self._CHUNK):
+            xi = x[lo : lo + self._CHUNK]
+            oi = own[lo : lo + self._CHUNK]
+            if self._distanceMeasure == "squaredEuclidean":
+                # E||p - x||^2 = E||p||^2 - 2 x.mean_c + ||x||^2
+                d = (
+                    mean_sq[None, :]
+                    - 2.0 * xi @ means.T
+                    + sq[lo : lo + self._CHUNK, None]
+                )
+            else:
+                d = 1.0 - xi @ means.T
+            rows = np.arange(len(xi))
+            n_own = counts[oi]
+            # a(i): exclude the point itself (distance 0) from its own
+            # cluster's mean
+            a = d[rows, oi] * n_own / np.maximum(n_own - 1, 1)
+            d[rows, oi] = np.inf
+            b = d.min(axis=1)
+            s = np.where(n_own > 1, (b - a) / np.maximum(a, b), 0.0)
+            total += float(s.sum())
+        return total / n
+
+
+class RegressionEvaluator:
+    """Regression metrics (Spark ml.evaluation.RegressionEvaluator —
+    used by the reference ALS examples, examples/als-pyspark/
+    als-pyspark.py:62).  Metrics: rmse (default), mse, mae, r2, var.
+    NaN predictions (coldStartStrategy="nan") must be dropped by the
+    caller or via coldStartStrategy="drop", as in Spark."""
+
+    def __init__(self, metricName: str = "rmse", labelCol: str = "label",
+                 predictionCol: str = "prediction"):
+        self._metricName = metricName
+        self._labelCol = labelCol
+        self._predictionCol = predictionCol
+
+    def setMetricName(self, v):     self._metricName = v; return self
+    def setLabelCol(self, v):       self._labelCol = v; return self
+    def setPredictionCol(self, v):  self._predictionCol = v; return self
+
+    def getMetricName(self):     return self._metricName
+    def getLabelCol(self):       return self._labelCol
+    def getPredictionCol(self):  return self._predictionCol
+
+    def isLargerBetter(self) -> bool:
+        return self._metricName in ("r2", "var")
+
+    def evaluate(self, dataset: DataFrame) -> float:
+        label = np.asarray(dataset[self._labelCol], np.float64)
+        pred = np.asarray(dataset[self._predictionCol], np.float64)
+        if len(label) == 0:
+            return float("nan")
+        err = pred - label
+        if self._metricName == "rmse":
+            return float(np.sqrt(np.mean(err ** 2)))
+        if self._metricName == "mse":
+            return float(np.mean(err ** 2))
+        if self._metricName == "mae":
+            return float(np.mean(np.abs(err)))
+        if self._metricName == "r2":
+            ss_res = float(np.sum(err ** 2))
+            ss_tot = float(np.sum((label - label.mean()) ** 2))
+            return 1.0 - ss_res / ss_tot if ss_tot > 0 else float("nan")
+        if self._metricName == "var":
+            return float(np.var(pred))
+        raise ValueError(f"unknown metric {self._metricName!r}")

@@ -274,3 +274,77 @@ class TestReviewRegressions:
         r = np.array([1.0, 2.0], np.float32)
         m = CoreALS(rank=3, max_iter=0, nonnegative=True).fit(u, i, r)
         assert (m.user_factors_ >= 0).all() and (m.item_factors_ >= 0).all()
+
+
+class TestEvaluators:
+    def _brute_silhouette(self, x, labels, dist):
+        n = len(x)
+        if dist == "cosine":
+            xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+            D = 1.0 - xn @ xn.T
+        else:
+            D = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        scores = []
+        for i in range(n):
+            own = labels == labels[i]
+            if own.sum() < 2:
+                scores.append(0.0)
+                continue
+            a = D[i][own].sum() / (own.sum() - 1)
+            b = min(
+                D[i][labels == c].mean()
+                for c in np.unique(labels) if c != labels[i]
+            )
+            scores.append((b - a) / max(a, b))
+        return float(np.mean(scores))
+
+    @pytest.mark.parametrize("dist", ["squaredEuclidean", "cosine"])
+    def test_clustering_evaluator_matches_brute_force(self, rng, dist):
+        from oap_mllib_tpu.compat import ClusteringEvaluator
+
+        x = rng.normal(size=(80, 5)) + 2.0
+        labels = rng.integers(0, 3, 80)
+        df = {"features": x, "prediction": labels}
+        ev = ClusteringEvaluator().setDistanceMeasure(dist)
+        got = ev.evaluate(df)
+        np.testing.assert_allclose(got, self._brute_silhouette(x, labels, dist),
+                                   atol=1e-10)
+        assert ev.isLargerBetter()
+
+    def test_clustering_evaluator_end_to_end(self, rng):
+        from oap_mllib_tpu.compat import ClusteringEvaluator
+
+        proto = rng.normal(size=(3, 4)) * 6
+        x = proto[rng.integers(3, size=300)] + 0.05 * rng.normal(size=(300, 4))
+        model = KMeans().setK(3).setSeed(1).fit({"features": x})
+        sil = ClusteringEvaluator().evaluate(model.transform({"features": x}))
+        assert sil > 0.95  # tight, well-separated blobs
+
+    def test_clustering_evaluator_validation(self):
+        from oap_mllib_tpu.compat import ClusteringEvaluator
+
+        df = {"features": np.zeros((4, 2)), "prediction": np.zeros(4, int)}
+        with pytest.raises(ValueError, match="2 clusters"):
+            ClusteringEvaluator().evaluate(df)
+        with pytest.raises(ValueError, match="distanceMeasure"):
+            ClusteringEvaluator().setDistanceMeasure("manhattan").evaluate(df)
+
+    def test_regression_evaluator_metrics(self, rng):
+        from oap_mllib_tpu.compat import RegressionEvaluator
+
+        label = rng.normal(size=50)
+        pred = label + rng.normal(size=50) * 0.1
+        df = {"rating": label, "prediction": pred}
+        err = pred - label
+        ev = RegressionEvaluator(labelCol="rating")
+        np.testing.assert_allclose(
+            ev.evaluate(df), np.sqrt(np.mean(err ** 2)))
+        np.testing.assert_allclose(
+            ev.setMetricName("mse").evaluate(df), np.mean(err ** 2))
+        np.testing.assert_allclose(
+            ev.setMetricName("mae").evaluate(df), np.mean(np.abs(err)))
+        r2 = 1 - np.sum(err ** 2) / np.sum((label - label.mean()) ** 2)
+        np.testing.assert_allclose(ev.setMetricName("r2").evaluate(df), r2)
+        assert ev.isLargerBetter()
+        with pytest.raises(ValueError):
+            ev.setMetricName("rmsle").evaluate(df)
