@@ -14,13 +14,14 @@ Precision tiers (``mode``) — Mosaic only lowers Precision.HIGHEST/DEFAULT,
 so split tiers are implemented by hand with bf16 hi/lo splits:
 
 - ``highest``: both matmuls f32 Precision.HIGHEST.  Parity default.
-- ``high`` / ``default``: distance cross-term single-pass bf16 (the tier
-  contract — kmeans_ops._assign_prec — runs the assignment matmul at bf16
-  for both: argmin is decision-only); cluster sums via an *exact-split*
-  trick: the unweighted one-hot is 0/1 — exactly representable in bf16 —
-  so ``one_hot.T @ (w*x)`` with (w*x) split into bf16 hi+lo needs only
-  TWO bf16 passes and is accurate to ~f32, meeting the XLA "high" tier's
-  error envelope (and exceeding XLA "default"'s).
+- ``high``: distance cross-term single-pass bf16 (the tier contract —
+  kmeans_ops._assign_prec — runs the assignment matmul at bf16: argmin is
+  decision-only); cluster sums via an *exact-split* trick: the unweighted
+  one-hot is 0/1 — exactly representable in bf16 — so ``one_hot.T @
+  (w*x)`` with (w*x) split into bf16 hi+lo needs only TWO bf16 passes
+  and is accurate to ~f32, meeting the XLA "high" tier's error envelope.
+- ``default``: bf16 assignment + SINGLE-pass bf16 sums — the XLA default
+  tier's ~1e-3 error envelope at its speed.
 
 Caller contract (see ``lloyd_accumulate_pallas``): rows padded to the block
 size with weight 0; k and d padded to lane multiples (128) by the wrapper —
@@ -80,11 +81,15 @@ def _cross_term(x, c, mode):
 
 def _cluster_sums(one_hot01, wx, mode):
     """one_hot.T @ (w*x) (k, d).  one_hot is exactly 0/1 in bf16, so the
-    split tiers lose nothing on it; wx is hi/lo-split for ~f32 accuracy."""
+    split tiers lose nothing on it; "high" hi/lo-splits wx for ~f32
+    accuracy (2 bf16 passes); "default" is single-pass all-bf16 — the
+    same error envelope as the XLA default tier (~1e-3)."""
     dn = (((0,), (0,)), ((), ()))
     if mode == "highest":
         return _dot_f32(one_hot01, wx, dn)
     oh = one_hot01.astype(jnp.bfloat16)  # exact
+    if mode == "default":
+        return _dot_bf16(oh, wx.astype(jnp.bfloat16), dn)
     wx_hi, wx_lo = _split_bf16(wx)
     return _dot_bf16(oh, wx_hi, dn) + _dot_bf16(oh, wx_lo, dn)
 

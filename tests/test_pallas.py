@@ -39,19 +39,21 @@ class TestFusedAccumulate:
         np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), atol=1e-4)
         np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), atol=1e-5)
 
-    @pytest.mark.parametrize("mode", ["high", "default"])
-    def test_fast_tiers_close(self, rng, mode):
-        """bf16 tiers: sums stay ~f32-exact via the hi/lo split (the one-hot
-        is exactly representable), distances may flip near-ties only."""
+    @pytest.mark.parametrize("mode,sums_atol", [("high", 5e-3), ("default", 2e-1)])
+    def test_fast_tiers_close(self, rng, mode, sums_atol):
+        """bf16 tiers: "high" sums stay ~f32-exact via the hi/lo split (the
+        one-hot is exactly representable); "default" is single-pass all
+        -bf16 — the XLA default tier's ~1e-3-relative envelope.  Distances
+        may flip near-ties only."""
         n, d, k = 640, 24, 9
         x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
         w = jnp.asarray((rng.random(n) + 0.5).astype(np.float32))
         c = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32))
         s1, c1, t1 = _accumulate(x, w, c)
         s2, c2, t2 = lloyd_accumulate_pallas(x, w, c, mode=mode, interpret=True)
-        # well-separated random clusters: assignments identical, sums ~exact
+        # well-separated random clusters: assignments identical
         np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), atol=1e-3)
-        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), atol=5e-3)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), atol=sums_atol)
         np.testing.assert_allclose(float(t1), float(t2), rtol=1e-3)
 
     def test_bad_mode_raises(self, rng):

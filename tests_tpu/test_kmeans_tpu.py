@@ -44,9 +44,11 @@ class TestPallasCompiled:
         np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), atol=1e-3)
         np.testing.assert_allclose(float(t1), float(t2), rtol=1e-3)
 
-    @pytest.mark.parametrize("mode", ["high", "default"])
-    def test_fast_tiers_compiled_within_parity(self, rng, mode):
-        """Fast tiers on blob-like data: centers within the 1e-4 bar."""
+    @pytest.mark.parametrize("mode,bound", [("high", 1e-4), ("default", 5e-3)])
+    def test_fast_tiers_compiled_within_parity(self, rng, mode, bound):
+        """Fast tiers on blob-like data: "high" centers within the 1e-4
+        parity bar; "default" (single-pass all-bf16 sums) within the XLA
+        default tier's ~1e-3-relative envelope."""
         n, d, k = 16384, 64, 32
         proto = rng.normal(size=(k, d)).astype(np.float32)
         x = proto[rng.integers(k, size=n)] + 0.1 * rng.normal(size=(n, d)).astype(
@@ -59,8 +61,8 @@ class TestPallasCompiled:
         c1, _, t1, _ = lloyd_run(xj, wj, cj, 5, tol)
         c2, _, t2, _ = lloyd_run_pallas(xj, wj, cj, 5, tol, mode=mode)
         scale = float(jnp.max(jnp.abs(c1)))
-        assert float(jnp.max(jnp.abs(c1 - c2))) / scale < 1e-4
-        assert abs(float(t1) - float(t2)) / float(t1) < 1e-4
+        assert float(jnp.max(jnp.abs(c1 - c2))) / scale < bound
+        assert abs(float(t1) - float(t2)) / float(t1) < bound
 
 
 class TestXlaPrecisionTiers:
