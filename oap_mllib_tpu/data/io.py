@@ -20,11 +20,15 @@ from typing import Optional, Tuple
 import numpy as np
 
 def _force_py() -> bool:
-    """Env escape hatch to force the pure-Python parsers (tests, debugging).
-    Read per call so it works even when set after import."""
-    return os.environ.get("OAP_MLLIB_TPU_PURE_PYTHON_IO", "").strip().lower() in (
-        "1", "true", "yes", "on",
-    )
+    """Env kill-switch for the native host layer: forces the pure-Python
+    parsers AND the native ALS host prep (ops/als_ops grouped-edge build)
+    back to NumPy (tests, debugging).  ``OAP_MLLIB_TPU_PURE_PYTHON`` is
+    the canonical name; ``..._IO`` is kept for back-compat.  Read per
+    call so it works even when set after import."""
+    for var in ("OAP_MLLIB_TPU_PURE_PYTHON", "OAP_MLLIB_TPU_PURE_PYTHON_IO"):
+        if os.environ.get(var, "").strip().lower() in ("1", "true", "yes", "on"):
+            return True
+    return False
 
 
 def _native():

@@ -49,6 +49,62 @@ def _build() -> bool:
         return False
 
 
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Declare every entry point's signature.  Raises AttributeError when
+    the .so predates a symbol — the caller rebuilds and retries once
+    (stale build caches must degrade to the NumPy fallbacks, never crash
+    the whole native layer)."""
+    i64, i32, f64p = ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_double)
+    lib.oap_table_create.restype = i64
+    lib.oap_table_create.argtypes = [i64, i64]
+    lib.oap_table_append.restype = i64
+    lib.oap_table_append.argtypes = [i64, f64p, i64]
+    lib.oap_table_merge.restype = i64
+    lib.oap_table_merge.argtypes = [i64, i64]
+    lib.oap_table_rows.restype = i64
+    lib.oap_table_rows.argtypes = [i64]
+    lib.oap_table_cols.restype = i64
+    lib.oap_table_cols.argtypes = [i64]
+    lib.oap_table_copy_out.restype = i64
+    lib.oap_table_copy_out.argtypes = [i64, f64p, i64]
+    lib.oap_table_data.restype = f64p
+    lib.oap_table_data.argtypes = [i64]
+    lib.oap_table_free.restype = i64
+    lib.oap_table_free.argtypes = [i64]
+    lib.oap_table_count.restype = i64
+    lib.oap_table_count.argtypes = []
+    lib.oap_parse_libsvm.restype = i64
+    lib.oap_parse_libsvm.argtypes = [ctypes.c_char_p, i64, ctypes.POINTER(i64)]
+    lib.oap_parse_csv.restype = i64
+    lib.oap_parse_csv.argtypes = [ctypes.c_char_p, ctypes.c_char]
+    lib.oap_parse_ratings.restype = i64
+    lib.oap_parse_ratings.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.oap_local_ip.restype = ctypes.c_int
+    lib.oap_local_ip.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.oap_free_port.restype = ctypes.c_int
+    lib.oap_free_port.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.oap_shuffle_block_ids.restype = None
+    lib.oap_shuffle_block_ids.argtypes = [
+        ctypes.POINTER(i64), i64, i64, i64, ctypes.POINTER(i32)]
+    lib.oap_shuffle_block_counts.restype = None
+    lib.oap_shuffle_block_counts.argtypes = [
+        ctypes.POINTER(i32), i64, i64, ctypes.POINTER(i64)]
+    lib.oap_shuffle_sort_perm.restype = None
+    lib.oap_shuffle_sort_perm.argtypes = [
+        ctypes.POINTER(i32), ctypes.POINTER(i64), ctypes.POINTER(i64),
+        i64, ctypes.POINTER(i64)]
+    lib.oap_distinct_count.restype = i64
+    lib.oap_distinct_count.argtypes = [ctypes.POINTER(i64), i64]
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.oap_als_grouped_total.restype = i64
+    lib.oap_als_grouped_total.argtypes = [ctypes.POINTER(i64), i64, i64, i64]
+    lib.oap_als_group_edges.restype = i64
+    lib.oap_als_group_edges.argtypes = [
+        ctypes.POINTER(i64), ctypes.POINTER(i64), f32p, i64, i64, i64,
+        i64, ctypes.POINTER(i32), f32p, f32p, ctypes.POINTER(i32)]
+    return lib
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     with _lock:
@@ -57,55 +113,40 @@ def _load() -> Optional[ctypes.CDLL]:
         _tried = True
         if not os.path.exists(_SO_PATH) and not _build():
             return None
-        try:
-            lib = ctypes.CDLL(_SO_PATH)
-        except OSError as e:
-            log.info("native load failed (using NumPy fallbacks): %s", e)
-            return None
-        # signatures
-        i64, i32, f64p = ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_double)
-        lib.oap_table_create.restype = i64
-        lib.oap_table_create.argtypes = [i64, i64]
-        lib.oap_table_append.restype = i64
-        lib.oap_table_append.argtypes = [i64, f64p, i64]
-        lib.oap_table_merge.restype = i64
-        lib.oap_table_merge.argtypes = [i64, i64]
-        lib.oap_table_rows.restype = i64
-        lib.oap_table_rows.argtypes = [i64]
-        lib.oap_table_cols.restype = i64
-        lib.oap_table_cols.argtypes = [i64]
-        lib.oap_table_copy_out.restype = i64
-        lib.oap_table_copy_out.argtypes = [i64, f64p, i64]
-        lib.oap_table_data.restype = f64p
-        lib.oap_table_data.argtypes = [i64]
-        lib.oap_table_free.restype = i64
-        lib.oap_table_free.argtypes = [i64]
-        lib.oap_table_count.restype = i64
-        lib.oap_table_count.argtypes = []
-        lib.oap_parse_libsvm.restype = i64
-        lib.oap_parse_libsvm.argtypes = [ctypes.c_char_p, i64, ctypes.POINTER(i64)]
-        lib.oap_parse_csv.restype = i64
-        lib.oap_parse_csv.argtypes = [ctypes.c_char_p, ctypes.c_char]
-        lib.oap_parse_ratings.restype = i64
-        lib.oap_parse_ratings.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
-        lib.oap_local_ip.restype = ctypes.c_int
-        lib.oap_local_ip.argtypes = [ctypes.c_char_p, ctypes.c_int]
-        lib.oap_free_port.restype = ctypes.c_int
-        lib.oap_free_port.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
-        lib.oap_shuffle_block_ids.restype = None
-        lib.oap_shuffle_block_ids.argtypes = [
-            ctypes.POINTER(i64), i64, i64, i64, ctypes.POINTER(i32)]
-        lib.oap_shuffle_block_counts.restype = None
-        lib.oap_shuffle_block_counts.argtypes = [
-            ctypes.POINTER(i32), i64, i64, ctypes.POINTER(i64)]
-        lib.oap_shuffle_sort_perm.restype = None
-        lib.oap_shuffle_sort_perm.argtypes = [
-            ctypes.POINTER(i32), ctypes.POINTER(i64), ctypes.POINTER(i64),
-            i64, ctypes.POINTER(i64)]
-        lib.oap_distinct_count.restype = i64
-        lib.oap_distinct_count.argtypes = [ctypes.POINTER(i64), i64]
-        _lib = lib
-        return _lib
+        load_path = _SO_PATH
+        for attempt in (0, 1):
+            try:
+                lib = ctypes.CDLL(load_path)
+            except OSError as e:
+                log.info("native load failed (using NumPy fallbacks): %s", e)
+                return None
+            try:
+                _lib = _bind(lib)
+                return _lib
+            except AttributeError as e:
+                # stale .so from before a symbol existed: delete it (make
+                # would otherwise see an up-to-date target), rebuild, and
+                # retry through a unique temp copy — dlopen caches the
+                # stale handle for the original path within this process
+                if attempt == 0:
+                    try:
+                        os.remove(_SO_PATH)
+                    except OSError:
+                        pass
+                    if _build():
+                        import shutil
+                        import tempfile
+
+                        fd, load_path = tempfile.mkstemp(suffix=".so")
+                        os.close(fd)
+                        shutil.copy(_SO_PATH, load_path)
+                        continue
+                log.info(
+                    "native library is stale and rebuild failed "
+                    "(using NumPy fallbacks): %s", e,
+                )
+                return None
+        return None
 
 
 def available() -> bool:
@@ -271,3 +312,69 @@ def distinct_count(sorted_keys: np.ndarray) -> int:
     return int(lib.oap_distinct_count(
         sorted_keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         len(sorted_keys)))
+
+
+# -- ALS grouped-edge prep --------------------------------------------------
+
+def als_grouped_total(dst: np.ndarray, n_dst: int, p: int) -> Optional[int]:
+    """Padded edge total for one grouped side (blowup-guard fast path);
+    None if the native lib is unavailable (or its O(n_dst) counts buffer
+    cannot be allocated — callers fall back to NumPy)."""
+    if n_dst <= 0 or len(dst) == 0:
+        return 0  # empty side: no groups, matching the NumPy path
+    lib = _load()
+    if lib is None:
+        return None
+    dst = np.ascontiguousarray(dst, dtype=np.int64)
+    total = lib.oap_als_grouped_total(
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(dst),
+        n_dst, p,
+    )
+    if total == -2:
+        return None  # allocation failure: NumPy fallback
+    if total < 0:
+        raise ValueError("destination id out of range for grouped layout")
+    return int(total)
+
+
+def als_group_edges(
+    dst: np.ndarray, src: np.ndarray, conf: np.ndarray, n_dst: int, p: int,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+    """Stable counting-sort build of the padded (G, P) grouped-edge layout
+    (~ ops/als_ops.build_grouped_edges, O(nnz + n_dst) instead of the
+    NumPy argsort path); None if the native lib is unavailable."""
+    lib = _load()
+    if lib is None or n_dst <= 0 or len(dst) == 0:
+        return None  # empty/degenerate sides keep the NumPy path's behavior
+    dst = np.ascontiguousarray(dst, dtype=np.int64)
+    src = np.ascontiguousarray(src, dtype=np.int64)
+    conf = np.ascontiguousarray(conf, dtype=np.float32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    # one counting pass to size the buffers, one inside the builder — the
+    # duplicate O(nnz) count is noise next to the argsort it replaces
+    total = als_grouped_total(dst, n_dst, p)
+    if total is None:
+        return None
+    src_g = np.zeros((total,), np.int32)
+    conf_g = np.zeros((total,), np.float32)
+    valid_g = np.zeros((total,), np.float32)
+    group_dst = np.zeros((total // p,), np.int32)
+    got = lib.oap_als_group_edges(
+        dst.ctypes.data_as(i64p), src.ctypes.data_as(i64p),
+        conf.ctypes.data_as(f32p), len(dst), n_dst, p, total,
+        src_g.ctypes.data_as(i32p), conf_g.ctypes.data_as(f32p),
+        valid_g.ctypes.data_as(f32p), group_dst.ctypes.data_as(i32p),
+    )
+    if got == -2:
+        return None  # allocation failure: NumPy fallback
+    if got != total:
+        raise RuntimeError("native grouped-edge build failed")
+    g = total // p
+    return (
+        src_g.reshape(g, p),
+        conf_g.reshape(g, p),
+        valid_g.reshape(g, p),
+        group_dst,
+    )

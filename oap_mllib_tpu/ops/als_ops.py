@@ -221,11 +221,20 @@ def grouped_padded_edges(dst, n_dst: int, group_size: int = 0) -> int:
     """Padded edge count the grouped layout WOULD produce for one side —
     the blowup-guard input, from per-destination counts alone (no sort of
     payloads, no (G, P) materialization).  Destinations with zero edges
-    pad to zero, so counting only the present ones (memory O(nnz), never
-    O(n_dst)) gives the exact total build_grouped_edges would realize."""
+    pad to zero, so counting only the present ones gives the exact total
+    build_grouped_edges would realize.  Prefers the native counting pass
+    (O(nnz + n_dst), native/src/grouped_prep.cpp) over np.unique's sort."""
     import numpy as np
 
+    from oap_mllib_tpu.data.io import _force_py
+
     p = group_size or auto_group_size(len(dst), n_dst)
+    if not _force_py():
+        from oap_mllib_tpu import native
+
+        total = native.als_grouped_total(np.asarray(dst, np.int64), n_dst, p)
+        if total is not None:
+            return total
     _, counts = np.unique(np.asarray(dst, np.int64), return_counts=True)
     return int((-(counts // -p) * p).sum())
 
@@ -262,10 +271,22 @@ def build_grouped_edges(
     group_dst (G,) int32).  Padding entries carry src=0, valid=0 so they
     vanish from every weighted sum.  ~1.2x edge blowup at P=64 on
     MovieLens-like degree distributions.
+
+    Prefers the native stable counting sort (O(nnz + n_dst),
+    native/src/grouped_prep.cpp — the reference's host-side CSR prep
+    analog, ALSDALImpl.cpp:184-230) over the NumPy argsort path.
     """
     import numpy as np
 
+    from oap_mllib_tpu.data.io import _force_py
+
     P = group_size or auto_group_size(len(dst), n_dst)
+    if not _force_py():
+        from oap_mllib_tpu import native
+
+        built = native.als_group_edges(dst, src, conf, n_dst, P)
+        if built is not None:
+            return built
     dst = np.asarray(dst, np.int64)
     order = np.argsort(dst, kind="stable")
     d = dst[order]

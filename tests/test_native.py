@@ -232,3 +232,34 @@ class TestReviewRegressions:
         lib.oap_table_copy_out(h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 1)
         assert out[0, 0] == 7.0
         lib.oap_table_free(h)
+
+
+class TestGroupedPrep:
+    def test_grouped_build_matches_numpy(self, rng, monkeypatch):
+        """Native counting-sort grouped-edge build is bit-identical to the
+        NumPy argsort path (incl. the padded-total guard)."""
+        from oap_mllib_tpu import native
+        from oap_mllib_tpu.ops import als_ops
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        nnz, n_dst = 5000, 120
+        dst = rng.integers(n_dst, size=nnz).astype(np.int64)
+        src = rng.integers(300, size=nnz).astype(np.int64)
+        conf = rng.random(nnz).astype(np.float32)
+        nat = als_ops.build_grouped_edges(dst, src, conf, n_dst, group_size=16)
+        monkeypatch.setenv("OAP_MLLIB_TPU_PURE_PYTHON_IO", "1")
+        ref = als_ops.build_grouped_edges(dst, src, conf, n_dst, group_size=16)
+        for a, b in zip(nat, ref):
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(a, b)
+        monkeypatch.delenv("OAP_MLLIB_TPU_PURE_PYTHON_IO")
+        assert als_ops.grouped_padded_edges(dst, n_dst, 16) == nat[0].size
+
+    def test_grouped_build_out_of_range_raises(self):
+        from oap_mllib_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        with pytest.raises(ValueError, match="out of range"):
+            native.als_grouped_total(np.asarray([0, 7], np.int64), 5, 8)
