@@ -254,25 +254,30 @@ class KMeans:
     # -- streamed (out-of-core) path -----------------------------------------
     def _fit_source(self, source, sample_weight) -> KMeansModel:
         """Out-of-core fit from a ChunkSource (ops/stream_ops.py): device
-        memory bounded by O(chunk), one pass per Lloyd iteration.  Single
-        -process only (each multi-host process should shard rows and use
-        the in-memory mesh path); weighted rows are not streamable yet.
-        The fallback path materializes the source — the CPU reference
-        semantics assume host-RAM-resident data anyway."""
-        import jax
-
+        memory bounded by O(chunk), one pass per Lloyd iteration.  Multi
+        -process: every process passes its OWN shard as a local source;
+        sums/counts/init state reduce across processes (host-mediated, the
+        DCN analog of the mesh path's ICI psums).  Weighted rows are not
+        streamable yet.  The fallback path materializes the (local)
+        source — the CPU reference semantics assume host-RAM-resident
+        data anyway."""
         if sample_weight is not None:
             raise ValueError("sample_weight is not supported with a ChunkSource")
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "streamed fit is single-process; shard rows per host and "
-                "use the in-memory mesh path instead"
-            )
         guard_ok = self.distance_measure == "euclidean"
         accelerated = should_accelerate(
             "KMeans", guard_ok, reason=f"distance_measure={self.distance_measure}"
         )
         if not accelerated:
+            import jax
+
+            if jax.process_count() > 1:
+                # each rank only holds its shard; a local-only fallback fit
+                # would silently diverge across ranks
+                raise NotImplementedError(
+                    "the fallback path cannot run a multi-process streamed "
+                    "fit (no cross-process reduction); use the accelerated "
+                    "path or fit in-memory"
+                )
             return self._fit_fallback(source.to_array(), None)
         from oap_mllib_tpu.utils.profiling import maybe_trace
         from oap_mllib_tpu.utils.timing import x64_scope

@@ -106,21 +106,25 @@ class PCA:
     def _fit_source(self, source) -> PCAModel:
         """Out-of-core fit from a ChunkSource: two streamed passes (column
         sums, centered Gram — ops/stream_ops.covariance_streamed), device
-        memory bounded by O(chunk + d^2).  Single-process only; the
-        fallback path materializes the source (CPU reference semantics
-        assume host-RAM-resident data anyway)."""
-        import jax
-
+        memory bounded by O(chunk + d^2).  Multi-process: every process
+        passes its OWN shard; the moments reduce across processes.  The
+        fallback path materializes the (local) source (CPU reference
+        semantics assume host-RAM-resident data anyway)."""
         d = source.n_features
         if self.k > d:
             raise ValueError(f"k={self.k} exceeds n_features={d}")
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "streamed fit is single-process; shard rows per host and "
-                "use the in-memory mesh path instead"
-            )
         guard_ok = d < MAX_PCA_FEATURES
         if not should_accelerate("PCA", guard_ok, reason=f"n_features={d}"):
+            import jax
+
+            if jax.process_count() > 1:
+                # each rank only holds its shard; a local-only fallback fit
+                # would silently diverge across ranks
+                raise NotImplementedError(
+                    "the fallback path cannot run a multi-process streamed "
+                    "fit (no cross-process reduction); use the accelerated "
+                    "path or fit in-memory"
+                )
             return self._fit_fallback(source.to_array())
         from oap_mllib_tpu.utils.profiling import maybe_trace
         from oap_mllib_tpu.utils.timing import x64_scope

@@ -120,6 +120,14 @@ def _load() -> Optional[ctypes.CDLL]:
             except OSError as e:
                 log.info("native load failed (using NumPy fallbacks): %s", e)
                 return None
+            finally:
+                if load_path != _SO_PATH:
+                    # the dlopen mapping outlives the unlink (Linux); never
+                    # leave the retry's temp copy behind
+                    try:
+                        os.remove(load_path)
+                    except OSError:
+                        pass
             try:
                 _lib = _bind(lib)
                 return _lib

@@ -24,7 +24,7 @@ Pass structure:
 from __future__ import annotations
 
 import functools
-from typing import List, Tuple
+from typing import List
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +39,53 @@ def _chunk_weights(n_valid: int, chunk_rows: int, dtype) -> np.ndarray:
     w = np.zeros((chunk_rows,), dtype)
     w[:n_valid] = 1.0
     return w
+
+
+# -- multi-host plumbing ----------------------------------------------------
+# Each process streams its OWN shard (a per-process ChunkSource); the
+# cross-process reductions are host-mediated via process_allgather — the
+# DCN analog of the mesh path's ICI psums.  The reduced payloads are tiny
+# ((k, d) sums, (d, d) Gram, scalars), so host mediation costs nothing
+# next to the per-pass IO, and every process computes bit-identical
+# results (deterministic rank-ordered gather + same summation order).
+
+
+def _world() -> int:
+    return jax.process_count()
+
+
+def _psum_host(arrays):
+    """Sum each array across processes; identity single-process.  Returns
+    np arrays, identical on every process.  The gather runs under an x64
+    scope: process_allgather device_puts its payload, which would
+    silently demote f64/i64 (row counts, reservoir state) when the
+    session default is x64-off."""
+    arrays = [np.asarray(a) for a in arrays]
+    if _world() == 1:
+        return arrays
+    from jax.experimental import multihost_utils
+
+    from oap_mllib_tpu.utils.timing import x64_scope
+
+    with x64_scope(True):
+        gathered = multihost_utils.process_allgather(arrays)
+    return [np.asarray(g).sum(axis=0) for g in gathered]
+
+
+def _allgather_host(arrays):
+    """Gather each array across processes along a new leading (rank)
+    axis; adds the axis single-process too (shape-stable callers).
+    x64 scope: see _psum_host."""
+    arrays = [np.asarray(a) for a in arrays]
+    if _world() == 1:
+        return [a[None] for a in arrays]
+    from jax.experimental import multihost_utils
+
+    from oap_mllib_tpu.utils.timing import x64_scope
+
+    with x64_scope(True):
+        gathered = multihost_utils.process_allgather(arrays)
+    return [np.asarray(g) for g in gathered]
 
 
 # ---------------------------------------------------------------------------
@@ -59,7 +106,9 @@ def _kmeans_chunk_accum(sums, counts, cost, chunk, w, centers, precision, need_c
 def streamed_accumulate(
     source: ChunkSource, centers, dtype, precision: str, need_cost: bool
 ):
-    """One full assignment pass: (sums (k,d), counts (k,), cost) on device."""
+    """One full assignment pass over this process's shard, reduced across
+    processes: (sums (k,d), counts (k,), cost) as host arrays (identical
+    on every process)."""
     k, d = centers.shape
     sums = jnp.zeros((k, d), dtype)
     counts = jnp.zeros((k,), dtype)
@@ -70,7 +119,7 @@ def streamed_accumulate(
         sums, counts, cost = _kmeans_chunk_accum(
             sums, counts, cost, cj, wj, centers, precision, need_cost
         )
-    return sums, counts, cost
+    return _psum_host([sums, counts, cost])
 
 
 @jax.jit
@@ -115,7 +164,14 @@ def lloyd_run_streamed(
 def reservoir_sample(source: ChunkSource, k: int, seed: int) -> np.ndarray:
     """Uniform k-row sample in one pass (Algorithm R, vectorized per chunk:
     one rng draw per chunk and a Python loop only over the expected
-    O(k log(n/k)) reservoir hits, never over all n rows)."""
+    O(k log(n/k)) reservoir hits, never over all n rows).
+
+    Multi-process: each process reservoirs its own shard, then the
+    per-process reservoirs are merged by weighted sampling without
+    replacement (Efraimidis–Spirakis keys; each reservoir row represents
+    seen_p / |reservoir_p| rows of the global table).  Deterministic rank
+    -ordered gather + a shared seed make every process return the SAME
+    sample."""
     rng = np.random.default_rng(seed)
     sample: List[np.ndarray] = []
     seen = 0
@@ -132,6 +188,32 @@ def reservoir_sample(source: ChunkSource, k: int, seed: int) -> np.ndarray:
             for i in np.nonzero(j < k)[0]:  # sparse hits only
                 sample[j[i]] = chunk[start + i].copy()
         seen += n_valid
+    if _world() > 1:
+        d = source.n_features
+        local = np.zeros((k, d))
+        if sample:
+            local[: len(sample)] = np.stack(sample)
+        rows_g, nv_g, seen_g = _allgather_host(
+            [local, np.asarray([len(sample)]), np.asarray([seen])]
+        )
+        rows = rows_g.reshape(-1, d)  # (nproc*k, d), rank-major
+        nv = nv_g.ravel()
+        weights = np.zeros(len(rows))
+        for p in range(len(nv)):
+            if nv[p]:
+                weights[p * k : p * k + nv[p]] = seen_g.ravel()[p] / nv[p]
+        valid = weights > 0
+        if not valid.any():
+            raise ValueError("empty source (all processes)")
+        # Efraimidis–Spirakis: top-k keys u^(1/w) ~ weighted sample
+        # without replacement; same rng stream on every process
+        merge_rng = np.random.default_rng(seed + 1000003)
+        keys = np.where(
+            valid, merge_rng.random(len(rows)) ** (1.0 / np.maximum(weights, 1e-300)), -1.0
+        )
+        top = np.argsort(-keys, kind="stable")[: min(k, int(valid.sum()))]
+        sample = [rows[t] for t in top]
+        seen = int(seen_g.sum())
     if not sample:
         raise ValueError("empty source")
     while len(sample) < k:  # fewer rows than clusters: duplicate
@@ -173,11 +255,20 @@ def init_kmeans_parallel_streamed(
     (one f32 per row — 400 MB at 100M rows, far under host RAM), and each
     sampling round uses the cost total from the previous pass (one-round
     -stale phi; the l=2k oversampling absorbs the drift — parity tests
-    compare converged cost, not centers, survey §7.3)."""
-    rng = np.random.default_rng(seed)
+    compare converged cost, not centers, survey §7.3).
+
+    Multi-process: each process folds/samples its own shard; phi, the
+    per-round picks, and the ownership weights are reduced/gathered across
+    processes, so every process ends each round with the SAME candidate
+    set (the sampling rng is per-process — distinct shards — while the
+    final weighted k-means++ rng is shared)."""
     d = source.n_features
     l = 2.0 * k
     cap = 4 * k  # per-round candidate block (2x expected picks)
+    # per-process stream for sampling OWN rows; shared stream for the
+    # final reduction (must be identical on every process)
+    samp_rng = np.random.default_rng(seed + 31 * jax.process_index())
+    final_rng = np.random.default_rng(seed + 7777)
 
     c0 = reservoir_sample(source, 1, seed)
     cands = [c0[0]]
@@ -218,11 +309,26 @@ def init_kmeans_parallel_streamed(
             new_phi += float(h.sum())
             if sampling:
                 prob = np.minimum(l * h / max(phi, 1e-300), 1.0)
-                hit = rng.random(source.chunk_rows) < prob
+                hit = samp_rng.random(source.chunk_rows) < prob
                 hit[n_valid:] = False
                 for i in np.nonzero(hit)[0]:
                     picks.append(chunk[i].copy())
-        phi = new_phi
+        (phi_arr,) = _psum_host([np.asarray([new_phi])])
+        phi = float(phi_arr[0])
+        if _world() > 1:
+            # fixed-shape gather of each process's picks (rank-major, so
+            # every process extends cands identically); overflow beyond
+            # cap drops, like the in-memory slot buffer
+            local = np.zeros((cap, d))
+            n_local = min(len(picks), cap)
+            if n_local:
+                local[:n_local] = np.stack(picks[:n_local])
+            rows_g, cnt_g = _allgather_host([local, np.asarray([n_local])])
+            picks = [
+                rows_g[p, i]
+                for p in range(rows_g.shape[0])
+                for i in range(int(cnt_g.ravel()[p]))
+            ]
         cands.extend(picks)
         new_block = (
             _pad_cands(
@@ -245,7 +351,8 @@ def init_kmeans_parallel_streamed(
         weights += np.asarray(
             _chunk_ownership(jnp.asarray(np.asarray(chunk, dtype)), w, cands_dev)
         )
-    return kmeans_ops._weighted_kmeans_pp(cand_arr, weights, k, rng)
+    (weights,) = _psum_host([weights])
+    return kmeans_ops._weighted_kmeans_pp(cand_arr, weights, k, final_rng)
 
 
 # ---------------------------------------------------------------------------
@@ -266,11 +373,13 @@ def _gram_chunk(gram, chunk, w, mean, precision):
 
 def covariance_streamed(
     source: ChunkSource, dtype, precision: str = "highest"
-) -> Tuple[jax.Array, jax.Array, int]:
-    """Two-pass streamed covariance: (cov (d,d), mean (d,), n_rows).
+):
+    """Two-pass streamed covariance: (cov (d,d), mean (d,), n_rows), as
+    host arrays identical on every process.
 
     Pass 1 accumulates column sums (mean), pass 2 the mean-centered Gram —
-    identical numerics to ops.pca_ops.covariance, O(chunk) device memory.
+    identical numerics to ops.pca_ops.covariance, O(chunk) device memory;
+    multi-process shards reduce across processes after each pass.
     """
     d = source.n_features
     total = jnp.zeros((d,), dtype)
@@ -279,15 +388,19 @@ def covariance_streamed(
         w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
         total = _colsum_chunk(total, jnp.asarray(np.asarray(chunk, dtype)), w)
         n += n_valid
+    total, n_arr = _psum_host([total, np.asarray([n], np.int64)])
+    n = int(n_arr[0])
     if n < 1:
         raise ValueError("empty source")
-    mean = total / n
+    mean = jnp.asarray(total.astype(dtype) / n)
     gram = jnp.zeros((d, d), dtype)
     for chunk, n_valid in source:
         w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
         gram = _gram_chunk(
             gram, jnp.asarray(np.asarray(chunk, dtype)), w, mean, precision
         )
-    cov = gram / max(n - 1.0, 1.0)
+    (gram,) = _psum_host([gram])
+    cov = gram.astype(np.float64 if dtype == np.float64 else np.float32)
+    cov = cov / max(n - 1.0, 1.0)
     cov = 0.5 * (cov + cov.T)
-    return cov, mean, n
+    return cov, np.asarray(mean), n

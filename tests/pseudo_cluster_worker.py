@@ -78,6 +78,22 @@ p_mp = PCA(k=4).fit(half)
 assert p_mp.summary["mesh_shape"] == {"data": 2, "model": 2}
 set_config(model_parallel=1)
 
+# --- streamed (out-of-core) fits: each rank streams its OWN shard as a
+# local ChunkSource; sums/Gram/init state reduce across processes
+# (ops/stream_ops._psum_host / _allgather_host — the DCN analog of the
+# mesh path's psums).  Every rank must produce IDENTICAL results.
+from oap_mllib_tpu.data.stream import ChunkSource
+
+ms = KMeans(k=5, seed=7, max_iter=30).fit(
+    ChunkSource.from_array(half, chunk_rows=512)
+)
+assert getattr(ms.summary, "streamed", False)
+ms_rand = KMeans(k=5, seed=11, init_mode="random", max_iter=15).fit(
+    ChunkSource.from_array(half, chunk_rows=512)
+)
+ps = PCA(k=4).fit(ChunkSource.from_array(half, chunk_rows=512))
+assert ps.summary["streamed"] and ps.summary["n_rows"] == 4000
+
 # --- ALS: each rank contributes its LOCAL ratings shard (the per-rank
 # partitions of the reference's shuffle, ALSDALImpl.scala:95-109).  This
 # exercises the multi-process branches of exchange_ratings (allgathered
@@ -122,6 +138,13 @@ print(
             "kmeans_mp_cost": float(m_mp.summary.training_cost),
             "kmeans_mp_iters": int(m_mp.summary.num_iter),
             "pca_mp_var": np.asarray(p_mp.explained_variance_).tolist(),
+            "streamed_cost": float(ms.summary.training_cost),
+            "streamed_iters": int(ms.summary.num_iter),
+            "streamed_rand_cost": float(ms_rand.summary.training_cost),
+            "streamed_pca_var": np.asarray(ps.explained_variance_).tolist(),
+            "streamed_pca_pc0_abs": np.abs(
+                np.asarray(ps.components_)[:, 0]
+            ).tolist(),
             **als_out,
         }
     ),

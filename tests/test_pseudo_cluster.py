@@ -208,8 +208,54 @@ class TestPseudoCluster:
                 atol=4e-3, rtol=4e-3,
             )
 
+    def test_streamed_kmeans_matches_single_process(self, world_results):
+        """Each rank streams its local half as a ChunkSource; the
+        host-mediated cross-process reductions must land on the same
+        clustering quality as the single-process streamed fit (init RNG
+        merges differ across world sizes, so compare cost — survey §7.3)."""
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = _oracle_data()
+        oracle = KMeans(k=5, seed=7, max_iter=30).fit(
+            ChunkSource.from_array(x, chunk_rows=512)
+        )
+        for rank in (0, 1):
+            r = world_results[rank]
+            # well-separated blobs: both reach the same optimum
+            np.testing.assert_allclose(
+                r["streamed_cost"], oracle.summary.training_cost, rtol=1e-3
+            )
+            np.testing.assert_allclose(
+                r["streamed_rand_cost"], oracle.summary.training_cost,
+                rtol=1e-3,
+            )
+
+    def test_streamed_pca_matches_single_process(self, world_results):
+        """Streamed PCA over per-process shards == streamed PCA over the
+        full table (exact moments, fp tolerance only)."""
+        from oap_mllib_tpu.models.pca import PCA
+
+        x = _oracle_data()
+        oracle = PCA(k=4).fit(x)
+        for rank in (0, 1):
+            r = world_results[rank]
+            np.testing.assert_allclose(
+                r["streamed_pca_var"],
+                np.asarray(oracle.explained_variance_), rtol=1e-3,
+            )
+            np.testing.assert_allclose(
+                r["streamed_pca_pc0_abs"],
+                np.abs(np.asarray(oracle.components_)[:, 0]), atol=1e-4,
+            )
+
     def test_ranks_agree(self, world_results):
         """Replicated results must be bitwise-identical across ranks."""
         assert world_results[0]["kmeans_cost"] == world_results[1]["kmeans_cost"]
         assert world_results[0]["pca_var"] == world_results[1]["pca_var"]
         assert world_results[0]["als_imp_if"] == world_results[1]["als_imp_if"]
+        assert world_results[0]["streamed_cost"] == world_results[1]["streamed_cost"]
+        assert (
+            world_results[0]["streamed_pca_var"]
+            == world_results[1]["streamed_pca_var"]
+        )
