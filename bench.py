@@ -129,7 +129,9 @@ def bench_kmeans(precision="highest", cpu_ips=None, extra=None):
         return np.asarray(c), int(it)
 
     n_iter = run()[1]  # warm-up/compile; n_iter is deterministic
-    dt = _best_of(lambda: run()[0], warm=False)
+    # 5 reps: the tunnel's per-call latency varies ~10% run-to-run and
+    # this is THE recorded headline — extra reps are cheap insurance
+    dt = _best_of(lambda: run()[0], reps=5, warm=False)
     iters_per_sec = n_iter / dt
     flops = 2 * 2 * n * k * d  # two n*k*d matmuls per iteration
     tflops = flops * iters_per_sec / 1e12
