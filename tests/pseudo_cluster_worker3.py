@@ -1,0 +1,65 @@
+"""Worker for the 3-process pseudo-cluster variant.
+
+The reference only ever tested 2 executors (its pseudo-YARN cluster,
+dev/test-cluster/env.sh); this stresses a world size that is neither a
+power of two nor the tested-everywhere 2: UNEVEN thirds through the
+in-memory mesh path AND the streamed per-process-source path.
+
+Invoked as:  python pseudo_cluster_worker3.py RANK NPROC COORD LOCAL_DEVICES
+"""
+
+import json
+import sys
+
+rank, nproc = int(sys.argv[1]), int(sys.argv[2])
+coord, local_dev = sys.argv[3], int(sys.argv[4])
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", local_dev)
+
+import numpy as np
+
+from oap_mllib_tpu.parallel import bootstrap
+
+assert bootstrap.initialize_distributed(coord, nproc, rank)
+assert jax.process_count() == nproc
+
+from oap_mllib_tpu.data.stream import ChunkSource
+from oap_mllib_tpu.models.kmeans import KMeans
+from oap_mllib_tpu.models.pca import PCA
+
+# same global dataset as the 2-process worker; uneven thirds
+rng = np.random.default_rng(123)
+proto = rng.normal(size=(5, 12)).astype(np.float32) * 3.0
+x = (proto[rng.integers(5, size=4000)]
+     + rng.normal(size=(4000, 12)).astype(np.float32) * 0.25)
+cuts = [0, 1300, 2600, 4000]
+shard = x[cuts[rank] : cuts[rank + 1]]
+
+m = KMeans(k=5, seed=7, max_iter=30).fit(shard)
+assert m.summary.accelerated
+
+p = PCA(k=4).fit(shard)
+
+ms = KMeans(k=5, seed=7, max_iter=30).fit(
+    ChunkSource.from_array(shard, chunk_rows=300)
+)
+assert getattr(ms.summary, "streamed", False)
+ps = PCA(k=4).fit(ChunkSource.from_array(shard, chunk_rows=300))
+assert ps.summary["n_rows"] == 4000
+
+print(
+    "RESULT "
+    + json.dumps(
+        {
+            "rank": rank,
+            "kmeans_cost": float(m.summary.training_cost),
+            "pca_var": np.asarray(p.explained_variance_).tolist(),
+            "streamed_cost": float(ms.summary.training_cost),
+            "streamed_pca_var": np.asarray(ps.explained_variance_).tolist(),
+        }
+    ),
+    flush=True,
+)

@@ -21,6 +21,7 @@ import numpy as np
 import pytest
 
 _WORKER = os.path.join(os.path.dirname(__file__), "pseudo_cluster_worker.py")
+_WORKER3 = os.path.join(os.path.dirname(__file__), "pseudo_cluster_worker3.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -37,14 +38,14 @@ def _worker_env():
     return env
 
 
-def _run_world(nproc=2, local_dev=2, timeout=300):
+def _run_world(nproc=2, local_dev=2, timeout=300, worker=_WORKER):
     from oap_mllib_tpu.parallel.bootstrap import free_port
 
     coord = f"127.0.0.1:{free_port('127.0.0.1', 4000)}"
     env = _worker_env()
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(r), str(nproc), coord, str(local_dev)],
+            [sys.executable, worker, str(r), str(nproc), coord, str(local_dev)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -75,6 +76,12 @@ def _run_world(nproc=2, local_dev=2, timeout=300):
 @pytest.fixture(scope="module")
 def world_results():
     return _run_world()
+
+
+@pytest.fixture(scope="module")
+def world3_results():
+    """3-process world, 1 device each, uneven thirds (1300/1300/1400)."""
+    return _run_world(nproc=3, local_dev=1, worker=_WORKER3)
 
 
 def _oracle_data():
@@ -248,6 +255,39 @@ class TestPseudoCluster:
                 r["streamed_pca_pc0_abs"],
                 np.abs(np.asarray(oracle.components_)[:, 0]), atol=1e-4,
             )
+
+    def test_three_process_world(self, world3_results):
+        """Uneven thirds over 3 processes (a world size the reference
+        never tested): in-memory mesh AND streamed per-process-source
+        fits match the single-process oracles; all ranks agree."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+        from oap_mllib_tpu.models.pca import PCA
+
+        x = _oracle_data()
+        km = KMeans(k=5, seed=7, max_iter=30).fit(x)
+        pc = PCA(k=4).fit(x)
+        for rank in (0, 1, 2):
+            r = world3_results[rank]
+            np.testing.assert_allclose(
+                r["kmeans_cost"], km.summary.training_cost, rtol=1e-3
+            )
+            np.testing.assert_allclose(
+                r["pca_var"], np.asarray(pc.explained_variance_), rtol=1e-3
+            )
+            np.testing.assert_allclose(
+                r["streamed_cost"], km.summary.training_cost, rtol=1e-3
+            )
+            np.testing.assert_allclose(
+                r["streamed_pca_var"],
+                np.asarray(pc.explained_variance_), rtol=1e-3,
+            )
+        assert world3_results[0] == {**world3_results[0], **{
+            k: v for k, v in world3_results[1].items() if k != "rank"
+        }}
+        assert (
+            world3_results[1]["streamed_cost"]
+            == world3_results[2]["streamed_cost"]
+        )
 
     def test_ranks_agree(self, world_results):
         """Replicated results must be bitwise-identical across ranks."""
