@@ -123,6 +123,43 @@ class TestStreamedOps:
         np.testing.assert_allclose(np.asarray(mean1), np.asarray(mean2), atol=1e-5)
         np.testing.assert_allclose(np.asarray(cov1), np.asarray(cov2), atol=1e-4)
 
+    def test_streamed_matches_in_memory_fuzz(self, rng):
+        """Randomized shapes/chunk sizes: streamed Lloyd and covariance
+        must match their in-memory counterparts for any chunking."""
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.ops import kmeans_ops, pca_ops, stream_ops
+
+        for trial in range(6):
+            n = int(rng.integers(3, 700))
+            d = int(rng.integers(1, 20))
+            k = int(rng.integers(1, min(6, n) + 1))
+            chunk = int(rng.integers(1, n + 8))
+            x = rng.normal(size=(n, d)).astype(np.float32) * 3
+            src = ChunkSource.from_array(x, chunk_rows=chunk)
+            init = x[rng.choice(n, k, replace=False)]
+            c1, i1, t1, n1 = kmeans_ops.lloyd_run(
+                jnp.asarray(x), jnp.ones((n,), jnp.float32),
+                jnp.asarray(init), 8, jnp.asarray(1e-6, jnp.float32),
+            )
+            c2, i2, t2, n2 = stream_ops.lloyd_run_streamed(
+                src, init, 8, 1e-6, np.float32
+            )
+            ctx = f"trial {trial}: n={n} d={d} k={k} chunk={chunk}"
+            assert int(i1) == int(i2), ctx
+            np.testing.assert_allclose(
+                np.asarray(c1), np.asarray(c2), atol=1e-3, err_msg=ctx
+            )
+            cov1, _ = pca_ops.covariance(
+                jnp.asarray(x), jnp.ones((n,), jnp.float32),
+                jnp.asarray(float(n), jnp.float32),
+            )
+            cov2, _, nn = stream_ops.covariance_streamed(src, np.float32)
+            assert nn == n, ctx
+            np.testing.assert_allclose(
+                np.asarray(cov1), np.asarray(cov2), atol=1e-3, err_msg=ctx
+            )
+
     def test_reservoir_sample_uniformish(self, rng):
         from oap_mllib_tpu.ops import stream_ops
 
