@@ -109,3 +109,36 @@ class TestEstimatorCompiled:
         else:
             rmse = float(np.sqrt(np.mean((pred - r) ** 2)))
             assert rmse < 0.5 * float(np.std(r))
+
+
+class TestGroupedChunkedCompiled:
+    def test_chunked_scan_path_compiled(self, rng, monkeypatch):
+        """The G-blocked lax.scan partials (the ML-25M-on-one-chip path)
+        compile for the real chip and match the unchunked program — the
+        flat (n_dst, (r+1)(r+2)) carry and the padded dummy groups take
+        lowering routes the interpret-mode CPU test cannot validate."""
+        n_users, n_items, rank, iters = 512, 256, 8, 2
+        u, i, r = _synthetic(rng, n_users, n_items)
+        x0 = (rng.normal(size=(n_users, rank)) * 0.1).astype(np.float32)
+        y0 = (rng.normal(size=(n_items, rank)) * 0.1).astype(np.float32)
+        by_user = als_ops.build_grouped_edges(u, i, r, n_users)
+        by_item = als_ops.build_grouped_edges(i, u, r, n_items)
+        dev = [jnp.asarray(a) for a in (*by_user, *by_item)]
+
+        def run():
+            return als_ops.als_run_grouped(
+                *dev, jnp.asarray(x0), jnp.asarray(y0),
+                n_users, n_items, iters, 0.1, 10.0, True,
+            )
+
+        x1, y1 = run()
+        # force the scan path: budget far below this side's (G, P, r) size
+        # (odd split so the dummy-group padding lowers on hardware too)
+        monkeypatch.setattr(als_ops, "_GROUPED_BUDGET_ELEMS", 1 << 14)
+        assert als_ops._grouped_block_count(*by_user[0].shape, rank) > 1
+        als_ops.als_run_grouped.clear_cache()
+        x2, y2 = run()
+        np.testing.assert_allclose(np.asarray(x1), np.asarray(x2), atol=2e-4)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=2e-4)
+        monkeypatch.setattr(als_ops, "_GROUPED_BUDGET_ELEMS", 1 << 26)
+        als_ops.als_run_grouped.clear_cache()
