@@ -257,12 +257,23 @@ class KMeans:
         memory bounded by O(chunk), one pass per Lloyd iteration.  Multi
         -process: every process passes its OWN shard as a local source;
         sums/counts/init state reduce across processes (host-mediated, the
-        DCN analog of the mesh path's ICI psums).  Weighted rows are not
-        streamable yet.  The fallback path materializes the (local)
-        source — the CPU reference semantics assume host-RAM-resident
-        data anyway."""
-        if sample_weight is not None:
-            raise ValueError("sample_weight is not supported with a ChunkSource")
+        DCN analog of the mesh path's ICI psums).  ``sample_weight`` may
+        be a width-1 ChunkSource chunked like the data, or an in-memory
+        array (wrapped automatically).  The fallback path materializes
+        the (local) source — the CPU reference semantics assume
+        host-RAM-resident data anyway."""
+        from oap_mllib_tpu.data.stream import ChunkSource
+
+        if sample_weight is not None and not isinstance(sample_weight, ChunkSource):
+            sample_weight = ChunkSource.from_array(
+                np.asarray(sample_weight).reshape(-1, 1),
+                chunk_rows=source.chunk_rows,
+            )
+        # validate up front so BOTH branches (accelerated and fallback)
+        # reject malformed weight sources with a clear error
+        from oap_mllib_tpu.ops.stream_ops import _check_weight_source
+
+        _check_weight_source(source, sample_weight)
         guard_ok = self.distance_measure == "euclidean"
         accelerated = should_accelerate(
             "KMeans", guard_ok, reason=f"distance_measure={self.distance_measure}"
@@ -278,16 +289,20 @@ class KMeans:
                     "fit (no cross-process reduction); use the accelerated "
                     "path or fit in-memory"
                 )
-            return self._fit_fallback(source.to_array(), None)
+            w_arr = (
+                sample_weight.to_array().reshape(-1)
+                if sample_weight is not None else None
+            )
+            return self._fit_fallback(source.to_array(), w_arr)
         from oap_mllib_tpu.utils.profiling import maybe_trace
         from oap_mllib_tpu.utils.timing import x64_scope
 
         cfg = get_config()
         dtype = np.float64 if cfg.enable_x64 else np.float32
         with maybe_trace(), x64_scope(cfg.enable_x64):
-            return self._fit_stream_inner(source, dtype, cfg)
+            return self._fit_stream_inner(source, sample_weight, dtype, cfg)
 
-    def _fit_stream_inner(self, source, dtype, cfg) -> KMeansModel:
+    def _fit_stream_inner(self, source, sample_weight, dtype, cfg) -> KMeansModel:
         from oap_mllib_tpu.ops import stream_ops
 
         # kmeans_kernel validation must run on EVERY accelerated fit (the
@@ -303,12 +318,13 @@ class KMeans:
                 centers0 = stream_ops.reservoir_sample(source, self.k, self.seed)
             else:
                 centers0 = stream_ops.init_kmeans_parallel_streamed(
-                    source, self.k, self.seed, self.init_steps, dtype
+                    source, self.k, self.seed, self.init_steps, dtype,
+                    weights=sample_weight,
                 )
         with phase_timer(timings, "lloyd_loop"):
             centers, n_iter, cost, counts = stream_ops.lloyd_run_streamed(
                 source, centers0, self.max_iter, self.tol, dtype,
-                cfg.matmul_precision,
+                cfg.matmul_precision, weights=sample_weight,
             )
         summary = KMeansSummary(
             float(cost), int(n_iter), timings, accelerated=True,

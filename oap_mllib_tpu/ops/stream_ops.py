@@ -41,6 +41,44 @@ def _chunk_weights(n_valid: int, chunk_rows: int, dtype) -> np.ndarray:
     return w
 
 
+def _iter_weighted(source: ChunkSource, weights, dtype):
+    """Yield (chunk, n_valid, w_vec) where w_vec is the row-weight vector
+    with padding masked to 0.  ``weights`` is None (all-ones), or a width-1
+    ChunkSource walked in lockstep (its per-chunk valid counts must match
+    the data source's)."""
+    if weights is None:
+        for chunk, n_valid in source:
+            yield chunk, n_valid, _chunk_weights(n_valid, source.chunk_rows, dtype)
+        return
+    # drive off the DATA iterator: a bare zip would silently drop the
+    # data tail if the weight source ran out at a chunk boundary (its
+    # n_rows may be unknown before a completed pass, so the up-front
+    # row-count check cannot always catch a mismatch)
+    wit = iter(weights)
+    for chunk, n_valid in source:
+        wpair = next(wit, None)
+        if wpair is None:
+            raise ValueError(
+                "sample_weight source ran out of chunks before the data "
+                "source — the two must be chunked identically"
+            )
+        wchunk, wn = wpair
+        if wn != n_valid:
+            raise ValueError(
+                f"sample_weight source yielded {wn} valid rows where the "
+                f"data source yielded {n_valid} — the two must be chunked "
+                "identically"
+            )
+        w = np.asarray(wchunk, dtype).reshape(-1)[: source.chunk_rows].copy()
+        w[n_valid:] = 0.0
+        yield chunk, n_valid, w
+    if next(wit, None) is not None:
+        raise ValueError(
+            "sample_weight source has more chunks than the data source — "
+            "the two must be chunked identically"
+        )
+
+
 # -- multi-host plumbing ----------------------------------------------------
 # Each process streams its OWN shard (a per-process ChunkSource); the
 # cross-process reductions are host-mediated via process_allgather — the
@@ -103,8 +141,31 @@ def _kmeans_chunk_accum(sums, counts, cost, chunk, w, centers, precision, need_c
     return sums + s, counts + c, cost + t
 
 
+def _check_weight_source(source: ChunkSource, weights) -> None:
+    if weights is None:
+        return
+    if not isinstance(weights, ChunkSource):
+        raise TypeError("sample_weight for a streamed fit must be a ChunkSource")
+    if weights.n_features != 1:
+        raise ValueError("sample_weight source must have width 1")
+    if weights.chunk_rows != source.chunk_rows:
+        raise ValueError(
+            f"sample_weight chunk_rows {weights.chunk_rows} != data "
+            f"chunk_rows {source.chunk_rows}"
+        )
+    if (
+        weights.n_rows is not None
+        and source.n_rows is not None
+        and weights.n_rows != source.n_rows
+    ):
+        raise ValueError(
+            f"sample_weight rows {weights.n_rows} != data rows {source.n_rows}"
+        )
+
+
 def streamed_accumulate(
-    source: ChunkSource, centers, dtype, precision: str, need_cost: bool
+    source: ChunkSource, centers, dtype, precision: str, need_cost: bool,
+    weights=None,
 ):
     """One full assignment pass over this process's shard, reduced across
     processes: (sums (k,d), counts (k,), cost) as host arrays (identical
@@ -113,11 +174,11 @@ def streamed_accumulate(
     sums = jnp.zeros((k, d), dtype)
     counts = jnp.zeros((k,), dtype)
     cost = jnp.zeros((), dtype)
-    for chunk, n_valid in source:
+    for chunk, _, w in _iter_weighted(source, weights, dtype):
         cj = jnp.asarray(np.asarray(chunk, dtype))
-        wj = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
         sums, counts, cost = _kmeans_chunk_accum(
-            sums, counts, cost, cj, wj, centers, precision, need_cost
+            sums, counts, cost, cj, jnp.asarray(w), centers, precision,
+            need_cost,
         )
     return _psum_host([sums, counts, cost])
 
@@ -132,26 +193,29 @@ def _center_update(centers, sums, counts):
 
 def lloyd_run_streamed(
     source: ChunkSource, init_centers: np.ndarray, max_iter: int, tol: float,
-    dtype, precision: str = "highest",
+    dtype, precision: str = "highest", weights=None,
 ):
     """Streamed Lloyd loop; same return contract as kmeans_ops.lloyd_run:
     (centers, n_iter, cost, counts).  Convergence semantics match
     _lloyd_loop (every center's squared move <= tol^2, or max_iter); one
     host sync per iteration (the converged flag) instead of zero — the
-    price of host-driven passes."""
+    price of host-driven passes.  ``weights`` is an optional width-1
+    ChunkSource walked in lockstep (per-row weights)."""
+    _check_weight_source(source, weights)
     centers = jnp.asarray(np.asarray(init_centers, dtype))
     tol_sq = float(tol) ** 2
     n_iter = 0
     for _ in range(max_iter):
         sums, counts, _ = streamed_accumulate(
-            source, centers, dtype, precision, need_cost=False
+            source, centers, dtype, precision, need_cost=False,
+            weights=weights,
         )
         centers, max_moved = _center_update(centers, sums, counts)
         n_iter += 1
         if float(max_moved) <= tol_sq:
             break
     _, counts, cost = streamed_accumulate(
-        source, centers, dtype, "highest", need_cost=True
+        source, centers, dtype, "highest", need_cost=True, weights=weights
     )
     return centers, n_iter, cost, counts
 
@@ -247,6 +311,7 @@ def _pad_cands(cands: np.ndarray, cap: int, d: int) -> np.ndarray:
 
 def init_kmeans_parallel_streamed(
     source: ChunkSource, k: int, seed: int, init_steps: int, dtype,
+    weights=None,
 ) -> np.ndarray:
     """Streamed k-means|| (Bahmani), host-orchestrated.
 
@@ -261,7 +326,13 @@ def init_kmeans_parallel_streamed(
     per-round picks, and the ownership weights are reduced/gathered across
     processes, so every process ends each round with the SAME candidate
     set (the sampling rng is per-process — distinct shards — while the
-    final weighted k-means++ rng is shared)."""
+    final weighted k-means++ rng is shared).
+
+    ``weights``: optional width-1 ChunkSource of per-row weights, walked
+    in lockstep — they scale the sampling cost (phi = sum w*dmin, like
+    the in-memory version's weighted _pll_round) and the candidate
+    ownership."""
+    _check_weight_source(source, weights)
     d = source.n_features
     l = 2.0 * k
     cap = 4 * k  # per-round candidate block (2x expected picks)
@@ -289,7 +360,9 @@ def init_kmeans_parallel_streamed(
         )
         picks: List[np.ndarray] = []
         new_phi = 0.0
-        for ci, (chunk, n_valid) in enumerate(source):
+        for ci, (chunk, n_valid, wv) in enumerate(
+            _iter_weighted(source, weights, dtype)
+        ):
             if cands_dev is not None:
                 prev = (
                     jnp.asarray(dmin_chunks[ci])
@@ -306,9 +379,10 @@ def init_kmeans_parallel_streamed(
                     dmin_chunks.append(h)
             else:
                 h = dmin_chunks[ci]
-            new_phi += float(h.sum())
+            hw = h * wv  # weighted cost (all-ones when weights is None)
+            new_phi += float(hw.sum())
             if sampling:
-                prob = np.minimum(l * h / max(phi, 1e-300), 1.0)
+                prob = np.minimum(l * hw / max(phi, 1e-300), 1.0)
                 hit = samp_rng.random(source.chunk_rows) < prob
                 hit[n_valid:] = False
                 for i in np.nonzero(hit)[0]:
@@ -345,14 +419,16 @@ def init_kmeans_parallel_streamed(
 
     # ownership pass: weight candidates, then host-side weighted k-means++
     cands_dev = jnp.asarray(cand_arr.astype(dtype))
-    weights = np.zeros((cand_arr.shape[0],), np.float64)
-    for chunk, n_valid in source:
-        w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
-        weights += np.asarray(
-            _chunk_ownership(jnp.asarray(np.asarray(chunk, dtype)), w, cands_dev)
+    own = np.zeros((cand_arr.shape[0],), np.float64)
+    for chunk, _, wv in _iter_weighted(source, weights, dtype):
+        own += np.asarray(
+            _chunk_ownership(
+                jnp.asarray(np.asarray(chunk, dtype)), jnp.asarray(wv),
+                cands_dev,
+            )
         )
-    (weights,) = _psum_host([weights])
-    return kmeans_ops._weighted_kmeans_pp(cand_arr, weights, k, final_rng)
+    (own,) = _psum_host([own])
+    return kmeans_ops._weighted_kmeans_pp(cand_arr, own, k, final_rng)
 
 
 # ---------------------------------------------------------------------------

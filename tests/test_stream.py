@@ -201,10 +201,47 @@ class TestStreamedEstimators:
         assert m.cluster_centers_.shape == (3, 5)
         assert np.isfinite(m.summary.training_cost)
 
-    def test_kmeans_streamed_rejects_weights(self, rng):
-        src = ChunkSource.from_array(rng.normal(size=(50, 3)))
-        with pytest.raises(ValueError, match="sample_weight"):
-            KMeans(k=2).fit(src, sample_weight=np.ones(50))
+    def test_kmeans_streamed_weighted_matches_in_memory(self, rng):
+        """sample_weight streams too (array or width-1 ChunkSource): the
+        streamed weighted fit matches the in-memory weighted fit at the
+        ops level (same init) and recovers weighted blob structure
+        end-to-end."""
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.ops import kmeans_ops, stream_ops
+
+        x = rng.normal(size=(400, 6)).astype(np.float32)
+        w = (rng.random(400) + 0.25).astype(np.float32)
+        init = x[rng.choice(400, 3, replace=False)]
+        c1, i1, t1, n1 = kmeans_ops.lloyd_run(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(init),
+            12, jnp.asarray(1e-6, jnp.float32),
+        )
+        src = ChunkSource.from_array(x, chunk_rows=128)
+        wsrc = ChunkSource.from_array(w.reshape(-1, 1), chunk_rows=128)
+        c2, i2, t2, n2 = stream_ops.lloyd_run_streamed(
+            src, init, 12, 1e-6, np.float32, weights=wsrc
+        )
+        assert int(i1) == int(i2)
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), atol=1e-4)
+        np.testing.assert_allclose(float(t1), float(t2), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(n1), np.asarray(n2), atol=1e-4)
+
+        # estimator path: weighted streamed vs weighted in-memory (k-means||
+        # init RNG differs — cost-based compare, survey §7.3)
+        m1 = KMeans(k=3, max_iter=20, seed=5).fit(src, sample_weight=w)
+        assert getattr(m1.summary, "streamed", False)
+        m2 = KMeans(k=3, max_iter=20, seed=5).fit(x, sample_weight=w)
+        assert m1.summary.training_cost <= m2.summary.training_cost * 1.5 + 1e-6
+
+    def test_kmeans_streamed_weight_mismatch_raises(self, rng):
+        src = ChunkSource.from_array(rng.normal(size=(50, 3)), chunk_rows=16)
+        bad = ChunkSource.from_array(np.ones((49, 1)), chunk_rows=16)
+        with pytest.raises(ValueError, match="rows"):
+            KMeans(k=2).fit(src, sample_weight=bad)
+        bad_chunk = ChunkSource.from_array(np.ones((50, 1)), chunk_rows=8)
+        with pytest.raises(ValueError, match="chunk_rows"):
+            KMeans(k=2).fit(src, sample_weight=bad_chunk)
 
     def test_kmeans_streamed_fallback_materializes(self, rng):
         set_config(device="cpu")
