@@ -463,7 +463,15 @@ class ClusteringEvaluator:
             a = d[rows, oi] * n_own / np.maximum(n_own - 1, 1)
             d[rows, oi] = np.inf
             b = d.min(axis=1)
-            s = np.where(n_own > 1, (b - a) / np.maximum(a, b), 0.0)
+            # s(i) = 0 when max(a, b) == 0 (coincident duplicate points):
+            # Spark/sklearn define the 0/0 case as 0, and without the guard
+            # the NaN would propagate into the mean.
+            denom = np.maximum(a, b)
+            s = np.where(
+                (n_own > 1) & (denom > 0),
+                (b - a) / np.where(denom > 0, denom, 1.0),
+                0.0,
+            )
             total += float(s.sum())
         return total / n
 

@@ -97,6 +97,10 @@ class ChunkSource:
         if self._n_rows is None:
             self._n_rows = total
         elif self._n_rows != total:
+            # In a multi-host streamed fit only the observing process sees
+            # this; stream_ops._PassGuard carries it to the next collective
+            # reduction so ALL ranks fail together instead of the peers
+            # hanging in process_allgather until the distributed timeout.
             raise ValueError(
                 f"source yielded {total} rows this pass but {self._n_rows} "
                 "before — streamed fits require a deterministic source"

@@ -270,10 +270,18 @@ class KMeans:
                 chunk_rows=source.chunk_rows,
             )
         # validate up front so BOTH branches (accelerated and fallback)
-        # reject malformed weight sources with a clear error
-        from oap_mllib_tpu.ops.stream_ops import _check_weight_source
+        # reject malformed weight sources with a clear error; the outcome
+        # is synced across ranks so a single bad shard fails the world
+        # together instead of leaving peers in process_allgather
+        if sample_weight is not None:
+            from oap_mllib_tpu.ops.stream_ops import (
+                _check_weight_source,
+                _checked_entry,
+            )
 
-        _check_weight_source(source, sample_weight)
+            _checked_entry(
+                lambda: _check_weight_source(source, sample_weight)
+            )
         guard_ok = self.distance_measure == "euclidean"
         accelerated = should_accelerate(
             "KMeans", guard_ok, reason=f"distance_measure={self.distance_measure}"

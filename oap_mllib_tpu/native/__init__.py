@@ -35,14 +35,16 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
+def _build(force: bool = False) -> bool:
+    """Run make; ``force`` rebuilds unconditionally (-B) for the stale-.so
+    retry.  The Makefile links to a temp file and renames it over the
+    target, so concurrent ranks sharing this checkout always dlopen a
+    complete .so (old or new), never a missing or half-written one."""
     try:
-        subprocess.run(
-            ["make", "-C", _HERE, "-j4"],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
+        cmd = ["make", "-C", _HERE, "-j4"]
+        if force:
+            cmd.insert(1, "-B")
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return os.path.exists(_SO_PATH)
     except (subprocess.SubprocessError, OSError) as e:
         log.info("native build failed (using NumPy fallbacks): %s", e)
@@ -132,16 +134,13 @@ def _load() -> Optional[ctypes.CDLL]:
                 _lib = _bind(lib)
                 return _lib
             except AttributeError as e:
-                # stale .so from before a symbol existed: delete it (make
-                # would otherwise see an up-to-date target), rebuild, and
-                # retry through a unique temp copy — dlopen caches the
-                # stale handle for the original path within this process
+                # stale .so from before a symbol existed: force-rebuild
+                # (make -B; never remove-then-rebuild — peers sharing this
+                # checkout must not see a missing .so) and retry through a
+                # unique temp copy — dlopen caches the stale handle for
+                # the original path within this process
                 if attempt == 0:
-                    try:
-                        os.remove(_SO_PATH)
-                    except OSError:
-                        pass
-                    if _build():
+                    if _build(force=True):
                         import shutil
                         import tempfile
 

@@ -92,38 +92,110 @@ def _world() -> int:
     return jax.process_count()
 
 
-def _psum_host(arrays):
+class _PassGuard:
+    """Capture a streaming-source error during a local pass so the next
+    cross-process reduction still runs on EVERY rank.
+
+    Without it, a rank whose source raises mid-pass (nondeterministic
+    source row-count mismatch, lockstep weight mismatch, IO error) exits
+    before its process_allgather while its peers are already blocked
+    inside theirs — the world hangs until the distributed timeout.  With
+    it, the erroring rank swallows the exception, reaches the reduction,
+    and the reduction gathers a 1-byte error flag alongside the data:
+    every rank then raises together (the local error is chained on the
+    rank that observed it).  Single-process, the original exception is
+    re-raised unchanged at the reduction.
+
+    Usage::
+
+        guard = _PassGuard()
+        with guard:
+            for chunk, n_valid in source: ...accumulate...
+        out = _psum_host([...], guard=guard)
+    """
+
+    def __init__(self):
+        self.err: Exception | None = None
+
+    def __enter__(self) -> "_PassGuard":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None and isinstance(exc, Exception):
+            self.err = exc
+            return True  # swallowed; the next reduction re-raises on ALL ranks
+        return False
+
+
+def _gather_with_guard(arrays, guard: "_PassGuard | None"):
+    """Shared core of _psum_host/_allgather_host: the x64-scoped
+    process_allgather, with the guard's error flag riding in front of the
+    payload so every rank fails together when any rank's pass failed.
+    Returns the per-rank stacked arrays (flag already checked+stripped);
+    None signals the single-process identity path (guard re-raised)."""
+    if _world() == 1:
+        if guard is not None and guard.err is not None:
+            raise guard.err
+        return None
+    from jax.experimental import multihost_utils
+
+    from oap_mllib_tpu.utils.timing import x64_scope
+
+    if guard is not None:
+        flag = np.asarray([0 if guard.err is None else 1], np.int64)
+        arrays = [flag] + arrays
+    with x64_scope(True):
+        gathered = multihost_utils.process_allgather(arrays)
+    if guard is not None:
+        if int(np.asarray(gathered[0]).sum()) > 0:
+            raise RuntimeError(
+                "streamed pass failed on at least one process"
+            ) from guard.err
+        gathered = gathered[1:]
+    return [np.asarray(g) for g in gathered]
+
+
+def _psum_host(arrays, guard: "_PassGuard | None" = None):
     """Sum each array across processes; identity single-process.  Returns
     np arrays, identical on every process.  The gather runs under an x64
     scope: process_allgather device_puts its payload, which would
     silently demote f64/i64 (row counts, reservoir state) when the
-    session default is x64-off."""
+    session default is x64-off.  ``guard``: see _PassGuard — when given,
+    an error flag rides the gather and all ranks fail together."""
     arrays = [np.asarray(a) for a in arrays]
-    if _world() == 1:
+    gathered = _gather_with_guard(arrays, guard)
+    if gathered is None:
         return arrays
-    from jax.experimental import multihost_utils
-
-    from oap_mllib_tpu.utils.timing import x64_scope
-
-    with x64_scope(True):
-        gathered = multihost_utils.process_allgather(arrays)
-    return [np.asarray(g).sum(axis=0) for g in gathered]
+    return [g.sum(axis=0) for g in gathered]
 
 
-def _allgather_host(arrays):
+def _allgather_host(arrays, guard: "_PassGuard | None" = None):
     """Gather each array across processes along a new leading (rank)
     axis; adds the axis single-process too (shape-stable callers).
-    x64 scope: see _psum_host."""
+    x64 scope and ``guard``: see _psum_host."""
     arrays = [np.asarray(a) for a in arrays]
-    if _world() == 1:
+    gathered = _gather_with_guard(arrays, guard)
+    if gathered is None:
         return [a[None] for a in arrays]
-    from jax.experimental import multihost_utils
+    return gathered
 
-    from oap_mllib_tpu.utils.timing import x64_scope
 
-    with x64_scope(True):
-        gathered = multihost_utils.process_allgather(arrays)
-    return [np.asarray(g) for g in gathered]
+def _checked_entry(validate) -> None:
+    """Run entry validation under a guard and sync the outcome across
+    ranks (one tiny scalar gather).  Without this, a rank whose
+    validation fails (e.g. a malformed per-rank weight shard) raises
+    before its first collective while peers with consistent shards
+    proceed into the pass and hang in process_allgather.
+
+    Callers skip this entirely for statically-infallible validations
+    (sample_weight=None) — the sync only pays for itself when the
+    validator can actually raise, and None-ness is assumed consistent
+    across ranks (passing a weight source on some ranks only is API
+    misuse outside this contract)."""
+    guard = _PassGuard()
+    with guard:
+        validate()
+    _psum_host([np.zeros((), np.int64)], guard=guard)
 
 
 # ---------------------------------------------------------------------------
@@ -174,13 +246,15 @@ def streamed_accumulate(
     sums = jnp.zeros((k, d), dtype)
     counts = jnp.zeros((k,), dtype)
     cost = jnp.zeros((), dtype)
-    for chunk, _, w in _iter_weighted(source, weights, dtype):
-        cj = jnp.asarray(np.asarray(chunk, dtype))
-        sums, counts, cost = _kmeans_chunk_accum(
-            sums, counts, cost, cj, jnp.asarray(w), centers, precision,
-            need_cost,
-        )
-    return _psum_host([sums, counts, cost])
+    guard = _PassGuard()
+    with guard:
+        for chunk, _, w in _iter_weighted(source, weights, dtype):
+            cj = jnp.asarray(np.asarray(chunk, dtype))
+            sums, counts, cost = _kmeans_chunk_accum(
+                sums, counts, cost, cj, jnp.asarray(w), centers, precision,
+                need_cost,
+            )
+    return _psum_host([sums, counts, cost], guard=guard)
 
 
 @jax.jit
@@ -201,7 +275,8 @@ def lloyd_run_streamed(
     host sync per iteration (the converged flag) instead of zero — the
     price of host-driven passes.  ``weights`` is an optional width-1
     ChunkSource walked in lockstep (per-row weights)."""
-    _check_weight_source(source, weights)
+    if weights is not None:
+        _checked_entry(lambda: _check_weight_source(source, weights))
     centers = jnp.asarray(np.asarray(init_centers, dtype))
     tol_sq = float(tol) ** 2
     n_iter = 0
@@ -239,26 +314,31 @@ def reservoir_sample(source: ChunkSource, k: int, seed: int) -> np.ndarray:
     rng = np.random.default_rng(seed)
     sample: List[np.ndarray] = []
     seen = 0
-    for chunk, n_valid in source:
-        start = 0
-        if len(sample) < k:  # head-fill straight into the reservoir
-            take = min(k - len(sample), n_valid)
-            sample.extend(chunk[i].copy() for i in range(take))
-            start = take
-        if start < n_valid:
-            # row at global index g replaces slot j ~ U[0, g] iff j < k
-            highs = np.arange(seen + start + 1, seen + n_valid + 1)
-            j = rng.integers(0, highs)  # vectorized per-row draws
-            for i in np.nonzero(j < k)[0]:  # sparse hits only
-                sample[j[i]] = chunk[start + i].copy()
-        seen += n_valid
+    guard = _PassGuard()
+    with guard:
+        for chunk, n_valid in source:
+            start = 0
+            if len(sample) < k:  # head-fill straight into the reservoir
+                take = min(k - len(sample), n_valid)
+                sample.extend(chunk[i].copy() for i in range(take))
+                start = take
+            if start < n_valid:
+                # row at global index g replaces slot j ~ U[0, g] iff j < k
+                highs = np.arange(seen + start + 1, seen + n_valid + 1)
+                j = rng.integers(0, highs)  # vectorized per-row draws
+                for i in np.nonzero(j < k)[0]:  # sparse hits only
+                    sample[j[i]] = chunk[start + i].copy()
+            seen += n_valid
+    if guard.err is not None and _world() == 1:
+        raise guard.err
     if _world() > 1:
         d = source.n_features
         local = np.zeros((k, d))
         if sample:
             local[: len(sample)] = np.stack(sample)
         rows_g, nv_g, seen_g = _allgather_host(
-            [local, np.asarray([len(sample)]), np.asarray([seen])]
+            [local, np.asarray([len(sample)]), np.asarray([seen])],
+            guard=guard,
         )
         rows = rows_g.reshape(-1, d)  # (nproc*k, d), rank-major
         nv = nv_g.ravel()
@@ -332,7 +412,8 @@ def init_kmeans_parallel_streamed(
     in lockstep — they scale the sampling cost (phi = sum w*dmin, like
     the in-memory version's weighted _pll_round) and the candidate
     ownership."""
-    _check_weight_source(source, weights)
+    if weights is not None:
+        _checked_entry(lambda: _check_weight_source(source, weights))
     d = source.n_features
     l = 2.0 * k
     cap = 4 * k  # per-round candidate block (2x expected picks)
@@ -360,34 +441,38 @@ def init_kmeans_parallel_streamed(
         )
         picks: List[np.ndarray] = []
         new_phi = 0.0
-        for ci, (chunk, n_valid, wv) in enumerate(
-            _iter_weighted(source, weights, dtype)
-        ):
-            if cands_dev is not None:
-                prev = (
-                    jnp.asarray(dmin_chunks[ci])
-                    if rnd > 0
-                    else jnp.full((source.chunk_rows,), np.inf, dtype)
-                )
-                h = np.array(  # writable host copy
-                    _chunk_min_d2(jnp.asarray(np.asarray(chunk, dtype)), prev, cands_dev)
-                )
-                h[n_valid:] = 0.0  # padded rows carry no cost
-                if rnd > 0:
-                    dmin_chunks[ci] = h
+        guard = _PassGuard()
+        with guard:
+            for ci, (chunk, n_valid, wv) in enumerate(
+                _iter_weighted(source, weights, dtype)
+            ):
+                if cands_dev is not None:
+                    prev = (
+                        jnp.asarray(dmin_chunks[ci])
+                        if rnd > 0
+                        else jnp.full((source.chunk_rows,), np.inf, dtype)
+                    )
+                    h = np.array(  # writable host copy
+                        _chunk_min_d2(
+                            jnp.asarray(np.asarray(chunk, dtype)), prev, cands_dev
+                        )
+                    )
+                    h[n_valid:] = 0.0  # padded rows carry no cost
+                    if rnd > 0:
+                        dmin_chunks[ci] = h
+                    else:
+                        dmin_chunks.append(h)
                 else:
-                    dmin_chunks.append(h)
-            else:
-                h = dmin_chunks[ci]
-            hw = h * wv  # weighted cost (all-ones when weights is None)
-            new_phi += float(hw.sum())
-            if sampling:
-                prob = np.minimum(l * hw / max(phi, 1e-300), 1.0)
-                hit = samp_rng.random(source.chunk_rows) < prob
-                hit[n_valid:] = False
-                for i in np.nonzero(hit)[0]:
-                    picks.append(chunk[i].copy())
-        (phi_arr,) = _psum_host([np.asarray([new_phi])])
+                    h = dmin_chunks[ci]
+                hw = h * wv  # weighted cost (all-ones when weights is None)
+                new_phi += float(hw.sum())
+                if sampling:
+                    prob = np.minimum(l * hw / max(phi, 1e-300), 1.0)
+                    hit = samp_rng.random(source.chunk_rows) < prob
+                    hit[n_valid:] = False
+                    for i in np.nonzero(hit)[0]:
+                        picks.append(chunk[i].copy())
+        (phi_arr,) = _psum_host([np.asarray([new_phi])], guard=guard)
         phi = float(phi_arr[0])
         if _world() > 1:
             # fixed-shape gather of each process's picks (rank-major, so
@@ -420,14 +505,16 @@ def init_kmeans_parallel_streamed(
     # ownership pass: weight candidates, then host-side weighted k-means++
     cands_dev = jnp.asarray(cand_arr.astype(dtype))
     own = np.zeros((cand_arr.shape[0],), np.float64)
-    for chunk, _, wv in _iter_weighted(source, weights, dtype):
-        own += np.asarray(
-            _chunk_ownership(
-                jnp.asarray(np.asarray(chunk, dtype)), jnp.asarray(wv),
-                cands_dev,
+    guard = _PassGuard()
+    with guard:
+        for chunk, _, wv in _iter_weighted(source, weights, dtype):
+            own += np.asarray(
+                _chunk_ownership(
+                    jnp.asarray(np.asarray(chunk, dtype)), jnp.asarray(wv),
+                    cands_dev,
+                )
             )
-        )
-    (own,) = _psum_host([own])
+    (own,) = _psum_host([own], guard=guard)
     return kmeans_ops._weighted_kmeans_pp(cand_arr, own, k, final_rng)
 
 
@@ -460,22 +547,26 @@ def covariance_streamed(
     d = source.n_features
     total = jnp.zeros((d,), dtype)
     n = 0
-    for chunk, n_valid in source:
-        w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
-        total = _colsum_chunk(total, jnp.asarray(np.asarray(chunk, dtype)), w)
-        n += n_valid
-    total, n_arr = _psum_host([total, np.asarray([n], np.int64)])
+    guard = _PassGuard()
+    with guard:
+        for chunk, n_valid in source:
+            w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
+            total = _colsum_chunk(total, jnp.asarray(np.asarray(chunk, dtype)), w)
+            n += n_valid
+    total, n_arr = _psum_host([total, np.asarray([n], np.int64)], guard=guard)
     n = int(n_arr[0])
     if n < 1:
         raise ValueError("empty source")
     mean = jnp.asarray(total.astype(dtype) / n)
     gram = jnp.zeros((d, d), dtype)
-    for chunk, n_valid in source:
-        w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
-        gram = _gram_chunk(
-            gram, jnp.asarray(np.asarray(chunk, dtype)), w, mean, precision
-        )
-    (gram,) = _psum_host([gram])
+    guard = _PassGuard()
+    with guard:
+        for chunk, n_valid in source:
+            w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
+            gram = _gram_chunk(
+                gram, jnp.asarray(np.asarray(chunk, dtype)), w, mean, precision
+            )
+    (gram,) = _psum_host([gram], guard=guard)
     cov = gram.astype(np.float64 if dtype == np.float64 else np.float32)
     cov = cov / max(n - 1.0, 1.0)
     cov = 0.5 * (cov + cov.T)

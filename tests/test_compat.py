@@ -320,6 +320,26 @@ class TestEvaluators:
         sil = ClusteringEvaluator().evaluate(model.transform({"features": x}))
         assert sil > 0.95  # tight, well-separated blobs
 
+    def test_clustering_evaluator_coincident_duplicates(self):
+        """a == b == 0 (duplicate points coincident with two cluster means)
+        defines s(i) = 0 (Spark/sklearn convention) — must not NaN."""
+        import warnings
+
+        from oap_mllib_tpu.compat import ClusteringEvaluator
+
+        # two clusters, each a pair of identical points at the same spot:
+        # within-cluster distance a = 0; and put both clusters at the SAME
+        # location so the between-cluster distance b = 0 too
+        x = np.zeros((4, 3))
+        labels = np.array([0, 0, 1, 1])
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # 0/0 would raise RuntimeWarning
+            got = ClusteringEvaluator().evaluate(
+                {"features": x, "prediction": labels}
+            )
+        assert np.isfinite(got)
+        assert got == 0.0
+
     def test_clustering_evaluator_validation(self):
         from oap_mllib_tpu.compat import ClusteringEvaluator
 

@@ -84,6 +84,49 @@ class TestChunkSource:
         with pytest.raises(ValueError, match="deterministic"):
             list(src)
 
+    def test_source_error_surfaces_through_streamed_pass(self, rng):
+        """A source that errors mid-fit must raise (via _PassGuard) out of
+        the streamed kernel, not be swallowed — single-process the
+        original exception type/message is preserved."""
+        from oap_mllib_tpu.ops import stream_ops
+
+        counts = iter([10, 9])  # pass 2 disagrees with pass 1
+
+        def gen():
+            yield np.zeros((next(counts), 3))
+
+        src = ChunkSource(gen, n_features=3, chunk_rows=8)
+        centers = np.zeros((2, 3), np.float32)
+        stream_ops.streamed_accumulate(  # pass 1 fixes n_rows=10
+            src, np.asarray(centers), np.float32, "highest", need_cost=False
+        )
+        with pytest.raises(ValueError, match="deterministic"):
+            stream_ops.streamed_accumulate(
+                src, np.asarray(centers), np.float32, "highest",
+                need_cost=False,
+            )
+
+    def test_pass_guard_reraises_at_reduction(self):
+        """_PassGuard swallows inside the with-block and the next
+        reduction re-raises — the mechanism that keeps multi-host ranks
+        from hanging in process_allgather when a peer's source fails."""
+        from oap_mllib_tpu.ops import stream_ops
+
+        guard = stream_ops._PassGuard()
+        with guard:
+            raise ValueError("boom mid-pass")
+        assert isinstance(guard.err, ValueError)
+        with pytest.raises(ValueError, match="boom mid-pass"):
+            stream_ops._psum_host([np.zeros(3)], guard=guard)
+        with pytest.raises(ValueError, match="boom mid-pass"):
+            stream_ops._allgather_host([np.zeros(3)], guard=guard)
+        # clean guard: reductions pass through untouched
+        ok = stream_ops._PassGuard()
+        with ok:
+            pass
+        (out,) = stream_ops._psum_host([np.ones(3)], guard=ok)
+        np.testing.assert_allclose(out, np.ones(3))
+
 
 class TestStreamedOps:
     def test_lloyd_streamed_matches_in_memory(self, rng):
