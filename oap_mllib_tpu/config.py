@@ -96,6 +96,17 @@ class Config:
     # layout.  Applies to both the single-device and the block-parallel
     # paths.
     als_kernel: str = "auto"
+    # ALS item-factor layout on the block-parallel path.  "replicated"
+    # keeps Y on every device and psums full (n_items, r, r+1) item
+    # partials each iteration — one collective, best at small n_items.
+    # "sharded" completes the 2-D user x item grid (the reference's
+    # per-rank transposed item blocks, ALSDALImpl.cpp:192-214,301-316):
+    # Y block-sharded over the data axis, per-iteration collectives are
+    # two factor all_gathers — ~(r+1)x less traffic — and the per-rank
+    # item partials and resident Y shrink world-fold.  "auto" shards once
+    # the replicated psum bytes/iteration exceed
+    # ops.als_block.ITEM_SHARD_AUTO_BYTES.
+    als_item_layout: str = "auto"
 
     @classmethod
     def from_env(cls) -> "Config":

@@ -55,32 +55,51 @@ class ALSModel:
     """
 
     def __init__(self, user_factors: Optional[np.ndarray],
-                 item_factors: np.ndarray,
+                 item_factors: Optional[np.ndarray],
                  summary: Optional[dict] = None, *,
-                 sharded_user: Optional[tuple] = None):
+                 sharded_user: Optional[tuple] = None,
+                 sharded_item: Optional[tuple] = None):
         if (user_factors is None) == (sharded_user is None):
             raise ValueError("pass exactly one of user_factors / sharded_user")
+        if (item_factors is None) == (sharded_item is None):
+            raise ValueError("pass exactly one of item_factors / sharded_item")
         self._user_factors = (
             None if user_factors is None else np.asarray(user_factors)
         )
-        # (x_blocks jax.Array (world*upb, r) block-sharded, offsets, upb)
+        self._item_factors = (
+            None if item_factors is None else np.asarray(item_factors)
+        )
+        # each: (blocks jax.Array (world*per, r) block-sharded, offsets, per)
         self._sharded_user = sharded_user
-        self.item_factors_ = np.asarray(item_factors)
+        self._sharded_item = sharded_item
         self.summary = summary or {}
 
     @property
     def user_factors_(self) -> np.ndarray:
         if self._user_factors is None:
-            self._user_factors = self._gather_user_factors()
+            self._user_factors = self._gather_blocks(self._sharded_user)
         return self._user_factors
 
-    def _gather_user_factors(self) -> np.ndarray:
-        """On-demand gather of the block-sharded user factors (collective
-        when the blocks span processes)."""
+    @property
+    def item_factors_(self) -> np.ndarray:
+        """Item factors; block-sharded fits (als_item_layout="sharded")
+        gather on first access — a COLLECTIVE in multi-process worlds,
+        same contract as user_factors_."""
+        if self._item_factors is None:
+            self._item_factors = self._gather_blocks(self._sharded_item)
+        return self._item_factors
+
+    @staticmethod
+    def _gather_blocks(shard: tuple) -> np.ndarray:
+        """On-demand gather of block-sharded factors (collective when the
+        blocks span processes).  ``shard`` = (blocks, offsets, per_block);
+        block b's real rows [offsets[b], offsets[b+1]) sit at padded rows
+        [b*per_block, ...) — the ALSResult cUserOffset bookkeeping of the
+        reference, ALSDALImpl.cpp:529-575."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        xb, offsets, upb = self._sharded_user
+        xb, offsets, per = shard
         if not xb.is_fully_addressable:
             mesh = xb.sharding.mesh
             xb = jax.jit(
@@ -92,12 +111,14 @@ class ALSModel:
         x = np.zeros((n, rank), np.float32)
         for b in range(len(offsets) - 1):
             lo, hi = int(offsets[b]), int(offsets[b + 1])
-            x[lo:hi] = xb[b * upb : b * upb + (hi - lo)]
+            x[lo:hi] = xb[b * per : b * per + (hi - lo)]
         return x
 
     @property
     def rank(self) -> int:
-        return self.item_factors_.shape[1]
+        if self._item_factors is not None:
+            return self._item_factors.shape[1]
+        return self._sharded_item[0].shape[1]
 
     def predict(self, users: np.ndarray, items: np.ndarray) -> np.ndarray:
         """Predicted preference/rating for (user, item) pairs
@@ -221,9 +242,10 @@ class ALS:
         # Block-layout hints (Spark ALS numUserBlocks/numItemBlocks,
         # reference ALS.scala:154-169).  Here the user-block count is the
         # mesh data-axis size (one block per device); num_user_blocks CAPS
-        # it in single-process worlds.  Item factors are replicated across
-        # the mesh (survey §2.5), so num_item_blocks has no layout effect;
-        # both requested values are recorded in the fit summary.
+        # it in single-process worlds.  The item side follows
+        # config.als_item_layout: "sharded" gives world item blocks (the
+        # 2-D grid), "replicated" one; num_item_blocks is recorded in the
+        # fit summary but the layout knob is the config field.
         self.num_user_blocks = num_user_blocks
         self.num_item_blocks = num_item_blocks
 
@@ -326,6 +348,9 @@ class ALS:
 
         from oap_mllib_tpu.parallel.mesh import get_mesh
 
+        from oap_mllib_tpu.ops.als_block import als_item_layout_cfg
+
+        als_item_layout_cfg()  # typo'd layout raises on every path
         mesh = get_mesh()
         world = mesh.shape[mesh.axis_names[0]]
         if (
@@ -436,13 +461,22 @@ class ALS:
         cfg = get_config()
         axis = cfg.data_axis
         world = mesh.shape[axis]
+        # item-factor layout: replicated-Y (one psum per item update) or
+        # the full 2-D grid (Y block-sharded, all_gather exchanges) —
+        # config knob + auto crossover, ops/als_block.py module notes
+        item_sharded = als_block.item_layout_sharded(n_items, self.rank, world)
         # grouped-vs-COO decided BEFORE the shuffle, from host bincounts of
         # the pre-shuffle edges: a COO decision pays neither the grouped
         # build nor the device->host pull of the shuffled blocks
         kernel = _als_kernel_cfg()
         sizes = None
         if kernel == "auto":
-            use_grouped, sizes = als_block.block_grouped_guard(
+            guard_fn = (
+                als_block.block_grouped_guard_2d
+                if item_sharded
+                else als_block.block_grouped_guard
+            )
+            use_grouped, sizes = guard_fn(
                 users, items, n_users, n_items, world
             )
         else:
@@ -451,15 +485,33 @@ class ALS:
             u_loc, i_glob, conf, valid, offsets, upb = als_block.prepare_block_inputs(
                 users, items, ratings, mesh, n_users
             )
+            item_shuffle = None
+            if item_sharded:
+                # second shuffle, by ITEM block: the transposed per-rank
+                # table of the reference (ALSDALImpl.cpp:192-214) as a
+                # role-swapped run of the same exchange
+                i_loc, u_glob, conf_i, valid_i, ioffsets, ipb = (
+                    als_block.prepare_block_inputs(
+                        items, users, ratings, mesh, n_items
+                    )
+                )
+                item_shuffle = (i_loc, u_glob, conf_i, valid_i)
             grouped = None
             if use_grouped:
                 # scatter-free grouped-edge layouts per rank (the one-time
                 # device->host pull of the shuffled blocks happens only on
                 # this branch; see als_ops grouped notes)
-                grouped = als_block.prepare_grouped_inputs(
-                    u_loc, i_glob, conf, valid, mesh, upb, n_items,
-                    sizes=sizes,
-                )
+                if item_sharded:
+                    grouped = als_block.prepare_grouped_inputs_2d(
+                        u_loc, i_glob, conf, valid,
+                        i_loc, u_glob, conf_i, valid_i,
+                        mesh, upb, ipb, sizes=sizes,
+                    )
+                else:
+                    grouped = als_block.prepare_grouped_inputs(
+                        u_loc, i_glob, conf, valid, mesh, upb, n_items,
+                        sizes=sizes,
+                    )
         with phase_timer(timings, "table_convert"):
             # block X init stays rank-local: each device's callback builds
             # ONLY its block's rows — from the user init if given, else
@@ -484,18 +536,53 @@ class ALS:
             x0_dev = jax.make_array_from_callback(
                 (world * upb, self.rank), sharding, x0_block
             )
-            y0_host = (
-                y0 if y0 is not None
-                else als_np.init_factors(n_items, self.rank, self.seed + 1)
-            )
-            y0_dev = jax.make_array_from_callback(
-                (n_items, self.rank), NamedSharding(mesh, P()),
-                lambda idx: y0_host[idx],
-            )
+            if item_sharded:
+                # Y block-sharded like X; real rows from the SAME
+                # position-addressable generator the replicated path
+                # seeds (bit-identical rows), padding zero — the zeros
+                # keep the psummed block Grams exact
+                def y0_block(idx):
+                    b = (idx[0].start or 0) // ipb
+                    lo, hi = int(ioffsets[b]), int(ioffsets[b + 1])
+                    blk = np.zeros((ipb, self.rank), np.float32)
+                    if y0 is not None:
+                        blk[: hi - lo] = y0[lo:hi]
+                    else:
+                        blk[: hi - lo] = als_np.init_factors_rows(
+                            lo, hi, self.rank, self.seed + 1
+                        )
+                    return blk
+
+                y0_dev = jax.make_array_from_callback(
+                    (world * ipb, self.rank), sharding, y0_block
+                )
+            else:
+                y0_host = (
+                    y0 if y0 is not None
+                    else als_np.init_factors(n_items, self.rank, self.seed + 1)
+                )
+                y0_dev = jax.make_array_from_callback(
+                    (n_items, self.rank), NamedSharding(mesh, P()),
+                    lambda idx: y0_host[idx],
+                )
         from oap_mllib_tpu.utils.profiling import maybe_trace
 
         with phase_timer(timings, "als_iterations"), maybe_trace():
-            if grouped is not None:
+            if item_sharded:
+                if grouped is not None:
+                    x_blocks, y = als_block.als_block_run_grouped_2d(
+                        grouped, x0_dev, y0_dev,
+                        self.max_iter, self.reg_param, self.alpha, mesh,
+                        implicit=self.implicit_prefs,
+                    )
+                else:
+                    x_blocks, y = als_block.als_block_run_2d(
+                        u_loc, i_glob, conf, valid, *item_shuffle,
+                        x0_dev, y0_dev,
+                        self.max_iter, self.reg_param, self.alpha, mesh,
+                        implicit=self.implicit_prefs,
+                    )
+            elif grouped is not None:
                 x_blocks, y = als_block.als_block_run_grouped(
                     grouped, x0_dev, y0_dev,
                     self.max_iter, self.reg_param, self.alpha, mesh,
@@ -510,13 +597,22 @@ class ALS:
             jax.block_until_ready((x_blocks, y))
         # X stays block-sharded on device; the model gathers on demand
         # (offset bookkeeping ~ ALSResult cUserOffset/cItemOffset,
-        # ALSDALImpl.cpp:529-575). Y is replicated (np.asarray of a fully
-        # replicated array reads the local copy on every process).
+        # ALSDALImpl.cpp:529-575).  Y mirrors that when sharded; a
+        # replicated Y reads the local copy on every process.
+        summary = {
+            "timings": timings, "accelerated": True,
+            "block_parallel": True, "sharded_factors": True,
+            "als_kernel": "grouped" if grouped is not None else "coo",
+            "item_layout": "sharded" if item_sharded else "replicated",
+            **self._block_summary(world),
+        }
+        if item_sharded:
+            return ALSModel(
+                None, None, summary,
+                sharded_user=(x_blocks, np.asarray(offsets), upb),
+                sharded_item=(y, np.asarray(ioffsets), ipb),
+            )
         return ALSModel(
-            None, np.asarray(y),
-            {"timings": timings, "accelerated": True,
-             "block_parallel": True, "sharded_factors": True,
-             "als_kernel": "grouped" if grouped is not None else "coo",
-             **self._block_summary(world)},
+            None, np.asarray(y), summary,
             sharded_user=(x_blocks, np.asarray(offsets), upb),
         )

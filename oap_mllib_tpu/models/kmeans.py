@@ -327,12 +327,12 @@ class KMeans:
             else:
                 centers0 = stream_ops.init_kmeans_parallel_streamed(
                     source, self.k, self.seed, self.init_steps, dtype,
-                    weights=sample_weight,
+                    weights=sample_weight, validated=True,
                 )
         with phase_timer(timings, "lloyd_loop"):
             centers, n_iter, cost, counts = stream_ops.lloyd_run_streamed(
                 source, centers0, self.max_iter, self.tol, dtype,
-                cfg.matmul_precision, weights=sample_weight,
+                cfg.matmul_precision, weights=sample_weight, validated=True,
             )
         summary = KMeansSummary(
             float(cost), int(n_iter), timings, accelerated=True,

@@ -11,18 +11,29 @@ scheme (native/ALSDALImpl.cpp):
 - User factors X are sharded by the same blocks: the user update is fully
   local — each rank solves only its users (reference step3/step4Local,
   ALSDALImpl.cpp:283-316), zero communication.
-- Item factors Y are replicated.  The item update computes per-rank
-  partial normal equations (A_i, b_i) for ALL items from local edges,
-  then one ``psum`` over the mesh — collapsing the reference's
-  gather -> step2Master -> broadcast -> all2all chain
-  (ALSDALImpl.cpp:336-431, 4 collective rounds per half-iteration) into a
-  single ICI allreduce.
-- The Gram matrix Y^T Y is computed redundantly per rank (r x r, trivial);
-  X^T X needs one psum because X is sharded.
+Two item-factor layouts (config ``als_item_layout``):
 
-Cost model per iteration: psum traffic = n_items * r * (r + 1) floats
-(the reference moves the same magnitude through gather+bcast+all2all,
-serialized through a root rank; here it rides ICI as one fused collective).
+- **replicated** (small n_items): Y lives on every device.  The item
+  update computes per-rank partial normal equations (A_i, b_i) for ALL
+  items from local edges, then one ``psum`` over the mesh — collapsing
+  the reference's gather -> step2Master -> broadcast -> all2all chain
+  (ALSDALImpl.cpp:336-431, 4 collective rounds per half-iteration) into a
+  single ICI allreduce.  Cost per iteration: psum traffic
+  ~2 * n_items * r * (r + 1) floats (allreduce = reduce-scatter +
+  all-gather), transient per-device partials O(n_items * r^2).
+- **sharded** (the full 2-D user x item grid, the reference's per-rank
+  transposed item blocks — ALSDALImpl.cpp:192-214 builds an item-major
+  CSR per rank, computeStep4Local:301-316 solves only that rank's item
+  partition): edges are shuffled a SECOND time by item block, Y is
+  block-sharded like X, and each half-iteration all_gathers the other
+  side's factors instead of psumming full item partials.  Cost per
+  iteration: all_gather traffic ~(n_users + n_items) * r floats —
+  ~(r + 1)x less than replicated — and both the per-rank item partials
+  and resident Y shrink world-fold.  Prep pays a second shuffle +
+  grouped build.
+
+- The Gram matrices (r x r) cost one psum each in the sharded layout
+  (both sides block-sharded); replicated needs it only for X^T X.
 """
 
 from __future__ import annotations
@@ -45,6 +56,38 @@ from oap_mllib_tpu.ops.als_ops import (
     normal_eq_partials,
     normal_eq_partials_grouped,
 )
+
+
+# Auto-crossover for als_item_layout="auto": the replicated layout
+# allreduces ~2 * n_items * r * (r+1) * 4 bytes per iteration AND holds a
+# transient (n_items, r, r) partial per device; the sharded layout
+# replaces both with two factor all_gathers at the price of a second
+# shuffle + grouped build at fit time.  Shard once the per-iteration
+# replicated psum payload (n_items * r * (r+1) * 4 bytes) crosses this
+# bound — below it the psum is cheap and the replicated path's simpler
+# prep wins (ML-25M at r=10 is ~26 MB/iter: replicated).
+ITEM_SHARD_AUTO_BYTES = 1 << 27  # 128 MB
+
+
+def als_item_layout_cfg() -> str:
+    """Validated Config.als_item_layout.  Called on EVERY accelerated
+    dispatch — single-device included, where the knob has no layout
+    effect — so a typo raises everywhere, matching the als_kernel
+    contract (it must not surface only once deployed to a mesh)."""
+    layout = get_config().als_item_layout
+    if layout not in ("auto", "replicated", "sharded"):
+        raise ValueError(
+            f"als_item_layout must be auto|replicated|sharded, got {layout!r}"
+        )
+    return layout
+
+
+def item_layout_sharded(n_items: int, r: int, world: int) -> bool:
+    """Resolve config.als_item_layout to a concrete layout decision."""
+    layout = als_item_layout_cfg()
+    if layout != "auto":
+        return layout == "sharded"
+    return world > 1 and n_items * r * (r + 1) * 4 > ITEM_SHARD_AUTO_BYTES
 
 
 def _block_body(user_partials, item_partials, reg, implicit, axis, eye):
@@ -76,6 +119,47 @@ def _block_body(user_partials, item_partials, reg, implicit, axis, eye):
             a_i = gram_x[None] + a_i
         y = masked_solve(a_i, b_i, n_i).astype(y.dtype)
         return (x_blk, y), None
+
+    return body
+
+
+def _block_body_2d(user_partials, item_partials, reg, implicit, axis, eye):
+    """One alternating iteration of the fully-sharded 2-D layout: BOTH
+    factor matrices block-sharded.  Each half-iteration all_gathers the
+    other side's factors (tiled, so the gathered array IS the padded
+    global layout — see the prepare_* identity-mapping note), builds
+    partials only for this rank's destinations, and solves locally — the
+    reference's computeStep4Local (ALSDALImpl.cpp:301-316) with the
+    4-collective exchange chain replaced by one all_gather.  The implicit
+    Gram needs a psum on both sides now (each side holds only its block;
+    padded rows are zero so the psum of block Grams is the exact Gram).
+
+    ``user_partials(y_full)`` -> (A, b, n) for this rank's upb users;
+    ``item_partials(x_full)`` -> (A, b, n) for this rank's ipb items."""
+
+    def body(carry, _):
+        x_blk, y_blk = carry
+        y_full = lax.all_gather(y_blk, axis, tiled=True)
+        a_u, b_u, n_u = user_partials(y_full)
+        a_u = a_u + reg * n_u[:, None, None] * eye[None]
+        if implicit:
+            gram_y = lax.psum(
+                jnp.matmul(y_blk.T, y_blk, precision=lax.Precision.HIGHEST),
+                axis,
+            )
+            a_u = gram_y[None] + a_u
+        x_blk = masked_solve(a_u, b_u, n_u).astype(y_blk.dtype)
+        x_full = lax.all_gather(x_blk, axis, tiled=True)
+        a_i, b_i, n_i = item_partials(x_full)
+        a_i = a_i + reg * n_i[:, None, None] * eye[None]
+        if implicit:
+            gram_x = lax.psum(
+                jnp.matmul(x_blk.T, x_blk, precision=lax.Precision.HIGHEST),
+                axis,
+            )
+            a_i = gram_x[None] + a_i
+        y_blk = masked_solve(a_i, b_i, n_i).astype(y_blk.dtype)
+        return (x_blk, y_blk), None
 
     return body
 
@@ -229,10 +313,12 @@ def block_grouped_guard(
     p_u, p_i = _group_sizes(nnz_global, world, kpb, n_items)
     u = np.asarray(users, np.int64)
     it = np.asarray(items, np.int64)
-    pu_b = np.zeros((world,), np.int64)
+    # user side: a user's edges land in ONE block — shared ceil-padding
+    # accounting with the 2-D guard (one formula, both guards)
+    pu_b = _side_padded_per_block(u, kpb, world, p_u)
+    # item side (replicated layout): each item's edges SPLIT across user
+    # blocks, so the per-(block, item) pair counts pad independently
     pi_b = np.zeros((world,), np.int64)
-    ku, cu = np.unique(u, return_counts=True)  # a user's edges: one block
-    np.add.at(pu_b, np.minimum(ku // kpb, world - 1), (-(cu // -p_u)) * p_u)
     block = np.minimum(u // kpb, world - 1)
     ki, ci = np.unique(block * n_items + it, return_counts=True)
     np.add.at(pi_b, ki // n_items, (-(ci // -p_i)) * p_i)
@@ -406,6 +492,252 @@ def als_block_run_grouped(
     )
 
 
+def als_block_run_2d(
+    u_local: jax.Array,  # user-sharded copy: (world * epr,) LOCAL user ids
+    i_row: jax.Array,  # global item ids == padded-Y rows (identity mapping)
+    conf_u: jax.Array,
+    valid_u: jax.Array,
+    i_local: jax.Array,  # item-sharded copy: (world * epr2,) LOCAL item ids
+    u_row: jax.Array,  # global user ids == padded-X rows
+    conf_i: jax.Array,
+    valid_i: jax.Array,
+    x0: jax.Array,  # (world * upb, r) block-sharded user factors
+    y0: jax.Array,  # (world * ipb, r) block-sharded item factors
+    max_iter: int,
+    reg: float,
+    alpha: float,
+    mesh: Mesh,
+    *,
+    implicit: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """COO 2-D ALS: both factor sides block-sharded (see _block_body_2d).
+
+    Takes TWO shuffled edge copies — by user block (u_local local,
+    i_row global) and by item block (i_local local, u_row global); the
+    global ids index the all_gathered padded factor layouts directly
+    (prepare_block_inputs identity-mapping note)."""
+    cfg = get_config()
+    axis = cfg.data_axis
+    world = mesh.shape[axis]
+    upb = x0.shape[0] // world
+    ipb = y0.shape[0] // world
+    r = y0.shape[1]
+    eye = jnp.eye(r, dtype=y0.dtype)
+
+    def rank_program(ul, ir, cu, vu, il, ur, ci, vi, x_blk, y_blk):
+        body = _block_body_2d(
+            lambda y_full: normal_eq_partials(
+                ul, ir, cu, vu, y_full, upb, alpha, implicit
+            ),
+            lambda x_full: normal_eq_partials(
+                il, ur, ci, vi, x_full, ipb, alpha, implicit
+            ),
+            reg, implicit, axis, eye,
+        )
+        (x_blk, y_blk), _ = lax.scan(body, (x_blk, y_blk), None, length=max_iter)
+        return x_blk, y_blk
+
+    sh1 = P(axis)
+    sh2 = P(axis, None)
+    fn = jax.jit(
+        jax.shard_map(
+            rank_program,
+            mesh=mesh,
+            in_specs=(sh1,) * 8 + (sh2, sh2),
+            out_specs=(sh2, sh2),
+            check_vma=False,
+        )
+    )
+    return fn(
+        u_local, i_row, conf_u, valid_u, i_local, u_row, conf_i, valid_i,
+        x0, y0,
+    )
+
+
+def als_block_run_grouped_2d(
+    gb: GroupedBlocks,
+    x0: jax.Array,  # (world * upb, r) block-sharded user factors
+    y0: jax.Array,  # (world * ipb, r) block-sharded item factors
+    max_iter: int,
+    reg: float,
+    alpha: float,
+    mesh: Mesh,
+    *,
+    implicit: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Grouped-edge 2-D ALS: scatter-free partials on both block-sharded
+    sides.  ``gb`` comes from :func:`prepare_grouped_inputs_2d` — its
+    u_* arrays group the user-sharded edge copy by LOCAL user (src =
+    padded-Y rows) and its i_* arrays group the item-sharded copy by
+    LOCAL item (src = padded-X rows)."""
+    cfg = get_config()
+    axis = cfg.data_axis
+    world = mesh.shape[axis]
+    upb = x0.shape[0] // world
+    ipb = y0.shape[0] // world
+    r = y0.shape[1]
+    eye = jnp.eye(r, dtype=y0.dtype)
+
+    def rank_program(su, cu, vu, gu, si, ci, vi, gi, x_blk, y_blk):
+        body = _block_body_2d(
+            lambda y_full: normal_eq_partials_grouped(
+                su, cu, vu, gu, y_full, upb, alpha, implicit
+            ),
+            lambda x_full: normal_eq_partials_grouped(
+                si, ci, vi, gi, x_full, ipb, alpha, implicit
+            ),
+            reg, implicit, axis, eye,
+        )
+        (x_blk, y_blk), _ = lax.scan(body, (x_blk, y_blk), None, length=max_iter)
+        return x_blk, y_blk
+
+    sh2 = P(axis, None)
+    sh1 = P(axis)
+    fn = jax.jit(
+        jax.shard_map(
+            rank_program,
+            mesh=mesh,
+            in_specs=(sh2, sh2, sh2, sh1, sh2, sh2, sh2, sh1, sh2, sh2),
+            out_specs=(sh2, sh2),
+            check_vma=False,
+        )
+    )
+    return fn(
+        gb.u_src, gb.u_conf, gb.u_valid, gb.u_dst,
+        gb.i_src, gb.i_conf, gb.i_valid, gb.i_dst,
+        x0, y0,
+    )
+
+
+def _side_padded_per_block(ids: np.ndarray, kpb: int, world: int, p: int):
+    """(world,) padded edge totals one grouped side would realize, from
+    host degree counts alone — every id's edges land in ONE block (ids
+    are partitioned contiguously by ``kpb``), so the block's total is the
+    sum of per-id ceil-paddings."""
+    k, c = np.unique(np.asarray(ids, np.int64), return_counts=True)
+    out = np.zeros((world,), np.int64)
+    np.add.at(out, np.minimum(k // kpb, world - 1), (-(c // -p)) * p)
+    return out
+
+
+def block_grouped_guard_2d(
+    users: np.ndarray,
+    items: np.ndarray,
+    n_users: int,
+    n_items: int,
+    world: int,
+    max_blowup: float = GROUPED_MAX_BLOWUP,
+):
+    """Grouped-vs-COO decision for the 2-D sharded-item path.
+
+    Symmetric pricing: both sides are block-partitioned by id, so each
+    side's realized total is ``world * max_b (per-block padded sum)``
+    (rank group counts pad to the global max, exactly like the user side
+    of :func:`block_grouped_guard`).  Returns
+    ``(use_grouped, (p_u, p_i, nnz_global))`` for
+    :func:`prepare_grouped_inputs_2d`."""
+    nnz_global = int(_global_sum([len(users)])[0])
+    kpb_u = max(1, -(-n_users // world))
+    kpb_i = max(1, -(-n_items // world))
+    p_u, p_i = _group_sizes_2d(nnz_global, world, kpb_u, kpb_i)
+    pu_b = _global_sum(_side_padded_per_block(users, kpb_u, world, p_u))
+    pi_b = _global_sum(_side_padded_per_block(items, kpb_i, world, p_i))
+    total = world * (int(pu_b.max()) + int(pi_b.max()))
+    return total <= max_blowup * max(nnz_global, 1), (p_u, p_i, nnz_global)
+
+
+def _group_sizes_2d(nnz_global: int, world: int, upb: int, ipb: int):
+    """Group sizes for the 2-D layout.  Unlike the replicated layout
+    (whose item side spreads each item's edges over all ranks), both
+    sides here keep every destination's edges on one rank, so both size
+    from the GLOBAL mean degree."""
+    from oap_mllib_tpu.ops.als_ops import auto_group_size
+
+    p_u = auto_group_size(max(1, nnz_global), world * upb)
+    p_i = auto_group_size(max(1, nnz_global), world * ipb)
+    return p_u, p_i
+
+
+def prepare_grouped_inputs_2d(
+    u_local: jax.Array,
+    i_row: jax.Array,
+    conf_u: jax.Array,
+    valid_u: jax.Array,
+    i_local: jax.Array,
+    u_row: jax.Array,
+    conf_i: jax.Array,
+    valid_i: jax.Array,
+    mesh: Mesh,
+    upb: int,
+    ipb: int,
+    *,
+    sizes=None,
+):
+    """Grouped-edge layouts for the 2-D path, one per shuffled copy:
+    by-LOCAL-user from the user-sharded copy (src = padded-Y rows) and
+    by-LOCAL-item from the item-sharded copy (src = padded-X rows) — the
+    reference's per-rank CSR + transposed-CSR pair (ALSDALImpl.cpp
+    :192-214) where, unlike :func:`prepare_grouped_inputs`, the item side
+    also covers only this rank's item partition.  Returns a
+    :class:`GroupedBlocks` for :func:`als_block_run_grouped_2d`."""
+    from oap_mllib_tpu.ops.als_ops import build_grouped_edges
+
+    cfg = get_config()
+    axis = cfg.data_axis
+    world = mesh.shape[axis]
+    ub = _host_blocks(u_local, world)
+    irb = _host_blocks(i_row, world)
+    cub = _host_blocks(conf_u, world)
+    vub = _host_blocks(valid_u, world)
+    ib = _host_blocks(i_local, world)
+    urb = _host_blocks(u_row, world)
+    cib = _host_blocks(conf_i, world)
+    vib = _host_blocks(valid_i, world)
+
+    if sizes is not None:
+        p_u, p_i, _ = sizes
+    else:
+        nnz_local = sum(int((vub[b] > 0).sum()) for b in vub)
+        nnz_global = int(_global_sum([nnz_local])[0])
+        p_u, p_i = _group_sizes_2d(nnz_global, world, upb, ipb)
+
+    by_user, by_item = {}, {}
+    for b in ub:
+        sel = vub[b] > 0
+        by_user[b] = build_grouped_edges(
+            ub[b][sel].astype(np.int64), irb[b][sel].astype(np.int64),
+            cub[b][sel].astype(np.float32), upb, p_u,
+        )
+        sel_i = vib[b] > 0
+        by_item[b] = build_grouped_edges(
+            ib[b][sel_i].astype(np.int64), urb[b][sel_i].astype(np.int64),
+            cib[b][sel_i].astype(np.float32), ipb, p_i,
+        )
+
+    gu_local = max(g[0].shape[0] for g in by_user.values())
+    hi_local = max(g[0].shape[0] for g in by_item.values())
+    gu, hi = (int(v) for v in _global_max([gu_local, hi_local]))
+
+    blocks = sorted(by_user)
+    u_pad = {b: _pad_groups(by_user[b], gu, upb) for b in blocks}
+    i_pad = {b: _pad_groups(by_item[b], hi, ipb) for b in blocks}
+    u_stack = [np.concatenate([u_pad[b][j] for b in blocks]) for j in range(4)]
+    i_stack = [np.concatenate([i_pad[b][j] for b in blocks]) for j in range(4)]
+
+    def place(local):
+        sharding = NamedSharding(mesh, P(axis, *([None] * (local.ndim - 1))))
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sharding, local)
+        return jax.device_put(local, sharding)
+
+    u_dev = [place(m) for m in u_stack]
+    i_dev = [place(m) for m in i_stack]
+    return GroupedBlocks(
+        u_src=u_dev[0], u_conf=u_dev[1], u_valid=u_dev[2], u_dst=u_dev[3],
+        i_src=i_dev[0], i_conf=i_dev[1], i_valid=i_dev[2], i_dst=i_dev[3],
+    )
+
+
 def prepare_block_inputs(
     users: np.ndarray,
     items: np.ndarray,
@@ -418,6 +750,14 @@ def prepare_block_inputs(
     Returns (u_local, i_global, conf, valid, offsets, upb) where the edge
     arrays are block-sharded over the mesh and user ids are local to each
     rank's block (padded user rows run to ``upb`` per rank).
+
+    Identity-mapping note (load-bearing for the 2-D layout): blocks are
+    contiguous id ranges of width kpb = ceil(n/world) and ``upb == kpb``
+    whenever world > 1, so a GLOBAL id g living in block b sits at padded
+    row ``b * upb + (g - b * kpb) == g`` of the block-stacked factor
+    array.  The 2-D runners exploit this: the OTHER side's global ids in
+    each edge copy index the all_gathered padded factors directly, no
+    remap tensor needed.
     """
     from oap_mllib_tpu.parallel.shuffle import exchange_ratings
 

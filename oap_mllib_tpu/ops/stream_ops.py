@@ -155,6 +155,25 @@ def _gather_with_guard(arrays, guard: "_PassGuard | None"):
     return [np.asarray(g) for g in gathered]
 
 
+def _materialize(arrays, guard: "_PassGuard | None"):
+    """Fetch accumulators to host np arrays, under the guard: the
+    np.asarray of an async device computation is where a rank-local XLA
+    error (e.g. RESOURCE_EXHAUSTED mid-fit on one host) surfaces, and it
+    must reach the collective like a source error — not strand peers in
+    process_allgather.  On a failed fetch the payload is replaced by
+    zeros of the same shapes (rank-consistent gather payloads are a
+    collective requirement; the riding error flag aborts the world
+    before anyone consumes them)."""
+    if guard is not None:
+        with guard:
+            return [np.asarray(a) for a in arrays]
+        return [
+            np.zeros(np.shape(a), getattr(a, "dtype", np.float64))
+            for a in arrays
+        ]
+    return [np.asarray(a) for a in arrays]
+
+
 def _psum_host(arrays, guard: "_PassGuard | None" = None):
     """Sum each array across processes; identity single-process.  Returns
     np arrays, identical on every process.  The gather runs under an x64
@@ -162,7 +181,7 @@ def _psum_host(arrays, guard: "_PassGuard | None" = None):
     silently demote f64/i64 (row counts, reservoir state) when the
     session default is x64-off.  ``guard``: see _PassGuard — when given,
     an error flag rides the gather and all ranks fail together."""
-    arrays = [np.asarray(a) for a in arrays]
+    arrays = _materialize(arrays, guard)
     gathered = _gather_with_guard(arrays, guard)
     if gathered is None:
         return arrays
@@ -173,7 +192,7 @@ def _allgather_host(arrays, guard: "_PassGuard | None" = None):
     """Gather each array across processes along a new leading (rank)
     axis; adds the axis single-process too (shape-stable callers).
     x64 scope and ``guard``: see _psum_host."""
-    arrays = [np.asarray(a) for a in arrays]
+    arrays = _materialize(arrays, guard)
     gathered = _gather_with_guard(arrays, guard)
     if gathered is None:
         return [a[None] for a in arrays]
@@ -267,15 +286,18 @@ def _center_update(centers, sums, counts):
 
 def lloyd_run_streamed(
     source: ChunkSource, init_centers: np.ndarray, max_iter: int, tol: float,
-    dtype, precision: str = "highest", weights=None,
+    dtype, precision: str = "highest", weights=None, validated: bool = False,
 ):
     """Streamed Lloyd loop; same return contract as kmeans_ops.lloyd_run:
     (centers, n_iter, cost, counts).  Convergence semantics match
     _lloyd_loop (every center's squared move <= tol^2, or max_iter); one
     host sync per iteration (the converged flag) instead of zero — the
     price of host-driven passes.  ``weights`` is an optional width-1
-    ChunkSource walked in lockstep (per-row weights)."""
-    if weights is not None:
+    ChunkSource walked in lockstep (per-row weights); ``validated``
+    skips the entry validation + its cross-rank sync when the caller
+    (KMeans._fit_source) already ran it — the sync is one collective per
+    call and must not triple up inside a single fit."""
+    if weights is not None and not validated:
         _checked_entry(lambda: _check_weight_source(source, weights))
     centers = jnp.asarray(np.asarray(init_centers, dtype))
     tol_sq = float(tol) ** 2
@@ -391,7 +413,7 @@ def _pad_cands(cands: np.ndarray, cap: int, d: int) -> np.ndarray:
 
 def init_kmeans_parallel_streamed(
     source: ChunkSource, k: int, seed: int, init_steps: int, dtype,
-    weights=None,
+    weights=None, validated: bool = False,
 ) -> np.ndarray:
     """Streamed k-means|| (Bahmani), host-orchestrated.
 
@@ -411,8 +433,8 @@ def init_kmeans_parallel_streamed(
     ``weights``: optional width-1 ChunkSource of per-row weights, walked
     in lockstep — they scale the sampling cost (phi = sum w*dmin, like
     the in-memory version's weighted _pll_round) and the candidate
-    ownership."""
-    if weights is not None:
+    ownership.  ``validated``: see lloyd_run_streamed."""
+    if weights is not None and not validated:
         _checked_entry(lambda: _check_weight_source(source, weights))
     d = source.n_features
     l = 2.0 * k

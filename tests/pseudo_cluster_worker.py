@@ -124,6 +124,18 @@ for implicit, tag in ((True, "imp"), (False, "exp")):
     als_out[f"als_{tag}_uf"] = np.asarray(m_als.user_factors_).tolist()
     als_out[f"als_{tag}_if"] = np.asarray(m_als.item_factors_).tolist()
 
+# item-sharded 2-D layout across the real 2-process world: a second
+# shuffle by item block, Y block-sharded over the global mesh, all_gather
+# exchanges inside the scan, and the on-demand item-factor gather becomes
+# a COLLECTIVE (every rank touches item_factors_ together)
+set_config(als_item_layout="sharded")
+m_sh = ALS(rank=RANK, max_iter=3, reg_param=0.1, alpha=0.8,
+           implicit_prefs=True, seed=3).fit(au[sl], ai[sl], ar[sl])
+assert m_sh.summary["item_layout"] == "sharded"
+als_out["als_sh_uf"] = np.asarray(m_sh.user_factors_).tolist()
+als_out["als_sh_if"] = np.asarray(m_sh.item_factors_).tolist()
+set_config(als_item_layout="auto")
+
 print(
     "RESULT "
     + json.dumps(

@@ -215,6 +215,26 @@ class TestPseudoCluster:
                 atol=4e-3, rtol=4e-3,
             )
 
+    def test_als_item_sharded_matches_single_process(self, world_results):
+        """als_item_layout="sharded" across the real 2-process world: the
+        second (item-block) shuffle, the all_gather exchange loop, and
+        the collective item-factor gather must land on the same factors
+        as the single-process fit."""
+        from oap_mllib_tpu.models.als import ALS
+
+        u, i, r = _als_oracle_ratings()
+        oracle = ALS(rank=3, max_iter=3, reg_param=0.1, alpha=0.8,
+                     implicit_prefs=True, seed=3).fit(u, i, r)
+        for rank in (0, 1):
+            res = world_results[rank]
+            np.testing.assert_allclose(
+                res["als_sh_uf"], oracle.user_factors_, atol=4e-3, rtol=4e-3
+            )
+            np.testing.assert_allclose(
+                res["als_sh_if"], oracle.item_factors_, atol=4e-3, rtol=4e-3
+            )
+        assert world_results[0]["als_sh_if"] == world_results[1]["als_sh_if"]
+
     def test_streamed_kmeans_matches_single_process(self, world_results):
         """Each rank streams its local half as a ChunkSource; the
         host-mediated cross-process reductions must land on the same
