@@ -167,9 +167,45 @@ def bench_kmeans(precision="highest", cpu_ips=None, extra=None):
 # ---------------------------------------------------------------------------
 
 
+def _slope(run_with_reps, r1=1, target_delta=0.8, r2_cap=2048, reps=3):
+    """Per-op seconds via an in-jit repeat slope: (t(r2) - t(r1)) /
+    (r2 - r1) cancels the constant per-call tunnel dispatch+fetch
+    (~0.1-0.4 s) that a single-call wall would book against the kernel —
+    the same protocol as the K-Means kernel table.
+
+    Two hard-won constraints: the repeat count must be a RUNTIME loop
+    bound (lax.fori_loop), not a static scan length — eigh at d=2048
+    takes ~4 minutes to compile on this backend, so both window sizes
+    must share one executable — and the window must be WORK-CALIBRATED
+    (a quick probe sizes r2 so the delta is ~``target_delta`` seconds):
+    fixed small windows put ms-scale per-op deltas under the tunnel's
+    10-30 ms jitter and read as zero."""
+    run_with_reps(r1)  # one compile (dynamic trip count) + warm
+    t_r1 = _best_of(lambda: run_with_reps(r1), reps=2, warm=False)
+    probe_r = min(r2_cap, 4 * r1 + 8)
+    t_probe = _best_of(lambda: run_with_reps(probe_r), reps=2, warm=False)
+    per = max((t_probe - t_r1) / (probe_r - r1), 1e-5)
+    r2 = min(r2_cap, r1 + max(8, int(target_delta / per)))
+    # the probe's r1 samples count toward the final best-of (no reason to
+    # pay the ~0.1-0.4 s dispatch for duplicate r1 windows)
+    t1 = min(t_r1, _best_of(lambda: run_with_reps(r1), reps=1, warm=False))
+    t2 = _best_of(lambda: run_with_reps(r2), reps=reps, warm=False)
+    return max(t2 - t1, 1e-9) / (r2 - r1)
+
+
 def bench_pca(n=1 << 20, d=128):
+    """PCA with per-phase kernel attribution (VERDICT r3 item 2): the
+    covariance Gram and the eigh are slope-measured SEPARATELY inside
+    jitted repeat loops, so the recorded numbers are kernel times — the
+    round-3 single-wall figure at 1M x 128 was mostly the ~0.1-0.4 s
+    device-tunnel dispatch (the 33-GFLOP Gram is sub-ms of MXU time).
+    The end-to-end wall (one call incl. dispatch + fetch, what a remote
+    caller sees per fit) is still the headline value for continuity."""
+    import functools
+
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from oap_mllib_tpu.ops import pca_ops
 
@@ -185,8 +221,37 @@ def bench_pca(n=1 << 20, d=128):
         return np.asarray(vals)  # host fetch = sync
 
     dt = _best_of(run)
-    flops = 2 * n * d * d  # Gram matmul dominates
-    tflops = flops / dt / 1e12
+
+    # phase 1: covariance (two-pass centered Gram at HIGHEST).  The
+    # carry-perturbed mask (numerically nil) defeats loop-invariant code
+    # motion hoisting the otherwise-identical Gram out of the loop.
+    @functools.partial(jax.jit)
+    def cov_reps(xr, m, nr, reps):
+        def body(i, acc):
+            cov, _ = pca_ops.covariance(xr, m + acc[0, 0] * 1e-30, nr)
+            return acc + cov
+
+        return lax.fori_loop(
+            0, reps, body, jnp.zeros((d, d), xr.dtype)
+        )
+
+    cov_sec = _slope(lambda r: np.asarray(cov_reps(xj, mask, n_rows, r)))
+
+    # phase 2: eigh (the finalizeCompute analog), same protocol
+    cov0 = jax.device_put(pca_ops.covariance(xj, mask, n_rows)[0])
+
+    @functools.partial(jax.jit)
+    def eigh_reps(cov, reps):
+        def body(i, acc):
+            _, vecs = pca_ops.eigh_descending(cov + acc * 1e-30)
+            return acc + vecs
+
+        return lax.fori_loop(0, reps, body, jnp.zeros_like(cov))
+
+    eigh_sec = _slope(lambda r: np.asarray(eigh_reps(cov0, r)))
+
+    cov_flops = 2 * n * d * d  # centered Gram matmul (mean pass is O(nd))
+    cov_tflops = cov_flops / cov_sec / 1e12
 
     # NumPy f64 baseline: covariance on a subsample scaled linearly in n
     # (Gram is linear in n); eigh timed once at full size (it is O(d^3),
@@ -207,8 +272,11 @@ def bench_pca(n=1 << 20, d=128):
         dt,
         "sec",
         t_cpu / dt,
-        tflops=round(tflops, 1),
-        mfu=round(tflops * 1e12 / _peak_flops(), 3),
+        cov_sec=round(cov_sec, 5),
+        eigh_sec=round(eigh_sec, 5),
+        dispatch_sec=round(max(dt - cov_sec - eigh_sec, 0.0), 4),
+        cov_tflops=round(cov_tflops, 1),
+        cov_mfu=round(cov_tflops * 1e12 / _peak_flops(), 3),
     )
     return dt
 

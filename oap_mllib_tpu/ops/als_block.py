@@ -359,6 +359,58 @@ def _pad_groups(grouped, g_max: int, n_dst: int):
     return src_g, conf_g, valid_g, gdst
 
 
+def _build_grouped_side(dst_b, src_b, conf_b, valid_b, n_dst: int, p: int):
+    """Per-rank grouped layouts for ONE side: {block: grouped tuple}.
+    Shared by the 1-D and 2-D preps so the build semantics cannot
+    diverge between the replicated and sharded item layouts."""
+    from oap_mllib_tpu.ops.als_ops import build_grouped_edges
+
+    out = {}
+    for b in dst_b:
+        sel = valid_b[b] > 0
+        out[b] = build_grouped_edges(
+            dst_b[b][sel].astype(np.int64),
+            src_b[b][sel].astype(np.int64),
+            conf_b[b][sel].astype(np.float32),
+            n_dst, p,
+        )
+    return out
+
+
+def _pad_stack_place(by_user, by_item, u_ndst: int, i_ndst: int, mesh: Mesh):
+    """Shared tail of both grouped preps: pad every rank to the GLOBAL
+    max group counts (one allgather covers both sides), stack rank-major,
+    and place block-sharded on the mesh."""
+    cfg = get_config()
+    axis = cfg.data_axis
+    gu_local = max(g[0].shape[0] for g in by_user.values())
+    hi_local = max(g[0].shape[0] for g in by_item.values())
+    gu, hi = (int(v) for v in _global_max([gu_local, hi_local]))
+
+    blocks = sorted(by_user)
+    u_pad = {b: _pad_groups(by_user[b], gu, u_ndst) for b in blocks}
+    i_pad = {b: _pad_groups(by_item[b], hi, i_ndst) for b in blocks}
+    u_stack = [
+        np.concatenate([u_pad[b][j] for b in blocks]) for j in range(4)
+    ]
+    i_stack = [
+        np.concatenate([i_pad[b][j] for b in blocks]) for j in range(4)
+    ]
+
+    def place(local):
+        sharding = NamedSharding(mesh, P(axis, *([None] * (local.ndim - 1))))
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sharding, local)
+        return jax.device_put(local, sharding)
+
+    u_dev = [place(m) for m in u_stack]
+    i_dev = [place(m) for m in i_stack]
+    return GroupedBlocks(
+        u_src=u_dev[0], u_conf=u_dev[1], u_valid=u_dev[2], u_dst=u_dev[3],
+        i_src=i_dev[0], i_conf=i_dev[1], i_valid=i_dev[2], i_dst=i_dev[3],
+    )
+
+
 def prepare_grouped_inputs(
     u_local: jax.Array,
     i_global: jax.Array,
@@ -381,11 +433,8 @@ def prepare_grouped_inputs(
     are static across iterations, so this runs once per fit (same
     contract as the single-device grouped prep).
     """
-    from oap_mllib_tpu.ops.als_ops import build_grouped_edges
-
     cfg = get_config()
-    axis = cfg.data_axis
-    world = mesh.shape[axis]
+    world = mesh.shape[cfg.data_axis]
     ub = _host_blocks(u_local, world)
     ib = _host_blocks(i_global, world)
     cb = _host_blocks(conf, world)
@@ -400,41 +449,9 @@ def prepare_grouped_inputs(
         # identical static shapes
         p_u, p_i = _group_sizes(nnz_global, world, upb, n_items)
 
-    by_user, by_item = {}, {}
-    for b in ub:
-        sel = vb[b] > 0
-        uu = ub[b][sel].astype(np.int64)
-        ii = ib[b][sel].astype(np.int64)
-        rr = cb[b][sel].astype(np.float32)
-        by_user[b] = build_grouped_edges(uu, ii, rr, upb, p_u)
-        by_item[b] = build_grouped_edges(ii, uu, rr, n_items, p_i)
-
-    gu_local = max(g[0].shape[0] for g in by_user.values())
-    hi_local = max(g[0].shape[0] for g in by_item.values())
-    gu, hi = (int(v) for v in _global_max([gu_local, hi_local]))
-
-    blocks = sorted(by_user)
-    u_pad = {b: _pad_groups(by_user[b], gu, upb) for b in blocks}
-    i_pad = {b: _pad_groups(by_item[b], hi, n_items) for b in blocks}
-    u_stack = [
-        np.concatenate([u_pad[b][j] for b in blocks]) for j in range(4)
-    ]
-    i_stack = [
-        np.concatenate([i_pad[b][j] for b in blocks]) for j in range(4)
-    ]
-
-    def place(local):
-        sharding = NamedSharding(mesh, P(axis, *([None] * (local.ndim - 1))))
-        if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(sharding, local)
-        return jax.device_put(local, sharding)
-
-    u_dev = [place(m) for m in u_stack]
-    i_dev = [place(m) for m in i_stack]
-    return GroupedBlocks(
-        u_src=u_dev[0], u_conf=u_dev[1], u_valid=u_dev[2], u_dst=u_dev[3],
-        i_src=i_dev[0], i_conf=i_dev[1], i_valid=i_dev[2], i_dst=i_dev[3],
-    )
+    by_user = _build_grouped_side(ub, ib, cb, vb, upb, p_u)
+    by_item = _build_grouped_side(ib, ub, cb, vb, n_items, p_i)
+    return _pad_stack_place(by_user, by_item, upb, n_items, mesh)
 
 
 def als_block_run_grouped(
@@ -680,11 +697,8 @@ def prepare_grouped_inputs_2d(
     :192-214) where, unlike :func:`prepare_grouped_inputs`, the item side
     also covers only this rank's item partition.  Returns a
     :class:`GroupedBlocks` for :func:`als_block_run_grouped_2d`."""
-    from oap_mllib_tpu.ops.als_ops import build_grouped_edges
-
     cfg = get_config()
-    axis = cfg.data_axis
-    world = mesh.shape[axis]
+    world = mesh.shape[cfg.data_axis]
     ub = _host_blocks(u_local, world)
     irb = _host_blocks(i_row, world)
     cub = _host_blocks(conf_u, world)
@@ -701,41 +715,9 @@ def prepare_grouped_inputs_2d(
         nnz_global = int(_global_sum([nnz_local])[0])
         p_u, p_i = _group_sizes_2d(nnz_global, world, upb, ipb)
 
-    by_user, by_item = {}, {}
-    for b in ub:
-        sel = vub[b] > 0
-        by_user[b] = build_grouped_edges(
-            ub[b][sel].astype(np.int64), irb[b][sel].astype(np.int64),
-            cub[b][sel].astype(np.float32), upb, p_u,
-        )
-        sel_i = vib[b] > 0
-        by_item[b] = build_grouped_edges(
-            ib[b][sel_i].astype(np.int64), urb[b][sel_i].astype(np.int64),
-            cib[b][sel_i].astype(np.float32), ipb, p_i,
-        )
-
-    gu_local = max(g[0].shape[0] for g in by_user.values())
-    hi_local = max(g[0].shape[0] for g in by_item.values())
-    gu, hi = (int(v) for v in _global_max([gu_local, hi_local]))
-
-    blocks = sorted(by_user)
-    u_pad = {b: _pad_groups(by_user[b], gu, upb) for b in blocks}
-    i_pad = {b: _pad_groups(by_item[b], hi, ipb) for b in blocks}
-    u_stack = [np.concatenate([u_pad[b][j] for b in blocks]) for j in range(4)]
-    i_stack = [np.concatenate([i_pad[b][j] for b in blocks]) for j in range(4)]
-
-    def place(local):
-        sharding = NamedSharding(mesh, P(axis, *([None] * (local.ndim - 1))))
-        if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(sharding, local)
-        return jax.device_put(local, sharding)
-
-    u_dev = [place(m) for m in u_stack]
-    i_dev = [place(m) for m in i_stack]
-    return GroupedBlocks(
-        u_src=u_dev[0], u_conf=u_dev[1], u_valid=u_dev[2], u_dst=u_dev[3],
-        i_src=i_dev[0], i_conf=i_dev[1], i_valid=i_dev[2], i_dst=i_dev[3],
-    )
+    by_user = _build_grouped_side(ub, irb, cub, vub, upb, p_u)
+    by_item = _build_grouped_side(ib, urb, cib, vib, ipb, p_i)
+    return _pad_stack_place(by_user, by_item, upb, ipb, mesh)
 
 
 def prepare_block_inputs(
