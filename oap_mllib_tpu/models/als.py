@@ -190,6 +190,19 @@ class ALSModel:
         )
 
 
+def _grouped_ok_single(kernel: str, users, items, n_users: int,
+                       n_items: int) -> bool:
+    """Grouped-vs-COO decision for the single-device layouts — ONE
+    definition shared by the in-memory and streamed entries so the two
+    paths can never route the same data to different kernels."""
+    if kernel != "auto":
+        return kernel == "grouped"
+    padded_total = als_ops.grouped_padded_edges(
+        users, n_users
+    ) + als_ops.grouped_padded_edges(items, n_items)
+    return padded_total <= als_ops.GROUPED_MAX_BLOWUP * max(len(users), 1)
+
+
 def _als_kernel_cfg() -> str:
     """Validated Config.als_kernel — every dispatch site (single-device AND
     block-parallel) goes through this so a typo can never silently fall
@@ -251,9 +264,9 @@ class ALS:
 
     def fit(
         self,
-        users: np.ndarray,
-        items: np.ndarray,
-        ratings: np.ndarray,
+        users,
+        items: Optional[np.ndarray] = None,
+        ratings: Optional[np.ndarray] = None,
         n_users: Optional[int] = None,
         n_items: Optional[int] = None,
         init: Optional[tuple] = None,
@@ -269,44 +282,29 @@ class ALS:
         process's LOCAL shard (the per-rank partitions of the reference's
         shuffle, ALSDALImpl.scala:95-109); n_users/n_items are resolved
         globally via allgathered maxima when not passed.
+
+        Out-of-core: ``users`` may instead be a width-3
+        :class:`~oap_mllib_tpu.data.stream.ChunkSource` of (user, item,
+        rating) rows (``items``/``ratings`` omitted) — the fit then keeps
+        device memory bounded by O(chunk + factors + moments) instead of
+        holding the full grouped edge layouts in HBM (the K-Means/PCA
+        streaming axis, extended to the hardest estimator;
+        ops/als_stream.py).  Ids ride f64 chunks exactly (<= 2^53).
         """
-        users = np.asarray(users, dtype=np.int64)
-        items = np.asarray(items, dtype=np.int64)
-        ratings = np.asarray(ratings, dtype=np.float32)
-        if not (len(users) == len(items) == len(ratings)):
-            raise ValueError("users/items/ratings must have equal length")
-        if len(users) == 0:
-            raise ValueError("empty ratings")
-        if users.min() < 0 or items.min() < 0:
-            raise ValueError("ids must be non-negative")
-        import jax as _jax
+        from oap_mllib_tpu.data.stream import ChunkSource
 
-        if _jax.process_count() > 1:
-            # global id space = allgathered max (the reference computes
-            # nUsers/nItems via RDD max jobs, ALSDALImpl.scala:62-70)
-            from jax.experimental import multihost_utils
-
-            maxes = np.asarray(
-                multihost_utils.process_allgather(
-                    np.asarray([users.max(), items.max()], np.int64)
+        if isinstance(users, ChunkSource):
+            if items is not None or ratings is not None:
+                raise ValueError(
+                    "pass EITHER a triples ChunkSource OR explicit "
+                    "users/items/ratings arrays"
                 )
-            ).reshape(-1, 2)
-            if n_users is None:
-                n_users = int(maxes[:, 0].max()) + 1
-            if n_items is None:
-                n_items = int(maxes[:, 1].max()) + 1
-        if n_users is None:
-            n_users = int(users.max()) + 1
-        elif int(users.max()) >= n_users:
-            raise ValueError(
-                f"user id {int(users.max())} out of range for n_users={n_users}"
-            )
-        if n_items is None:
-            n_items = int(items.max()) + 1
-        elif int(items.max()) >= n_items:
-            raise ValueError(
-                f"item id {int(items.max())} out of range for n_items={n_items}"
-            )
+            return self._fit_source(users, n_users, n_items, init)
+        if items is None or ratings is None:
+            raise TypeError("fit needs items and ratings arrays")
+        users, items, ratings, n_users, n_items = self._validate_resolve(
+            users, items, ratings, n_users, n_items
+        )
 
         # nonnegative uses the NNLS fallback path (the reference likewise
         # accelerates only the unconstrained implicit solver, ALS.scala:925)
@@ -389,15 +387,9 @@ class ALS:
             # so a "coo" decision must not pay for the build).
             nnz = len(users)
             kernel = _als_kernel_cfg()
-            if kernel == "auto":
-                padded_total = als_ops.grouped_padded_edges(
-                    users, n_users
-                ) + als_ops.grouped_padded_edges(items, n_items)
-                grouped_ok = (
-                    padded_total <= als_ops.GROUPED_MAX_BLOWUP * max(nnz, 1)
-                )
-            else:
-                grouped_ok = kernel == "grouped"
+            grouped_ok = _grouped_ok_single(
+                kernel, users, items, n_users, n_items
+            )
             if grouped_ok:
                 by_user = als_ops.build_grouped_edges(
                     users, items, ratings, n_users
@@ -438,6 +430,141 @@ class ALS:
             {"timings": timings, "accelerated": True,
              "als_kernel": "grouped" if grouped_ok else "coo",
              **self._block_summary(1)},
+        )
+
+    @staticmethod
+    def _validate_resolve(users, items, ratings, n_users, n_items):
+        """Shared triple validation + id-space resolution (array and
+        streamed entries).  Multi-process: global maxima by allgather
+        (the reference's RDD max jobs, ALSDALImpl.scala:62-70)."""
+        users = np.asarray(users, dtype=np.int64)
+        items = np.asarray(items, dtype=np.int64)
+        ratings = np.asarray(ratings, dtype=np.float32)
+        if not (len(users) == len(items) == len(ratings)):
+            raise ValueError("users/items/ratings must have equal length")
+        if len(users) == 0:
+            raise ValueError("empty ratings")
+        if users.min() < 0 or items.min() < 0:
+            raise ValueError("ids must be non-negative")
+        import jax as _jax
+
+        if _jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            maxes = np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray([users.max(), items.max()], np.int64)
+                )
+            ).reshape(-1, 2)
+            if n_users is None:
+                n_users = int(maxes[:, 0].max()) + 1
+            if n_items is None:
+                n_items = int(maxes[:, 1].max()) + 1
+        if n_users is None:
+            n_users = int(users.max()) + 1
+        elif int(users.max()) >= n_users:
+            raise ValueError(
+                f"user id {int(users.max())} out of range for n_users={n_users}"
+            )
+        if n_items is None:
+            n_items = int(items.max()) + 1
+        elif int(items.max()) >= n_items:
+            raise ValueError(
+                f"item id {int(items.max())} out of range for n_items={n_items}"
+            )
+        return users, items, ratings, n_users, n_items
+
+    def _fit_source(self, source, n_users, n_items, init) -> ALSModel:
+        """Out-of-core fit from a width-3 (user, item, rating) ChunkSource
+        (ops/als_stream.py).  The triples are ingested to host arrays —
+        host RAM is O(nnz), like the reference's executor partitions
+        (OneDAL.scala:92-166) — and the STREAMED property is device
+        memory: only one budget-bounded chunk of the grouped edge layouts
+        is resident per step, with factors staying on device.
+
+        Falls back to the standard in-memory fit when the streamed path
+        does not apply: fallback/nonnegative dispatch, multi-device or
+        multi-process worlds (the block path already shards HBM across
+        ranks), or a long-tail degree distribution the grouped guard
+        rejects (COO streaming would need a lane-padded (n_dst, r, r)
+        resident accumulator — the flat-moment trick is grouped-only)."""
+        import jax
+
+        if source.n_features != 3:
+            raise ValueError(
+                "ALS source must have width 3 (user, item, rating); "
+                f"got {source.n_features}"
+            )
+        us, its, rs = [], [], []
+        for chunk, n_valid in source:
+            us.append(np.asarray(chunk[:n_valid, 0], np.int64))
+            its.append(np.asarray(chunk[:n_valid, 1], np.int64))
+            rs.append(np.asarray(chunk[:n_valid, 2], np.float32))
+        users = np.concatenate(us) if us else np.zeros((0,), np.int64)
+        items = np.concatenate(its) if its else np.zeros((0,), np.int64)
+        ratings = np.concatenate(rs) if rs else np.zeros((0,), np.float32)
+
+        accelerated = should_accelerate(
+            "ALS", guard_ok=not self.nonnegative, reason="nonnegative=True"
+        )
+        multi = jax.process_count() > 1
+        if accelerated and not multi:
+            from oap_mllib_tpu.parallel.mesh import get_mesh
+
+            mesh = get_mesh()
+            world = mesh.shape[mesh.axis_names[0]]
+            if self.num_user_blocks is not None:
+                world = min(world, self.num_user_blocks)
+            multi = world > 1
+        if not accelerated or multi:
+            return self.fit(
+                users, items, ratings, n_users=n_users, n_items=n_items,
+                init=init,
+            )
+
+        from oap_mllib_tpu.ops.als_block import als_item_layout_cfg
+
+        als_item_layout_cfg()  # typo'd layout raises on every path
+        users, items, ratings, n_users, n_items = self._validate_resolve(
+            users, items, ratings, n_users, n_items
+        )
+        kernel = _als_kernel_cfg()
+        if not _grouped_ok_single(kernel, users, items, n_users, n_items):
+            # in-memory COO fallback (the guard re-runs inside fit — an
+            # O(nnz) native bincount, cheap next to the fit itself)
+            return self.fit(
+                users, items, ratings, n_users=n_users, n_items=n_items,
+                init=init,
+            )
+
+        from oap_mllib_tpu.ops import als_stream
+
+        timings = Timings()
+        if init is not None:
+            x0 = np.array(init[0], np.float32)
+            y0 = np.array(init[1], np.float32)
+        else:
+            x0 = als_np.init_factors(n_users, self.rank, self.seed)
+            y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
+        with phase_timer(timings, "table_convert"):
+            by_user = als_ops.build_grouped_edges(
+                users, items, ratings, n_users
+            )
+            by_item = als_ops.build_grouped_edges(
+                items, users, ratings, n_items
+            )
+        from oap_mllib_tpu.utils.profiling import maybe_trace
+
+        with phase_timer(timings, "als_iterations"), maybe_trace():
+            x, y = als_stream.als_run_streamed(
+                by_user, by_item, x0, y0, n_users, n_items,
+                self.max_iter, self.reg_param, self.alpha,
+                self.implicit_prefs,
+            )
+        return ALSModel(
+            x, y,
+            {"timings": timings, "accelerated": True, "streamed": True,
+             "als_kernel": "grouped", **self._block_summary(1)},
         )
 
     def _block_summary(self, effective_user_blocks: int) -> dict:

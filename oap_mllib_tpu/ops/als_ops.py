@@ -336,6 +336,42 @@ def _grouped_block_count(G: int, P: int, r: int) -> int:
     return n
 
 
+def grouped_block_moments(
+    src_b: jax.Array,  # (Gb, P) int32
+    conf_b: jax.Array,
+    valid_b: jax.Array,
+    src_factors: jax.Array,  # (n_src, r)
+    alpha,
+    implicit: bool,
+) -> jax.Array:
+    """(Gb, r+1, r+2) normal-equation moment matrices for one group
+    block — the MXU inner kernel shared by the in-memory grouped partials
+    (:func:`normal_eq_partials_grouped`) and the host-chunked streamed
+    accumulate (ops/als_stream.py), so the two paths cannot diverge in
+    the weighting math.  Layout note: the transposed gather keeps the big
+    static group width P on the 128-lane axis (see the grouped-path
+    module notes)."""
+    ys = src_factors.T[:, src_b]  # (r, Gb, P) transposed gather
+    if implicit:
+        a_w = alpha * jnp.abs(conf_b) * valid_b
+        pos = (conf_b > 0).astype(conf_b.dtype) * valid_b
+        b_w = (1.0 + alpha * jnp.abs(conf_b)) * pos
+        n_w = pos
+    else:
+        a_w = valid_b
+        b_w = conf_b * valid_b
+        n_w = valid_b
+    lhs = jnp.concatenate(
+        [ys, jnp.ones_like(conf_b)[None]], axis=0
+    )  # (r+1, Gb, P)
+    rhs = jnp.concatenate(
+        [ys * a_w[None], b_w[None], n_w[None]], axis=0
+    )  # (r+2, Gb, P)
+    return jnp.einsum(
+        "agp,bgp->gab", lhs, rhs, precision=lax.Precision.HIGHEST
+    )  # (Gb, r+1, r+2)  <- batched MXU, P-lane contraction
+
+
 def normal_eq_partials_grouped(
     src_g: jax.Array,  # (G, P) int32
     conf_g: jax.Array,  # (G, P) f32
@@ -367,26 +403,9 @@ def normal_eq_partials_grouped(
     G, P = src_g.shape
 
     def block_moments(src_b, conf_b, valid_b):
-        """(Gb, r+1, r+2) moment matrices for one group block."""
-        ys = src_factors.T[:, src_b]  # (r, Gb, P) transposed gather
-        if implicit:
-            a_w = alpha * jnp.abs(conf_b) * valid_b
-            pos = (conf_b > 0).astype(conf_b.dtype) * valid_b
-            b_w = (1.0 + alpha * jnp.abs(conf_b)) * pos
-            n_w = pos
-        else:
-            a_w = valid_b
-            b_w = conf_b * valid_b
-            n_w = valid_b
-        lhs = jnp.concatenate(
-            [ys, jnp.ones_like(conf_b)[None]], axis=0
-        )  # (r+1, Gb, P)
-        rhs = jnp.concatenate(
-            [ys * a_w[None], b_w[None], n_w[None]], axis=0
-        )  # (r+2, Gb, P)
-        return jnp.einsum(
-            "agp,bgp->gab", lhs, rhs, precision=lax.Precision.HIGHEST
-        )  # (Gb, r+1, r+2)  <- batched MXU, P-lane contraction
+        return grouped_block_moments(
+            src_b, conf_b, valid_b, src_factors, alpha, implicit
+        )
 
     blocks = _grouped_block_count(G, P, r)
     if blocks == 1:

@@ -1,0 +1,181 @@
+"""Streamed (bounded-HBM) ALS: host-chunked grouped-edge training.
+
+The in-memory grouped path (ops/als_ops.als_run_grouped) keeps BOTH
+grouped edge layouts resident in HBM for the whole fit — ~12 bytes x
+padded-nnz per side.  That is what bounded the round-3 single-chip proof
+to ML-25M.  This module is the ALS leg of the framework's out-of-core
+axis (survey §5; ops/stream_ops.py is the K-Means/PCA leg): the grouped
+layouts live in HOST memory and each half-iteration walks them through
+the device in fixed-shape group blocks, accumulating the per-destination
+normal-equation moments in a device-resident flat carry.  Peak HBM is
+O(chunk + factors + moments):
+
+- chunk: one (Gc, P) slice of each grouped array (~the same
+  _GROUPED_BUDGET_ELEMS bound the in-memory kernel uses for its scan
+  blocks — here it bounds the UPLOAD, not just the intermediates);
+- factors: (n_users + n_items) x r, resident across the fit;
+- moments: (n_dst, (r+1)(r+2)) flat — flat so the carry pads to lane
+  tiles once, not per (r+1, r+2) tile (als_ops grouped-path notes).
+
+The price is re-uploading the grouped edges every iteration (the
+streamed K-Means/PCA passes re-read their source per pass the same
+way); the win is that nnz is bounded by host RAM, not HBM.  Host memory
+is O(nnz) — the reference's executors hold their whole partition in RAM
+too (OneDAL.scala:92-166); the streaming axis here is host->device.
+
+Math parity: the per-chunk moment kernel IS the in-memory kernel
+(als_ops.grouped_block_moments), and the solve consumes the summed
+moments identically — streamed-vs-in-memory factors match to fp
+tolerance (chunked segment-sums only reorder the additions).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from oap_mllib_tpu.ops.als_ops import (
+    _GROUPED_BUDGET_ELEMS,
+    grouped_block_moments,
+    masked_solve,
+)
+
+
+def groups_per_chunk(P: int, r: int) -> int:
+    """Group rows per uploaded chunk, from the shared live-buffer budget
+    (charging XLA's 128-lane padding and the ~3 concurrently-live
+    (r+2)-deep intermediates, like als_ops._grouped_block_count)."""
+    lanes = max(P, 128)
+    return max(1, _GROUPED_BUDGET_ELEMS // (lanes * (r + 2) * 3))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_dst", "implicit"), donate_argnums=(0,)
+)
+def _accum_moments(
+    m_flat: jax.Array,  # (n_dst, (r+1)(r+2)) running moments (donated)
+    src_g: jax.Array,  # (Gc, P) int32
+    conf_g: jax.Array,
+    valid_g: jax.Array,
+    group_dst: jax.Array,  # (Gc,) int32, sorted
+    factors: jax.Array,  # (n_src, r) resident
+    alpha: jax.Array,
+    n_dst: int,
+    implicit: bool,
+) -> jax.Array:
+    m = grouped_block_moments(src_g, conf_g, valid_g, factors, alpha, implicit)
+    gb = m.shape[0]
+    width = m.shape[1] * m.shape[2]
+    return m_flat + jax.ops.segment_sum(
+        m.reshape(gb, width), group_dst, num_segments=n_dst,
+        indices_are_sorted=True,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("implicit",))
+def _solve_side(
+    m_flat: jax.Array, src_factors: jax.Array, reg: jax.Array, implicit: bool
+) -> jax.Array:
+    """Factors from the summed flat moments — identical consumption to
+    als_ops.als_run_grouped's half step (A + reg-scaled eye [+ Gram],
+    masked Cholesky solve)."""
+    r = src_factors.shape[1]
+    n_dst = m_flat.shape[0]
+    m = m_flat.reshape(n_dst, r + 1, r + 2)
+    a, b, n_reg = m[:, :r, :r], m[:, :r, r], m[:, r, r + 1]
+    eye = jnp.eye(r, dtype=src_factors.dtype)
+    a = a + reg * n_reg[:, None, None] * eye[None]
+    if implicit:
+        gram = jnp.matmul(
+            src_factors.T, src_factors, precision=lax.Precision.HIGHEST
+        )
+        a = gram[None] + a
+    return masked_solve(a, b, n_reg).astype(src_factors.dtype)
+
+
+def _pad_group_rows(grouped, multiple: int, n_dst: int):
+    """Pad a grouped layout's group count to a multiple of the chunk size
+    so every uploaded slice has the same static shape (one compile).
+    Padding groups carry valid=0 and dst = n_dst - 1 (keeps group_dst
+    sorted for the segment-sum's indices_are_sorted contract)."""
+    src_g, conf_g, valid_g, gdst = grouped
+    G, P = src_g.shape
+    pad = (-G) % multiple
+    if pad:
+        src_g = np.concatenate([src_g, np.zeros((pad, P), np.int32)])
+        conf_g = np.concatenate([conf_g, np.zeros((pad, P), np.float32)])
+        valid_g = np.concatenate([valid_g, np.zeros((pad, P), np.float32)])
+        gdst = np.concatenate([gdst, np.full((pad,), n_dst - 1, np.int32)])
+    return src_g, conf_g, valid_g, gdst
+
+
+def _half_update_streamed(
+    grouped_host, factors_dev: jax.Array, n_dst: int, gc: int, reg, alpha,
+    implicit: bool,
+) -> jax.Array:
+    """One side's update: walk the host-resident grouped layout (already
+    padded to a multiple of ``gc`` group rows) through the device in
+    chunks, then solve.  Returns the (n_dst, r) factors."""
+    r = factors_dev.shape[1]
+    src_g, conf_g, valid_g, gdst = grouped_host
+    width = (r + 1) * (r + 2)
+    m = jnp.zeros((n_dst, width), factors_dev.dtype)
+    alpha_j = jnp.asarray(alpha, factors_dev.dtype)
+    for lo in range(0, src_g.shape[0], gc):
+        sl = slice(lo, lo + gc)
+        m = _accum_moments(
+            m,
+            jnp.asarray(src_g[sl]),
+            jnp.asarray(conf_g[sl]),
+            jnp.asarray(valid_g[sl]),
+            jnp.asarray(gdst[sl]),
+            factors_dev,
+            alpha_j,
+            n_dst,
+            implicit,
+        )
+    return _solve_side(
+        m, factors_dev, jnp.asarray(reg, factors_dev.dtype), implicit
+    )
+
+
+def als_run_streamed(
+    by_user, by_item,  # host grouped layouts (src, conf, valid, dst)
+    x0: np.ndarray,
+    y0: np.ndarray,
+    n_users: int,
+    n_items: int,
+    max_iter: int,
+    reg: float,
+    alpha: float,
+    implicit: bool,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Full streamed ALS loop (both feedback modes), host-driven.
+
+    ``by_user``/``by_item`` are host grouped-edge layouts
+    (als_ops.build_grouped_edges outputs); factors stay device-resident
+    across iterations, edges are re-uploaded per half-iteration in
+    budget-bounded chunks.  Same alternating math as als_run_grouped.
+    Chunk padding is hoisted here, ONCE per side — padding inside the
+    half-update would re-copy the whole (possibly multi-GB) host layout
+    every iteration."""
+    r = np.asarray(x0).shape[1]
+    gc_u = groups_per_chunk(by_user[0].shape[1], r)
+    gc_i = groups_per_chunk(by_item[0].shape[1], r)
+    by_user = _pad_group_rows(by_user, gc_u, n_users)
+    by_item = _pad_group_rows(by_item, gc_i, n_items)
+    x = jnp.asarray(np.asarray(x0, np.float32))
+    y = jnp.asarray(np.asarray(y0, np.float32))
+    for _ in range(max_iter):
+        x = _half_update_streamed(
+            by_user, y, n_users, gc_u, reg, alpha, implicit
+        )
+        y = _half_update_streamed(
+            by_item, x, n_items, gc_i, reg, alpha, implicit
+        )
+    return np.asarray(x), np.asarray(y)

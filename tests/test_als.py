@@ -614,6 +614,138 @@ class TestItemSharded:
         np.testing.assert_allclose(model.item_factors_, oy, atol=2e-3, rtol=2e-3)
 
 
+class TestStreamedALS:
+    """Out-of-core ALS (ops/als_stream.py): a width-3 (user, item,
+    rating) ChunkSource fit must match the in-memory fit — same grouped
+    math, host-chunked device uploads.  The suite mesh has 8 devices, so
+    the single-device streamed path is pinned via num_user_blocks=1."""
+
+    def _triples_source(self, u, i, r, chunk_rows):
+        from oap_mllib_tpu.data.stream import ChunkSource
+
+        trip = np.stack(
+            [u.astype(np.float64), i.astype(np.float64),
+             r.astype(np.float64)], axis=1,
+        )
+        return ChunkSource.from_array(trip, chunk_rows=chunk_rows)
+
+    @pytest.mark.parametrize("implicit", [True, False])
+    def test_streamed_matches_in_memory(self, rng, implicit):
+        u, i, r, nu, ni = _ratings(rng, n_users=50, n_items=30)
+        x0 = init_factors(nu, 4, 1)
+        y0 = init_factors(ni, 4, 2)
+        kw = dict(rank=4, max_iter=3, reg_param=0.1, alpha=1.2,
+                  implicit_prefs=implicit, num_user_blocks=1)
+        m1 = ALS(**kw).fit(u, i, r, n_users=nu, n_items=ni, init=(x0, y0))
+        m2 = ALS(**kw).fit(
+            self._triples_source(u, i, r, 137),
+            n_users=nu, n_items=ni, init=(x0, y0),
+        )
+        assert m2.summary.get("streamed")
+        assert m2.summary["als_kernel"] == "grouped"
+        np.testing.assert_allclose(
+            m1.user_factors_, m2.user_factors_, atol=1e-4, rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            m1.item_factors_, m2.item_factors_, atol=1e-4, rtol=1e-4
+        )
+
+    def test_streamed_parity_fuzz(self, rng):
+        """Random shapes x chunkings (mirroring tests/test_stream.py's
+        streamed-vs-in-memory fuzz): every draw must match the in-memory
+        fit on the same init."""
+        for trial in range(4):
+            nu = int(rng.integers(5, 60))
+            ni = int(rng.integers(5, 50))
+            nnz = int(rng.integers(20, 800))
+            u = rng.integers(0, nu, nnz)
+            i = rng.integers(0, ni, nnz)
+            r = (rng.random(nnz) * 4 + 1).astype(np.float32)
+            chunk = int(rng.integers(8, 512))
+            implicit = bool(rng.integers(2))
+            x0 = init_factors(nu, 3, trial)
+            y0 = init_factors(ni, 3, trial + 100)
+            kw = dict(rank=3, max_iter=2, reg_param=0.15, alpha=0.7,
+                      implicit_prefs=implicit, num_user_blocks=1)
+            m1 = ALS(**kw).fit(u, i, r, n_users=nu, n_items=ni,
+                               init=(x0, y0))
+            m2 = ALS(**kw).fit(
+                self._triples_source(u, i, r, chunk),
+                n_users=nu, n_items=ni, init=(x0, y0),
+            )
+            np.testing.assert_allclose(
+                m1.user_factors_, m2.user_factors_, atol=1e-4, rtol=1e-4,
+                err_msg=f"trial {trial}: nu={nu} ni={ni} nnz={nnz} "
+                        f"chunk={chunk} implicit={implicit}",
+            )
+
+    def test_streamed_small_chunks_stress(self, rng):
+        """Chunk smaller than one group's width and a tiny upload budget
+        (monkeypatched groups_per_chunk) — many uploads per side."""
+        from oap_mllib_tpu.ops import als_stream
+
+        u, i, r, nu, ni = _ratings(rng, n_users=30, n_items=20)
+        x0 = init_factors(nu, 3, 1)
+        y0 = init_factors(ni, 3, 2)
+        kw = dict(rank=3, max_iter=2, num_user_blocks=1)
+        m1 = ALS(**kw).fit(u, i, r, n_users=nu, n_items=ni, init=(x0, y0))
+        orig = als_stream.groups_per_chunk
+        als_stream.groups_per_chunk = lambda P, r_: 2
+        try:
+            m2 = ALS(**kw).fit(
+                self._triples_source(u, i, r, 16),
+                n_users=nu, n_items=ni, init=(x0, y0),
+            )
+        finally:
+            als_stream.groups_per_chunk = orig
+        np.testing.assert_allclose(
+            m1.user_factors_, m2.user_factors_, atol=1e-4, rtol=1e-4
+        )
+
+    def test_streamed_delegates_to_block_path_on_mesh(self, rng):
+        """On the 8-device suite mesh the source fit materializes and
+        takes the block-parallel path (HBM is already sharded there)."""
+        u, i, r, nu, ni = _ratings(rng)
+        m = ALS(rank=3, max_iter=2).fit(
+            self._triples_source(u, i, r, 128), n_users=nu, n_items=ni
+        )
+        assert m.summary.get("block_parallel")
+        assert not m.summary.get("streamed")
+
+    def test_streamed_long_tail_delegates_to_coo(self, rng):
+        """Degree ~1: the grouped guard rejects, so the source fit falls
+        back to the in-memory COO programs (flat-moment streaming is
+        grouped-only) and still matches the oracle."""
+        nu = ni = 120
+        u = np.arange(nu, dtype=np.int64)
+        i = rng.permutation(ni).astype(np.int64)
+        r = rng.integers(1, 6, size=nu).astype(np.float32)
+        x0 = init_factors(nu, 3, 1)
+        y0 = init_factors(ni, 3, 2)
+        m = ALS(rank=3, max_iter=2, reg_param=0.1, num_user_blocks=1).fit(
+            self._triples_source(u, i, r, 64),
+            n_users=nu, n_items=ni, init=(x0, y0),
+        )
+        assert m.summary["als_kernel"] == "coo"
+        assert not m.summary.get("streamed")
+        ox, _ = _oracle_als(u, i, r, nu, ni, 3, 2, 0.1, 1.0, False, x0, y0)
+        np.testing.assert_allclose(m.user_factors_, ox, atol=2e-3, rtol=2e-3)
+
+    def test_source_width_validation(self, rng):
+        from oap_mllib_tpu.data.stream import ChunkSource
+
+        src = ChunkSource.from_array(np.zeros((10, 2)), chunk_rows=4)
+        with pytest.raises(ValueError, match="width 3"):
+            ALS(rank=3).fit(src)
+        with pytest.raises(ValueError, match="EITHER"):
+            ALS(rank=3).fit(
+                ChunkSource.from_array(np.zeros((10, 3)), chunk_rows=4),
+                np.zeros(3, np.int64), np.zeros(3, np.float32),
+            )
+        with pytest.raises(TypeError, match="items and ratings"):
+            ALS(rank=3).fit(np.zeros(3, np.int64))
+
+
 class TestNonnegative:
     def test_nonnegative_factors(self, rng):
         u, i, r, nu, ni = _ratings(rng)
