@@ -5,7 +5,8 @@ set -euo pipefail
 cd "$(dirname "$0")"
 
 for ex in kmeans_example.py pca_example.py als_example.py \
-          kmeans_compat_example.py pca_compat_example.py als_compat_example.py; do
+          kmeans_compat_example.py pca_compat_example.py als_compat_example.py \
+          als_pyspark_example.py; do
   echo "=== $ex ==="
   python "$ex" "$@"
   echo

@@ -1,0 +1,431 @@
+"""pyspark.ml-shaped estimators over REAL Spark DataFrames.
+
+The reference's core promise is *user code unmodified*: its Scala shims
+classpath-shadow ``org.apache.spark.ml`` so the stock PySpark examples
+run verbatim (reference examples/als-pyspark/als-pyspark.py:52-54,
+kmeans-pyspark.py, pca-pyspark.py).  Python has no classpath shadowing,
+so the drop-in point is the import line — change only
+
+    from pyspark.ml.recommendation import ALS
+    from pyspark.ml.clustering import KMeans
+    from pyspark.ml.feature import PCA
+    from pyspark.ml.evaluation import RegressionEvaluator, ClusteringEvaluator
+
+to
+
+    from oap_mllib_tpu.compat.pyspark import (
+        ALS, KMeans, PCA, RegressionEvaluator, ClusteringEvaluator)
+
+and the rest of the example runs unchanged: keyword constructors,
+builder setters, ``fit(dataframe)``, ``model.transform(dataframe)``
+returning a DataFrame with the prediction column appended, evaluators
+that consume that DataFrame.
+
+Scope (documented, deliberate): the data plane is DRIVER-COLLECT — the
+needed columns are collected to host NumPy and the TPU framework takes
+over from there (mesh sharding happens inside the estimators).  That
+matches this framework's design point (the device mesh replaces the
+executor fleet; survey §2.5): Spark is the front-end API, not the
+compute fabric.  A cluster-scale ingestion (mapPartitions into
+per-process shards feeding the multi-host fit) would slot in at
+``_features_matrix``/``_column`` without touching the estimator API.
+
+Availability: importing this module does NOT require pyspark — every
+DataFrame interaction goes through the duck-typed surface
+(``df.select(...).collect()``, ``df.columns``, ``df.sparkSession
+.createDataFrame(rows, schema)``), which is exactly what the contract
+tests mock (tests/test_pyspark_compat.py).  With pyspark installed, a
+real DataFrame satisfies the same surface; ``HAVE_PYSPARK`` reports
+which world you are in (output vectors use pyspark.ml.linalg when
+available, plain lists otherwise).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from oap_mllib_tpu.compat import spark as _compat
+
+try:  # optional: only used to emit real ml.linalg vectors from transform
+    from pyspark.ml.linalg import Vectors as _Vectors
+
+    HAVE_PYSPARK = True
+except ImportError:  # pragma: no cover - exercised when pyspark is absent
+    _Vectors = None
+    HAVE_PYSPARK = False
+
+
+# ---------------------------------------------------------------------------
+# DataFrame duck-typed surface
+# ---------------------------------------------------------------------------
+
+
+def _session_of(df):
+    """The DataFrame's session (``sparkSession`` on 3.3+, ``sql_ctx
+    .sparkSession`` on older lines)."""
+    spark = getattr(df, "sparkSession", None)
+    if spark is None:
+        spark = df.sql_ctx.sparkSession
+    return spark
+
+
+def _collect_once(df):
+    """ONE materializing action per adapter call: Spark does not
+    guarantee identical row order across separate actions on an
+    uncached DataFrame (randomSplit output recomputed after an executor
+    loss, upstream shuffles/samples), so every extracted column AND the
+    egress rows must come from the same collect() — zip-by-position
+    across two actions would silently pair predictions with the wrong
+    rows.  Returns (rows, column-name list)."""
+    return df.collect(), list(df.columns)
+
+
+def _col_from(rows, cols, name: str, dtype=None) -> np.ndarray:
+    j = cols.index(name)
+    return np.asarray([r[j] for r in rows], dtype=dtype)
+
+
+def _mat_from(rows, cols, name: str) -> np.ndarray:
+    """(n, d) float matrix from a vector column of materialized rows
+    (pyspark.ml.linalg Vector — sparse or dense — via toArray();
+    lists/arrays pass through)."""
+    j = cols.index(name)
+    return np.asarray(
+        [
+            np.asarray(
+                r[j].toArray() if hasattr(r[j], "toArray") else r[j],
+                np.float64,
+            )
+            for r in rows
+        ]
+    )
+
+
+def _vectorize(mat: np.ndarray):
+    """Rows of a matrix as output-column values: ml.linalg DenseVectors
+    with pyspark installed, plain float lists otherwise."""
+    if _Vectors is not None:
+        return [_Vectors.dense([float(v) for v in row]) for row in mat]
+    return [[float(v) for v in row] for row in mat]
+
+
+def _out_schema(df, name: str, kind: str):
+    """Output schema = df.schema + one explicitly-typed column (kind:
+    "int" | "double" | "vector").  The explicit schema matters on real
+    Spark: name-only inference raises on an EMPTY result (every row
+    cold-dropped, an empty randomSplit slice) where pyspark.ml's own
+    transform returns an empty typed DataFrame, and on all-null
+    columns.  Mocks without .schema/pyspark fall back to the name list
+    (inference never runs on them)."""
+    base = getattr(df, "schema", None)
+    if base is None or not HAVE_PYSPARK:
+        return list(df.columns) + [name]
+    from pyspark.sql.types import (
+        DoubleType,
+        IntegerType,
+        StructField,
+        StructType,
+    )
+
+    if kind == "vector":
+        from pyspark.ml.linalg import VectorUDT
+
+        t = VectorUDT()
+    elif kind == "int":
+        t = IntegerType()
+    else:
+        t = DoubleType()
+    return StructType(list(base.fields) + [StructField(name, t, True)])
+
+
+def _append_column(df, rows, name: str, values, kind: str) -> object:
+    """New DataFrame = the ALREADY-MATERIALIZED rows + one appended
+    column (driver-side; the egress mirror of the driver-collect
+    ingestion — same collect as the ingestion, see _collect_once)."""
+    data = [tuple(r) + (v,) for r, v in zip(rows, values)]
+    return _session_of(df).createDataFrame(data, _out_schema(df, name, kind))
+
+
+def _rebuild_rows(df, rows, keep_idx, name: str, values, kind: str) -> object:
+    """Like _append_column but keeping only ``keep_idx`` of the
+    materialized rows — the coldStartStrategy="drop" egress."""
+    data = [tuple(rows[int(j)]) + (v,) for j, v in zip(keep_idx, values)]
+    return _session_of(df).createDataFrame(data, _out_schema(df, name, kind))
+
+
+# ---------------------------------------------------------------------------
+# K-Means
+# ---------------------------------------------------------------------------
+
+
+class KMeans(_compat.KMeans):
+    """ml.clustering.KMeans over Spark DataFrames (keyword constructor +
+    builder setters, Spark defaults)."""
+
+    def __init__(self, *, featuresCol: str = "features",
+                 predictionCol: str = "prediction", k: int = 2,
+                 initMode: str = "k-means||", initSteps: int = 2,
+                 tol: float = 1e-4, maxIter: int = 20,
+                 seed: Optional[int] = None,
+                 distanceMeasure: str = "euclidean",
+                 weightCol: Optional[str] = None):
+        super().__init__()
+        self.setFeaturesCol(featuresCol).setPredictionCol(predictionCol)
+        self.setK(k).setInitMode(initMode).setInitSteps(initSteps)
+        self.setTol(tol).setMaxIter(maxIter)
+        self.setSeed(0 if seed is None else seed)
+        self.setDistanceMeasure(distanceMeasure)
+        if weightCol is not None:
+            self.setWeightCol(weightCol)
+
+    def fit(self, dataset) -> "KMeansModel":
+        want = [self._featuresCol] + (
+            [self._weightCol] if self._weightCol is not None else []
+        )
+        rows, cols = _collect_once(dataset.select(*want))
+        data = {self._featuresCol: _mat_from(rows, cols, self._featuresCol)}
+        if self._weightCol is not None:
+            data[self._weightCol] = _col_from(
+                rows, cols, self._weightCol, np.float64
+            )
+        inner = super().fit(data)
+        return KMeansModel(inner)
+
+
+class KMeansModel:
+    def __init__(self, inner: _compat.KMeansModel):
+        self._inner = inner
+
+    def clusterCenters(self):
+        return self._inner.clusterCenters()
+
+    @property
+    def summary(self):
+        return self._inner.summary
+
+    def predict(self, features):
+        return self._inner.predict(
+            features.toArray() if hasattr(features, "toArray") else features
+        )
+
+    def transform(self, dataset):
+        rows, cols = _collect_once(dataset)
+        x = _mat_from(rows, cols, self._inner._featuresCol)
+        out = self._inner.transform({self._inner._featuresCol: x})
+        pred = [int(p) for p in out[self._inner._predictionCol]]
+        return _append_column(
+            dataset, rows, self._inner._predictionCol, pred, "int"
+        )
+
+    def save(self, path: str) -> None:
+        self._inner.save(path)
+
+
+# ---------------------------------------------------------------------------
+# PCA
+# ---------------------------------------------------------------------------
+
+
+class PCA(_compat.PCA):
+    """ml.feature.PCA over Spark DataFrames."""
+
+    def __init__(self, *, k: Optional[int] = None,
+                 inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None):
+        super().__init__()
+        if k is not None:
+            self.setK(k)
+        if inputCol is not None:
+            self.setInputCol(inputCol)
+        if outputCol is not None:
+            self.setOutputCol(outputCol)
+
+    def fit(self, dataset) -> "PCAModel":
+        rows, cols = _collect_once(dataset.select(self._inputCol))
+        inner = super().fit(
+            {self._inputCol: _mat_from(rows, cols, self._inputCol)}
+        )
+        return PCAModel(inner)
+
+
+class PCAModel:
+    def __init__(self, inner: _compat.PCAModel):
+        self._inner = inner
+
+    @property
+    def pc(self) -> np.ndarray:
+        return self._inner.pc
+
+    @property
+    def explainedVariance(self) -> np.ndarray:
+        return self._inner.explainedVariance
+
+    def transform(self, dataset):
+        rows, cols = _collect_once(dataset)
+        x = _mat_from(rows, cols, self._inner._inputCol)
+        out = self._inner.transform({self._inner._inputCol: x})
+        return _append_column(
+            dataset, rows, self._inner._outputCol,
+            _vectorize(out[self._inner._outputCol]), "vector",
+        )
+
+    def save(self, path: str) -> None:
+        self._inner.save(path)
+
+
+# ---------------------------------------------------------------------------
+# ALS
+# ---------------------------------------------------------------------------
+
+
+class ALS(_compat.ALS):
+    """ml.recommendation.ALS over Spark DataFrames (full keyword
+    constructor of the reference's example usage: als-pyspark.py:52-54)."""
+
+    def __init__(self, *, rank: int = 10, maxIter: int = 10,
+                 regParam: float = 0.1, numUserBlocks: Optional[int] = None,
+                 numItemBlocks: Optional[int] = None,
+                 implicitPrefs: bool = False, alpha: float = 1.0,
+                 userCol: str = "user", itemCol: str = "item",
+                 ratingCol: str = "rating", seed: Optional[int] = None,
+                 nonnegative: bool = False,
+                 checkpointInterval: int = 10,
+                 coldStartStrategy: str = "nan",
+                 predictionCol: str = "prediction"):
+        super().__init__()
+        self.setRank(rank).setMaxIter(maxIter).setRegParam(regParam)
+        self.setImplicitPrefs(implicitPrefs).setAlpha(alpha)
+        self.setUserCol(userCol).setItemCol(itemCol).setRatingCol(ratingCol)
+        self.setSeed(0 if seed is None else seed)
+        self.setNonnegative(nonnegative)
+        self.setCheckpointInterval(checkpointInterval)
+        self.setColdStartStrategy(coldStartStrategy)
+        self.setPredictionCol(predictionCol)
+        if numUserBlocks is not None:
+            self.setNumUserBlocks(numUserBlocks)
+        if numItemBlocks is not None:
+            self.setNumItemBlocks(numItemBlocks)
+
+    def getSeed(self):
+        return self._seed
+
+    def fit(self, dataset) -> "ALSModel":
+        rows, cols = _collect_once(
+            dataset.select(self._userCol, self._itemCol, self._ratingCol)
+        )
+        inner = super().fit(
+            {
+                self._userCol: _col_from(rows, cols, self._userCol, np.int64),
+                self._itemCol: _col_from(rows, cols, self._itemCol, np.int64),
+                self._ratingCol: _col_from(
+                    rows, cols, self._ratingCol, np.float32
+                ),
+            }
+        )
+        return ALSModel(inner)
+
+
+class ALSModel:
+    def __init__(self, inner: _compat.ALSModel):
+        self._inner = inner
+
+    @property
+    def rank(self) -> int:
+        return self._inner.rank
+
+    @property
+    def userFactors(self) -> np.ndarray:
+        return self._inner.userFactors
+
+    @property
+    def itemFactors(self) -> np.ndarray:
+        return self._inner.itemFactors
+
+    def transform(self, dataset):
+        """Prediction column for (user, item) rows; coldStartStrategy
+        "nan"/"drop" rides the inner transform — a hidden row-index
+        column reports which input rows survive "drop"."""
+        rows, cols = _collect_once(dataset)
+        u = _col_from(rows, cols, self._inner._userCol, np.int64)
+        i = _col_from(rows, cols, self._inner._itemCol, np.int64)
+        pairs = {
+            self._inner._userCol: u,
+            self._inner._itemCol: i,
+            "__row_idx": np.arange(len(u)),
+        }
+        out = self._inner.transform(pairs)
+        pred = [float(p) for p in out[self._inner._predictionCol]]
+        idx = out["__row_idx"]
+        if len(idx) == len(u) and np.array_equal(idx, np.arange(len(u))):
+            return _append_column(
+                dataset, rows, self._inner._predictionCol, pred, "double"
+            )
+        return _rebuild_rows(
+            dataset, rows, idx, self._inner._predictionCol, pred, "double"
+        )
+
+    def recommendForAllUsers(self, numItems: int) -> np.ndarray:
+        return self._inner.recommendForAllUsers(numItems)
+
+    def recommendForAllItems(self, numUsers: int) -> np.ndarray:
+        return self._inner.recommendForAllItems(numUsers)
+
+    def save(self, path: str) -> None:
+        self._inner.save(path)
+
+
+# ---------------------------------------------------------------------------
+# Evaluators
+# ---------------------------------------------------------------------------
+
+
+class RegressionEvaluator(_compat.RegressionEvaluator):
+    """ml.evaluation.RegressionEvaluator over Spark DataFrames (keyword
+    constructor, als-pyspark.py:62 usage)."""
+
+    def __init__(self, *, metricName: str = "rmse",
+                 labelCol: str = "label", predictionCol: str = "prediction"):
+        super().__init__(metricName=metricName, labelCol=labelCol,
+                         predictionCol=predictionCol)
+
+    def evaluate(self, dataset) -> float:
+        rows, cols = _collect_once(
+            dataset.select(self._labelCol, self._predictionCol)
+        )
+        return super().evaluate(
+            {
+                self._labelCol: _col_from(rows, cols, self._labelCol,
+                                          np.float64),
+                self._predictionCol: _col_from(
+                    rows, cols, self._predictionCol, np.float64
+                ),
+            }
+        )
+
+
+class ClusteringEvaluator(_compat.ClusteringEvaluator):
+    """ml.evaluation.ClusteringEvaluator over Spark DataFrames
+    (kmeans-pyspark.py:57 usage)."""
+
+    def __init__(self, *, featuresCol: str = "features",
+                 predictionCol: str = "prediction",
+                 metricName: str = "silhouette",
+                 distanceMeasure: str = "squaredEuclidean"):
+        super().__init__()
+        self.setFeaturesCol(featuresCol).setPredictionCol(predictionCol)
+        self.setMetricName(metricName).setDistanceMeasure(distanceMeasure)
+
+    def evaluate(self, dataset) -> float:
+        rows, cols = _collect_once(
+            dataset.select(self._featuresCol, self._predictionCol)
+        )
+        return super().evaluate(
+            {
+                self._featuresCol: _mat_from(rows, cols, self._featuresCol),
+                self._predictionCol: _col_from(
+                    rows, cols, self._predictionCol, np.int64
+                ),
+            }
+        )
