@@ -401,6 +401,166 @@ def bench_als_large():
     return sec_per_iter
 
 
+# ---------------------------------------------------------------------------
+# Multi-chip weak-scaling harness (bench.py --mesh N)
+# ---------------------------------------------------------------------------
+
+
+def _mesh_of(m):
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:m]).reshape(m), ("data",))
+
+
+def bench_mesh(n_devices: int, backend: str = "cpu", sizes: str = "small"):
+    """Weak-scaling protocol over 1..n_devices ranks: per-rank work is
+    FIXED and the global problem grows with the mesh, for all three
+    estimator kernels.  One JSON line per (kernel, mesh) with wall time,
+    per-rank work, and the analytic per-iteration collective payload
+    (allreduce counted 2x payload x (m-1)/m).
+
+    The same entry point runs unchanged on a real slice
+    (``--mesh-backend real``); with ``backend="cpu"`` (the default, and
+    what CI pins at N=8) the ranks are VIRTUAL CPU devices sharing one
+    host — wall times then measure protocol/compute overheads, NOT ICI
+    scaling, and every line carries ``"virtual_cpu": true`` to say so.
+    ``sizes="big"`` selects slice-scale shapes for real hardware."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"--mesh {n_devices} needs {n_devices} devices, backend has "
+            f"{len(jax.devices())} (forcing the virtual CPU mesh failed — "
+            "a backend initialized before bench_mesh could configure it?)"
+        )
+    virtual = jax.default_backend() == "cpu" and backend == "cpu"
+    big = sizes == "big"
+    rng = np.random.default_rng(7)
+
+    meshes = [1]
+    while meshes[-1] * 2 <= n_devices:
+        meshes.append(meshes[-1] * 2)
+    if meshes[-1] != n_devices:  # --mesh 6: [1, 2, 4, 6], never skip N
+        meshes.append(n_devices)
+
+    # -- K-Means: per-rank rows fixed -------------------------------------
+    from oap_mllib_tpu.ops import kmeans_ops
+
+    rows_per_rank, d, k = (1 << 18, 256, 256) if big else (1 << 14, 32, 16)
+    iters = 10
+    for m in meshes:
+        n = rows_per_rank * m
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        init = x[rng.choice(n, size=k, replace=False)]
+        mesh = _mesh_of(m)
+        xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("data", None)))
+        ws = jax.device_put(
+            jnp.ones((n,), jnp.float32), NamedSharding(mesh, P("data"))
+        )
+        cj = jnp.asarray(init)
+        tol = jnp.asarray(0.0, jnp.float32)
+        chunks = kmeans_ops.auto_row_chunks(rows_per_rank, k)
+
+        def run():
+            c, it, _, _ = kmeans_ops.lloyd_run(
+                xs, ws, cj, iters, tol, chunks, "highest"
+            )
+            return np.asarray(c), int(it)
+
+        n_iter = run()[1]
+        dt = _best_of(lambda: run()[0], reps=2, warm=False)
+        _emit(
+            "mesh_scaling_kmeans", dt / max(n_iter, 1), "sec/iter", 1.0,
+            mesh=m, per_rank_rows=rows_per_rank, d=d, k=k,
+            collective_bytes_per_iter=int(
+                2 * (k * d + k) * 4 * (m - 1) / max(m, 1)
+            ),
+            virtual_cpu=virtual,
+        )
+
+    # -- PCA: per-rank rows fixed -----------------------------------------
+    from oap_mllib_tpu.ops import pca_ops
+
+    rows_per_rank, d = (1 << 18, 512) if big else (1 << 15, 128)
+    for m in meshes:
+        n = rows_per_rank * m
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        mesh = _mesh_of(m)
+        xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("data", None)))
+        ws = jax.device_put(
+            jnp.ones((n,), jnp.float32), NamedSharding(mesh, P("data"))
+        )
+        nr = jnp.asarray(float(n), jnp.float32)
+
+        def run():
+            cov, _ = pca_ops.covariance(xs, ws, nr)
+            return np.asarray(cov)
+
+        dt = _best_of(run, reps=2)
+        _emit(
+            "mesh_scaling_pca_cov", dt, "sec", 1.0,
+            mesh=m, per_rank_rows=rows_per_rank, d=d,
+            collective_bytes_per_iter=int(
+                2 * (d * d + d) * 4 * (m - 1) / max(m, 1)
+            ),
+            virtual_cpu=virtual,
+        )
+
+    # -- ALS: per-rank edges + user rows fixed, replicated item layout ----
+    from oap_mllib_tpu.ops import als_block
+
+    edges_per_rank, users_per_rank, n_items, r = (
+        (1 << 21, 1 << 18, 1 << 16, 10) if big else (100_000, 10_000, 5_000, 8)
+    )
+    als_iters = 3
+    for m in meshes:
+        nnz = edges_per_rank * m
+        n_users = users_per_rank * m
+        u = rng.integers(0, n_users, nnz).astype(np.int64)
+        i = rng.integers(0, n_items, nnz).astype(np.int64)
+        rr = (rng.random(nnz) * 4 + 1).astype(np.float32)
+        mesh = _mesh_of(m)
+        u_loc, i_glob, conf, valid, offsets, upb = (
+            als_block.prepare_block_inputs(u, i, rr, mesh, n_users)
+        )
+        grouped = als_block.prepare_grouped_inputs(
+            u_loc, i_glob, conf, valid, mesh, upb, n_items
+        )
+        from jax.sharding import NamedSharding as NS
+
+        x0 = jax.device_put(
+            (rng.normal(size=(mesh.shape["data"] * upb, r)) * 0.1).astype(
+                np.float32
+            ),
+            NS(mesh, P("data", None)),
+        )
+        y0 = jax.device_put(
+            (rng.normal(size=(n_items, r)) * 0.1).astype(np.float32),
+            NS(mesh, P()),
+        )
+
+        def run():
+            bx, by = als_block.als_block_run_grouped(
+                grouped, x0, y0, als_iters, 0.1, 1.0, mesh, implicit=True
+            )
+            return np.asarray(by)
+
+        dt = _best_of(run, reps=2)
+        _emit(
+            "mesh_scaling_als", dt / als_iters, "sec/iter", 1.0,
+            mesh=m, per_rank_edges=edges_per_rank,
+            per_rank_users=users_per_rank, n_items=n_items, rank=r,
+            item_layout="replicated",
+            collective_bytes_per_iter=int(
+                2 * (n_items * r * (r + 1) + r * r) * 4 * (m - 1) / max(m, 1)
+            ),
+            virtual_cpu=virtual,
+        )
+
+
 def _tests_tpu_status(timeout=900):
     """Run the compiled-mode TPU suite and report its outcome, so the
     bench artifact itself proves whether compiled-Pallas coverage ran on
@@ -427,7 +587,28 @@ def main():
                     help="emit every BASELINE.md metric (one JSON line each)")
     ap.add_argument("--skip-tests-tpu", action="store_true",
                     help="omit the compiled-suite status probe (slow)")
+    ap.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="weak-scaling harness over 1..N ranks "
+                         "(virtual CPU devices unless --mesh-backend real)")
+    ap.add_argument("--mesh-backend", choices=("cpu", "real"), default="cpu",
+                    help="cpu: force an N-device virtual CPU mesh (protocol "
+                         "check, not ICI scaling); real: use the live "
+                         "backend's devices (a TPU slice)")
+    ap.add_argument("--mesh-sizes", choices=("small", "big"), default="small",
+                    help="per-rank work: small = CI-affordable, big = "
+                         "slice-scale shapes")
     args = ap.parse_args()
+
+    if args.mesh:
+        if args.mesh_backend == "cpu":
+            # must happen before any backend initializes (env vars alone
+            # are ignored when a site hook pins the platform)
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", args.mesh)
+        bench_mesh(args.mesh, args.mesh_backend, args.mesh_sizes)
+        return
 
     extra = {}
     if not args.skip_tests_tpu:

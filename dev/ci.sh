@@ -38,6 +38,9 @@ else
   echo "no TPU backend - skipping tests_tpu/"
 fi
 
+echo "== mesh weak-scaling harness (8 virtual ranks, protocol check) =="
+python bench.py --mesh 8
+
 echo "== examples (CPU fallback path) =="
 bash examples/run_all.sh --device cpu
 

@@ -175,7 +175,8 @@ class KMeans(_compat.KMeans):
         self.setFeaturesCol(featuresCol).setPredictionCol(predictionCol)
         self.setK(k).setInitMode(initMode).setInitSteps(initSteps)
         self.setTol(tol).setMaxIter(maxIter)
-        self.setSeed(0 if seed is None else seed)
+        if seed is not None:  # unset flows to Config.seed (compat contract)
+            self.setSeed(seed)
         self.setDistanceMeasure(distanceMeasure)
         if weightCol is not None:
             self.setWeightCol(weightCol)
@@ -212,6 +213,10 @@ class KMeansModel:
 
     def transform(self, dataset):
         rows, cols = _collect_once(dataset)
+        if not rows:  # empty split: empty typed output, like pyspark.ml
+            return _append_column(
+                dataset, rows, self._inner._predictionCol, [], "int"
+            )
         x = _mat_from(rows, cols, self._inner._featuresCol)
         out = self._inner.transform({self._inner._featuresCol: x})
         pred = [int(p) for p in out[self._inner._predictionCol]]
@@ -264,6 +269,10 @@ class PCAModel:
 
     def transform(self, dataset):
         rows, cols = _collect_once(dataset)
+        if not rows:  # empty split: empty typed output, like pyspark.ml
+            return _append_column(
+                dataset, rows, self._inner._outputCol, [], "vector"
+            )
         x = _mat_from(rows, cols, self._inner._inputCol)
         out = self._inner.transform({self._inner._inputCol: x})
         return _append_column(
@@ -298,7 +307,8 @@ class ALS(_compat.ALS):
         self.setRank(rank).setMaxIter(maxIter).setRegParam(regParam)
         self.setImplicitPrefs(implicitPrefs).setAlpha(alpha)
         self.setUserCol(userCol).setItemCol(itemCol).setRatingCol(ratingCol)
-        self.setSeed(0 if seed is None else seed)
+        if seed is not None:  # unset flows to Config.seed (compat contract)
+            self.setSeed(seed)
         self.setNonnegative(nonnegative)
         self.setCheckpointInterval(checkpointInterval)
         self.setColdStartStrategy(coldStartStrategy)
@@ -307,9 +317,6 @@ class ALS(_compat.ALS):
             self.setNumUserBlocks(numUserBlocks)
         if numItemBlocks is not None:
             self.setNumItemBlocks(numItemBlocks)
-
-    def getSeed(self):
-        return self._seed
 
     def fit(self, dataset) -> "ALSModel":
         rows, cols = _collect_once(
@@ -348,6 +355,10 @@ class ALSModel:
         "nan"/"drop" rides the inner transform — a hidden row-index
         column reports which input rows survive "drop"."""
         rows, cols = _collect_once(dataset)
+        if not rows:  # empty split: empty typed output, like pyspark.ml
+            return _append_column(
+                dataset, rows, self._inner._predictionCol, [], "double"
+            )
         u = _col_from(rows, cols, self._inner._userCol, np.int64)
         i = _col_from(rows, cols, self._inner._itemCol, np.int64)
         pairs = {

@@ -47,7 +47,7 @@ class KMeans:
         self._k = 2
         self._maxIter = 20
         self._tol = 1e-4
-        self._seed = 0
+        self._seed = None  # unset -> Config.seed (OAP_MLLIB_TPU_SEED)
         self._initMode = "k-means||"
         self._initSteps = 2
         self._distanceMeasure = "euclidean"
@@ -71,8 +71,15 @@ class KMeans:
     def getK(self):                return self._k
     def getMaxIter(self):          return self._maxIter
     def getTol(self):              return self._tol
-    def getSeed(self):             return self._seed
     def getInitMode(self):         return self._initMode
+
+    def getSeed(self):
+        """The RESOLVED seed (Config.seed when unset) — the value the fit
+        will actually use, mirroring how Spark's getSeed always returns a
+        concrete value."""
+        from oap_mllib_tpu.config import get_config
+
+        return get_config().seed if self._seed is None else self._seed
     def getInitSteps(self):        return self._initSteps
     def getDistanceMeasure(self):  return self._distanceMeasure
     def getFeaturesCol(self):      return self._featuresCol
@@ -202,7 +209,7 @@ class ALS:
         self._regParam = 0.1
         self._alpha = 1.0
         self._implicitPrefs = False
-        self._seed = 0
+        self._seed = None  # unset -> Config.seed (OAP_MLLIB_TPU_SEED)
         self._nonnegative = False
         self._userCol = "user"
         self._itemCol = "item"
@@ -276,6 +283,12 @@ class ALS:
     def getRegParam(self):      return self._regParam
     def getAlpha(self):         return self._alpha
     def getImplicitPrefs(self): return self._implicitPrefs
+
+    def getSeed(self):
+        """The RESOLVED seed (Config.seed when unset) — see KMeans.getSeed."""
+        from oap_mllib_tpu.config import get_config
+
+        return get_config().seed if self._seed is None else self._seed
     def getNonnegative(self):   return self._nonnegative
     def getUserCol(self):       return self._userCol
     def getItemCol(self):       return self._itemCol
@@ -322,7 +335,9 @@ class ALSModel:
         # ids that actually appeared in training — Spark's cold-start set
         # is "unseen in training", which in a dense id space also covers
         # in-range ids whose every rating landed outside the training
-        # split.  None (e.g. a loaded model) degrades to range checks.
+        # split.  Persisted by save/load (like Spark's factor id lists);
+        # None (a pre-round-4 save, or direct construction without the
+        # sets) degrades to range checks.
         self._seenUsers = seen_users
         self._seenItems = seen_items
 
@@ -378,11 +393,55 @@ class ALSModel:
         return self._inner.recommend_for_all_items(numUsers)
 
     def save(self, path: str) -> None:
+        """Persist factors AND the compat surface: column names,
+        coldStartStrategy, and the seen-id sets — Spark's cold-start
+        semantics ("unseen in training") must survive a save/load
+        round-trip (its ALSModel persists the factor id lists,
+        ALS.scala:119-128); without them a loaded model silently
+        degrades to range checks."""
+        import json as _json
+        import os as _os
+
         self._inner.save(path)
+        if self._seenUsers is not None:
+            np.save(_os.path.join(path, "seen_users.npy"), self._seenUsers)
+        if self._seenItems is not None:
+            np.save(_os.path.join(path, "seen_items.npy"), self._seenItems)
+        with open(_os.path.join(path, "compat_metadata.json"), "w") as f:
+            _json.dump(
+                {
+                    "userCol": self._userCol,
+                    "itemCol": self._itemCol,
+                    "predictionCol": self._predictionCol,
+                    "coldStartStrategy": self._coldStartStrategy,
+                },
+                f,
+            )
 
     @classmethod
     def load(cls, path: str) -> "ALSModel":
-        return cls(_als.ALSModel.load(path), "user", "item")
+        import json as _json
+        import os as _os
+
+        meta = {}
+        meta_path = _os.path.join(path, "compat_metadata.json")
+        if _os.path.exists(meta_path):  # older saves: core-only defaults
+            with open(meta_path) as f:
+                meta = _json.load(f)
+
+        def _opt(name):
+            p = _os.path.join(path, name)
+            return np.load(p) if _os.path.exists(p) else None
+
+        return cls(
+            _als.ALSModel.load(path),
+            meta.get("userCol", "user"),
+            meta.get("itemCol", "item"),
+            prediction_col=meta.get("predictionCol", "prediction"),
+            cold_start_strategy=meta.get("coldStartStrategy", "nan"),
+            seen_users=_opt("seen_users.npy"),
+            seen_items=_opt("seen_items.npy"),
+        )
 
 
 class ClusteringEvaluator:

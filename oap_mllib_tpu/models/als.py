@@ -228,7 +228,7 @@ class ALS:
         reg_param: float = 0.1,
         implicit_prefs: bool = False,
         alpha: float = 1.0,
-        seed: int = 0,
+        seed: Optional[int] = None,
         nonnegative: bool = False,
         num_user_blocks: Optional[int] = None,
         num_item_blocks: Optional[int] = None,
@@ -250,7 +250,11 @@ class ALS:
         self.reg_param = reg_param
         self.implicit_prefs = implicit_prefs
         self.alpha = alpha
-        self.seed = seed
+        # None = Config.seed (the OAP_MLLIB_TPU_SEED default for
+        # estimators that do not set one — docs/configuration.md)
+        from oap_mllib_tpu.config import get_config
+
+        self.seed = get_config().seed if seed is None else seed
         self.nonnegative = nonnegative
         # Block-layout hints (Spark ALS numUserBlocks/numItemBlocks,
         # reference ALS.scala:154-169).  Here the user-block count is the

@@ -207,7 +207,7 @@ class KMeans:
         k: int = 2,
         max_iter: int = 20,
         tol: float = 1e-4,
-        seed: int = 0,
+        seed: Optional[int] = None,
         init_mode: str = INIT_PARALLEL,
         init_steps: int = 2,
         distance_measure: str = "euclidean",
@@ -225,7 +225,9 @@ class KMeans:
         self.k = k
         self.max_iter = max_iter
         self.tol = tol
-        self.seed = seed
+        # None = Config.seed (the OAP_MLLIB_TPU_SEED default for
+        # estimators that do not set one — docs/configuration.md)
+        self.seed = get_config().seed if seed is None else seed
         self.init_mode = init_mode
         self.init_steps = init_steps
         self.distance_measure = distance_measure

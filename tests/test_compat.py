@@ -225,6 +225,41 @@ class TestALSCompat:
         # case-insensitive like the Spark param validator (ALS.scala:125-128)
         assert ALS().setColdStartStrategy("DROP").getColdStartStrategy() == "drop"
 
+    def test_cold_start_survives_save_load(self, tmp_path, rng):
+        """save/load persists the seen-id sets, coldStartStrategy, and
+        column names (Spark ALSModel persistence, ALS.scala:119-128) —
+        an in-range-but-unseen id must still be cold on a LOADED model
+        (round-3 loads silently degraded to range checks)."""
+        from oap_mllib_tpu.compat.spark import ALSModel as CompatALSModel
+
+        df = self._ratings_df(rng)
+        keep = df["user"] != 3  # user 3: in-range, unseen in training
+        train = {k: v[keep] for k, v in df.items()}
+        model = (
+            ALS().setRank(3).setMaxIter(2).setImplicitPrefs(True)
+            .setColdStartStrategy("drop").setPredictionCol("p")
+            .fit(train)
+        )
+        path = str(tmp_path / "als_cold")
+        model.save(path)
+        loaded = CompatALSModel.load(path)
+        test = {"user": np.array([0, 3]), "item": np.array([0, 0]),
+                "rating": np.array([1.0, 1.0], np.float32)}
+        out = loaded.transform(test)
+        np.testing.assert_array_equal(out["user"], [0])  # drop survived
+        assert "p" in out  # predictionCol survived
+        # nan mode round-trips too
+        m2 = ALS().setRank(3).setMaxIter(2).setImplicitPrefs(True).fit(train)
+        m2.save(str(tmp_path / "als_nan"))
+        l2 = CompatALSModel.load(str(tmp_path / "als_nan"))
+        out2 = l2.transform(test)
+        assert np.isfinite(out2["prediction"][0])
+        assert np.isnan(out2["prediction"][1])
+        np.testing.assert_array_equal(
+            l2.transform(test)["prediction"],
+            m2.transform(test)["prediction"],
+        )
+
     def test_checkpoint_interval_accepted_noop(self, rng):
         """checkpointInterval is API-parity only: the reference's DAL path
         ignores it too (survey §5)."""

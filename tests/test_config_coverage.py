@@ -1,0 +1,113 @@
+"""Config-surface coverage: every field is read somewhere, documented,
+and env-overridable — the audit VERDICT r3 item 6 asked for (the round-3
+`Config.seed` was documented but read by nothing)."""
+
+import dataclasses
+import os
+import re
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu.config import Config, set_config
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PKG = os.path.join(HERE, "..", "oap_mllib_tpu")
+DOCS = os.path.join(HERE, "..", "docs", "configuration.md")
+
+
+def _package_source_without_config():
+    parts = []
+    for root, _, files in os.walk(PKG):
+        for f in files:
+            if f.endswith(".py") and f != "config.py":
+                with open(os.path.join(root, f)) as fh:
+                    parts.append(fh.read())
+    return "\n".join(parts)
+
+
+class TestConfigCoverage:
+    def test_every_field_is_read_somewhere(self):
+        """A Config field nothing reads is dead weight that will drift
+        from its docs (the round-3 seed bug).  Accepted read patterns:
+        ``cfg.NAME`` / ``conf.NAME`` / ``config.NAME`` /
+        ``get_config().NAME``."""
+        src = _package_source_without_config()
+        for f in dataclasses.fields(Config):
+            pat = rf"(cfg|conf|config|get_config\(\))\.{f.name}\b"
+            assert re.search(pat, src), (
+                f"Config.{f.name} is read nowhere in the package — wire it "
+                "or delete it (and its docs row)"
+            )
+
+    def test_every_field_is_documented(self):
+        """docs/configuration.md's field table and the dataclass must
+        list the same fields, both directions."""
+        with open(DOCS) as fh:
+            doc = fh.read()
+        fields = {f.name for f in dataclasses.fields(Config)}
+        documented = set(re.findall(r"^\| `(\w+)` \|", doc, re.M))
+        assert fields - documented == set(), "undocumented Config fields"
+        assert documented - fields == set(), "docs rows for deleted fields"
+
+    def test_env_override_every_field(self, monkeypatch):
+        """OAP_MLLIB_TPU_<FIELD> overrides each field with the right
+        type coercion."""
+        samples = {bool: "true", int: "7", str: "xyz"}
+        for f in dataclasses.fields(Config):
+            t = {"bool": bool, "int": int, "str": str}.get(str(f.type), str)
+            monkeypatch.setenv(
+                "OAP_MLLIB_TPU_" + f.name.upper(), samples[t]
+            )
+        cfg = Config.from_env()
+        for f in dataclasses.fields(Config):
+            t = {"bool": bool, "int": int, "str": str}.get(str(f.type), str)
+            expected = {bool: True, int: 7, str: "xyz"}[t]
+            assert getattr(cfg, f.name) == expected, f.name
+
+    def test_seed_default_flows_to_estimators(self):
+        """Config.seed is the default RNG seed for estimators that do
+        not set one (docs/configuration.md row); an explicit seed wins."""
+        from oap_mllib_tpu.models.als import ALS
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        set_config(seed=123)
+        assert KMeans().seed == 123
+        assert ALS().seed == 123
+        assert KMeans(seed=5).seed == 5
+        assert ALS(seed=5).seed == 5
+
+    def test_seed_default_flows_through_compat_layers(self):
+        """The drop-in surfaces must honor it too (the feature is
+        advertised for exactly the unmodified-user-code path): compat
+        builders and the pyspark adapters resolve an unset seed from
+        config at fit time."""
+        from oap_mllib_tpu.compat import spark as compat_spark
+        from oap_mllib_tpu.compat import pyspark as compat_pyspark
+
+        set_config(seed=77)
+        assert compat_spark.KMeans().getSeed() == 77
+        assert compat_spark.ALS().getSeed() == 77
+        assert compat_pyspark.KMeans().getSeed() == 77
+        assert compat_pyspark.ALS().getSeed() == 77
+        assert compat_spark.KMeans().setSeed(9).getSeed() == 9
+        assert compat_pyspark.ALS(seed=9).getSeed() == 9
+
+    def test_seed_default_changes_random_init(self, rng):
+        """The wired seed actually reaches the RNG: two config seeds give
+        different random-init clusterings of ambiguous data."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = rng.normal(size=(200, 4)).astype(np.float32)
+        set_config(seed=1)
+        m1 = KMeans(k=8, init_mode="random", max_iter=0).fit(x)
+        set_config(seed=2)
+        m2 = KMeans(k=8, init_mode="random", max_iter=0).fit(x)
+        set_config(seed=1)
+        m3 = KMeans(k=8, init_mode="random", max_iter=0).fit(x)
+        assert not np.allclose(m1.cluster_centers_, m2.cluster_centers_)
+        np.testing.assert_allclose(m1.cluster_centers_, m3.cluster_centers_)
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown config field"):
+            set_config(sead=1)

@@ -101,6 +101,20 @@ class TestKMeansAdapter:
         assert len(out.collect()) == 50
         assert model.predict(FakeVector(x[0])) in (0, 1, 2)
 
+    def test_empty_input_transform(self, rng, session):
+        """An empty split (randomSplit can produce one) transforms to an
+        empty DataFrame with the prediction column — pyspark.ml
+        semantics, not a shape crash."""
+        x = rng.normal(size=(40, 3))
+        dataset = _df(session, features=[list(r) for r in x])
+        model = KMeans(k=2, seed=1).fit(dataset)
+        empty = _df(session, features=[])
+        out = model.transform(empty)
+        assert out.collect() == []
+        assert out.columns == ["features", "prediction"]
+        pca = PCA(k=2, inputCol="features", outputCol="pc").fit(dataset)
+        assert pca.transform(empty).collect() == []
+
     def test_weight_col(self, rng, session):
         x = rng.normal(size=(60, 4))
         w = np.ones(60)
