@@ -107,6 +107,16 @@ class Config:
     # the replicated psum bytes/iteration exceed
     # ops.als_block.ITEM_SHARD_AUTO_BYTES.
     als_item_layout: str = "auto"
+    # PCA eigensolver.  "eigh" (and "auto", today's resolution of it) =
+    # the full d x d factorization — the parity contract, exact for any
+    # spectrum.  "randomized" = top-k subspace iteration
+    # (ops/pca_ops.topk_eigh_randomized): replaces the O(d^3) eigh that
+    # owns 66% of the large-d wall (BASELINE.md row 5) with a few
+    # (d, d) x (d, k+16) MXU matmuls — opt-in because accuracy is
+    # spectral-gap-dependent (decaying spectra ~1e-4 vs eigh; a flat
+    # spectrum biases values ~5% low and its eigenvectors are
+    # ill-defined).  The fit summary records which solver ran.
+    pca_solver: str = "auto"
 
     @classmethod
     def from_env(cls) -> "Config":

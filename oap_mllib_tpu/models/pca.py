@@ -75,6 +75,17 @@ class PCAModel:
         )
 
 
+def _pca_solver_cfg() -> str:
+    """Validated Config.pca_solver — a typo must raise, not silently run
+    eigh (the als_kernel/als_item_layout contract)."""
+    solver = get_config().pca_solver
+    if solver not in ("auto", "eigh", "randomized"):
+        raise ValueError(
+            f"pca_solver must be auto|eigh|randomized, got {solver!r}"
+        )
+    return solver
+
+
 class PCA:
     """PCA estimator. Param parity: k (number of components)."""
 
@@ -83,9 +94,46 @@ class PCA:
             raise ValueError("k must be >= 1")
         self.k = k
 
+    def _solve_spectrum(self, cov, d: int, timings: Timings):
+        """Shared eigensolver tail (in-memory and streamed paths): full
+        eigh, or the randomized top-k subspace when configured.  ``cov``
+        may carry padded feature dims beyond ``d`` (model-sharded path);
+        the randomized path slices them off (cov is block-diagonal with
+        zero padding, so the genuine spectrum is untouched) instead of
+        the eigh path's -1 diagonal demotion — subspace iteration ranks
+        by |eigenvalue|, and a -1 would outrank small genuine ones.
+        Returns (vals_topk, vecs (d, k), total_variance, solver_used) —
+        ``solver_used`` lands in the fit summary so an A/B of the knob
+        can confirm which solver actually ran (the als_kernel
+        convention)."""
+        solver = _pca_solver_cfg()
+        if solver == "randomized":
+            with phase_timer(timings, "randomized_topk"):
+                cov_valid = cov[:d, :d]
+                vals, vecs = pca_ops.topk_eigh_randomized(cov_valid, self.k)
+                # ratio denominator: trace == eigenvalue sum, no full
+                # spectrum needed
+                total = float(jnp.trace(cov_valid))
+                return np.asarray(vals), np.asarray(vecs), total, solver
+        with phase_timer(timings, "eigh"):
+            if cov.shape[0] > d:
+                # padded feature dims: demote their eigenvalues below any
+                # genuine one so ties at zero can't surface a padded
+                # basis vector in the top-k
+                cov = pca_ops.mark_padded_features(cov, d)
+            vals, vecs = pca_ops.eigh_descending(cov)
+            vals = np.asarray(vals)[:d]  # genuine spectrum only
+            vecs = np.asarray(vecs)[:d, : self.k]
+        return vals[: self.k], vecs, float(vals.sum()), "eigh"
+
     def fit(self, x) -> PCAModel:
         from oap_mllib_tpu.data.stream import ChunkSource
 
+        # validate up front, on EVERY path: a typo'd solver must fail
+        # fast — before a (potentially multi-minute) streamed covariance
+        # pass, and on the fallback path too (which runs NumPy eigh
+        # regardless and must not silently accept garbage)
+        _pca_solver_cfg()
         if isinstance(x, ChunkSource):
             return self._fit_source(x)
         x = np.asarray(x)
@@ -142,20 +190,17 @@ class PCA:
         with phase_timer(timings, "covariance_streamed"):
             tier = "highest" if cfg.enable_x64 else cfg.matmul_precision
             cov, _, n = stream_ops.covariance_streamed(source, dtype, tier)
-        with phase_timer(timings, "eigh"):
-            # cov is exactly (d, d) here — no model-sharding feature pad
-            vals, vecs = pca_ops.eigh_descending(cov)
-            vals = np.asarray(vals)
-            vecs = np.asarray(vecs)
-        total = float(vals.sum())
-        ratio = vals[: self.k] / total if total > 0 else np.zeros(self.k)
+        # cov is exactly (d, d) here — no model-sharding feature pad
+        vals, vecs, total, solver = self._solve_spectrum(cov, d, timings)
+        ratio = vals / total if total > 0 else np.zeros(self.k)
         summary = {
             "timings": timings,
             "accelerated": True,
             "streamed": True,
             "n_rows": n,
+            "pca_solver": solver,
         }
-        return PCAModel(vecs[:, : self.k], ratio, summary)
+        return PCAModel(vecs, ratio, summary)
 
     # -- accelerated path (~ PCADALImpl.train, PCADALImpl.scala:35) ----------
     def _fit_tpu(self, x: np.ndarray) -> PCAModel:
@@ -199,27 +244,24 @@ class PCA:
                 cov, _ = pca_ops.covariance(
                     table.data, table.mask, n_rows, tier
                 )
-        with phase_timer(timings, "eigh"):
-            if cov.shape[0] > d:
-                # padded feature dims: demote their eigenvalues below any
-                # genuine one so ties at zero can't surface a padded basis
-                # vector in the top-k
-                cov = pca_ops.mark_padded_features(cov, d)
-            vals, vecs = pca_ops.eigh_descending(cov)
-            vals = np.asarray(vals)[:d]  # genuine spectrum only
-            vecs = np.asarray(vecs)
-        total = float(vals.sum())
-        ratio = vals[: self.k] / total if total > 0 else np.zeros(self.k)
+        vals, vecs, total, solver = self._solve_spectrum(cov, d, timings)
+        ratio = vals / total if total > 0 else np.zeros(self.k)
         summary = {
             "timings": timings,
             "accelerated": True,
             "mesh_shape": dict(mesh.shape),
+            "pca_solver": solver,
         }
-        return PCAModel(vecs[:d, : self.k], ratio, summary)
+        return PCAModel(vecs, ratio, summary)
 
     # -- fallback path (~ vanilla mllib.feature.PCA, PCA.scala:110-116) ------
     def _fit_fallback(self, x: np.ndarray) -> PCAModel:
         timings = Timings()
         with phase_timer(timings, "pca_np"):
             comps, ratio = pca_np(x, self.k)
-        return PCAModel(comps, ratio, {"timings": timings, "accelerated": False})
+        # the fallback always factorizes fully; recording it keeps a
+        # configured-but-ineffective "randomized" visible in the summary
+        return PCAModel(
+            comps, ratio,
+            {"timings": timings, "accelerated": False, "pca_solver": "eigh"},
+        )

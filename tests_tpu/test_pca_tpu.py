@@ -102,3 +102,31 @@ class TestPcaCompiled:
             m.explained_variance_, vals_o[:k] / vals_o.sum(), atol=1e-4
         )
         assert m.transform(x[:16]).shape == (16, k)
+
+    def test_randomized_solver_compiled(self, rng):
+        """pca_solver="randomized" on the real chip: the QR + subspace
+        iteration lowering must match eigh on a decaying spectrum (the
+        solver's advertised regime) — hardware QR/eigh lowerings differ
+        from the CPU suite's."""
+        from oap_mllib_tpu.config import set_config
+        from oap_mllib_tpu.models.pca import PCA
+
+        n, d, k = 4096, 64, 5
+        scales = (2.0 ** -np.arange(d)).astype(np.float32)
+        basis = np.linalg.qr(rng.normal(size=(d, d)))[0].astype(np.float32)
+        x = ((rng.normal(size=(n, d)).astype(np.float32) * scales * 10)
+             @ basis.T)
+        m_eigh = PCA(k=k).fit(x)
+        set_config(pca_solver="randomized")
+        try:
+            m_rand = PCA(k=k).fit(x)
+        finally:
+            set_config(pca_solver="auto")
+        np.testing.assert_allclose(
+            m_rand.explained_variance_, m_eigh.explained_variance_,
+            rtol=1e-3, atol=1e-6,
+        )
+        dots = np.abs(np.einsum(
+            "dk,dk->k", m_rand.components_, m_eigh.components_
+        ))
+        assert np.all(dots > 1.0 - 1e-3), dots
