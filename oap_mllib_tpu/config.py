@@ -117,6 +117,13 @@ class Config:
     # spectrum biases values ~5% low and its eigenvectors are
     # ill-defined).  The fit summary records which solver ran.
     pca_solver: str = "auto"
+    # Randomized-solver tuning: probe width = k + pca_rand_oversample,
+    # subspace iterations = pca_rand_iters.  The defaults hold ~1e-4 on
+    # decaying spectra; weakly-gapped spectra tighten with more of both
+    # (measured d=2048 Wishart edge: ~5% value bias at 8/16, ~0.3% at
+    # 16/64 — BASELINE.md row 5).  Ignored unless pca_solver="randomized".
+    pca_rand_oversample: int = 16
+    pca_rand_iters: int = 8
 
     @classmethod
     def from_env(cls) -> "Config":

@@ -109,8 +109,17 @@ class PCA:
         solver = _pca_solver_cfg()
         if solver == "randomized":
             with phase_timer(timings, "randomized_topk"):
+                cfg = get_config()
+                if cfg.pca_rand_oversample < 1 or cfg.pca_rand_iters < 1:
+                    raise ValueError(
+                        "pca_rand_oversample and pca_rand_iters must be >= 1"
+                    )
                 cov_valid = cov[:d, :d]
-                vals, vecs = pca_ops.topk_eigh_randomized(cov_valid, self.k)
+                vals, vecs = pca_ops.topk_eigh_randomized(
+                    cov_valid, self.k,
+                    oversample=cfg.pca_rand_oversample,
+                    iters=cfg.pca_rand_iters,
+                )
                 # ratio denominator: trace == eigenvalue sum, no full
                 # spectrum needed
                 total = float(jnp.trace(cov_valid))

@@ -50,6 +50,26 @@ assert getattr(ms.summary, "streamed", False)
 ps = PCA(k=4).fit(ChunkSource.from_array(shard, chunk_rows=300))
 assert ps.summary["n_rows"] == 4000
 
+# item-sharded ALS over a 3-rank world: a block count that is neither a
+# power of two nor 2 exercises the item-block offsets/padding (last
+# block short) through the second shuffle + all_gather exchange
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.models.als import ALS
+
+rng_als = np.random.default_rng(77)
+NU, NI, RANK_ = 60, 40, 3
+au = rng_als.integers(NU, size=1200).astype(np.int64)
+ai = rng_als.integers(NI, size=1200).astype(np.int64)
+au[0], ai[0] = NU - 1, NI - 1
+ar = rng_als.random(1200).astype(np.float32) * 4 + 1
+acuts = [0, 400, 800, 1200]
+asl = slice(acuts[rank], acuts[rank + 1])
+set_config(als_item_layout="sharded")
+m_sh = ALS(rank=RANK_, max_iter=3, reg_param=0.1, implicit_prefs=True,
+           seed=3).fit(au[asl], ai[asl], ar[asl])
+assert m_sh.summary["item_layout"] == "sharded"
+set_config(als_item_layout="auto")
+
 print(
     "RESULT "
     + json.dumps(
@@ -59,6 +79,7 @@ print(
             "pca_var": np.asarray(p.explained_variance_).tolist(),
             "streamed_cost": float(ms.summary.training_cost),
             "streamed_pca_var": np.asarray(ps.explained_variance_).tolist(),
+            "als_sh_if": np.asarray(m_sh.item_factors_).tolist(),
         }
     ),
     flush=True,

@@ -297,6 +297,24 @@ class TestRandomizedSolver:
         with pytest.raises(ValueError, match="pca_solver"):
             PCA(k=2).fit(_data(rng, n=50, d=5))
 
+    def test_tuning_knobs_flow_through(self, rng):
+        """pca_rand_oversample/iters reach the solver: cranking them on a
+        weakly-gapped spectrum tightens the eigenvalues toward eigh."""
+        from oap_mllib_tpu.config import set_config
+
+        x = rng.normal(size=(3000, 48)).astype(np.float32)
+        ref = PCA(k=4).fit(x).explained_variance_
+        set_config(pca_solver="randomized", pca_rand_oversample=2,
+                   pca_rand_iters=1)
+        loose = PCA(k=4).fit(x).explained_variance_
+        set_config(pca_rand_oversample=44, pca_rand_iters=24)
+        tight = PCA(k=4).fit(x).explained_variance_
+        assert np.abs(tight - ref).max() < np.abs(loose - ref).max()
+        np.testing.assert_allclose(tight, ref, rtol=5e-3)
+        set_config(pca_rand_iters=0)
+        with pytest.raises(ValueError, match="pca_rand"):
+            PCA(k=4).fit(x)
+
 
 class TestPersistence:
     def test_save_load_roundtrip(self, tmp_path, rng):

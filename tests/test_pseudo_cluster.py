@@ -309,6 +309,26 @@ class TestPseudoCluster:
             == world3_results[2]["streamed_cost"]
         )
 
+    def test_three_process_item_sharded_als(self, world3_results):
+        """als_item_layout="sharded" over 3 ranks (a block count that is
+        neither 2 nor a power of two — the last item block is short):
+        factors match the single-process fit on the same global edges."""
+        from oap_mllib_tpu.models.als import ALS
+
+        rng_als = np.random.default_rng(77)
+        nu, ni = 60, 40
+        u = rng_als.integers(nu, size=1200).astype(np.int64)
+        i = rng_als.integers(ni, size=1200).astype(np.int64)
+        u[0], i[0] = nu - 1, ni - 1
+        r = rng_als.random(1200).astype(np.float32) * 4 + 1
+        oracle = ALS(rank=3, max_iter=3, reg_param=0.1,
+                     implicit_prefs=True, seed=3).fit(u, i, r)
+        for rank in (0, 1, 2):
+            np.testing.assert_allclose(
+                world3_results[rank]["als_sh_if"], oracle.item_factors_,
+                atol=4e-3, rtol=4e-3,
+            )
+
     def test_ranks_agree(self, world_results):
         """Replicated results must be bitwise-identical across ranks."""
         assert world_results[0]["kmeans_cost"] == world_results[1]["kmeans_cost"]

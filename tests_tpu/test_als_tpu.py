@@ -143,3 +143,34 @@ class TestGroupedChunkedCompiled:
         # monkeypatch teardown restores the budget; clearing the jit cache
         # keeps the small-budget trace from leaking into later tests
         als_ops.als_run_grouped.clear_cache()
+
+
+class TestStreamedALSTpu:
+    def test_streamed_matches_in_memory_compiled(self, rng):
+        """The host-chunked streamed ALS (ops/als_stream.py) on the real
+        chip: per-chunk moment accumulation + flat-carry solve must match
+        the one-program in-memory grouped run (compiled lowerings of the
+        donated-carry segment-sum differ from the CPU suite's)."""
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.ops import als_ops, als_stream
+
+        n_users, n_items, nnz, rank, iters = 300, 200, 20_000, 6, 3
+        u = rng.integers(0, n_users, nnz).astype(np.int64)
+        i = rng.integers(0, n_items, nnz).astype(np.int64)
+        r = (rng.random(nnz) * 4 + 1).astype(np.float32)
+        x0 = (rng.normal(size=(n_users, rank)) * 0.1).astype(np.float32)
+        y0 = (rng.normal(size=(n_items, rank)) * 0.1).astype(np.float32)
+        by_user = als_ops.build_grouped_edges(u, i, r, n_users)
+        by_item = als_ops.build_grouped_edges(i, u, r, n_items)
+        dev = [jnp.asarray(a) for a in (*by_user, *by_item)]
+        xm, ym = als_ops.als_run_grouped(
+            *dev, jnp.asarray(x0), jnp.asarray(y0),
+            n_users, n_items, iters, 0.1, 5.0, True,
+        )
+        xs, ys = als_stream.als_run_streamed(
+            by_user, by_item, x0, y0, n_users, n_items, iters, 0.1, 5.0,
+            True,
+        )
+        np.testing.assert_allclose(np.asarray(xm), xs, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(ym), ys, atol=2e-4)
