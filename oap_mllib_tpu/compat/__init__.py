@@ -12,6 +12,13 @@ column values) — the dependency-free stand-in; ``fit`` also accepts a bare
 ndarray for the features-only case.
 """
 
+from oap_mllib_tpu.compat.pipeline import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    Pipeline,
+    PipelineModel,
+)
 from oap_mllib_tpu.compat.spark import (
     ALS,
     ClusteringEvaluator,
@@ -20,4 +27,8 @@ from oap_mllib_tpu.compat.spark import (
     RegressionEvaluator,
 )
 
-__all__ = ["KMeans", "PCA", "ALS", "ClusteringEvaluator", "RegressionEvaluator"]
+__all__ = [
+    "KMeans", "PCA", "ALS", "ClusteringEvaluator", "RegressionEvaluator",
+    "Pipeline", "PipelineModel", "ParamGridBuilder", "CrossValidator",
+    "CrossValidatorModel",
+]

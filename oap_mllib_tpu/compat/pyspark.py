@@ -416,6 +416,16 @@ class RegressionEvaluator(_compat.RegressionEvaluator):
         )
 
 
+# Pipeline composability is data-plane agnostic — it only touches the
+# stage fit/transform contract — so the SAME classes serve real Spark
+# DataFrames here (the pyspark.ml.Pipeline import-line drop-in):
+#   from oap_mllib_tpu.compat.pyspark import Pipeline
+from oap_mllib_tpu.compat.pipeline import (  # noqa: E402,F401
+    Pipeline,
+    PipelineModel,
+)
+
+
 class ClusteringEvaluator(_compat.ClusteringEvaluator):
     """ml.evaluation.ClusteringEvaluator over Spark DataFrames
     (kmeans-pyspark.py:57 usage)."""

@@ -77,11 +77,21 @@ class PCAModel:
 
 def _pca_solver_cfg() -> str:
     """Validated Config.pca_solver — a typo must raise, not silently run
-    eigh (the als_kernel/als_item_layout contract)."""
-    solver = get_config().pca_solver
+    eigh (the als_kernel/als_item_layout contract).  The randomized
+    tuning knobs validate here too, so a bad value fails at fit() entry
+    on EVERY path (fallback included) instead of after a multi-minute
+    streamed covariance pass."""
+    cfg = get_config()
+    solver = cfg.pca_solver
     if solver not in ("auto", "eigh", "randomized"):
         raise ValueError(
             f"pca_solver must be auto|eigh|randomized, got {solver!r}"
+        )
+    if solver == "randomized" and (
+        cfg.pca_rand_oversample < 1 or cfg.pca_rand_iters < 1
+    ):
+        raise ValueError(
+            "pca_rand_oversample and pca_rand_iters must be >= 1"
         )
     return solver
 
@@ -110,10 +120,6 @@ class PCA:
         if solver == "randomized":
             with phase_timer(timings, "randomized_topk"):
                 cfg = get_config()
-                if cfg.pca_rand_oversample < 1 or cfg.pca_rand_iters < 1:
-                    raise ValueError(
-                        "pca_rand_oversample and pca_rand_iters must be >= 1"
-                    )
                 cov_valid = cov[:d, :d]
                 vals, vecs = pca_ops.topk_eigh_randomized(
                     cov_valid, self.k,

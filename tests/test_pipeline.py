@@ -1,0 +1,172 @@
+"""Pipeline / ParamGridBuilder / CrossValidator composability tests
+(compat/pipeline.py — the ml.Pipeline / ml.tuning analog the round-3
+review flagged as missing from the dict world)."""
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu.compat import (
+    ALS,
+    CrossValidator,
+    KMeans,
+    PCA,
+    ParamGridBuilder,
+    Pipeline,
+    RegressionEvaluator,
+)
+
+
+def _blobs(rng, n=300, d=6, k=3):
+    proto = rng.normal(size=(k, d)) * 8
+    x = proto[rng.integers(k, size=n)] + 0.1 * rng.normal(size=(n, d))
+    return {"features": x.astype(np.float64)}
+
+
+def _ratings(rng, n=1500, nu=40, ni=30, rank=3):
+    u = rng.integers(0, nu, n)
+    i = rng.integers(0, ni, n)
+    xt = rng.normal(size=(nu, rank))
+    yt = rng.normal(size=(ni, rank))
+    r = (xt[u] * yt[i]).sum(1) + 0.05 * rng.normal(size=n)
+    return {"user": u, "item": i,
+            "rating": r.astype(np.float32)}
+
+
+class TestPipeline:
+    def test_pca_then_kmeans(self, rng):
+        """Classic two-stage flow: PCA features feed K-Means — the
+        second stage must fit on the FIRST stage's transformed frame."""
+        df = _blobs(rng, d=8)
+        pipe = Pipeline(stages=[
+            PCA().setK(3).setInputCol("features").setOutputCol("pca"),
+            KMeans().setK(3).setSeed(1).setFeaturesCol("pca"),
+        ])
+        model = pipe.fit(df)
+        out = model.transform(df)
+        assert out["pca"].shape == (300, 3)
+        assert set(np.unique(out["prediction"])) <= {0, 1, 2}
+        # blobs survive the projection: near-pure clusters
+        assert len(np.unique(out["prediction"])) == 3
+
+    def test_transformer_stage_passthrough(self, rng):
+        """A fitted model used as a stage passes through (no fit call)."""
+        df = _blobs(rng)
+        km = KMeans().setK(3).setSeed(1).fit(df)
+        model = Pipeline(stages=[km]).fit(df)
+        out = model.transform(df)
+        assert "prediction" in out
+
+    def test_bad_stage_raises(self, rng):
+        with pytest.raises(TypeError, match="neither fit nor transform"):
+            Pipeline(stages=[object()]).fit(_blobs(rng))
+
+    def test_stages_accessors(self):
+        p = Pipeline().setStages([1, 2])
+        assert p.getStages() == [1, 2]
+
+
+class TestParamGrid:
+    def test_cartesian_build(self):
+        grid = (ParamGridBuilder()
+                .addGrid("regParam", [0.01, 0.1])
+                .addGrid("rank", [2, 4, 8])
+                .baseOn({"maxIter": 3})
+                .build())
+        assert len(grid) == 6
+        assert all(m["maxIter"] == 3 for m in grid)
+        assert {m["regParam"] for m in grid} == {0.01, 0.1}
+
+    def test_empty_grid_is_one_default_map(self):
+        assert ParamGridBuilder().build() == [{}]
+
+
+class TestCrossValidator:
+    def test_als_reg_param_selection(self, rng):
+        """The canonical Spark tuning flow: ALS regParam grid, RMSE
+        evaluator (smaller better) — CV must prefer a sane reg over an
+        absurd one and expose per-map metrics."""
+        df = _ratings(rng)
+        cv = CrossValidator(
+            estimator=(ALS().setRank(4).setMaxIter(4)
+                       .setColdStartStrategy("drop")),
+            estimatorParamMaps=(ParamGridBuilder()
+                                .addGrid("regParam", [0.05, 50.0])
+                                .build()),
+            evaluator=RegressionEvaluator(metricName="rmse",
+                                          labelCol="rating"),
+            numFolds=3, seed=1,
+        )
+        model = cv.fit(df)
+        assert len(model.avgMetrics) == 2
+        assert model.bestParams == {"regParam": 0.05}
+        assert model.avgMetrics[0] < model.avgMetrics[1]
+        out = model.transform(df)
+        assert np.isfinite(out["prediction"]).all()
+
+    def test_larger_is_better_direction(self, rng):
+        """r2 (larger better) must flip the argbest direction."""
+        df = _ratings(rng)
+        cv = CrossValidator(
+            estimator=(ALS().setRank(4).setMaxIter(4)
+                       .setColdStartStrategy("drop")),
+            estimatorParamMaps=(ParamGridBuilder()
+                                .addGrid("regParam", [0.05, 50.0])
+                                .build()),
+            evaluator=RegressionEvaluator(metricName="r2",
+                                          labelCol="rating"),
+            numFolds=2, seed=1,
+        )
+        model = cv.fit(df)
+        assert model.bestParams == {"regParam": 0.05}
+
+    def test_unknown_param_fails_before_any_fit(self, rng):
+        cv = CrossValidator(
+            estimator=ALS(),
+            estimatorParamMaps=[{"regParm": 0.1}],  # typo
+            evaluator=RegressionEvaluator(labelCol="rating"),
+        )
+        with pytest.raises(ValueError, match="regParm"):
+            cv.fit(_ratings(rng))
+
+    def test_nan_metric_raises_not_wins(self, rng):
+        """coldStartStrategy="nan" leaks NaN predictions into RMSE; the
+        NaN map must raise, not silently win argmin."""
+        df = _ratings(rng, nu=15, ni=12)
+        # a user with exactly ONE rating: whichever fold holds it tests
+        # an id unseen in that fold's training -> NaN prediction
+        df = {k: np.asarray(v).copy() for k, v in df.items()}
+        df["user"][0] = 999
+        cv = CrossValidator(
+            estimator=ALS().setRank(3).setMaxIter(2),  # default "nan"
+            estimatorParamMaps=(ParamGridBuilder()
+                                .addGrid("regParam", [0.05, 0.5]).build()),
+            evaluator=RegressionEvaluator(labelCol="rating"),
+            numFolds=5, seed=0,
+        )
+        with pytest.raises(ValueError, match="NaN"):
+            cv.fit(df)
+
+    def test_empty_grid_raises(self, rng):
+        cv = CrossValidator(
+            estimator=ALS().setColdStartStrategy("drop"),
+            estimatorParamMaps=(ParamGridBuilder()
+                                .addGrid("regParam", []).build()),
+            evaluator=RegressionEvaluator(labelCol="rating"),
+        )
+        with pytest.raises(ValueError, match="empty"):
+            cv.fit(_ratings(rng))
+
+    def test_validation(self, rng):
+        with pytest.raises(ValueError, match="estimator and evaluator"):
+            CrossValidator().fit(_ratings(rng))
+        with pytest.raises(ValueError, match="numFolds"):
+            CrossValidator(
+                estimator=ALS(),
+                evaluator=RegressionEvaluator(labelCol="rating"),
+                numFolds=1,
+            ).fit(_ratings(rng))
+        with pytest.raises(TypeError, match="dict DataFrames"):
+            CrossValidator(
+                estimator=ALS(),
+                evaluator=RegressionEvaluator(labelCol="rating"),
+            ).fit(np.zeros((10, 3)))

@@ -125,6 +125,26 @@ class TestKMeansAdapter:
         assert model.summary.accelerated
 
 
+class TestPipelineAdapter:
+    def test_pca_kmeans_pipeline_over_dataframes(self, rng, session):
+        """Pipeline is data-plane agnostic: the same class chains the
+        DataFrame adapters (PCA features feed K-Means through the
+        adapter's transform DataFrames)."""
+        from oap_mllib_tpu.compat.pyspark import Pipeline
+
+        proto = rng.normal(size=(3, 6)) * 8
+        x = proto[rng.integers(3, size=150)] + 0.1 * rng.normal(size=(150, 6))
+        dataset = _df(session, features=[list(r) for r in x])
+        pipe = Pipeline(stages=[
+            PCA(k=3, inputCol="features", outputCol="pca"),
+            KMeans(k=3, seed=1, featuresCol="pca"),
+        ])
+        model = pipe.fit(dataset)
+        out = model.transform(dataset)
+        assert out.columns == ["features", "pca", "prediction"]
+        assert len(np.unique([r[2] for r in out.collect()])) == 3
+
+
 class TestPCAAdapter:
     def test_pca_example_flow(self, rng, session):
         """pca-pyspark.py verbatim-minus-import: keyword constructor,
