@@ -377,11 +377,13 @@ class ALSModel:
             dataset, rows, idx, self._inner._predictionCol, pred, "double"
         )
 
-    def recommendForAllUsers(self, numItems: int) -> np.ndarray:
-        return self._inner.recommendForAllUsers(numItems)
+    def recommendForAllUsers(self, numItems: int, withScores: bool = False):
+        return self._inner.recommendForAllUsers(numItems,
+                                                withScores=withScores)
 
-    def recommendForAllItems(self, numUsers: int) -> np.ndarray:
-        return self._inner.recommendForAllItems(numUsers)
+    def recommendForAllItems(self, numUsers: int, withScores: bool = False):
+        return self._inner.recommendForAllItems(numUsers,
+                                                withScores=withScores)
 
     def save(self, path: str) -> None:
         self._inner.save(path)

@@ -40,6 +40,29 @@ def _features_from(data: Union[np.ndarray, DataFrame], col: str) -> np.ndarray:
     return np.asarray(data)
 
 
+def _save_compat_meta(path: str, meta: dict) -> None:
+    """Persist the compat surface alongside the core model artifacts —
+    column names (and per-model extras) must survive save/load, like
+    Spark's own model metadata (DefaultParamsWriter)."""
+    import json as _json
+    import os as _os
+
+    with open(_os.path.join(path, "compat_metadata.json"), "w") as f:
+        _json.dump(meta, f)
+
+
+def _load_compat_meta(path: str) -> dict:
+    """{} for pre-round-4 saves (callers fall back to defaults)."""
+    import json as _json
+    import os as _os
+
+    p = _os.path.join(path, "compat_metadata.json")
+    if not _os.path.exists(p):
+        return {}
+    with open(p) as f:
+        return _json.load(f)
+
+
 class KMeans:
     """Spark-ML-style K-Means builder (reference shim: ml.clustering.KMeans)."""
 
@@ -140,10 +163,19 @@ class KMeansModel:
 
     def save(self, path: str) -> None:
         self._inner.save(path)
+        _save_compat_meta(path, {
+            "featuresCol": self._featuresCol,
+            "predictionCol": self._predictionCol,
+        })
 
     @classmethod
     def load(cls, path: str) -> "KMeansModel":
-        return cls(_kmeans.KMeansModel.load(path), "features", "prediction")
+        meta = _load_compat_meta(path)
+        return cls(
+            _kmeans.KMeansModel.load(path),
+            meta.get("featuresCol", "features"),
+            meta.get("predictionCol", "prediction"),
+        )
 
 
 class PCA:
@@ -192,10 +224,19 @@ class PCAModel:
 
     def save(self, path: str) -> None:
         self._inner.save(path)
+        _save_compat_meta(path, {
+            "inputCol": self._inputCol,
+            "outputCol": self._outputCol,
+        })
 
     @classmethod
     def load(cls, path: str) -> "PCAModel":
-        return cls(_pca.PCAModel.load(path), "features", "pcaFeatures")
+        meta = _load_compat_meta(path)
+        return cls(
+            _pca.PCAModel.load(path),
+            meta.get("inputCol", "features"),
+            meta.get("outputCol", "pcaFeatures"),
+        )
 
 
 class ALS:
@@ -385,12 +426,21 @@ class ALSModel:
             out[self._predictionCol] = pred
         return out
 
-    def recommendForAllUsers(self, numItems: int) -> np.ndarray:
-        return self._inner.recommend_for_all_users(numItems)
+    def recommendForAllUsers(self, numItems: int,
+                             withScores: bool = False):
+        """Top-N item ids per user; ``withScores=True`` also returns the
+        predicted ratings (Spark's recommendForAllUsers returns
+        (item, rating) structs)."""
+        return self._inner.recommend_for_all_users(
+            numItems, with_scores=withScores
+        )
 
-    def recommendForAllItems(self, numUsers: int) -> np.ndarray:
-        """Top-N user ids per item."""
-        return self._inner.recommend_for_all_items(numUsers)
+    def recommendForAllItems(self, numUsers: int,
+                             withScores: bool = False):
+        """Top-N user ids per item; ``withScores`` as above."""
+        return self._inner.recommend_for_all_items(
+            numUsers, with_scores=withScores
+        )
 
     def save(self, path: str) -> None:
         """Persist factors AND the compat surface: column names,
@@ -399,7 +449,6 @@ class ALSModel:
         round-trip (its ALSModel persists the factor id lists,
         ALS.scala:119-128); without them a loaded model silently
         degrades to range checks."""
-        import json as _json
         import os as _os
 
         self._inner.save(path)
@@ -407,27 +456,18 @@ class ALSModel:
             np.save(_os.path.join(path, "seen_users.npy"), self._seenUsers)
         if self._seenItems is not None:
             np.save(_os.path.join(path, "seen_items.npy"), self._seenItems)
-        with open(_os.path.join(path, "compat_metadata.json"), "w") as f:
-            _json.dump(
-                {
-                    "userCol": self._userCol,
-                    "itemCol": self._itemCol,
-                    "predictionCol": self._predictionCol,
-                    "coldStartStrategy": self._coldStartStrategy,
-                },
-                f,
-            )
+        _save_compat_meta(path, {
+            "userCol": self._userCol,
+            "itemCol": self._itemCol,
+            "predictionCol": self._predictionCol,
+            "coldStartStrategy": self._coldStartStrategy,
+        })
 
     @classmethod
     def load(cls, path: str) -> "ALSModel":
-        import json as _json
         import os as _os
 
-        meta = {}
-        meta_path = _os.path.join(path, "compat_metadata.json")
-        if _os.path.exists(meta_path):  # older saves: core-only defaults
-            with open(meta_path) as f:
-                meta = _json.load(f)
+        meta = _load_compat_meta(path)
 
         def _opt(name):
             p = _os.path.join(path, name)

@@ -33,12 +33,12 @@ from oap_mllib_tpu.utils.timing import Timings, phase_timer
 
 
 @functools.partial(jax.jit, static_argnames=("n",))
-def _top_k_ids(q: jax.Array, targets: jax.Array, n: int) -> jax.Array:
-    """Top-n target ids for a block of query rows — module-level so the
-    compiled program caches across recommend_for_all_* calls (a per-call
-    jit lambda would recompile every time AND constant-fold the whole
-    factor matrix into the executable)."""
-    return jax.lax.top_k(jnp.matmul(q, targets.T), n)[1]
+def _top_k_pairs(q: jax.Array, targets: jax.Array, n: int):
+    """Top-n (scores, ids) for a block of query rows — module-level so
+    the compiled program caches across recommend_for_all_* calls (a
+    per-call jit lambda would recompile every time AND constant-fold the
+    whole factor matrix into the executable)."""
+    return jax.lax.top_k(jnp.matmul(q, targets.T), n)
 
 
 class ALSModel:
@@ -136,40 +136,64 @@ class ALSModel:
 
     @staticmethod
     def _top_k_scores(query: np.ndarray, targets: np.ndarray, n: int,
-                      row_chunk: int = 0) -> np.ndarray:
-        """Top-n target ids per query row, chunked over query rows so the
-        (n_query, n_targets) score matrix never materializes (the
+                      row_chunk: int = 0, with_scores: bool = True):
+        """Top-n (ids, scores) per query row, chunked over query rows so
+        the (n_query, n_targets) score matrix never materializes (the
         reference blocks its recommendForAll the same way —
         ALS.scala:383-401 blockify — because the full cross product is
         quadratic in memory).  ``row_chunk`` 0 sizes chunks from the
         shared live-buffer budget over the score block AND the query
         chunk (kmeans_ops.rows_per_chunk) — a fixed row count would blow
         up against a huge target side, and a score-only bound against a
-        wide query side."""
+        wide query side.  ``with_scores=False`` skips the host transfer
+        of the float score blocks entirely (ids-only callers should not
+        pay a second device->host copy); the scores slot is then None."""
         from oap_mllib_tpu.ops.kmeans_ops import rows_per_chunk
 
         if query.shape[0] == 0:
-            return np.zeros((0, n), np.int32)
+            return (
+                np.zeros((0, n), np.int32),
+                np.zeros((0, n), np.float32) if with_scores else None,
+            )
         rows = row_chunk or rows_per_chunk(
             targets.shape[0], query.shape[1]
         )
         tj = jnp.asarray(targets)
-        out = [
-            np.asarray(
-                _top_k_ids(jnp.asarray(query[lo : lo + rows]), tj, n)
-            )
-            for lo in range(0, query.shape[0], rows)
-        ]
-        return np.concatenate(out, axis=0)
+        ids, scores = [], []
+        for lo in range(0, query.shape[0], rows):
+            s, i = _top_k_pairs(jnp.asarray(query[lo : lo + rows]), tj, n)
+            ids.append(np.asarray(i))
+            if with_scores:
+                scores.append(np.asarray(s))
+        return (
+            np.concatenate(ids, axis=0),
+            np.concatenate(scores, axis=0) if with_scores else None,
+        )
 
-    def recommend_for_all_users(self, num_items: int) -> np.ndarray:
+    def recommend_for_all_users(
+        self, num_items: int, with_scores: bool = False
+    ):
         """Top-N item ids per user — one (n_users, r)x(r, n_items) MXU
-        matmul + top_k (~ ALSModel.recommendForAllUsers)."""
-        return self._top_k_scores(self.user_factors_, self.item_factors_, num_items)
+        matmul + top_k (~ ALSModel.recommendForAllUsers).  Spark returns
+        (item, rating) structs; ``with_scores=True`` returns the
+        (ids, scores) pair (descending scores, the predicted
+        preferences)."""
+        ids, scores = self._top_k_scores(
+            self.user_factors_, self.item_factors_, num_items,
+            with_scores=with_scores,
+        )
+        return (ids, scores) if with_scores else ids
 
-    def recommend_for_all_items(self, num_users: int) -> np.ndarray:
-        """Top-N user ids per item (~ ALSModel.recommendForAllItems)."""
-        return self._top_k_scores(self.item_factors_, self.user_factors_, num_users)
+    def recommend_for_all_items(
+        self, num_users: int, with_scores: bool = False
+    ):
+        """Top-N user ids per item (~ ALSModel.recommendForAllItems);
+        ``with_scores`` as in recommend_for_all_users."""
+        ids, scores = self._top_k_scores(
+            self.item_factors_, self.user_factors_, num_users,
+            with_scores=with_scores,
+        )
+        return (ids, scores) if with_scores else ids
 
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)

@@ -166,7 +166,7 @@ class TestBehavior:
         # chunking, and both vs the NumPy full cross product: compare
         # SCORES, not ids — near-tie rows may order differently between
         # compiled shapes / matmul implementations
-        chunked = model._top_k_scores(
+        chunked, _ = model._top_k_scores(
             model.user_factors_, model.item_factors_, 5, row_chunk=7
         )
         scores = model.user_factors_ @ model.item_factors_.T
@@ -178,10 +178,25 @@ class TestBehavior:
             np.take_along_axis(scores, recs, axis=1), best, rtol=1e-5
         )
         # empty query side: shape-(0, n) result, no crash
-        empty = model._top_k_scores(
+        empty_ids, empty_scores = model._top_k_scores(
             model.user_factors_[:0], model.item_factors_, 5
         )
-        assert empty.shape == (0, 5)
+        assert empty_ids.shape == empty_scores.shape == (0, 5)
+
+    def test_recommend_with_scores(self, rng):
+        """with_scores returns descending predicted preferences that
+        match predict() on the same (user, item) pairs."""
+        u, i, r, nu, ni = _ratings(rng)
+        m = ALS(rank=4, max_iter=3, implicit_prefs=True).fit(
+            u, i, r, n_users=nu, n_items=ni
+        )
+        ids, scores = m.recommend_for_all_users(5, with_scores=True)
+        assert ids.shape == scores.shape == (nu, 5)
+        assert (np.diff(scores, axis=1) <= 1e-6).all()  # descending
+        uu = np.repeat(np.arange(nu), 5)
+        np.testing.assert_allclose(
+            scores.ravel(), m.predict(uu, ids.ravel()), atol=1e-5
+        )
 
     def test_param_validation(self):
         for bad in (dict(rank=0), dict(max_iter=-1), dict(reg_param=-0.1), dict(alpha=-1)):

@@ -67,6 +67,26 @@ class TestKMeansCompat:
         loaded = KMeansModel.load(str(tmp_path / "m"))
         np.testing.assert_array_equal(loaded.clusterCenters(), model.clusterCenters())
 
+    def test_save_load_keeps_columns(self, tmp_path, rng):
+        """Column config survives persistence (the round-4 ALS fix,
+        applied to every compat model): a loaded model transforms frames
+        with the SAME custom columns the fitted one did."""
+        from oap_mllib_tpu.compat.spark import KMeansModel, PCAModel
+
+        x = rng.normal(size=(60, 5))
+        km = (KMeans().setK(2).setSeed(1)
+              .setFeaturesCol("f").setPredictionCol("lbl")
+              .fit({"f": x}))
+        km.save(str(tmp_path / "km"))
+        lk = KMeansModel.load(str(tmp_path / "km"))
+        out = lk.transform({"f": x})
+        assert "lbl" in out
+        pm = (PCA().setK(2).setInputCol("f").setOutputCol("proj")
+              .fit({"f": x}))
+        pm.save(str(tmp_path / "pca"))
+        lp = PCAModel.load(str(tmp_path / "pca"))
+        assert "proj" in lp.transform({"f": x})
+
 
 class TestPCACompat:
     def test_fit_transform(self, rng):
