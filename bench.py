@@ -7,11 +7,15 @@ row-chunked Lloyd so the (n, k) distance matrix never materializes):
 
   {"metric": ..., "value": N, "unit": "iters/sec", "vs_baseline": N, ...}
 
-``python bench.py --all`` regenerates EVERY number in BASELINE.md — one
-JSON line per metric (K-Means both precision tiers, PCA 1M x 128 plus the
-largest-d single-chip proxy, ALS at MovieLens-1M scale) — the analog of
-the reference's per-phase timing printouts (PCADALImpl.cpp:71-159,
+``python bench.py --all`` regenerates every number in BASELINE.md's main
+measured table — one JSON line per metric (K-Means both precision tiers,
+PCA 1M x 128 plus the largest-d single-chip proxy with per-phase slope
+attribution, ALS at MovieLens-1M and -25M scale) — the analog of the
+reference's per-phase timing printouts (PCADALImpl.cpp:71-159,
 ALSDALImpl.cpp:429-436), but recorded instead of scrolled away.
+(BASELINE's feature sections — streamed ALS, item layouts, the
+randomized PCA solver — record their own scripted measurements inline;
+``--mesh N`` runs the weak-scaling harness.)
 
 K-Means/PCA lines report achieved TFLOP/s and MFU against the chip's bf16
 peak.  Timings are best-of-3: the device tunnel used in this environment
