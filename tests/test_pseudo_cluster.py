@@ -38,11 +38,18 @@ def _worker_env():
     return env
 
 
-def _run_world(nproc=2, local_dev=2, timeout=300, worker=_WORKER):
+def _launch_world(nproc=2, local_dev=2, timeout=300, worker=_WORKER):
+    """Spawn an nproc world and collect (procs, outs, elapsed_sec) —
+    the shared plumbing; callers interpret success/failure (the happy
+    -path suites demand RESULT lines, the error-injection test demands
+    prompt collective failure)."""
+    import time
+
     from oap_mllib_tpu.parallel.bootstrap import free_port
 
     coord = f"127.0.0.1:{free_port('127.0.0.1', 4000)}"
     env = _worker_env()
+    t0 = time.monotonic()
     procs = [
         subprocess.Popen(
             [sys.executable, worker, str(r), str(nproc), coord, str(local_dev)],
@@ -63,6 +70,11 @@ def _run_world(nproc=2, local_dev=2, timeout=300, worker=_WORKER):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    return procs, outs, time.monotonic() - t0
+
+
+def _run_world(nproc=2, local_dev=2, timeout=300, worker=_WORKER):
+    procs, outs, _ = _launch_world(nproc, local_dev, timeout, worker)
     results = {}
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}"
@@ -328,6 +340,27 @@ class TestPseudoCluster:
                 world3_results[rank]["als_sh_if"], oracle.item_factors_,
                 atol=4e-3, rtol=4e-3,
             )
+
+    def test_source_error_fails_world_fast(self):
+        """The _PassGuard contract in a REAL 2-process world: rank 1's
+        source errors mid-pass, and BOTH ranks must raise out of the
+        same fit promptly — not hang in process_allgather until the
+        distributed timeout (the pre-round-4 behavior)."""
+        worker = os.path.join(
+            os.path.dirname(__file__), "pseudo_cluster_worker_err.py"
+        )
+        procs, outs, elapsed = _launch_world(
+            nproc=2, local_dev=1, timeout=120, worker=worker
+        )
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"worker did not see the error:\n{out}"
+            assert "EXPECTED_ERROR" in out, out
+        # rank 0's source is consistent — its failure can only be the
+        # guard flag riding the collective (the mechanism under test)
+        assert "RuntimeError: streamed pass failed" in outs[0], outs[0]
+        assert "deterministic" in outs[1], outs[1]  # the original error
+        # both ranks failed together, well under any distributed timeout
+        assert elapsed < 90, f"world took {elapsed:.0f}s to fail"
 
     def test_ranks_agree(self, world_results):
         """Replicated results must be bitwise-identical across ranks."""
