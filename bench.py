@@ -564,6 +564,43 @@ def bench_mesh(n_devices: int, backend: str = "cpu", sizes: str = "small"):
             virtual_cpu=virtual,
         )
 
+        # the 2-D item-sharded layout on the same edges/sizes: second
+        # shuffle by item block, Y block-sharded, all_gather exchanges
+        i_loc, u_glob, conf_i, valid_i, _, ipb = (
+            als_block.prepare_block_inputs(i, u, rr, mesh, n_items)
+        )
+        grouped2 = als_block.prepare_grouped_inputs_2d(
+            u_loc, i_glob, conf, valid, i_loc, u_glob, conf_i, valid_i,
+            mesh, upb, ipb,
+        )
+        y0_sh = jax.device_put(
+            (rng.normal(size=(m * ipb, r)) * 0.1).astype(np.float32),
+            NS(mesh, P("data", None)),
+        )
+
+        def run_sh():
+            bx, by = als_block.als_block_run_grouped_2d(
+                grouped2, x0, y0_sh, als_iters, 0.1, 1.0, mesh,
+                implicit=True,
+            )
+            return np.asarray(by)
+
+        dt = _best_of(run_sh, reps=2)
+        _emit(
+            "mesh_scaling_als", dt / als_iters, "sec/iter", 1.0,
+            mesh=m, per_rank_edges=edges_per_rank,
+            per_rank_users=users_per_rank, n_items=n_items, rank=r,
+            item_layout="sharded",
+            # two tiled all_gathers (X, Y) + TWO r*r Gram allreduces
+            # (allreduce = 2x payload, the same convention as every
+            # other formula in this file)
+            collective_bytes_per_iter=int(
+                ((n_users + n_items) * r + 4 * r * r)
+                * 4 * (m - 1) / max(m, 1)
+            ),
+            virtual_cpu=virtual,
+        )
+
 
 def _tests_tpu_status(timeout=900):
     """Run the compiled-mode TPU suite and report its outcome, so the
