@@ -105,7 +105,9 @@ class Config:
     # two factor all_gathers — ~(r+1)x less traffic — and the per-rank
     # item partials and resident Y shrink world-fold.  "auto" shards once
     # the replicated psum bytes/iteration exceed
-    # ops.als_block.ITEM_SHARD_AUTO_BYTES.
+    # ops.als_block.ITEM_SHARD_AUTO_BYTES AND the sharded all_gather
+    # traffic is actually lower (user-dominated id spaces stay
+    # replicated — ops.als_block.item_layout_sharded).
     als_item_layout: str = "auto"
     # PCA eigensolver.  "eigh" (and "auto", today's resolution of it) =
     # the full d x d factorization — the parity contract, exact for any

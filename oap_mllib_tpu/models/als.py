@@ -619,7 +619,9 @@ class ALS:
         # item-factor layout: replicated-Y (one psum per item update) or
         # the full 2-D grid (Y block-sharded, all_gather exchanges) —
         # config knob + auto crossover, ops/als_block.py module notes
-        item_sharded = als_block.item_layout_sharded(n_items, self.rank, world)
+        item_sharded = als_block.item_layout_sharded(
+            n_items, self.rank, world, n_users
+        )
         # grouped-vs-COO decided BEFORE the shuffle, from host bincounts of
         # the pre-shuffle edges: a COO decision pays neither the grouped
         # build nor the device->host pull of the shuffled blocks

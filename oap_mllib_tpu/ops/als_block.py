@@ -82,12 +82,26 @@ def als_item_layout_cfg() -> str:
     return layout
 
 
-def item_layout_sharded(n_items: int, r: int, world: int) -> bool:
-    """Resolve config.als_item_layout to a concrete layout decision."""
+def item_layout_sharded(
+    n_items: int, r: int, world: int, n_users: int = 0
+) -> bool:
+    """Resolve config.als_item_layout to a concrete layout decision.
+
+    "auto" shards when BOTH hold: the replicated psum payload
+    (n_items·r·(r+1)·4 bytes/iter) crosses ITEM_SHARD_AUTO_BYTES, AND
+    the sharded layout's traffic is actually lower — its per-iteration
+    all_gathers move ~(n_users+n_items)·r vs the psum's
+    ~2·n_items·r·(r+1), so a USER-dominated workload
+    (n_users > n_items·(2r+1)) would trade a big psum for a bigger X
+    all_gather and stays replicated."""
     layout = als_item_layout_cfg()
     if layout != "auto":
         return layout == "sharded"
-    return world > 1 and n_items * r * (r + 1) * 4 > ITEM_SHARD_AUTO_BYTES
+    return (
+        world > 1
+        and n_items * r * (r + 1) * 4 > ITEM_SHARD_AUTO_BYTES
+        and n_users <= n_items * (2 * r + 1)
+    )
 
 
 def _block_body(user_partials, item_partials, reg, implicit, axis, eye):

@@ -591,6 +591,13 @@ class TestItemSharded:
         assert not item_layout_sharded(1000, r, 8)
         assert item_layout_sharded(big, r, 8)
         assert not item_layout_sharded(big, r, 1)  # no mesh to shard over
+        # user-dominated past the traffic crossover (n_users > (2r+1) x
+        # n_items): the X all_gather would outweigh the psum — stay
+        # replicated even above the payload threshold
+        assert item_layout_sharded(big, r, 8, n_users=big * (2 * r + 1))
+        assert not item_layout_sharded(
+            big, r, 8, n_users=big * (2 * r + 1) + 1
+        )
         set_config(als_item_layout="sharded")
         assert item_layout_sharded(10, r, 8)
         set_config(als_item_layout="replicated")
