@@ -18,6 +18,8 @@ from oap_mllib_tpu.compat.pipeline import (
     ParamGridBuilder,
     Pipeline,
     PipelineModel,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
 )
 from oap_mllib_tpu.compat.spark import (
     ALS,
@@ -30,5 +32,6 @@ from oap_mllib_tpu.compat.spark import (
 __all__ = [
     "KMeans", "PCA", "ALS", "ClusteringEvaluator", "RegressionEvaluator",
     "Pipeline", "PipelineModel", "ParamGridBuilder", "CrossValidator",
-    "CrossValidatorModel",
+    "CrossValidatorModel", "TrainValidationSplit",
+    "TrainValidationSplitModel",
 ]

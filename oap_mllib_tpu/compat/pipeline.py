@@ -14,9 +14,10 @@ contract:
 - dict "DataFrames" (`compat.spark` estimators) — k-fold row slicing is
   column slicing;
 - real Spark DataFrames (`compat.pyspark` estimators) for `Pipeline` /
-  `PipelineModel`, which never look inside the data.  CrossValidator's
-  fold slicing is dict-plane only (on Spark, collect the columns first
-  — the adapters' driver-collect scope).
+  `PipelineModel`, which never look inside the data.  The tuners'
+  (`CrossValidator`, `TrainValidationSplit`) row slicing is dict-plane
+  only (on Spark, collect the columns first — the adapters'
+  driver-collect scope).
 
 Param grids: Spark's `ParamGridBuilder.addGrid` takes `Param` objects
 (`als.regParam`); these builders carry no Param descriptors, so
@@ -129,6 +130,52 @@ def _apply_params(estimator, param_map: Dict[str, object]):
     return est
 
 
+def _tuner_prepare(estimator, evaluator, maps, dataset, kind: str):
+    """Shared guard rails for both tuners: presence checks, the
+    empty-grid and dict-plane errors, and EAGER setter validation (an
+    unknown param must fail before any split is fit).  Returns the
+    concrete param-map list."""
+    if estimator is None or evaluator is None:
+        raise ValueError("estimator and evaluator must be set")
+    maps = [{}] if maps is None else list(maps)
+    if not maps:
+        # an EXPLICIT empty grid (e.g. addGrid with an empty values
+        # list collapses the Cartesian product to zero maps) must not
+        # silently become a defaults-only run
+        raise ValueError(
+            "estimatorParamMaps is empty — the param grid collapsed "
+            "to zero maps (addGrid with an empty values list?)"
+        )
+    if not isinstance(dataset, dict):
+        raise TypeError(
+            f"{kind} runs on dict DataFrames (on Spark, collect the "
+            "columns first — the adapter's driver-collect scope)"
+        )
+    for m in maps:
+        for name in m:
+            _setter(estimator, name)
+    return maps
+
+
+def _select_and_refit(estimator, evaluator, maps, metrics, dataset,
+                      label: str):
+    """Shared selection tail: NaN guard (np.argmin/argmax return a
+    NaN's index, so a single NaN split — e.g. coldStartStrategy="nan"
+    leaking NaN predictions into RMSE — would silently win), argbest by
+    the evaluator's direction, refit the winner on the full data.
+    Returns (best_model, best_index)."""
+    if any(np.isnan(a) for a in metrics):
+        bad = [m for m, a in zip(maps, metrics) if np.isnan(a)]
+        raise ValueError(
+            f"{label} metric is NaN for param map(s) {bad} — with ALS "
+            'use coldStartStrategy="drop" and ensure every split keeps '
+            "evaluable rows"
+        )
+    larger = bool(evaluator.isLargerBetter())
+    best = int(np.argmax(metrics) if larger else np.argmin(metrics))
+    return _apply_params(estimator, maps[best]).fit(dataset), best
+
+
 def _n_rows(df: dict) -> int:
     arrays = list(df.values())
     if not arrays:
@@ -170,29 +217,12 @@ class CrossValidator:
     def getNumFolds(self):           return self._numFolds
 
     def fit(self, dataset: dict) -> "CrossValidatorModel":
-        if self._estimator is None or self._evaluator is None:
-            raise ValueError("estimator and evaluator must be set")
-        maps = [{}] if self._maps is None else list(self._maps)
-        if not maps:
-            # an EXPLICIT empty grid (e.g. addGrid with an empty values
-            # list collapses the Cartesian product to zero maps) must not
-            # silently become a defaults-only run
-            raise ValueError(
-                "estimatorParamMaps is empty — the param grid collapsed "
-                "to zero maps (addGrid with an empty values list?)"
-            )
+        maps = _tuner_prepare(
+            self._estimator, self._evaluator, self._maps, dataset,
+            "CrossValidator",
+        )
         if self._numFolds < 2:
             raise ValueError("numFolds must be >= 2")
-        if not isinstance(dataset, dict):
-            raise TypeError(
-                "CrossValidator runs on dict DataFrames (on Spark, collect "
-                "the columns first — the adapter's driver-collect scope)"
-            )
-        # eager setter validation: an unknown param must fail before any
-        # fold is fit
-        for m in maps:
-            for name in m:
-                _setter(self._estimator, name)
         n = _n_rows(dataset)
         if n < self._numFolds:
             raise ValueError(
@@ -200,7 +230,6 @@ class CrossValidator:
             )
         perm = np.random.default_rng(self._seed).permutation(n)
         folds = np.array_split(perm, self._numFolds)
-        larger = bool(self._evaluator.isLargerBetter())
 
         avg = []
         for m in maps:
@@ -216,19 +245,9 @@ class CrossValidator:
                 scores.append(float(self._evaluator.evaluate(pred)))
             avg.append(float(np.mean(scores)))
 
-        if any(np.isnan(a) for a in avg):
-            # np.argmin/argmax return a NaN's index, so a single NaN fold
-            # (e.g. coldStartStrategy="nan" leaking NaN predictions into
-            # RMSE, or a fold whose every test row was cold-dropped)
-            # would silently "win" the selection
-            bad = [m for m, a in zip(maps, avg) if np.isnan(a)]
-            raise ValueError(
-                f"CV metric is NaN for param map(s) {bad} — with ALS use "
-                'coldStartStrategy="drop" and ensure every fold keeps '
-                "evaluable rows"
-            )
-        best = int(np.argmax(avg) if larger else np.argmin(avg))
-        best_model = _apply_params(self._estimator, maps[best]).fit(dataset)
+        best_model, best = _select_and_refit(
+            self._estimator, self._evaluator, maps, avg, dataset, "CV"
+        )
         return CrossValidatorModel(best_model, avg, maps[best])
 
 
@@ -237,6 +256,73 @@ class CrossValidatorModel:
                  bestParams: Dict[str, object]):
         self.bestModel = bestModel
         self.avgMetrics = list(avgMetrics)
+        self.bestParams = dict(bestParams)
+
+    def transform(self, dataset):
+        return self.bestModel.transform(dataset)
+
+
+class TrainValidationSplit:
+    """Single-split model selection (ml.tuning.TrainValidationSplit):
+    CrossValidator's cheaper sibling — one random train/validation
+    split per param map instead of k folds.  Same dict-plane scope,
+    setter-name grids, and NaN/empty-grid guard rails."""
+
+    def __init__(self, *, estimator=None, estimatorParamMaps=None,
+                 evaluator=None, trainRatio: float = 0.75, seed: int = 0):
+        self._estimator = estimator
+        self._maps = estimatorParamMaps
+        self._evaluator = evaluator
+        self._trainRatio = trainRatio
+        self._seed = seed
+
+    def setEstimator(self, v):          self._estimator = v; return self
+    def setEstimatorParamMaps(self, v): self._maps = v; return self
+    def setEvaluator(self, v):          self._evaluator = v; return self
+    def setTrainRatio(self, v):         self._trainRatio = v; return self
+    def setSeed(self, v):               self._seed = v; return self
+
+    def getEstimator(self):          return self._estimator
+    def getEstimatorParamMaps(self): return self._maps
+    def getEvaluator(self):          return self._evaluator
+    def getTrainRatio(self):         return self._trainRatio
+
+    def fit(self, dataset: dict) -> "TrainValidationSplitModel":
+        maps = _tuner_prepare(
+            self._estimator, self._evaluator, self._maps, dataset,
+            "TrainValidationSplit",
+        )
+        if not 0.0 < self._trainRatio < 1.0:
+            raise ValueError("trainRatio must be in (0, 1)")
+        n = _n_rows(dataset)
+        n_train = int(n * self._trainRatio)
+        if n_train < 1 or n_train >= n:
+            raise ValueError(
+                f"trainRatio={self._trainRatio} leaves an empty split "
+                f"({n} rows)"
+            )
+        perm = np.random.default_rng(self._seed).permutation(n)
+        train = _take(dataset, perm[:n_train])
+        val = _take(dataset, perm[n_train:])
+
+        metrics = []
+        for m in maps:
+            model = _apply_params(self._estimator, m).fit(train)
+            metrics.append(
+                float(self._evaluator.evaluate(model.transform(val)))
+            )
+        best_model, best = _select_and_refit(
+            self._estimator, self._evaluator, maps, metrics, dataset,
+            "validation",
+        )
+        return TrainValidationSplitModel(best_model, metrics, maps[best])
+
+
+class TrainValidationSplitModel:
+    def __init__(self, bestModel, validationMetrics: List[float],
+                 bestParams: Dict[str, object]):
+        self.bestModel = bestModel
+        self.validationMetrics = list(validationMetrics)
         self.bestParams = dict(bestParams)
 
     def transform(self, dataset):

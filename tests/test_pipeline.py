@@ -13,6 +13,7 @@ from oap_mllib_tpu.compat import (
     ParamGridBuilder,
     Pipeline,
     RegressionEvaluator,
+    TrainValidationSplit,
 )
 
 
@@ -170,3 +171,33 @@ class TestCrossValidator:
                 estimator=ALS(),
                 evaluator=RegressionEvaluator(labelCol="rating"),
             ).fit(np.zeros((10, 3)))
+
+
+class TestTrainValidationSplit:
+    def test_selects_sane_reg(self, rng):
+        df = _ratings(rng)
+        tvs = TrainValidationSplit(
+            estimator=(ALS().setRank(4).setMaxIter(4)
+                       .setColdStartStrategy("drop")),
+            estimatorParamMaps=(ParamGridBuilder()
+                                .addGrid("regParam", [0.05, 50.0])
+                                .build()),
+            evaluator=RegressionEvaluator(metricName="rmse",
+                                          labelCol="rating"),
+            trainRatio=0.8, seed=1,
+        )
+        model = tvs.fit(df)
+        assert model.bestParams == {"regParam": 0.05}
+        assert len(model.validationMetrics) == 2
+        assert model.validationMetrics[0] < model.validationMetrics[1]
+        out = model.transform(df)
+        assert np.isfinite(out["prediction"]).all()
+
+    def test_train_ratio_validation(self, rng):
+        tvs = TrainValidationSplit(
+            estimator=ALS().setColdStartStrategy("drop"),
+            evaluator=RegressionEvaluator(labelCol="rating"),
+            trainRatio=1.0,
+        )
+        with pytest.raises(ValueError, match="trainRatio"):
+            tvs.fit(_ratings(rng))
