@@ -615,6 +615,29 @@ class TestItemSharded:
         np.testing.assert_allclose(m2.item_factors_, m.item_factors_)
         np.testing.assert_allclose(m2.predict(u, i), m.predict(u, i))
 
+    def test_sharded_on_model_parallel_mesh(self, rng):
+        """als_item_layout="sharded" composes with model_parallel: the
+        (data=4, model=2) mesh replicates the block arrays over the
+        model axis and the data-axis all_gathers/psums still produce
+        the single-mesh factors."""
+        u, i, r, nu, ni = _ratings(rng, n_users=40, n_items=24)
+        x0 = init_factors(nu, 3, 1)
+        y0 = init_factors(ni, 3, 2)
+        set_config(als_item_layout="sharded")
+        m1 = ALS(rank=3, max_iter=2).fit(u, i, r, n_users=nu, n_items=ni,
+                                         init=(x0, y0))
+        set_config(model_parallel=2)
+        m2 = ALS(rank=3, max_iter=2).fit(u, i, r, n_users=nu, n_items=ni,
+                                         init=(x0, y0))
+        assert m2.summary["item_layout"] == "sharded"
+        assert m2.summary["num_user_blocks"] == 4  # data axis shrank
+        np.testing.assert_allclose(
+            m1.item_factors_, m2.item_factors_, atol=2e-4, rtol=2e-4
+        )
+        np.testing.assert_allclose(
+            m1.user_factors_, m2.user_factors_, atol=2e-4, rtol=2e-4
+        )
+
     def test_sharded_long_tail_falls_back_to_coo(self, rng):
         """Degree ~1: block_grouped_guard_2d must decide COO on the
         sharded path too, and the COO 2-D program must match the
