@@ -111,17 +111,42 @@ def _vectorize(mat: np.ndarray):
     return [[float(v) for v in row] for row in mat]
 
 
+def _out_pos(df, name: str) -> int:
+    """Where the output column lands: pyspark.ml's transform is
+    withColumn, which REPLACES a same-name column IN PLACE (appending
+    blindly would produce duplicate names on a re-scored DataFrame,
+    and moving the column to the end would break positional access and
+    union-by-position vs real pyspark output).  New names append."""
+    cols = list(df.columns)
+    return cols.index(name) if name in cols else len(cols)
+
+
 def _out_schema(df, name: str, kind: str):
-    """Output schema = df.schema + one explicitly-typed column (kind:
-    "int" | "double" | "vector").  The explicit schema matters on real
-    Spark: name-only inference raises on an EMPTY result (every row
-    cold-dropped, an empty randomSplit slice) where pyspark.ml's own
-    transform returns an empty typed DataFrame, and on all-null
-    columns.  Mocks without .schema/pyspark fall back to the name list
-    (inference never runs on them)."""
+    """Output schema = df.schema with one explicitly-typed column
+    (kind: "int" | "double" | "vector") placed at _out_pos.  The
+    explicit schema matters on real Spark: name-only inference raises
+    on an EMPTY result (every row cold-dropped, an empty randomSplit
+    slice) where pyspark.ml's own transform returns an empty typed
+    DataFrame, and on all-null columns.  Mocks without .schema/pyspark
+    fall back to the name list (inference never runs on them)."""
+    j = _out_pos(df, name)
+
+    def _drop_first(seq, match):
+        # only the FIRST occurrence, mirroring _stripped_rows — a
+        # duplicate-name frame (Spark permits them after joins) must
+        # keep row and schema lengths consistent
+        out, dropped = [], False
+        for f in seq:
+            if not dropped and match(f):
+                dropped = True
+            else:
+                out.append(f)
+        return out
+
     base = getattr(df, "schema", None)
     if base is None or not HAVE_PYSPARK:
-        return list(df.columns) + [name]
+        cols = _drop_first(list(df.columns), lambda c: c == name)
+        return cols[:j] + [name] + cols[j:]
     from pyspark.sql.types import (
         DoubleType,
         IntegerType,
@@ -137,22 +162,47 @@ def _out_schema(df, name: str, kind: str):
         t = IntegerType()
     else:
         t = DoubleType()
-    return StructType(list(base.fields) + [StructField(name, t, True)])
+    fields = _drop_first(list(base.fields), lambda f: f.name == name)
+    return StructType(fields[:j] + [StructField(name, t, True)] + fields[j:])
+
+
+def _replace_cell(row, j: int, v):
+    """Row tuple with the cell at ``j`` swapped for the output value —
+    the withColumn in-place replace (see _out_pos)."""
+    t = tuple(row)
+    return t[:j] + (v,) + t[j + 1 :]
 
 
 def _append_column(df, rows, name: str, values, kind: str) -> object:
-    """New DataFrame = the ALREADY-MATERIALIZED rows + one appended
+    """New DataFrame = the ALREADY-MATERIALIZED rows + the output
     column (driver-side; the egress mirror of the driver-collect
-    ingestion — same collect as the ingestion, see _collect_once)."""
-    data = [tuple(r) + (v,) for r, v in zip(rows, values)]
-    return _session_of(df).createDataFrame(data, _out_schema(df, name, kind))
+    ingestion — same collect as the ingestion, see _collect_once).
+    An existing same-name column is replaced in place (withColumn
+    semantics, see _out_pos)."""
+    schema = _out_schema(df, name, kind)
+    if name in list(df.columns):
+        j = _out_pos(df, name)
+        data = [_replace_cell(r, j, v) for r, v in zip(rows, values)]
+    else:
+        data = [tuple(r) + (v,) for r, v in zip(rows, values)]
+    return _session_of(df).createDataFrame(data, schema)
 
 
 def _rebuild_rows(df, rows, keep_idx, name: str, values, kind: str) -> object:
     """Like _append_column but keeping only ``keep_idx`` of the
     materialized rows — the coldStartStrategy="drop" egress."""
-    data = [tuple(rows[int(j)]) + (v,) for j, v in zip(keep_idx, values)]
-    return _session_of(df).createDataFrame(data, _out_schema(df, name, kind))
+    schema = _out_schema(df, name, kind)
+    if name in list(df.columns):
+        j = _out_pos(df, name)
+        data = [
+            _replace_cell(rows[int(i)], j, v)
+            for i, v in zip(keep_idx, values)
+        ]
+    else:
+        data = [
+            tuple(rows[int(i)]) + (v,) for i, v in zip(keep_idx, values)
+        ]
+    return _session_of(df).createDataFrame(data, schema)
 
 
 # ---------------------------------------------------------------------------

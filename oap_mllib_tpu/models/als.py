@@ -366,6 +366,7 @@ class ALS:
             return ALSModel(
                 x, y,
                 {"timings": timings, "accelerated": False,
+                 "item_layout": "replicated",
                  **self._block_summary(1)},
             )
 
@@ -457,6 +458,7 @@ class ALS:
             x, y,
             {"timings": timings, "accelerated": True,
              "als_kernel": "grouped" if grouped_ok else "coo",
+             "item_layout": "replicated",
              **self._block_summary(1)},
         )
 
@@ -592,7 +594,8 @@ class ALS:
         return ALSModel(
             x, y,
             {"timings": timings, "accelerated": True, "streamed": True,
-             "als_kernel": "grouped", **self._block_summary(1)},
+             "als_kernel": "grouped", "item_layout": "replicated",
+             **self._block_summary(1)},
         )
 
     def _block_summary(self, effective_user_blocks: int) -> dict:

@@ -115,6 +115,32 @@ class TestKMeansAdapter:
         pca = PCA(k=2, inputCol="features", outputCol="pc").fit(dataset)
         assert pca.transform(empty).collect() == []
 
+    def test_retransform_replaces_prediction_column(self, rng, session):
+        """Transforming an already-scored DataFrame must REPLACE the
+        prediction column (withColumn semantics), not append a
+        duplicate name."""
+        x = rng.normal(size=(40, 3))
+        dataset = _df(session, features=[list(r) for r in x])
+        model = KMeans(k=2, seed=1).fit(dataset)
+        once = model.transform(dataset)
+        twice = model.transform(once)
+        assert twice.columns == ["features", "prediction"]
+        assert [r[-1] for r in twice.collect()] == [
+            r[-1] for r in once.collect()
+        ]
+        # withColumn replaces IN PLACE: a reordered frame keeps the
+        # prediction column at its original position
+        reordered = _df(
+            session,
+            prediction=[0] * 40,
+            features=[list(r) for r in x],
+        )
+        out = model.transform(reordered)
+        assert out.columns == ["prediction", "features"]
+        assert [r[0] for r in out.collect()] == [
+            r[-1] for r in once.collect()
+        ]
+
     def test_weight_col(self, rng, session):
         x = rng.normal(size=(60, 4))
         w = np.ones(60)
