@@ -13,11 +13,21 @@ Works over BOTH data planes, because it only touches the stage
 contract:
 - dict "DataFrames" (`compat.spark` estimators) — k-fold row slicing is
   column slicing;
-- real Spark DataFrames (`compat.pyspark` estimators) for `Pipeline` /
-  `PipelineModel`, which never look inside the data.  The tuners'
-  (`CrossValidator`, `TrainValidationSplit`) row slicing is dict-plane
-  only (on Spark, collect the columns first — the adapters'
-  driver-collect scope).
+- real Spark DataFrames (`compat.pyspark` estimators): `Pipeline` /
+  `PipelineModel` never look inside the data, and the tuners
+  (`CrossValidator`, `TrainValidationSplit`) do the documented ONE
+  collect themselves (the adapters' driver-collect scope) — split
+  fit/evaluate runs on the collected dict plane (the pyspark adapters
+  delegate dict inputs to their dict-plane base), and the winning
+  params are refit on the ORIGINAL DataFrame so the returned
+  ``bestModel`` transforms DataFrames.
+
+Persistence: `Pipeline`/`PipelineModel`/`CrossValidatorModel`/
+`TrainValidationSplitModel` all save/load (Spark's MLWritable surface,
+which the reference inherits — e.g. IntelPCASuite.scala:90-104 tests
+model read/write): a JSON manifest records each stage's class, and
+fitted stages delegate to the stage model's own save/load (so e.g. a
+loaded ALS stage keeps its coldStartStrategy and seen-id sets).
 
 Param grids: Spark's `ParamGridBuilder.addGrid` takes `Param` objects
 (`als.regParam`); these builders carry no Param descriptors, so
@@ -30,9 +40,102 @@ mid-CV).
 from __future__ import annotations
 
 import copy
+import importlib
+import json
+import os
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Persistence plumbing (Spark MLWritable analog): a JSON manifest per
+# container records each stage's class; fitted stages delegate to the
+# stage model's own save/load, unfitted estimators snapshot their param
+# attributes (all simple scalars on the builder classes).
+# ---------------------------------------------------------------------------
+
+
+def _class_ref(obj) -> dict:
+    return {"module": type(obj).__module__, "cls": type(obj).__qualname__}
+
+
+def _resolve_class(ref: dict):
+    module = ref["module"]
+    # manifests name classes to import — constrain to this package so a
+    # tampered manifest cannot import-and-instantiate arbitrary code
+    if module != "oap_mllib_tpu" and not module.startswith("oap_mllib_tpu."):
+        raise ValueError(f"refusing to load stage class from {module!r}")
+    return getattr(importlib.import_module(module), ref["cls"])
+
+
+def _jsonable(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    raise TypeError(
+        f"cannot persist non-scalar param value {v!r} ({type(v).__name__})"
+    )
+
+
+def _save_estimator(est, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    params = {k: _jsonable(v) for k, v in est.__dict__.items()}
+    with open(os.path.join(path, "estimator.json"), "w") as f:
+        json.dump({"ref": _class_ref(est), "params": params}, f)
+
+
+def _load_estimator(path: str):
+    with open(os.path.join(path, "estimator.json")) as f:
+        blob = json.load(f)
+    est = _resolve_class(blob["ref"])()
+    est.__dict__.update(blob["params"])
+    return est
+
+
+def _save_stage(stage, path: str) -> None:
+    """Estimators snapshot params; anything else must bring its own
+    save (every model class in this package does)."""
+    if hasattr(stage, "fit"):
+        _save_estimator(stage, path)
+    elif hasattr(stage, "save"):
+        os.makedirs(path, exist_ok=True)
+        stage.save(path)
+    else:
+        raise TypeError(
+            f"stage {type(stage).__name__} has neither params to "
+            "snapshot (fit) nor a save method"
+        )
+
+
+def _load_stage(ref: dict, path: str):
+    if os.path.exists(os.path.join(path, "estimator.json")):
+        return _load_estimator(path)
+    cls = _resolve_class(ref)
+    if not hasattr(cls, "load"):
+        raise TypeError(f"stage class {cls.__name__} has no load method")
+    return cls.load(path)
+
+
+def _write_manifest(path: str, blob: dict) -> None:
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "pipeline_metadata.json"), "w") as f:
+        json.dump(blob, f)
+
+
+def _read_manifest(path: str, expect: str) -> dict:
+    with open(os.path.join(path, "pipeline_metadata.json")) as f:
+        blob = json.load(f)
+    if blob.get("type") != expect:
+        raise ValueError(
+            f"not a {expect} directory: {path} (found {blob.get('type')!r})"
+        )
+    return blob
 
 
 class Pipeline:
@@ -76,6 +179,25 @@ class Pipeline:
             fitted.append(model)
         return PipelineModel(fitted)
 
+    def save(self, path: str) -> None:
+        """Persist the (unfitted) stage list — param snapshots for
+        estimators, model save for pre-fitted transformer stages."""
+        stages = []
+        for i, stage in enumerate(self._stages):
+            d = f"stage_{i:02d}_{type(stage).__name__}"
+            _save_stage(stage, os.path.join(path, d))
+            stages.append({"dir": d, **_class_ref(stage)})
+        _write_manifest(path, {"type": "Pipeline", "version": 1,
+                               "stages": stages})
+
+    @classmethod
+    def load(cls, path: str) -> "Pipeline":
+        blob = _read_manifest(path, "Pipeline")
+        return cls(stages=[
+            _load_stage(s, os.path.join(path, s["dir"]))
+            for s in blob["stages"]
+        ])
+
 
 class PipelineModel:
     def __init__(self, stages: List):
@@ -86,6 +208,26 @@ class PipelineModel:
         for stage in self.stages:
             df = stage.transform(df)
         return df
+
+    def save(self, path: str) -> None:
+        """Persist every fitted stage via its own save (column names,
+        coldStartStrategy, seen-id sets all ride the stage models'
+        metadata)."""
+        stages = []
+        for i, stage in enumerate(self.stages):
+            d = f"stage_{i:02d}_{type(stage).__name__}"
+            _save_stage(stage, os.path.join(path, d))
+            stages.append({"dir": d, **_class_ref(stage)})
+        _write_manifest(path, {"type": "PipelineModel", "version": 1,
+                               "stages": stages})
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineModel":
+        blob = _read_manifest(path, "PipelineModel")
+        return cls([
+            _load_stage(s, os.path.join(path, s["dir"]))
+            for s in blob["stages"]
+        ])
 
 
 class ParamGridBuilder:
@@ -130,11 +272,36 @@ def _apply_params(estimator, param_map: Dict[str, object]):
     return est
 
 
+def _as_dict(dataset) -> dict:
+    """Dict-plane copy of a DataFrame via the adapters' documented ONE
+    collect (see compat/pyspark._collect_once: every column must come
+    from the same materializing action).  Cell conversion (vectors,
+    lists, scalars) is compat/pyspark._column_to_array — one set of
+    duck-type rules for every ingestion path.  Dicts return
+    unchanged."""
+    if isinstance(dataset, dict):
+        return dataset
+    if not (hasattr(dataset, "collect") and hasattr(dataset, "columns")):
+        raise TypeError(
+            "dataset must be a dict DataFrame or a Spark DataFrame "
+            f"(got {type(dataset).__name__})"
+        )
+    from oap_mllib_tpu.compat.pyspark import _column_to_array
+
+    rows, cols = dataset.collect(), list(dataset.columns)
+    return {
+        c: _column_to_array([r[j] for r in rows])
+        for j, c in enumerate(cols)
+    }
+
+
 def _tuner_prepare(estimator, evaluator, maps, dataset, kind: str):
     """Shared guard rails for both tuners: presence checks, the
-    empty-grid and dict-plane errors, and EAGER setter validation (an
-    unknown param must fail before any split is fit).  Returns the
-    concrete param-map list."""
+    empty-grid error, EAGER setter validation (an unknown param must
+    fail before any split is fit — and before the dataset is even
+    collected), then the one-collect to the dict plane for Spark
+    DataFrames.  Returns (param-map list, dict data for the split
+    loop)."""
     if estimator is None or evaluator is None:
         raise ValueError("estimator and evaluator must be set")
     maps = [{}] if maps is None else list(maps)
@@ -146,15 +313,21 @@ def _tuner_prepare(estimator, evaluator, maps, dataset, kind: str):
             "estimatorParamMaps is empty — the param grid collapsed "
             "to zero maps (addGrid with an empty values list?)"
         )
-    if not isinstance(dataset, dict):
-        raise TypeError(
-            f"{kind} runs on dict DataFrames (on Spark, collect the "
-            "columns first — the adapter's driver-collect scope)"
-        )
     for m in maps:
         for name in m:
             _setter(estimator, name)
-    return maps
+    import jax
+
+    if jax.process_count() > 1:
+        # splitting/refitting on collected copies would feed every rank
+        # the FULL data as its "local shard" (world-duplicated rows);
+        # tuning is a driver-side, single-process flow
+        raise NotImplementedError(
+            f"{kind} runs single-process; in a multi-process world run "
+            "the tuner on one process (or fit the chosen params "
+            "directly with the multi-host estimators)"
+        )
+    return maps, _as_dict(dataset)
 
 
 def _select_and_refit(estimator, evaluator, maps, metrics, dataset,
@@ -216,14 +389,14 @@ class CrossValidator:
     def getEvaluator(self):          return self._evaluator
     def getNumFolds(self):           return self._numFolds
 
-    def fit(self, dataset: dict) -> "CrossValidatorModel":
-        maps = _tuner_prepare(
+    def fit(self, dataset) -> "CrossValidatorModel":
+        if self._numFolds < 2:  # before _tuner_prepare's collect
+            raise ValueError("numFolds must be >= 2")
+        maps, data = _tuner_prepare(
             self._estimator, self._evaluator, self._maps, dataset,
             "CrossValidator",
         )
-        if self._numFolds < 2:
-            raise ValueError("numFolds must be >= 2")
-        n = _n_rows(dataset)
+        n = _n_rows(data)
         if n < self._numFolds:
             raise ValueError(
                 f"{n} rows cannot split into {self._numFolds} folds"
@@ -240,11 +413,13 @@ class CrossValidator:
                     [folds[g] for g in range(self._numFolds) if g != f]
                 )
                 est = _apply_params(self._estimator, m)
-                model = est.fit(_take(dataset, train_idx))
-                pred = model.transform(_take(dataset, test_idx))
+                model = est.fit(_take(data, train_idx))
+                pred = model.transform(_take(data, test_idx))
                 scores.append(float(self._evaluator.evaluate(pred)))
             avg.append(float(np.mean(scores)))
 
+        # refit on the ORIGINAL dataset: a Spark-plane tuner must hand
+        # back a bestModel that transforms DataFrames
         best_model, best = _select_and_refit(
             self._estimator, self._evaluator, maps, avg, dataset, "CV"
         )
@@ -260,6 +435,23 @@ class CrossValidatorModel:
 
     def transform(self, dataset):
         return self.bestModel.transform(dataset)
+
+    def save(self, path: str) -> None:
+        _save_stage(self.bestModel, os.path.join(path, "bestModel"))
+        _write_manifest(path, {
+            "type": "CrossValidatorModel", "version": 1,
+            "bestModel": {"dir": "bestModel", **_class_ref(self.bestModel)},
+            "avgMetrics": [float(a) for a in self.avgMetrics],
+            "bestParams": {k: _jsonable(v)
+                           for k, v in self.bestParams.items()},
+        })
+
+    @classmethod
+    def load(cls, path: str) -> "CrossValidatorModel":
+        blob = _read_manifest(path, "CrossValidatorModel")
+        best = _load_stage(blob["bestModel"],
+                           os.path.join(path, blob["bestModel"]["dir"]))
+        return cls(best, blob["avgMetrics"], blob["bestParams"])
 
 
 class TrainValidationSplit:
@@ -287,14 +479,14 @@ class TrainValidationSplit:
     def getEvaluator(self):          return self._evaluator
     def getTrainRatio(self):         return self._trainRatio
 
-    def fit(self, dataset: dict) -> "TrainValidationSplitModel":
-        maps = _tuner_prepare(
+    def fit(self, dataset) -> "TrainValidationSplitModel":
+        if not 0.0 < self._trainRatio < 1.0:  # before the collect
+            raise ValueError("trainRatio must be in (0, 1)")
+        maps, data = _tuner_prepare(
             self._estimator, self._evaluator, self._maps, dataset,
             "TrainValidationSplit",
         )
-        if not 0.0 < self._trainRatio < 1.0:
-            raise ValueError("trainRatio must be in (0, 1)")
-        n = _n_rows(dataset)
+        n = _n_rows(data)
         n_train = int(n * self._trainRatio)
         if n_train < 1 or n_train >= n:
             raise ValueError(
@@ -302,8 +494,8 @@ class TrainValidationSplit:
                 f"({n} rows)"
             )
         perm = np.random.default_rng(self._seed).permutation(n)
-        train = _take(dataset, perm[:n_train])
-        val = _take(dataset, perm[n_train:])
+        train = _take(data, perm[:n_train])
+        val = _take(data, perm[n_train:])
 
         metrics = []
         for m in maps:
@@ -327,3 +519,20 @@ class TrainValidationSplitModel:
 
     def transform(self, dataset):
         return self.bestModel.transform(dataset)
+
+    def save(self, path: str) -> None:
+        _save_stage(self.bestModel, os.path.join(path, "bestModel"))
+        _write_manifest(path, {
+            "type": "TrainValidationSplitModel", "version": 1,
+            "bestModel": {"dir": "bestModel", **_class_ref(self.bestModel)},
+            "validationMetrics": [float(a) for a in self.validationMetrics],
+            "bestParams": {k: _jsonable(v)
+                           for k, v in self.bestParams.items()},
+        })
+
+    @classmethod
+    def load(cls, path: str) -> "TrainValidationSplitModel":
+        blob = _read_manifest(path, "TrainValidationSplitModel")
+        best = _load_stage(blob["bestModel"],
+                           os.path.join(path, blob["bestModel"]["dir"]))
+        return cls(best, blob["validationMetrics"], blob["bestParams"])

@@ -21,14 +21,21 @@ builder setters, ``fit(dataframe)``, ``model.transform(dataframe)``
 returning a DataFrame with the prediction column appended, evaluators
 that consume that DataFrame.
 
-Scope (documented, deliberate): the data plane is DRIVER-COLLECT — the
-needed columns are collected to host NumPy and the TPU framework takes
-over from there (mesh sharding happens inside the estimators).  That
-matches this framework's design point (the device mesh replaces the
-executor fleet; survey §2.5): Spark is the front-end API, not the
-compute fabric.  A cluster-scale ingestion (mapPartitions into
-per-process shards feeding the multi-host fit) would slot in at
-``_features_matrix``/``_column`` without touching the estimator API.
+Scope (documented, deliberate): in a single-process world the data
+plane is DRIVER-COLLECT — the needed columns are collected to host
+NumPy and the TPU framework takes over from there (mesh sharding
+happens inside the estimators).  That matches this framework's design
+point (the device mesh replaces the executor fleet; survey §2.5):
+Spark is the front-end API, not the compute fabric.
+
+In a MULTI-PROCESS world (``jax.process_count() > 1``) fits ingest
+partition-wise instead (the executor-local conversion of the
+reference, OneDAL.scala:92-166): each process materializes ONLY the
+partitions assigned to it (``partition % world == rank``) via
+``dataset.rdd.mapPartitionsWithIndex`` and feeds those rows as its
+process-local shard of the multi-host fit — no process ever collects
+the whole dataset (see ``_collect_local_partitions``).  Transform and
+the evaluators remain driver-collect scoring paths.
 
 Availability: importing this module does NOT require pyspark — every
 DataFrame interaction goes through the duck-typed surface
@@ -82,9 +89,84 @@ def _collect_once(df):
     return df.collect(), list(df.columns)
 
 
+def _collect_local_partitions(df, rank: Optional[int] = None,
+                              world: Optional[int] = None):
+    """Partition-wise ingestion for multi-process worlds: process r
+    materializes ONLY partitions p with ``p % world == r`` (the
+    reference's executor-local conversion, OneDAL.scala:92-166 — every
+    executor converts its own partitions, never the dataset).  The
+    kept rows become this process's LOCAL shard, which the estimators'
+    multi-host fit contract already accepts (models treat array inputs
+    as process-local when ``jax.process_count() > 1``).  Returns
+    (rows, cols) like _collect_once."""
+    import jax
+
+    rank = jax.process_index() if rank is None else rank
+    world = jax.process_count() if world is None else world
+    rdd = getattr(df, "rdd", None)
+    if rdd is None:
+        raise TypeError(
+            "multi-process ingestion needs dataset.rdd"
+            ".mapPartitionsWithIndex (a Spark DataFrame or equivalent); "
+            "a plain collect would hand every process the FULL dataset "
+            "as its shard"
+        )
+    keep = rdd.mapPartitionsWithIndex(
+        lambda pid, it, _r=rank, _w=world: it if pid % _w == _r else iter(())
+    )
+    rows = keep.collect()
+    # a rank with zero partitions (fewer partitions than world, e.g.
+    # coalesce(1)) must fail on EVERY rank together — a one-rank raise
+    # would leave the others hanging in the fit's first collective
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        counts = np.asarray(multihost_utils.process_allgather(
+            np.asarray([len(rows)], np.int64)
+        )).reshape(-1)
+        if (counts == 0).any():
+            empty = [int(r) for r in np.nonzero(counts == 0)[0]]
+            raise ValueError(
+                f"process(es) {empty} received zero partitions "
+                f"(world={world}); repartition the DataFrame to at "
+                "least the process count"
+            )
+    elif not rows:
+        raise ValueError(
+            f"process {rank} received zero partitions (world={world}); "
+            "repartition the DataFrame to at least the process count"
+        )
+    return rows, list(df.columns)
+
+
+def _ingest(df):
+    """The fit-side ingestion dispatch: one driver collect in a
+    single-process world, partition-wise local shards otherwise."""
+    import jax
+
+    if jax.process_count() > 1:
+        return _collect_local_partitions(df)
+    return _collect_once(df)
+
+
 def _col_from(rows, cols, name: str, dtype=None) -> np.ndarray:
     j = cols.index(name)
     return np.asarray([r[j] for r in rows], dtype=dtype)
+
+
+def _column_to_array(vals) -> np.ndarray:
+    """One collected column's cells -> ndarray: vector cells (toArray
+    duck-type) and list/tuple cells become (n, d) float64 matrices,
+    scalars pass through.  THE converter for whole-frame ingestion
+    (compat.pipeline._as_dict) — keep the duck-type rules here, next to
+    _mat_from/_col_from, so the planes cannot drift."""
+    if vals and hasattr(vals[0], "toArray"):
+        return np.asarray(
+            [np.asarray(v.toArray(), np.float64) for v in vals]
+        )
+    if vals and isinstance(vals[0], (list, tuple)):
+        return np.asarray([np.asarray(v, np.float64) for v in vals])
+    return np.asarray(vals)
 
 
 def _mat_from(rows, cols, name: str) -> np.ndarray:
@@ -231,11 +313,15 @@ class KMeans(_compat.KMeans):
         if weightCol is not None:
             self.setWeightCol(weightCol)
 
-    def fit(self, dataset) -> "KMeansModel":
+    def fit(self, dataset):
+        if isinstance(dataset, dict):
+            # tuner split plane: compat.pipeline collects a Spark frame
+            # once and fits the splits as dicts (dict-plane model out)
+            return super().fit(dataset)
         want = [self._featuresCol] + (
             [self._weightCol] if self._weightCol is not None else []
         )
-        rows, cols = _collect_once(dataset.select(*want))
+        rows, cols = _ingest(dataset.select(*want))
         data = {self._featuresCol: _mat_from(rows, cols, self._featuresCol)}
         if self._weightCol is not None:
             data[self._weightCol] = _col_from(
@@ -262,6 +348,8 @@ class KMeansModel:
         )
 
     def transform(self, dataset):
+        if isinstance(dataset, dict):  # dict-plane passthrough (tuners)
+            return self._inner.transform(dataset)
         rows, cols = _collect_once(dataset)
         if not rows:  # empty split: empty typed output, like pyspark.ml
             return _append_column(
@@ -276,6 +364,10 @@ class KMeansModel:
 
     def save(self, path: str) -> None:
         self._inner.save(path)
+
+    @classmethod
+    def load(cls, path: str) -> "KMeansModel":
+        return cls(_compat.KMeansModel.load(path))
 
 
 # ---------------------------------------------------------------------------
@@ -297,8 +389,10 @@ class PCA(_compat.PCA):
         if outputCol is not None:
             self.setOutputCol(outputCol)
 
-    def fit(self, dataset) -> "PCAModel":
-        rows, cols = _collect_once(dataset.select(self._inputCol))
+    def fit(self, dataset):
+        if isinstance(dataset, dict):  # tuner split plane (see KMeans.fit)
+            return super().fit(dataset)
+        rows, cols = _ingest(dataset.select(self._inputCol))
         inner = super().fit(
             {self._inputCol: _mat_from(rows, cols, self._inputCol)}
         )
@@ -318,6 +412,8 @@ class PCAModel:
         return self._inner.explainedVariance
 
     def transform(self, dataset):
+        if isinstance(dataset, dict):  # dict-plane passthrough (tuners)
+            return self._inner.transform(dataset)
         rows, cols = _collect_once(dataset)
         if not rows:  # empty split: empty typed output, like pyspark.ml
             return _append_column(
@@ -332,6 +428,10 @@ class PCAModel:
 
     def save(self, path: str) -> None:
         self._inner.save(path)
+
+    @classmethod
+    def load(cls, path: str) -> "PCAModel":
+        return cls(_compat.PCAModel.load(path))
 
 
 # ---------------------------------------------------------------------------
@@ -368,8 +468,10 @@ class ALS(_compat.ALS):
         if numItemBlocks is not None:
             self.setNumItemBlocks(numItemBlocks)
 
-    def fit(self, dataset) -> "ALSModel":
-        rows, cols = _collect_once(
+    def fit(self, dataset):
+        if isinstance(dataset, dict):  # tuner split plane (see KMeans.fit)
+            return super().fit(dataset)
+        rows, cols = _ingest(
             dataset.select(self._userCol, self._itemCol, self._ratingCol)
         )
         inner = super().fit(
@@ -403,7 +505,10 @@ class ALSModel:
     def transform(self, dataset):
         """Prediction column for (user, item) rows; coldStartStrategy
         "nan"/"drop" rides the inner transform — a hidden row-index
-        column reports which input rows survive "drop"."""
+        column reports which input rows survive "drop".  Dicts pass
+        through to the dict-plane model (tuners, loaded containers)."""
+        if isinstance(dataset, dict):
+            return self._inner.transform(dataset)
         rows, cols = _collect_once(dataset)
         if not rows:  # empty split: empty typed output, like pyspark.ml
             return _append_column(
@@ -438,6 +543,10 @@ class ALSModel:
     def save(self, path: str) -> None:
         self._inner.save(path)
 
+    @classmethod
+    def load(cls, path: str) -> "ALSModel":
+        return cls(_compat.ALSModel.load(path))
+
 
 # ---------------------------------------------------------------------------
 # Evaluators
@@ -454,6 +563,8 @@ class RegressionEvaluator(_compat.RegressionEvaluator):
                          predictionCol=predictionCol)
 
     def evaluate(self, dataset) -> float:
+        if isinstance(dataset, dict):  # tuner split plane (see KMeans.fit)
+            return super().evaluate(dataset)
         rows, cols = _collect_once(
             dataset.select(self._labelCol, self._predictionCol)
         )
@@ -491,6 +602,8 @@ class ClusteringEvaluator(_compat.ClusteringEvaluator):
         self.setMetricName(metricName).setDistanceMeasure(distanceMeasure)
 
     def evaluate(self, dataset) -> float:
+        if isinstance(dataset, dict):  # tuner split plane (see KMeans.fit)
+            return super().evaluate(dataset)
         rows, cols = _collect_once(
             dataset.select(self._featuresCol, self._predictionCol)
         )

@@ -40,6 +40,29 @@ def _features_from(data: Union[np.ndarray, DataFrame], col: str) -> np.ndarray:
     return np.asarray(data)
 
 
+def _global_unique(ids: np.ndarray) -> np.ndarray:
+    """Unique ids across ALL processes: the cold-start "seen in
+    training" sets must be world-consistent when each rank only holds
+    its shard (partition-wise ingestion, compat/pyspark module notes) —
+    rank-local sets would make transform drop different rows on
+    different ranks.  Fixed-shape allgather (lengths first, then padded
+    ids) since every cross-process exchange here is fixed-shape."""
+    import jax
+
+    loc = np.unique(np.asarray(ids, np.int64))
+    if jax.process_count() == 1:
+        return loc
+    from jax.experimental import multihost_utils
+
+    n = int(np.max(multihost_utils.process_allgather(
+        np.asarray([len(loc)], np.int64)
+    )))
+    pad = np.full((n,), -1, np.int64)
+    pad[: len(loc)] = loc
+    allv = np.asarray(multihost_utils.process_allgather(pad)).reshape(-1)
+    return np.unique(allv[allv >= 0])
+
+
 def _save_compat_meta(path: str, meta: dict) -> None:
     """Persist the compat surface alongside the core model artifacts —
     column names (and per-model extras) must survive save/load, like
@@ -359,7 +382,8 @@ class ALS:
         return ALSModel(inner, self._userCol, self._itemCol,
                         prediction_col=self._predictionCol,
                         cold_start_strategy=self.getColdStartStrategy(),
-                        seen_users=np.unique(users), seen_items=np.unique(items))
+                        seen_users=_global_unique(users),
+                        seen_items=_global_unique(items))
 
 
 class ALSModel:

@@ -136,6 +136,78 @@ als_out["als_sh_uf"] = np.asarray(m_sh.user_factors_).tolist()
 als_out["als_sh_if"] = np.asarray(m_sh.item_factors_).tolist()
 set_config(als_item_layout="auto")
 
+# --- PySpark-adapter distributed ingestion: a mocked partitioned
+# DataFrame (the duck-typed rdd.mapPartitionsWithIndex surface) feeds
+# each process ONLY its partitions (pid % world == rank), which the
+# adapter passes as this process's local shard of the multi-host fit
+# (compat/pyspark._collect_local_partitions — the executor-local
+# conversion of the reference, OneDAL.scala:92-166).  No process ever
+# collects the whole dataset.
+from oap_mllib_tpu.compat import pyspark as compat_pyspark
+
+
+class _PartDF:
+    """Minimal partitioned-DataFrame mock: rows split into n_parts
+    contiguous partitions; mapPartitionsWithIndex hands each (pid,
+    iterator) to the filter like Spark would."""
+
+    def __init__(self, cols, n_parts):
+        self._cols, self._nparts = cols, n_parts
+
+    @property
+    def columns(self):
+        return list(self._cols)
+
+    def select(self, *names):
+        return _PartDF({n: self._cols[n] for n in names}, self._nparts)
+
+    def collect(self):
+        names = list(self._cols)
+        n = len(self._cols[names[0]])
+        return [tuple(self._cols[c][j] for c in names) for j in range(n)]
+
+    @property
+    def rdd(self):
+        rows = self.collect()
+        parts = np.array_split(np.arange(len(rows)), self._nparts)
+
+        class _Res:
+            def __init__(self, out):
+                self._out = out
+
+            def collect(self):
+                return self._out
+
+        class _RDD:
+            def mapPartitionsWithIndex(self, f):
+                out = []
+                for pid, idx in enumerate(parts):
+                    out.extend(f(pid, iter([rows[j] for j in idx])))
+                return _Res(out)
+
+        return _RDD()
+
+
+pdf = _PartDF({"features": [list(row) for row in x]}, 8)
+am = compat_pyspark.KMeans(k=5, seed=7, maxIter=30).fit(pdf)
+assert am.summary.accelerated
+
+rdf = _PartDF(
+    {
+        "user": [int(v) for v in au],
+        "item": [int(v) for v in ai],
+        "rating": [float(v) for v in ar],
+    },
+    6,
+)
+a_als = compat_pyspark.ALS(rank=RANK, maxIter=3, regParam=0.1, alpha=0.8,
+                           implicitPrefs=True, seed=3, userCol="user",
+                           itemCol="item", ratingCol="rating",
+                           coldStartStrategy="drop").fit(rdf)
+# the cold-start seen sets must be WORLD-consistent even though each
+# rank ingested different partitions (compat/spark._global_unique)
+seen_u = sorted(int(v) for v in a_als._inner._seenUsers)
+
 print(
     "RESULT "
     + json.dumps(
@@ -157,6 +229,9 @@ print(
             "streamed_pca_pc0_abs": np.abs(
                 np.asarray(ps.components_)[:, 0]
             ).tolist(),
+            "adapter_mp_cost": float(am.summary.training_cost),
+            "adapter_als_uf": np.asarray(a_als.userFactors).tolist(),
+            "adapter_seen_users": seen_u,
             **als_out,
         }
     ),

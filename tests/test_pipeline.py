@@ -166,7 +166,7 @@ class TestCrossValidator:
                 evaluator=RegressionEvaluator(labelCol="rating"),
                 numFolds=1,
             ).fit(_ratings(rng))
-        with pytest.raises(TypeError, match="dict DataFrames"):
+        with pytest.raises(TypeError, match="dict DataFrame"):
             CrossValidator(
                 estimator=ALS(),
                 evaluator=RegressionEvaluator(labelCol="rating"),
@@ -201,3 +201,114 @@ class TestTrainValidationSplit:
         )
         with pytest.raises(ValueError, match="trainRatio"):
             tvs.fit(_ratings(rng))
+
+
+class TestPersistence:
+    """save/load for the composability containers (Spark MLWritable
+    analog — the reference inherits pipeline/tuner persistence from
+    Spark for free, e.g. IntelPCASuite.scala:90-104)."""
+
+    def test_pipeline_model_roundtrip(self, rng, tmp_path):
+        from oap_mllib_tpu.compat.pipeline import PipelineModel
+
+        df = _blobs(rng, d=8)
+        model = Pipeline(stages=[
+            PCA().setK(3).setInputCol("features").setOutputCol("pca"),
+            KMeans().setK(3).setSeed(1).setFeaturesCol("pca"),
+        ]).fit(df)
+        model.save(str(tmp_path / "pm"))
+        loaded = PipelineModel.load(str(tmp_path / "pm"))
+        a, b = model.transform(df), loaded.transform(df)
+        np.testing.assert_allclose(a["pca"], b["pca"], atol=1e-6)
+        np.testing.assert_array_equal(a["prediction"], b["prediction"])
+
+    def test_unfitted_pipeline_roundtrip(self, rng, tmp_path):
+        pipe = Pipeline(stages=[
+            PCA().setK(2).setInputCol("features").setOutputCol("pca"),
+            KMeans().setK(3).setSeed(7).setFeaturesCol("pca"),
+        ])
+        pipe.save(str(tmp_path / "p"))
+        loaded = Pipeline.load(str(tmp_path / "p"))
+        stages = loaded.getStages()
+        assert stages[0].getK() == 2 and stages[0].getOutputCol() == "pca"
+        assert stages[1].getK() == 3 and stages[1].getSeed() == 7
+        # a loaded estimator pipeline must still FIT
+        df = _blobs(rng)
+        out = loaded.fit(df).transform(df)
+        assert out["pca"].shape[1] == 2
+
+    def test_cv_model_roundtrip_cold_start(self, rng, tmp_path):
+        """A loaded CrossValidatorModel keeps metrics/params AND its ALS
+        stage's coldStartStrategy (drop must still remove unseen ids)."""
+        from oap_mllib_tpu.compat.pipeline import CrossValidatorModel
+
+        df = _ratings(rng)
+        cv = CrossValidator(
+            estimator=(ALS().setRank(3).setMaxIter(3)
+                       .setColdStartStrategy("drop")),
+            estimatorParamMaps=(ParamGridBuilder()
+                                .addGrid("regParam", [0.05, 50.0])
+                                .build()),
+            evaluator=RegressionEvaluator(metricName="rmse",
+                                          labelCol="rating"),
+            numFolds=2, seed=1,
+        )
+        model = cv.fit(df)
+        model.save(str(tmp_path / "cv"))
+        loaded = CrossValidatorModel.load(str(tmp_path / "cv"))
+        assert loaded.bestParams == model.bestParams
+        np.testing.assert_allclose(loaded.avgMetrics, model.avgMetrics)
+        probe = {"user": np.array([0, 999]), "item": np.array([0, 1]),
+                 "rating": np.array([1.0, 2.0], np.float32)}
+        out = loaded.transform(probe)
+        assert len(out["prediction"]) == 1  # unseen user still dropped
+        assert np.isfinite(out["prediction"]).all()
+
+    def test_tvs_model_roundtrip(self, rng, tmp_path):
+        from oap_mllib_tpu.compat.pipeline import TrainValidationSplitModel
+
+        df = _ratings(rng)
+        model = TrainValidationSplit(
+            estimator=(ALS().setRank(3).setMaxIter(3)
+                       .setColdStartStrategy("drop")),
+            estimatorParamMaps=(ParamGridBuilder()
+                                .addGrid("regParam", [0.05, 50.0])
+                                .build()),
+            evaluator=RegressionEvaluator(metricName="rmse",
+                                          labelCol="rating"),
+            trainRatio=0.8, seed=1,
+        ).fit(df)
+        model.save(str(tmp_path / "tvs"))
+        loaded = TrainValidationSplitModel.load(str(tmp_path / "tvs"))
+        assert loaded.bestParams == model.bestParams
+        np.testing.assert_allclose(loaded.validationMetrics,
+                                   model.validationMetrics)
+        a, b = model.transform(df), loaded.transform(df)
+        np.testing.assert_allclose(a["prediction"], b["prediction"],
+                                   atol=1e-6)
+
+    def test_manifest_type_mismatch_raises(self, rng, tmp_path):
+        from oap_mllib_tpu.compat.pipeline import CrossValidatorModel
+
+        df = _blobs(rng)
+        Pipeline(stages=[KMeans().setK(2).setSeed(1)]).fit(df).save(
+            str(tmp_path / "pm")
+        )
+        with pytest.raises(ValueError, match="not a CrossValidatorModel"):
+            CrossValidatorModel.load(str(tmp_path / "pm"))
+
+    def test_manifest_foreign_module_refused(self, tmp_path):
+        """A tampered manifest must not import arbitrary classes."""
+        import json
+        import os
+
+        from oap_mllib_tpu.compat.pipeline import PipelineModel
+
+        d = tmp_path / "evil"
+        os.makedirs(d / "stage_00_X")
+        with open(d / "pipeline_metadata.json", "w") as f:
+            json.dump({"type": "PipelineModel", "version": 1,
+                       "stages": [{"dir": "stage_00_X",
+                                   "module": "os", "cls": "system"}]}, f)
+        with pytest.raises(ValueError, match="refusing"):
+            PipelineModel.load(str(d))

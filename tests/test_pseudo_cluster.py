@@ -341,6 +341,49 @@ class TestPseudoCluster:
                 atol=4e-3, rtol=4e-3,
             )
 
+    def test_adapter_partitioned_kmeans(self, world_results):
+        """The PySpark adapter's multi-process ingestion: each rank
+        materialized only its partitions of a mocked partitioned
+        DataFrame (pid % world == rank) and fed them as its local shard;
+        the converged cost must match the single-process fit on the full
+        data, and both ranks must agree exactly."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = _oracle_data()
+        oracle = KMeans(k=5, seed=7, max_iter=30).fit(x)
+        for rank in (0, 1):
+            np.testing.assert_allclose(
+                world_results[rank]["adapter_mp_cost"],
+                oracle.summary.training_cost, rtol=1e-3,
+            )
+        assert (
+            world_results[0]["adapter_mp_cost"]
+            == world_results[1]["adapter_mp_cost"]
+        )
+
+    def test_adapter_partitioned_als(self, world_results):
+        """Adapter ALS over partitioned ratings: factors match the
+        single-process fit, and the cold-start seen-user sets are
+        WORLD-consistent (global uniques, not rank-local) — rank-local
+        sets would drop different rows on different ranks."""
+        from oap_mllib_tpu.models.als import ALS
+
+        u, i, r = _als_oracle_ratings()
+        oracle = ALS(rank=3, max_iter=3, reg_param=0.1, alpha=0.8,
+                     implicit_prefs=True, seed=3).fit(u, i, r)
+        expect_seen = sorted(int(v) for v in np.unique(u))
+        for rank in (0, 1):
+            res = world_results[rank]
+            np.testing.assert_allclose(
+                res["adapter_als_uf"], oracle.user_factors_,
+                atol=4e-3, rtol=4e-3,
+            )
+            assert res["adapter_seen_users"] == expect_seen
+        assert (
+            world_results[0]["adapter_als_uf"]
+            == world_results[1]["adapter_als_uf"]
+        )
+
     def test_source_error_fails_world_fast(self):
         """The _PassGuard contract in a REAL 2-process world: rank 1's
         source errors mid-pass, and BOTH ranks must raise out of the
