@@ -6,6 +6,7 @@ cd "$(dirname "$0")"
 
 for ex in kmeans_example.py pca_example.py als_example.py \
           kmeans_compat_example.py pca_compat_example.py als_compat_example.py \
+          kmeans_pyspark_example.py pca_pyspark_example.py \
           als_pyspark_example.py; do
   echo "=== $ex ==="
   python "$ex" "$@"
