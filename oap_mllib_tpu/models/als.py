@@ -512,12 +512,18 @@ class ALS:
         memory: only one budget-bounded chunk of the grouped edge layouts
         is resident per step, with factors staying on device.
 
-        Falls back to the standard in-memory fit when the streamed path
-        does not apply: fallback/nonnegative dispatch, multi-device or
-        multi-process worlds (the block path already shards HBM across
-        ranks), or a long-tail degree distribution the grouped guard
-        rejects (COO streaming would need a lane-padded (n_dst, r, r)
-        resident accumulator — the flat-moment trick is grouped-only)."""
+        Multi-device / multi-process worlds COMPOSE streaming with the
+        mesh (ops/als_block_stream.py): each rank keeps only its block's
+        grouped layouts in host RAM and streams them through its device,
+        with the block path's collective structure unchanged — per-device
+        HBM stays O(chunk + factors + moments) while nnz scales with
+        aggregate host RAM.
+
+        Falls back to the standard in-memory fit only when the streamed
+        path does not apply: fallback/nonnegative dispatch, or a
+        long-tail degree distribution the grouped guard rejects (COO
+        streaming would need a lane-padded (n_dst, r, r) resident
+        accumulator — the flat-moment trick is grouped-only)."""
         import jax
 
         if source.n_features != 3:
@@ -537,28 +543,42 @@ class ALS:
         accelerated = should_accelerate(
             "ALS", guard_ok=not self.nonnegative, reason="nonnegative=True"
         )
-        multi = jax.process_count() > 1
-        if accelerated and not multi:
-            from oap_mllib_tpu.parallel.mesh import get_mesh
-
-            mesh = get_mesh()
-            world = mesh.shape[mesh.axis_names[0]]
-            if self.num_user_blocks is not None:
-                world = min(world, self.num_user_blocks)
-            multi = world > 1
-        if not accelerated or multi:
+        if not accelerated:
             return self.fit(
                 users, items, ratings, n_users=n_users, n_items=n_items,
                 init=init,
             )
 
+        from oap_mllib_tpu.parallel.mesh import get_mesh
         from oap_mllib_tpu.ops.als_block import als_item_layout_cfg
 
         als_item_layout_cfg()  # typo'd layout raises on every path
+        mesh = get_mesh()
+        world = mesh.shape[mesh.axis_names[0]]
+        if (
+            self.num_user_blocks is not None
+            and jax.process_count() == 1
+            and self.num_user_blocks < world
+        ):
+            # same numUserBlocks cap as the in-memory fit (see fit)
+            mp = (
+                mesh.shape[mesh.axis_names[1]]
+                if len(mesh.axis_names) > 1 else 1
+            )
+            mesh = get_mesh(n_devices=self.num_user_blocks * mp)
+            world = mesh.shape[mesh.axis_names[0]]
         users, items, ratings, n_users, n_items = self._validate_resolve(
             users, items, ratings, n_users, n_items
         )
         kernel = _als_kernel_cfg()
+        if world > 1 or jax.process_count() > 1:
+            # out-of-core COMPOSED with the mesh: per-rank streamed
+            # grouped accumulation inside the block layout
+            # (ops/als_block_stream.py) — a multi-device world no longer
+            # silently falls back to fully-resident device layouts
+            return self._fit_source_block(
+                users, items, ratings, n_users, n_items, init, mesh
+            )
         if not _grouped_ok_single(kernel, users, items, n_users, n_items):
             # in-memory COO fallback (the guard re-runs inside fit — an
             # O(nnz) native bincount, cheap next to the fit itself)
@@ -598,6 +618,138 @@ class ALS:
              **self._block_summary(1)},
         )
 
+    def _block_dispatch(self, users, items, n_users, n_items, world):
+        """(item_sharded, use_grouped, sizes) — ONE decision point for
+        both block fits (in-memory and streamed), so the layout choice,
+        the grouped-vs-COO guard, and the group sizes the guard priced
+        can never diverge between them.  ``sizes`` is the guard's
+        (p_u, p_i, nnz_global) when it ran, else None (forced kernel)."""
+        from oap_mllib_tpu.ops import als_block
+
+        item_sharded = als_block.item_layout_sharded(
+            n_items, self.rank, world, n_users
+        )
+        kernel = _als_kernel_cfg()
+        sizes = None
+        if kernel == "auto":
+            guard_fn = (
+                als_block.block_grouped_guard_2d
+                if item_sharded
+                else als_block.block_grouped_guard
+            )
+            use_grouped, sizes = guard_fn(
+                users, items, n_users, n_items, world
+            )
+        else:
+            use_grouped = kernel == "grouped"
+        return item_sharded, use_grouped, sizes
+
+    def _place_block_factors(self, mesh, offsets, per: int,
+                             init_full: Optional[np.ndarray], seed: int):
+        """Block-sharded (world*per, rank) factor init where each
+        device's callback builds ONLY its block's rows — from the user
+        init if given, else the counter-based position-addressable
+        generator (bit-identical to the global init_factors rows; the
+        per-rank seeding of the reference, ALSDALImpl.cpp:165-169).  No
+        host materializes the full matrix."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from oap_mllib_tpu.config import get_config
+
+        world = len(offsets) - 1
+        sharding = NamedSharding(mesh, P(get_config().data_axis, None))
+
+        def blk(idx):
+            b = (idx[0].start or 0) // per
+            lo, hi = int(offsets[b]), int(offsets[b + 1])
+            out = np.zeros((per, self.rank), np.float32)
+            if init_full is not None:
+                out[: hi - lo] = init_full[lo:hi]
+            else:
+                out[: hi - lo] = als_np.init_factors_rows(
+                    lo, hi, self.rank, seed
+                )
+            return out
+
+        return jax.make_array_from_callback(
+            (world * per, self.rank), sharding, blk
+        )
+
+    def _fit_source_block(
+        self, users, items, ratings, n_users, n_items, init, mesh
+    ) -> ALSModel:
+        """Streamed fit composed with the mesh (ops/als_block_stream.py):
+        host-resident per-rank grouped layouts, chunked uploads, the
+        block path's psum / all_gather structure.  COO long-tail data
+        falls back to the in-memory block fit (grouped-only streaming,
+        see _fit_source notes)."""
+        import jax
+
+        from oap_mllib_tpu.ops import als_block_stream
+
+        world = mesh.shape[mesh.axis_names[0]]
+        item_sharded, use_grouped, sizes = self._block_dispatch(
+            users, items, n_users, n_items, world
+        )
+        if not use_grouped:
+            return self.fit(
+                users, items, ratings, n_users=n_users, n_items=n_items,
+                init=init,
+            )
+        timings = Timings()
+        x0 = None if init is None else np.array(init[0], np.float32)
+        y0 = None if init is None else np.array(init[1], np.float32)
+        with phase_timer(timings, "table_convert"):
+            lay = als_block_stream.prepare_streamed_block_layouts(
+                users, items, ratings, n_users, n_items, mesh, self.rank,
+                item_sharded=item_sharded, sizes=sizes,
+            )
+            x0_dev = self._place_block_factors(
+                mesh, lay.offsets_u, lay.upb, x0, self.seed
+            )
+            if item_sharded:
+                y0_dev = self._place_block_factors(
+                    mesh, lay.offsets_i, lay.ipb, y0, self.seed + 1
+                )
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                y0_host = (
+                    y0 if y0 is not None
+                    else als_np.init_factors(n_items, self.rank,
+                                             self.seed + 1)
+                )
+                y0_dev = jax.make_array_from_callback(
+                    (n_items, self.rank), NamedSharding(mesh, P()),
+                    lambda idx: y0_host[idx],
+                )
+        from oap_mllib_tpu.utils.profiling import maybe_trace
+
+        with phase_timer(timings, "als_iterations"), maybe_trace():
+            x_blocks, y = als_block_stream.als_block_run_streamed(
+                lay, x0_dev, y0_dev, self.max_iter, self.reg_param,
+                self.alpha, mesh, implicit=self.implicit_prefs,
+            )
+            jax.block_until_ready((x_blocks, y))
+        summary = {
+            "timings": timings, "accelerated": True, "streamed": True,
+            "block_parallel": True, "sharded_factors": True,
+            "als_kernel": "grouped",
+            "item_layout": "sharded" if item_sharded else "replicated",
+            **self._block_summary(world),
+        }
+        if item_sharded:
+            return ALSModel(
+                None, None, summary,
+                sharded_user=(x_blocks, np.asarray(lay.offsets_u), lay.upb),
+                sharded_item=(y, np.asarray(lay.offsets_i), lay.ipb),
+            )
+        return ALSModel(
+            None, np.asarray(y), summary,
+            sharded_user=(x_blocks, np.asarray(lay.offsets_u), lay.upb),
+        )
+
     def _block_summary(self, effective_user_blocks: int) -> dict:
         """Requested vs effective block layout for the fit summary."""
         out = {"num_user_blocks": effective_user_blocks}
@@ -619,28 +771,13 @@ class ALS:
         cfg = get_config()
         axis = cfg.data_axis
         world = mesh.shape[axis]
-        # item-factor layout: replicated-Y (one psum per item update) or
-        # the full 2-D grid (Y block-sharded, all_gather exchanges) —
-        # config knob + auto crossover, ops/als_block.py module notes
-        item_sharded = als_block.item_layout_sharded(
-            n_items, self.rank, world, n_users
-        )
-        # grouped-vs-COO decided BEFORE the shuffle, from host bincounts of
-        # the pre-shuffle edges: a COO decision pays neither the grouped
+        # item-factor layout (replicated-Y vs the full 2-D grid) and the
+        # pre-shuffle grouped-vs-COO guard — the shared decision point
+        # (_block_dispatch): a COO decision pays neither the grouped
         # build nor the device->host pull of the shuffled blocks
-        kernel = _als_kernel_cfg()
-        sizes = None
-        if kernel == "auto":
-            guard_fn = (
-                als_block.block_grouped_guard_2d
-                if item_sharded
-                else als_block.block_grouped_guard
-            )
-            use_grouped, sizes = guard_fn(
-                users, items, n_users, n_items, world
-            )
-        else:
-            use_grouped = kernel == "grouped"
+        item_sharded, use_grouped, sizes = self._block_dispatch(
+            users, items, n_users, n_items, world
+        )
         with phase_timer(timings, "ratings_shuffle"):
             u_loc, i_glob, conf, valid, offsets, upb = als_block.prepare_block_inputs(
                 users, items, ratings, mesh, n_users
@@ -673,48 +810,18 @@ class ALS:
                         sizes=sizes,
                     )
         with phase_timer(timings, "table_convert"):
-            # block X init stays rank-local: each device's callback builds
-            # ONLY its block's rows — from the user init if given, else
-            # from the counter-based position-addressable generator, which
-            # is bit-identical to the global init_factors(n_users) rows
-            # (the per-rank init the reference seeds with rank offsets,
-            # ALSDALImpl.cpp:165-169).  No host materializes (n_users, r).
-            sharding = NamedSharding(mesh, P(axis, None))
-
-            def x0_block(idx):
-                b = (idx[0].start or 0) // upb
-                lo, hi = int(offsets[b]), int(offsets[b + 1])
-                blk = np.zeros((upb, self.rank), np.float32)
-                if x0 is not None:
-                    blk[: hi - lo] = x0[lo:hi]
-                else:
-                    blk[: hi - lo] = als_np.init_factors_rows(
-                        lo, hi, self.rank, self.seed
-                    )
-                return blk
-
-            x0_dev = jax.make_array_from_callback(
-                (world * upb, self.rank), sharding, x0_block
+            # block X init stays rank-local — no host materializes
+            # (n_users, r); see _place_block_factors
+            x0_dev = self._place_block_factors(
+                mesh, offsets, upb, x0, self.seed
             )
             if item_sharded:
                 # Y block-sharded like X; real rows from the SAME
                 # position-addressable generator the replicated path
                 # seeds (bit-identical rows), padding zero — the zeros
                 # keep the psummed block Grams exact
-                def y0_block(idx):
-                    b = (idx[0].start or 0) // ipb
-                    lo, hi = int(ioffsets[b]), int(ioffsets[b + 1])
-                    blk = np.zeros((ipb, self.rank), np.float32)
-                    if y0 is not None:
-                        blk[: hi - lo] = y0[lo:hi]
-                    else:
-                        blk[: hi - lo] = als_np.init_factors_rows(
-                            lo, hi, self.rank, self.seed + 1
-                        )
-                    return blk
-
-                y0_dev = jax.make_array_from_callback(
-                    (world * ipb, self.rank), sharding, y0_block
+                y0_dev = self._place_block_factors(
+                    mesh, ioffsets, ipb, y0, self.seed + 1
                 )
             else:
                 y0_host = (

@@ -52,9 +52,9 @@ from oap_mllib_tpu.config import get_config
 # partials and solve, so the two paths cannot diverge in the weighting
 from oap_mllib_tpu.ops.als_ops import (
     GROUPED_MAX_BLOWUP,
-    masked_solve,
     normal_eq_partials,
     normal_eq_partials_grouped,
+    regularized_solve,
 )
 
 
@@ -115,23 +115,27 @@ def _block_body(user_partials, item_partials, reg, implicit, axis, eye):
     def body(carry, _):
         x_blk, y = carry
         a_u, b_u, n_u = user_partials(y)
-        a_u = a_u + reg * n_u[:, None, None] * eye[None]
-        if implicit:
-            gram_y = jnp.matmul(y.T, y, precision=lax.Precision.HIGHEST)
-            a_u = gram_y[None] + a_u
-        x_blk = masked_solve(a_u, b_u, n_u).astype(y.dtype)
+        gram_y = (
+            jnp.matmul(y.T, y, precision=lax.Precision.HIGHEST)
+            if implicit else None
+        )
+        x_blk = regularized_solve(a_u, b_u, n_u, reg, eye, gram_y).astype(
+            y.dtype
+        )
         a_i, b_i, n_i = item_partials(x_blk)
         a_i = lax.psum(a_i, axis)
         b_i = lax.psum(b_i, axis)
         n_i = lax.psum(n_i, axis)
-        a_i = a_i + reg * n_i[:, None, None] * eye[None]
-        if implicit:
-            gram_x = lax.psum(
+        gram_x = (
+            lax.psum(
                 jnp.matmul(x_blk.T, x_blk, precision=lax.Precision.HIGHEST),
                 axis,
             )
-            a_i = gram_x[None] + a_i
-        y = masked_solve(a_i, b_i, n_i).astype(y.dtype)
+            if implicit else None
+        )
+        y = regularized_solve(a_i, b_i, n_i, reg, eye, gram_x).astype(
+            x_blk.dtype
+        )
         return (x_blk, y), None
 
     return body
@@ -155,24 +159,28 @@ def _block_body_2d(user_partials, item_partials, reg, implicit, axis, eye):
         x_blk, y_blk = carry
         y_full = lax.all_gather(y_blk, axis, tiled=True)
         a_u, b_u, n_u = user_partials(y_full)
-        a_u = a_u + reg * n_u[:, None, None] * eye[None]
-        if implicit:
-            gram_y = lax.psum(
+        gram_y = (
+            lax.psum(
                 jnp.matmul(y_blk.T, y_blk, precision=lax.Precision.HIGHEST),
                 axis,
             )
-            a_u = gram_y[None] + a_u
-        x_blk = masked_solve(a_u, b_u, n_u).astype(y_blk.dtype)
+            if implicit else None
+        )
+        x_blk = regularized_solve(a_u, b_u, n_u, reg, eye, gram_y).astype(
+            y_blk.dtype
+        )
         x_full = lax.all_gather(x_blk, axis, tiled=True)
         a_i, b_i, n_i = item_partials(x_full)
-        a_i = a_i + reg * n_i[:, None, None] * eye[None]
-        if implicit:
-            gram_x = lax.psum(
+        gram_x = (
+            lax.psum(
                 jnp.matmul(x_blk.T, x_blk, precision=lax.Precision.HIGHEST),
                 axis,
             )
-            a_i = gram_x[None] + a_i
-        y_blk = masked_solve(a_i, b_i, n_i).astype(y_blk.dtype)
+            if implicit else None
+        )
+        y_blk = regularized_solve(a_i, b_i, n_i, reg, eye, gram_x).astype(
+            y_blk.dtype
+        )
         return (x_blk, y_blk), None
 
     return body

@@ -1,0 +1,530 @@
+"""Streamed (bounded-HBM) block-parallel ALS: out-of-core composed with
+the mesh.
+
+`ops/als_stream.py` bounds a SINGLE device's HBM by walking host-resident
+grouped edge layouts through the chip in chunks; `ops/als_block.py`
+shards the fit over the mesh but keeps every rank's grouped layouts
+device-resident.  This module is their composition — the round-4 review
+gap ("out-of-core ALS does not compose with the mesh"): each rank keeps
+its OWN block's grouped layouts in HOST memory (the reference's
+executors likewise hold only their partition in RAM, OneDAL.scala
+:92-166) and streams them through its device per half-iteration, while
+the inter-rank structure stays exactly the in-memory block path's:
+
+- **replicated item layout**: user update fully local; item update
+  accumulates a per-rank (n_items, (r+1)(r+2)) flat moment carry and
+  psums it once at solve time (the same single-allreduce collapse of the
+  reference's gather -> step2Master -> bcast -> all2all chain,
+  ALSDALImpl.cpp:336-431).
+- **sharded (2-D) item layout**: both factor sides block-sharded; each
+  half-iteration all_gathers the OTHER side's factors once into a
+  replicated table, then streams chunks against it (the same
+  per-iteration collective payload as als_block_run_grouped_2d — the
+  gather just lives between chunk launches instead of inside one
+  shard_map program).
+
+Per-device HBM is O(chunk + factors + moments):
+
+- chunk: one (world*gc, Pw) slice of each grouped array per launch,
+  gc from the shared ``_GROUPED_BUDGET_ELEMS`` budget
+  (als_stream.groups_per_chunk);
+- factors: this rank's X block + one replicated source-side table
+  (Y, or the all_gathered other side);
+- moments: (upb, (r+1)(r+2)) for the user side; item side
+  (n_items, (r+1)(r+2)) replicated / (ipb, (r+1)(r+2)) sharded.
+
+Host memory per process is O(its blocks' padded nnz).  Multi-process
+worlds first REDISTRIBUTE the triples so each process holds exactly its
+blocks' edges — a chunked fixed-shape allgather over DCN
+(``_redistribute_triples``; bounded host transient of
+O(processes x chunk), the alltoall(lengths)+alltoallv idiom of the
+reference's shuffle, ALSShuffle.cpp:92-109, in its simplest
+fixed-shape form).
+
+Math parity: the per-chunk moment kernel IS the in-memory kernel
+(als_ops.grouped_block_moments) and the solves consume summed moments
+identically — streamed-vs-in-memory factors match to fp tolerance on
+every layout (chunked segment-sums only reorder additions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.ops.als_block import (
+    _global_max,
+    _global_sum,
+    _group_sizes,
+    _group_sizes_2d,
+    _pad_groups,
+)
+from oap_mllib_tpu.ops.als_ops import (
+    build_grouped_edges,
+    grouped_block_moments,
+    regularized_solve,
+    unpack_flat_moments,
+)
+from oap_mllib_tpu.ops.als_stream import groups_per_chunk
+
+
+def owned_blocks(mesh: Mesh, axis: str) -> List[int]:
+    """Data-axis block indices whose device(s) live in THIS process
+    (all blocks in single-process worlds; with a model axis, a block is
+    owned if any of its model-replica devices is local)."""
+    ax = mesh.axis_names.index(axis)
+    arr = np.moveaxis(np.asarray(mesh.devices, dtype=object), ax, 0)
+    arr = arr.reshape(arr.shape[0], -1)
+    pidx = jax.process_index()
+    return [
+        b for b in range(arr.shape[0])
+        if any(d.process_index == pidx for d in arr[b])
+    ]
+
+
+# chunk rows for the multi-process triple redistribution: 1M rows of
+# (u, i, r) f64 = 24 MB local, x processes transient on receive
+_REDIST_CHUNK_ROWS = 1 << 20
+
+
+def _gathered_triple_chunks(keys, other, ratings):
+    """Yield globally-gathered (keys, other, ratings) host chunks: each
+    process contributes its local triples, padded to a globally equal
+    chunk count so the allgather stays fixed-shape.  Ratings ride f64
+    exactly (f32 embeds exactly); ids ride f64 exactly up to 2^53 (the
+    ChunkSource id contract)."""
+    from jax.experimental import multihost_utils
+
+    n_local = len(keys)
+    n_max = int(_global_max([n_local])[0])
+    for lo in range(0, max(n_max, 1), _REDIST_CHUNK_ROWS):
+        hi = min(lo + _REDIST_CHUNK_ROWS, n_max)
+        blob = np.full((hi - lo, 3), -1.0, np.float64)
+        if lo < n_local:
+            m = min(hi, n_local) - lo
+            blob[:m, 0] = keys[lo : lo + m]
+            blob[:m, 1] = other[lo : lo + m]
+            blob[:m, 2] = ratings[lo : lo + m]
+        g = np.asarray(multihost_utils.process_allgather(blob)).reshape(-1, 3)
+        g = g[g[:, 0] >= 0]
+        yield (
+            g[:, 0].astype(np.int64),
+            g[:, 1].astype(np.int64),
+            g[:, 2].astype(np.float32),
+        )
+
+
+def _own_mask(world: int, owned: List[int]) -> np.ndarray:
+    own = np.zeros((world,), bool)
+    own[np.asarray(owned, np.int64)] = True
+    return own
+
+
+def _cat(parts, dtype):
+    return np.concatenate(parts) if parts else np.zeros((0,), dtype)
+
+
+def _redistribute_triples(
+    keys: np.ndarray,      # the side's PARTITION ids
+    other: np.ndarray,
+    ratings: np.ndarray,
+    kpb: int,
+    world: int,
+    owned: List[int],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Multi-process edge redistribution by block of ``keys``: returns
+    the (keys, other, ratings) triples belonging to THIS process's
+    blocks.  Identity when single-process (the caller's triples are
+    already the whole dataset)."""
+    if jax.process_count() == 1:
+        return (
+            np.asarray(keys, np.int64),
+            np.asarray(other, np.int64),
+            np.asarray(ratings, np.float32),
+        )
+    own = _own_mask(world, owned)
+    ku, ko, kr = [], [], []
+    for k, o, r in _gathered_triple_chunks(keys, other, ratings):
+        mine = own[np.minimum(k // kpb, world - 1)]
+        ku.append(k[mine])
+        ko.append(o[mine])
+        kr.append(r[mine])
+    return _cat(ku, np.int64), _cat(ko, np.int64), _cat(kr, np.float32)
+
+
+def _redistribute_triples_2d(
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    kpb_u: int,
+    kpb_i: int,
+    world: int,
+    owned: List[int],
+):
+    """Both keyed edge sets from ONE gathered sweep (the 2-D layout
+    needs user-block AND item-block copies; sweeping the global edges
+    twice would double the dominant DCN prep traffic).  Returns
+    ((users, items, ratings) for my user blocks,
+     (items, users, ratings) for my item blocks)."""
+    if jax.process_count() == 1:
+        u = np.asarray(users, np.int64)
+        i = np.asarray(items, np.int64)
+        r = np.asarray(ratings, np.float32)
+        return (u, i, r), (i, u, r)
+    own = _own_mask(world, owned)
+    au, ai = ([], [], []), ([], [], [])
+    for u, i, r in _gathered_triple_chunks(users, items, ratings):
+        mu = own[np.minimum(u // kpb_u, world - 1)]
+        au[0].append(u[mu]); au[1].append(i[mu]); au[2].append(r[mu])
+        mi = own[np.minimum(i // kpb_i, world - 1)]
+        ai[0].append(i[mi]); ai[1].append(u[mi]); ai[2].append(r[mi])
+    return (
+        (_cat(au[0], np.int64), _cat(au[1], np.int64),
+         _cat(au[2], np.float32)),
+        (_cat(ai[0], np.int64), _cat(ai[1], np.int64),
+         _cat(ai[2], np.float32)),
+    )
+
+
+@dataclasses.dataclass
+class StreamedBlockLayouts:
+    """Host-resident per-owned-block grouped layouts + the shapes every
+    rank agreed on (group sizes / padded group counts are GLOBAL so the
+    compiled programs see one static shape)."""
+
+    by_user: Dict[int, tuple]   # block -> (src, conf, valid, dst), padded
+    by_item: Dict[int, tuple]
+    upb: int
+    ipb: int                    # 0 in the replicated layout
+    n_items: int
+    offsets_u: np.ndarray
+    offsets_i: Optional[np.ndarray]
+    gc_u: int                   # groups per uploaded chunk, user side
+    gc_i: int
+    g_u: int                    # padded per-rank group count (== across ranks)
+    g_i: int
+    item_sharded: bool
+    owned: List[int]
+
+
+def prepare_streamed_block_layouts(
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    n_users: int,
+    n_items: int,
+    mesh: Mesh,
+    r: int,
+    *,
+    item_sharded: bool,
+    sizes=None,
+) -> StreamedBlockLayouts:
+    """Build the host-side grouped layouts for the streamed block fit.
+
+    Triples are this process's LOCAL edges (multi-process worlds
+    redistribute by block first); each owned block gets the same two
+    grouped layouts the in-memory block path builds
+    (als_block.prepare_grouped_inputs / _2d), except they STAY on host.
+    ``sizes`` is the block guard's (p_u, p_i, nnz_global) tuple when the
+    guard ran (models/als._block_dispatch) — threaded through so the
+    build uses exactly the layout the guard priced, like the in-memory
+    preps; otherwise group sizes derive from global stats here.  Either
+    way every process compiles identical static shapes."""
+    cfg = get_config()
+    axis = cfg.data_axis
+    world = mesh.shape[axis]
+    owned = owned_blocks(mesh, axis)
+    # integer ceil, matching the guards' kpb (a float ceil could differ
+    # at large n and desynchronize the priced vs built layout)
+    kpb_u = max(1, -(-n_users // world))
+    upb = kpb_u
+    offsets_u = np.minimum(np.arange(world + 1) * kpb_u, n_users)
+    if item_sharded:
+        kpb_i = max(1, -(-n_items // world))
+        ipb = kpb_i
+        offsets_i = np.minimum(np.arange(world + 1) * kpb_i, n_items)
+    else:
+        kpb_i = ipb = 0
+        offsets_i = None
+
+    if sizes is not None:
+        p_u, p_i, _ = sizes
+    else:
+        nnz_global = int(_global_sum([len(users)])[0])
+        if item_sharded:
+            p_u, p_i = _group_sizes_2d(nnz_global, world, upb, ipb)
+        else:
+            p_u, p_i = _group_sizes(nnz_global, world, upb, n_items)
+
+    by_user: Dict[int, tuple] = {}
+    by_item: Dict[int, tuple] = {}
+    if item_sharded:
+        # both keyed copies from ONE gathered sweep (the reference's
+        # transposed per-rank table, ALSDALImpl.cpp:192-214, as a role
+        # swap of the same exchange)
+        (uu, ui, ur), (iu, io, ir) = _redistribute_triples_2d(
+            users, items, ratings, kpb_u, kpb_i, world, owned
+        )
+    else:
+        uu, ui, ur = _redistribute_triples(
+            users, items, ratings, kpb_u, world, owned
+        )
+    ublock = np.minimum(uu // kpb_u, world - 1)
+    for b in owned:
+        sel = ublock == b
+        # user side: dst = block-local user, src = global item id (the
+        # padded-Y row under the identity mapping — als_block
+        # prepare_block_inputs note — so the SAME layout serves both
+        # item layouts' user updates)
+        by_user[b] = build_grouped_edges(
+            uu[sel] - b * kpb_u, ui[sel], ur[sel], upb, p_u
+        )
+        if not item_sharded:
+            # replicated item side: dst = global item, src = LOCAL user
+            # (indexes this rank's x block), exactly like
+            # als_block.prepare_grouped_inputs
+            by_item[b] = build_grouped_edges(
+                ui[sel], uu[sel] - b * kpb_u, ur[sel], n_items, p_i
+            )
+    if item_sharded:
+        iblock = np.minimum(iu // kpb_i, world - 1)
+        for b in owned:
+            sel = iblock == b
+            # dst = block-local item, src = global user id (padded-X row)
+            by_item[b] = build_grouped_edges(
+                iu[sel] - b * kpb_i, io[sel], ir[sel], ipb, p_i
+            )
+
+    # one static shape everywhere: pad group counts to the global max,
+    # then to a multiple of the chunk size
+    gc_u = groups_per_chunk(p_u, r)
+    gc_i = groups_per_chunk(p_i, r)
+    gu_local = max((g[0].shape[0] for g in by_user.values()), default=0)
+    hi_local = max((g[0].shape[0] for g in by_item.values()), default=0)
+    gu, hi = (int(v) for v in _global_max([gu_local, hi_local]))
+    g_u = max(gc_u, -(-max(gu, 1) // gc_u) * gc_u)
+    g_i = max(gc_i, -(-max(hi, 1) // gc_i) * gc_i)
+    i_ndst = ipb if item_sharded else n_items
+    for b in owned:
+        by_user[b] = _pad_groups(by_user[b], g_u, upb)
+        by_item[b] = _pad_groups(by_item[b], g_i, i_ndst)
+
+    return StreamedBlockLayouts(
+        by_user=by_user, by_item=by_item, upb=upb, ipb=ipb,
+        n_items=n_items, offsets_u=offsets_u, offsets_i=offsets_i,
+        gc_u=gc_u, gc_i=gc_i, g_u=g_u, g_i=g_i,
+        item_sharded=item_sharded, owned=owned,
+    )
+
+
+def _chunk_placer(mesh: Mesh, axis: str, owned: List[int]):
+    """Host-chunk -> block-sharded device array.  The local stack is the
+    owned blocks' slices in block order (exactly the addressable portion
+    of the P(axis, ...) sharding)."""
+
+    def place(per_block: Dict[int, np.ndarray], sl: slice, world: int):
+        local = np.concatenate([per_block[b][sl] for b in owned])
+        shape = (world * (local.shape[0] // len(owned)),) + local.shape[1:]
+        sharding = NamedSharding(
+            mesh, P(axis, *([None] * (local.ndim - 1)))
+        )
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                sharding, local, shape
+            )
+        return jax.device_put(local, sharding)
+
+    return place
+
+
+def _make_programs(mesh: Mesh, axis: str, implicit: bool):
+    """The four compiled building blocks (closures cache compilations
+    across chunks and iterations)."""
+    sh2 = P(axis, None)
+    sh1 = P(axis)
+    rep = P()
+
+    def accum_local(m, src, conf, valid, gdst, factors, alpha):
+        # m block: (n_loc, width); factors: FULL replicated table
+        mm = grouped_block_moments(src, conf, valid, factors, alpha, implicit)
+        gb = mm.shape[0]
+        return m + jax.ops.segment_sum(
+            mm.reshape(gb, -1), gdst, num_segments=m.shape[0],
+            indices_are_sorted=True,
+        )
+
+    accum_local_fn = jax.jit(
+        jax.shard_map(
+            accum_local, mesh=mesh,
+            in_specs=(sh2, sh2, sh2, sh2, sh1, rep, rep),
+            out_specs=sh2, check_vma=False,
+        ),
+        donate_argnums=(0,),
+    )
+
+    def accum_item_rep(m, src, conf, valid, gdst, x_blk, alpha):
+        # m block: (1, n_items, width); x_blk: this rank's (upb, r);
+        # src = LOCAL user ids
+        mm = grouped_block_moments(src, conf, valid, x_blk, alpha, implicit)
+        gb = mm.shape[0]
+        return m + jax.ops.segment_sum(
+            mm.reshape(gb, -1), gdst, num_segments=m.shape[1],
+            indices_are_sorted=True,
+        )[None]
+
+    accum_item_rep_fn = jax.jit(
+        jax.shard_map(
+            accum_item_rep, mesh=mesh,
+            in_specs=(P(axis, None, None), sh2, sh2, sh2, sh1, sh2, rep),
+            out_specs=P(axis, None, None), check_vma=False,
+        ),
+        donate_argnums=(0,),
+    )
+
+    def solve_local(m, f_full, reg):
+        # one side's local solve from summed flat moments (the shared
+        # regularized_solve); f_full replicated, padded rows zero so its
+        # Gram is exact
+        r = f_full.shape[1]
+        a, b, n_reg = unpack_flat_moments(m, r)
+        eye = jnp.eye(r, dtype=f_full.dtype)
+        gram = (
+            jnp.matmul(f_full.T, f_full, precision=lax.Precision.HIGHEST)
+            if implicit else None
+        )
+        return regularized_solve(a, b, n_reg, reg, eye, gram).astype(
+            f_full.dtype
+        )
+
+    solve_local_fn = jax.jit(
+        jax.shard_map(
+            solve_local, mesh=mesh, in_specs=(sh2, rep, rep),
+            out_specs=sh2, check_vma=False,
+        )
+    )
+
+    def solve_item_rep(m, x_blk, reg):
+        # m block: (1, n_items, width) -> psum = the in-memory path's one
+        # item-update allreduce; X Gram psums block Grams (exact: padded
+        # rows are zero)
+        r = x_blk.shape[1]
+        a, b, n_reg = unpack_flat_moments(lax.psum(m[0], axis), r)
+        eye = jnp.eye(r, dtype=x_blk.dtype)
+        gram = (
+            lax.psum(
+                jnp.matmul(x_blk.T, x_blk, precision=lax.Precision.HIGHEST),
+                axis,
+            )
+            if implicit else None
+        )
+        return regularized_solve(a, b, n_reg, reg, eye, gram).astype(
+            x_blk.dtype
+        )
+
+    solve_item_rep_fn = jax.jit(
+        jax.shard_map(
+            solve_item_rep, mesh=mesh,
+            in_specs=(P(axis, None, None), sh2, rep),
+            out_specs=rep, check_vma=False,
+        )
+    )
+
+    replicate = jax.jit(
+        lambda a: a, out_shardings=NamedSharding(mesh, P())
+    )
+    return (accum_local_fn, accum_item_rep_fn, solve_local_fn,
+            solve_item_rep_fn, replicate)
+
+
+def als_block_run_streamed(
+    lay: StreamedBlockLayouts,
+    x0: jax.Array,   # (world * upb, r) block-sharded user factors
+    y0: jax.Array,   # (n_items, r) replicated OR (world * ipb, r) sharded
+    max_iter: int,
+    reg: float,
+    alpha: float,
+    mesh: Mesh,
+    *,
+    implicit: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Streamed block-parallel ALS over the mesh (both feedback modes,
+    both item layouts).  Returns (X blocks, Y) in the same forms as the
+    in-memory runners (als_block_run_grouped / _grouped_2d)."""
+    cfg = get_config()
+    axis = cfg.data_axis
+    world = mesh.shape[axis]
+    r = x0.shape[1]
+    width = (r + 1) * (r + 2)
+    dtype = x0.dtype
+    place = _chunk_placer(mesh, axis, lay.owned)
+    (accum_local_fn, accum_item_rep_fn, solve_local_fn,
+     solve_item_rep_fn, replicate) = _make_programs(mesh, axis, implicit)
+    alpha_j = jnp.asarray(alpha, dtype)
+    reg_j = jnp.asarray(reg, dtype)
+    sh2 = NamedSharding(mesh, P(axis, None))
+    sh3 = NamedSharding(mesh, P(axis, None, None))
+    zeros_u = jax.jit(
+        lambda: jnp.zeros((world * lay.upb, width), dtype),
+        out_shardings=sh2,
+    )
+    if lay.item_sharded:
+        zeros_i = jax.jit(
+            lambda: jnp.zeros((world * lay.ipb, width), dtype),
+            out_shardings=sh2,
+        )
+    else:
+        zeros_i = jax.jit(
+            lambda: jnp.zeros((world, lay.n_items, width), dtype),
+            out_shardings=sh3,
+        )
+
+    def stream_side(by_side, g_total, gc, accum, m, *factor_args):
+        su = {b: by_side[b][0] for b in lay.owned}
+        cu = {b: by_side[b][1] for b in lay.owned}
+        vu = {b: by_side[b][2] for b in lay.owned}
+        gu = {b: by_side[b][3] for b in lay.owned}
+        for lo in range(0, g_total, gc):
+            sl = slice(lo, lo + gc)
+            m = accum(
+                m,
+                place(su, sl, world),
+                place(cu, sl, world),
+                place(vu, sl, world),
+                place(gu, sl, world),
+                *factor_args,
+                alpha_j,
+            )
+        return m
+
+    x_blk, y = x0, y0
+    for _ in range(max_iter):
+        # -- user update: stream by-user chunks against the (gathered)
+        # item table, solve locally
+        y_full = replicate(y) if lay.item_sharded else y
+        m_u = stream_side(
+            lay.by_user, lay.g_u, lay.gc_u, accum_local_fn, zeros_u(),
+            y_full,
+        )
+        x_blk = solve_local_fn(m_u, y_full, reg_j)
+        # -- item update
+        if lay.item_sharded:
+            x_full = replicate(x_blk)
+            m_i = stream_side(
+                lay.by_item, lay.g_i, lay.gc_i, accum_local_fn,
+                zeros_i(), x_full,
+            )
+            y = solve_local_fn(m_i, x_full, reg_j)
+        else:
+            m_i = stream_side(
+                lay.by_item, lay.g_i, lay.gc_i, accum_item_rep_fn,
+                zeros_i(), x_blk,
+            )
+            y = solve_item_rep_fn(m_i, x_blk, reg_j)
+    return x_blk, y

@@ -214,6 +214,28 @@ def masked_solve(a: jax.Array, b: jax.Array, deg: jax.Array) -> jax.Array:
 # this factor of the true edge count (extreme long-tail degree splits fall
 # back to the COO programs).  One definition so the two paths cannot
 # silently route the same dataset to different kernels.
+def unpack_flat_moments(m_flat: jax.Array, r: int):
+    """(a, b, n_reg) from a flat (n_dst, (r+1)(r+2)) moment carry — the
+    layout every streamed accumulate produces (als_stream,
+    als_block_stream)."""
+    n_dst = m_flat.shape[0]
+    m = m_flat.reshape(n_dst, r + 1, r + 2)
+    return m[:, :r, :r], m[:, :r, r], m[:, r, r + 1]
+
+
+def regularized_solve(a, b, n_reg, reg, eye, gram=None) -> jax.Array:
+    """THE half-update solve every ALS path consumes moments through
+    (single-device grouped/COO, streamed, block-parallel, streamed
+    block): ALS-WR lambda scaling (reg x per-row rating count — Spark
+    parity, reference ALS.scala:1794-1795), optional implicit-feedback
+    Gram term, masked Cholesky.  One definition so the paths cannot
+    diverge in the regularization convention."""
+    a = a + reg * n_reg[:, None, None] * eye[None]
+    if gram is not None:
+        a = gram[None] + a
+    return masked_solve(a, b, n_reg)
+
+
 GROUPED_MAX_BLOWUP = 6.0
 
 
@@ -475,13 +497,13 @@ def als_run_grouped(
         a, b, n_reg = normal_eq_partials_grouped(
             src_g, conf_g, valid_g, group_dst, factors, n_dst, alpha, implicit
         )
-        a = a + reg * n_reg[:, None, None] * eye[None]
-        if implicit:
-            gram = jnp.matmul(
-                factors.T, factors, precision=lax.Precision.HIGHEST
-            )
-            a = gram[None] + a
-        return masked_solve(a, b, n_reg).astype(factors.dtype)
+        gram = (
+            jnp.matmul(factors.T, factors, precision=lax.Precision.HIGHEST)
+            if implicit else None
+        )
+        return regularized_solve(a, b, n_reg, reg, eye, gram).astype(
+            factors.dtype
+        )
 
     def body(carry, _):
         x, y = carry
@@ -511,9 +533,9 @@ def _half_update(
         dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha, True
     )
     eye = jnp.eye(r, dtype=src_factors.dtype)
-    # ALS-WR: lambda scaled by the per-row rating count (Spark parity)
-    a = gram[None, :, :] + a_part + reg * n_reg[:, None, None] * eye[None, :, :]
-    return masked_solve(a, b, n_reg).astype(src_factors.dtype)
+    return regularized_solve(a_part, b, n_reg, reg, eye, gram).astype(
+        src_factors.dtype
+    )
 
 
 @functools.partial(
@@ -569,9 +591,9 @@ def als_explicit_run(
             dst_idx, src_idx, rating, valid, src_factors, n_dst, 0.0, False
         )
         eye = jnp.eye(r, dtype=src_factors.dtype)
-        # ALS-WR lambda scaling (Spark parity)
-        a = a_part + reg * n_reg[:, None, None] * eye[None, :, :]
-        return masked_solve(a, b, n_reg).astype(src_factors.dtype)
+        return regularized_solve(a_part, b, n_reg, reg, eye).astype(
+            src_factors.dtype
+        )
 
     def body(carry, _):
         x, y = carry

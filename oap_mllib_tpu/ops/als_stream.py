@@ -42,7 +42,8 @@ from jax import lax
 from oap_mllib_tpu.ops.als_ops import (
     _GROUPED_BUDGET_ELEMS,
     grouped_block_moments,
-    masked_solve,
+    regularized_solve,
+    unpack_flat_moments,
 )
 
 
@@ -82,20 +83,19 @@ def _solve_side(
     m_flat: jax.Array, src_factors: jax.Array, reg: jax.Array, implicit: bool
 ) -> jax.Array:
     """Factors from the summed flat moments — identical consumption to
-    als_ops.als_run_grouped's half step (A + reg-scaled eye [+ Gram],
-    masked Cholesky solve)."""
+    als_ops.als_run_grouped's half step (the shared regularized_solve)."""
     r = src_factors.shape[1]
-    n_dst = m_flat.shape[0]
-    m = m_flat.reshape(n_dst, r + 1, r + 2)
-    a, b, n_reg = m[:, :r, :r], m[:, :r, r], m[:, r, r + 1]
+    a, b, n_reg = unpack_flat_moments(m_flat, r)
     eye = jnp.eye(r, dtype=src_factors.dtype)
-    a = a + reg * n_reg[:, None, None] * eye[None]
-    if implicit:
-        gram = jnp.matmul(
+    gram = (
+        jnp.matmul(
             src_factors.T, src_factors, precision=lax.Precision.HIGHEST
         )
-        a = gram[None] + a
-    return masked_solve(a, b, n_reg).astype(src_factors.dtype)
+        if implicit else None
+    )
+    return regularized_solve(a, b, n_reg, reg, eye, gram).astype(
+        src_factors.dtype
+    )
 
 
 def _pad_group_rows(grouped, multiple: int, n_dst: int):

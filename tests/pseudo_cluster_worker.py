@@ -136,6 +136,41 @@ als_out["als_sh_uf"] = np.asarray(m_sh.user_factors_).tolist()
 als_out["als_sh_if"] = np.asarray(m_sh.item_factors_).tolist()
 set_config(als_item_layout="auto")
 
+# --- streamed ALS composed with the REAL 2-process mesh: each rank
+# streams its LOCAL triples through a ChunkSource; the prep
+# redistributes edges by block over the process boundary (chunked
+# fixed-shape allgather) and the fit walks host-resident grouped
+# layouts through each device (ops/als_block_stream).  Forced grouped:
+# the tiny test data would otherwise trip the COO blowup guard.
+set_config(als_kernel="grouped")
+trip = np.stack(
+    [au[sl].astype(np.float64), ai[sl].astype(np.float64),
+     ar[sl].astype(np.float64)], axis=1,
+)
+m_st = ALS(rank=RANK, max_iter=3, reg_param=0.1, alpha=0.8,
+           implicit_prefs=True, seed=3).fit(
+    ChunkSource.from_array(trip, chunk_rows=256)
+)
+assert m_st.summary.get("streamed"), m_st.summary
+assert m_st.summary.get("block_parallel"), m_st.summary
+als_out["als_st_uf"] = np.asarray(m_st.user_factors_).tolist()
+als_out["als_st_if"] = np.asarray(m_st.item_factors_).tolist()
+
+# the 2-D item-sharded streamed composition across the process boundary:
+# the single-sweep double redistribution (user AND item keyed), the
+# per-half-iteration replicate() of the other side's block factors, and
+# the collective item-factor gather all cross processes here
+set_config(als_item_layout="sharded")
+m_st2 = ALS(rank=RANK, max_iter=3, reg_param=0.1, alpha=0.8,
+            implicit_prefs=True, seed=3).fit(
+    ChunkSource.from_array(trip, chunk_rows=256)
+)
+assert m_st2.summary.get("streamed"), m_st2.summary
+assert m_st2.summary["item_layout"] == "sharded", m_st2.summary
+als_out["als_st_sh_uf"] = np.asarray(m_st2.user_factors_).tolist()
+als_out["als_st_sh_if"] = np.asarray(m_st2.item_factors_).tolist()
+set_config(als_item_layout="auto", als_kernel="auto")
+
 # --- PySpark-adapter distributed ingestion: a mocked partitioned
 # DataFrame (the duck-typed rdd.mapPartitionsWithIndex surface) feeds
 # each process ONLY its partitions (pid % world == rank), which the

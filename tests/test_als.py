@@ -747,15 +747,99 @@ class TestStreamedALS:
             m1.user_factors_, m2.user_factors_, atol=1e-4, rtol=1e-4
         )
 
-    def test_streamed_delegates_to_block_path_on_mesh(self, rng):
-        """On the 8-device suite mesh the source fit materializes and
-        takes the block-parallel path (HBM is already sharded there)."""
+    def test_streamed_composes_with_mesh(self, rng):
+        """On the 8-device suite mesh the source fit COMPOSES streaming
+        with the block layout (ops/als_block_stream.py) — per-rank
+        host-resident grouped layouts, chunked uploads, the block path's
+        collectives — instead of falling back to fully-resident device
+        layouts (the round-4 review gap).  Factors must match the
+        in-memory block fit on the same init."""
         u, i, r, nu, ni = _ratings(rng)
-        m = ALS(rank=3, max_iter=2).fit(
-            self._triples_source(u, i, r, 128), n_users=nu, n_items=ni
+        x0 = init_factors(nu, 3, 1)
+        y0 = init_factors(ni, 3, 2)
+        kw = dict(rank=3, max_iter=2, reg_param=0.1, alpha=0.9)
+        # force grouped: the test dataset is small enough that the block
+        # guard would price 8-block padding above the COO crossover
+        set_config(als_kernel="grouped")
+        try:
+            m1 = ALS(**kw).fit(u, i, r, n_users=nu, n_items=ni,
+                               init=(x0, y0))
+            m2 = ALS(**kw).fit(
+                self._triples_source(u, i, r, 128), n_users=nu,
+                n_items=ni, init=(x0, y0),
+            )
+        finally:
+            set_config(als_kernel="auto")
+        assert m1.summary.get("block_parallel")
+        assert m2.summary.get("block_parallel")
+        assert m2.summary.get("streamed")
+        assert m2.summary.get("sharded_factors")
+        assert m2.summary["item_layout"] == "replicated"
+        np.testing.assert_allclose(
+            m1.user_factors_, m2.user_factors_, atol=1e-4, rtol=1e-4
         )
-        assert m.summary.get("block_parallel")
-        assert not m.summary.get("streamed")
+        np.testing.assert_allclose(
+            m1.item_factors_, m2.item_factors_, atol=1e-4, rtol=1e-4
+        )
+
+    @pytest.mark.parametrize("implicit", [True, False])
+    def test_streamed_mesh_parity_item_sharded(self, rng, implicit):
+        """Streamed-vs-in-memory parity on the mesh with the 2-D
+        item-sharded layout (uneven n_users/n_items vs the 8 blocks, so
+        the last blocks are short): both feedback modes."""
+        u, i, r, nu, ni = _ratings(rng, n_users=53, n_items=37)
+        x0 = init_factors(nu, 3, 1)
+        y0 = init_factors(ni, 3, 2)
+        kw = dict(rank=3, max_iter=2, reg_param=0.1, alpha=0.8,
+                  implicit_prefs=implicit)
+        set_config(als_item_layout="sharded")
+        try:
+            m1 = ALS(**kw).fit(u, i, r, n_users=nu, n_items=ni,
+                               init=(x0, y0))
+            m2 = ALS(**kw).fit(
+                self._triples_source(u, i, r, 97), n_users=nu,
+                n_items=ni, init=(x0, y0),
+            )
+        finally:
+            set_config(als_item_layout="auto")
+        assert m2.summary.get("streamed")
+        assert m2.summary["item_layout"] == "sharded"
+        np.testing.assert_allclose(
+            m1.user_factors_, m2.user_factors_, atol=1e-4, rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            m1.item_factors_, m2.item_factors_, atol=1e-4, rtol=1e-4
+        )
+
+    def test_streamed_mesh_small_chunks(self, rng):
+        """Tiny upload budget on the mesh path (monkeypatched
+        groups_per_chunk -> many chunk launches per half-iteration)."""
+        from oap_mllib_tpu.ops import als_block_stream
+
+        u, i, r, nu, ni = _ratings(rng, n_users=30, n_items=20)
+        x0 = init_factors(nu, 3, 1)
+        y0 = init_factors(ni, 3, 2)
+        kw = dict(rank=3, max_iter=2)
+        set_config(als_kernel="grouped")  # see test_streamed_composes_with_mesh
+        orig = als_block_stream.groups_per_chunk
+        try:
+            m1 = ALS(**kw).fit(u, i, r, n_users=nu, n_items=ni,
+                               init=(x0, y0))
+            als_block_stream.groups_per_chunk = lambda P, r_: 2
+            m2 = ALS(**kw).fit(
+                self._triples_source(u, i, r, 16),
+                n_users=nu, n_items=ni, init=(x0, y0),
+            )
+        finally:
+            als_block_stream.groups_per_chunk = orig
+            set_config(als_kernel="auto")
+        assert m2.summary.get("streamed")
+        np.testing.assert_allclose(
+            m1.user_factors_, m2.user_factors_, atol=1e-4, rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            m1.item_factors_, m2.item_factors_, atol=1e-4, rtol=1e-4
+        )
 
     def test_streamed_long_tail_delegates_to_coo(self, rng):
         """Degree ~1: the grouped guard rejects, so the source fit falls

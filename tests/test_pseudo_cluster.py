@@ -341,6 +341,43 @@ class TestPseudoCluster:
                 atol=4e-3, rtol=4e-3,
             )
 
+    def test_streamed_block_als_two_process(self, world_results):
+        """Out-of-core ALS composed with a REAL 2-process world: each
+        rank streamed only its local triples; the block redistribution
+        ran over the process boundary and the chunked uploads + block
+        collectives must land on the single-process factors."""
+        from oap_mllib_tpu.models.als import ALS
+
+        u, i, r = _als_oracle_ratings()
+        oracle = ALS(rank=3, max_iter=3, reg_param=0.1, alpha=0.8,
+                     implicit_prefs=True, seed=3).fit(u, i, r)
+        for rank in (0, 1):
+            res = world_results[rank]
+            np.testing.assert_allclose(
+                res["als_st_uf"], oracle.user_factors_,
+                atol=4e-3, rtol=4e-3,
+            )
+            np.testing.assert_allclose(
+                res["als_st_if"], oracle.item_factors_,
+                atol=4e-3, rtol=4e-3,
+            )
+            # 2-D item-sharded streamed composition (double
+            # redistribution + cross-process replicate + collective
+            # factor gathers) lands on the same factors
+            np.testing.assert_allclose(
+                res["als_st_sh_uf"], oracle.user_factors_,
+                atol=4e-3, rtol=4e-3,
+            )
+            np.testing.assert_allclose(
+                res["als_st_sh_if"], oracle.item_factors_,
+                atol=4e-3, rtol=4e-3,
+            )
+        assert world_results[0]["als_st_if"] == world_results[1]["als_st_if"]
+        assert (
+            world_results[0]["als_st_sh_if"]
+            == world_results[1]["als_st_sh_if"]
+        )
+
     def test_adapter_partitioned_kmeans(self, world_results):
         """The PySpark adapter's multi-process ingestion: each rank
         materialized only its partitions of a mocked partitioned
