@@ -601,6 +601,135 @@ def bench_mesh(n_devices: int, backend: str = "cpu", sizes: str = "small"):
             virtual_cpu=virtual,
         )
 
+        # the streamed out-of-core composition on the same edges: each
+        # rank's grouped layouts stay HOST-resident and stream through
+        # its device in chunks (ops/als_block_stream); the collective
+        # structure matches the replicated run above, so the delta vs
+        # mesh_scaling_als is the upload-per-iteration price
+        from oap_mllib_tpu.ops import als_block_stream
+
+        lay = als_block_stream.prepare_streamed_block_layouts(
+            u, i, rr, n_users, n_items, mesh, r, item_sharded=False
+        )
+
+        def run_st():
+            bx, by = als_block_stream.als_block_run_streamed(
+                lay, x0, y0, als_iters, 0.1, 1.0, mesh, implicit=True
+            )
+            return np.asarray(by)
+
+        dt = _best_of(run_st, reps=2)
+        _emit(
+            "mesh_scaling_als_streamed", dt / als_iters, "sec/iter", 1.0,
+            mesh=m, per_rank_edges=edges_per_rank,
+            per_rank_users=users_per_rank, n_items=n_items, rank=r,
+            item_layout="replicated", virtual_cpu=virtual,
+        )
+
+
+# ---------------------------------------------------------------------------
+# North-star streamed scale (bench.py --streamed ROWS)
+# ---------------------------------------------------------------------------
+
+
+def bench_streamed(rows: int, d: int = 256, k: int = 1000,
+                   max_iter: int = 2):
+    """Streamed K-Means + PCA at north-star row counts (BASELINE.json's
+    100M x 256 config): a generator-backed ChunkSource synthesizes the
+    table on the fly — host RAM holds one ~1 GB base buffer and one
+    chunk, device HBM one chunk + the running state — so THE SAME
+    command scales to any row count the wall clock affords:
+
+        python bench.py --streamed 100000000     # full north star (pod host)
+        python bench.py --streamed 10000000      # tunnel-affordable point
+
+    Emits the measured host->device bandwidth first (on the axon tunnel
+    used here that bandwidth, not compute, bounds the per-pass time —
+    the JSON records both so a reader can project a directly-attached
+    host; compute per pass at k=1000 is ~0.2 s, BASELINE).
+    """
+    import jax
+
+    from oap_mllib_tpu.data.stream import ChunkSource
+    from oap_mllib_tpu.models.kmeans import KMeans
+    from oap_mllib_tpu.models.pca import PCA
+
+    if rows < k:
+        raise SystemExit(
+            f"--streamed ROWS must be >= k={k} (got {rows}); the point of "
+            "this mode is north-star row counts"
+        )
+    chunk_rows = 1 << 16
+    base_n = min(rows, 1 << 20)
+    rng = np.random.default_rng(0)
+    proto = rng.normal(size=(k, d)).astype(np.float32) * 4
+    x_base = (
+        proto[rng.integers(k, size=base_n)]
+        + rng.normal(size=(base_n, d)).astype(np.float32) * 0.3
+    )
+
+    def gen():
+        remaining = rows
+        while remaining > 0:
+            take = min(base_n, remaining)
+            yield x_base[:take]
+            remaining -= take
+
+    # raw ingest bandwidth at the fit's own chunk size — the bound this
+    # environment puts on every per-pass number below
+    probe = x_base[:chunk_rows]
+    _ = np.asarray(jax.device_put(probe)[0, 0])  # warm (sync via fetch)
+    t_up = _best_of(
+        lambda: np.asarray(jax.device_put(probe)[0, 0]), reps=3, warm=False
+    )
+    mbps = probe.nbytes / t_up / 1e6
+    _emit("host_to_device_MBps", mbps, "MB/s", 1.0,
+          chunk_mb=probe.nbytes >> 20)
+
+    # CPU per-pass reference (one Lloyd pass on a subsample, scaled)
+    sub = min(1 << 14, base_n)
+    from oap_mllib_tpu.fallback.kmeans_np import lloyd_np
+
+    t0 = time.perf_counter()
+    lloyd_np(
+        x_base[:sub].astype(np.float64),
+        x_base[rng.choice(base_n, size=k, replace=False)].astype(np.float64),
+        1, 0.0, np.ones((sub,), np.float64),
+    )
+    cpu_pass = (time.perf_counter() - t0) * (rows / sub)
+
+    src = ChunkSource(gen, d, chunk_rows=chunk_rows, n_rows=rows)
+    t0 = time.perf_counter()
+    m = KMeans(k=k, seed=1, init_mode="random", max_iter=max_iter).fit(src)
+    t_fit = time.perf_counter() - t0
+    assert getattr(m.summary, "streamed", False)
+    ph = m.summary.timings.as_dict()
+    n_iter = max(int(m.summary.num_iter), 1)
+    per_pass = ph["lloyd_loop"] / n_iter
+    bytes_per_pass = rows * d * 4
+    _emit(
+        f"streamed_kmeans_{rows}x{d}_k{k}_sec_per_pass",
+        per_pass, "sec/pass", cpu_pass / per_pass,
+        rows_per_sec=round(rows / per_pass),
+        effective_MBps=round(bytes_per_pass / per_pass / 1e6),
+        n_iter=n_iter, init_sec=round(ph.get("init_centers", 0.0), 1),
+        fit_sec=round(t_fit, 1),
+    )
+
+    t0 = time.perf_counter()
+    p = PCA(k=16).fit(src)
+    t_fit_p = time.perf_counter() - t0
+    assert p.summary["streamed"] and p.summary["n_rows"] == rows
+    php = p.summary["timings"].as_dict()
+    per_pass_p = php["covariance_streamed"] / 2  # two-pass centered Gram
+    _emit(
+        f"streamed_pca_{rows}x{d}_sec_per_pass",
+        per_pass_p, "sec/pass", 1.0,
+        effective_MBps=round(bytes_per_pass / per_pass_p / 1e6),
+        eigh_sec=round(php.get("eigh", 0.0), 3),
+        fit_sec=round(t_fit_p, 1),
+    )
+
 
 def _tests_tpu_status(timeout=900):
     """Run the compiled-mode TPU suite and report its outcome, so the
@@ -638,7 +767,15 @@ def main():
     ap.add_argument("--mesh-sizes", choices=("small", "big"), default="small",
                     help="per-rank work: small = CI-affordable, big = "
                          "slice-scale shapes")
+    ap.add_argument("--streamed", type=int, default=0, metavar="ROWS",
+                    help="north-star streamed scale: generator-backed "
+                         "K-Means + PCA at ROWS x 256 (100000000 = the "
+                         "full BASELINE.json config on a pod host)")
     args = ap.parse_args()
+
+    if args.streamed:
+        bench_streamed(args.streamed)
+        return
 
     if args.mesh:
         if args.mesh_backend == "cpu":

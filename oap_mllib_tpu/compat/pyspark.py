@@ -579,13 +579,20 @@ class RegressionEvaluator(_compat.RegressionEvaluator):
         )
 
 
-# Pipeline composability is data-plane agnostic — it only touches the
-# stage fit/transform contract — so the SAME classes serve real Spark
-# DataFrames here (the pyspark.ml.Pipeline import-line drop-in):
-#   from oap_mllib_tpu.compat.pyspark import Pipeline
+# Pipeline/tuning composability is data-plane agnostic — Pipeline only
+# touches the stage fit/transform contract, and the tuners do their own
+# one-collect on Spark frames — so the SAME classes serve real Spark
+# DataFrames here (the pyspark.ml.Pipeline / ml.tuning import-line
+# drop-in):
+#   from oap_mllib_tpu.compat.pyspark import Pipeline, CrossValidator
 from oap_mllib_tpu.compat.pipeline import (  # noqa: E402,F401
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
     Pipeline,
     PipelineModel,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
 )
 
 
