@@ -811,6 +811,37 @@ class TestStreamedALS:
             m1.item_factors_, m2.item_factors_, atol=1e-4, rtol=1e-4
         )
 
+    def test_streamed_composes_with_model_parallel_mesh(self, rng):
+        """The streamed block path on a (data=4, model=2) mesh: owned
+        blocks are data-axis blocks (model replicas collapse), chunk
+        placement replicates over the model axis, and the factors match
+        the pure-data-parallel streamed fit."""
+        u, i, r, nu, ni = _ratings(rng, n_users=40, n_items=24)
+        x0 = init_factors(nu, 3, 1)
+        y0 = init_factors(ni, 3, 2)
+        kw = dict(rank=3, max_iter=2, reg_param=0.1)
+        set_config(als_kernel="grouped")
+        try:
+            m1 = ALS(**kw).fit(
+                self._triples_source(u, i, r, 64), n_users=nu,
+                n_items=ni, init=(x0, y0),
+            )
+            set_config(model_parallel=2)
+            m2 = ALS(**kw).fit(
+                self._triples_source(u, i, r, 64), n_users=nu,
+                n_items=ni, init=(x0, y0),
+            )
+        finally:
+            set_config(model_parallel=1, als_kernel="auto")
+        assert m2.summary.get("streamed") and m2.summary.get("block_parallel")
+        assert m2.summary["num_user_blocks"] == 4  # data axis shrank
+        np.testing.assert_allclose(
+            m1.user_factors_, m2.user_factors_, atol=2e-4, rtol=2e-4
+        )
+        np.testing.assert_allclose(
+            m1.item_factors_, m2.item_factors_, atol=2e-4, rtol=2e-4
+        )
+
     def test_streamed_mesh_small_chunks(self, rng):
         """Tiny upload budget on the mesh path (monkeypatched
         groups_per_chunk -> many chunk launches per half-iteration)."""
