@@ -68,7 +68,21 @@ set_config(als_item_layout="sharded")
 m_sh = ALS(rank=RANK_, max_iter=3, reg_param=0.1, implicit_prefs=True,
            seed=3).fit(au[asl], ai[asl], ar[asl])
 assert m_sh.summary["item_layout"] == "sharded"
-set_config(als_item_layout="auto")
+
+# streamed-block 2-D composition over the SAME 3-rank world: each rank
+# streams its local triples; the single-sweep double redistribution and
+# the short last item block (kpb_i=14, 40 items over 3 blocks) cross
+# the process boundary (ops/als_block_stream)
+set_config(als_kernel="grouped")
+trip3 = np.stack(
+    [au[asl].astype(np.float64), ai[asl].astype(np.float64),
+     ar[asl].astype(np.float64)], axis=1,
+)
+m_st3 = ALS(rank=RANK_, max_iter=3, reg_param=0.1, implicit_prefs=True,
+            seed=3).fit(ChunkSource.from_array(trip3, chunk_rows=200))
+assert m_st3.summary.get("streamed"), m_st3.summary
+assert m_st3.summary["item_layout"] == "sharded", m_st3.summary
+set_config(als_item_layout="auto", als_kernel="auto")
 
 print(
     "RESULT "
@@ -80,6 +94,7 @@ print(
             "streamed_cost": float(ms.summary.training_cost),
             "streamed_pca_var": np.asarray(ps.explained_variance_).tolist(),
             "als_sh_if": np.asarray(m_sh.item_factors_).tolist(),
+            "als_st3_if": np.asarray(m_st3.item_factors_).tolist(),
         }
     ),
     flush=True,

@@ -340,6 +340,12 @@ class TestPseudoCluster:
                 world3_results[rank]["als_sh_if"], oracle.item_factors_,
                 atol=4e-3, rtol=4e-3,
             )
+            # streamed-block 2-D over the same 3-rank world (short last
+            # item block through the cross-process double redistribution)
+            np.testing.assert_allclose(
+                world3_results[rank]["als_st3_if"], oracle.item_factors_,
+                atol=4e-3, rtol=4e-3,
+            )
 
     def test_streamed_block_als_two_process(self, world_results):
         """Out-of-core ALS composed with a REAL 2-process world: each
