@@ -323,6 +323,100 @@ class TestPartitionedIngestion:
             _collect_local_partitions(df, rank=0, world=2)
 
 
+class TestAdapterFuzz:
+    """Randomized-schema fuzz: for every draw the DataFrame plane must
+    produce exactly the dict plane's numbers on the same data — shuffled
+    column orders, bystander columns, nan/drop cold-start, and a
+    re-transform cycle.  Runs against the mock always and against a real
+    SparkSession in CI (the dual-plane ``session`` fixture)."""
+
+    def test_als_matches_dict_plane_fuzz(self, rng, session):
+        from oap_mllib_tpu.compat import spark as dictplane
+
+        for trial in range(4):
+            nu = int(rng.integers(8, 30))
+            ni = int(rng.integers(6, 24))
+            nnz = int(rng.integers(60, 300))
+            u = rng.integers(0, nu, nnz)
+            i = rng.integers(0, ni, nnz)
+            r = (rng.random(nnz) * 4 + 1).astype(np.float32)
+            strategy = ["nan", "drop"][trial % 2]
+
+            cols = {
+                "userId": [int(v) for v in u],
+                "movieId": [int(v) for v in i],
+                "rating": [float(v) for v in r],
+                "bystander": [float(v) for v in rng.random(nnz)],
+            }
+            names = list(cols)
+            rng.shuffle(names)  # random column order
+            df = _df(session, **{n: cols[n] for n in names})
+
+            kw = dict(rank=3, maxIter=2, regParam=0.1, seed=trial,
+                      userCol="userId", itemCol="movieId",
+                      ratingCol="rating", coldStartStrategy=strategy)
+            model = ALS(**kw).fit(df)
+            oracle = (
+                dictplane.ALS().setRank(3).setMaxIter(2).setRegParam(0.1)
+                .setSeed(trial).setUserCol("userId").setItemCol("movieId")
+                .setRatingCol("rating").setColdStartStrategy(strategy)
+                .fit({k: np.asarray(v) for k, v in cols.items()})
+            )
+
+            # probe includes unseen ids so both strategies do real work
+            pu = np.concatenate([u[:10], [nu + 5]])
+            pi = np.concatenate([i[:10], [0]])
+            probe_cols = {
+                "userId": [int(v) for v in pu],
+                "movieId": [int(v) for v in pi],
+                "rating": [1.0] * len(pu),
+            }
+            probe = _df(session, **probe_cols)
+            out_rows = model.transform(probe).collect()
+            want = oracle.transform(
+                {k: np.asarray(v) for k, v in probe_cols.items()}
+            )
+            got = np.asarray([row[-1] for row in out_rows], np.float64)
+            np.testing.assert_allclose(
+                got, np.asarray(want["prediction"], np.float64),
+                atol=1e-5, rtol=1e-5,
+                err_msg=f"trial {trial} strategy={strategy} order={names}",
+            )
+            if strategy == "drop":
+                # the unseen probe user must actually be dropped
+                assert len(out_rows) == len(pu) - 1
+
+    def test_kmeans_matches_dict_plane_fuzz(self, rng, session):
+        from oap_mllib_tpu.compat import spark as dictplane
+
+        for trial in range(3):
+            n = int(rng.integers(40, 120))
+            d = int(rng.integers(3, 8))
+            k = int(rng.integers(2, 5))
+            x = rng.normal(size=(n, d))
+            cols = {
+                "noise": [float(v) for v in rng.random(n)],
+                "features": [list(row) for row in x],
+            }
+            df = _df(session, **cols)
+            model = KMeans(k=k, seed=trial, maxIter=5).fit(df)
+            oracle = (
+                dictplane.KMeans().setK(k).setSeed(trial).setMaxIter(5)
+                .fit({"features": x})
+            )
+            got = [row[-1] for row in model.transform(df).collect()]
+            want = oracle.transform({"features": x})["prediction"]
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"trial {trial} n={n} d={d} k={k}"
+            )
+            # a second transform over the scored frame must be stable
+            again = [
+                row[-1] for row in model.transform(model.transform(df))
+                .collect()
+            ]
+            np.testing.assert_array_equal(again, want)
+
+
 class TestPipelineAdapter:
     def test_pca_kmeans_pipeline_over_dataframes(self, rng, session):
         """Pipeline is data-plane agnostic: the same class chains the
