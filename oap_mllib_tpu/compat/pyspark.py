@@ -540,6 +540,42 @@ class ALSModel:
         return self._inner.recommendForAllItems(numUsers,
                                                 withScores=withScores)
 
+    @staticmethod
+    def _subset_col_dict(dataset, col: str):
+        """DataFrame -> {col: int64 ids} for the subset recommenders,
+        with a .distinct() pushdown when the frame supports it (Spark's
+        own recommendForUserSubset distincts distributedly; collecting
+        every raw row only for the dict plane to unique them away would
+        bound driver IO by the ROW count instead of the distinct
+        count).  Dicts pass through."""
+        if isinstance(dataset, dict) or not hasattr(dataset, "select"):
+            return dataset
+        sel = dataset.select(col)
+        distinct = getattr(sel, "distinct", None)
+        if distinct is not None:
+            sel = distinct()
+        rows, cols = _collect_once(sel)
+        return {col: _col_from(rows, cols, col, np.int64)}
+
+    def recommendForUserSubset(self, dataset, numItems: int,
+                               withScores: bool = False):
+        """Subset recommendations from a DataFrame carrying the user id
+        column (ml.recommendation.ALSModel.recommendForUserSubset);
+        returns (user_ids, item_ids[, scores]) like the dict plane."""
+        return self._inner.recommendForUserSubset(
+            self._subset_col_dict(dataset, self._inner._userCol),
+            numItems, withScores=withScores,
+        )
+
+    def recommendForItemSubset(self, dataset, numUsers: int,
+                               withScores: bool = False):
+        """Subset recommendations from a DataFrame carrying the item id
+        column; shape contract as recommendForUserSubset."""
+        return self._inner.recommendForItemSubset(
+            self._subset_col_dict(dataset, self._inner._itemCol),
+            numUsers, withScores=withScores,
+        )
+
     def save(self, path: str) -> None:
         self._inner.save(path)
 

@@ -466,6 +466,51 @@ class ALSModel:
             numUsers, with_scores=withScores
         )
 
+    def _subset_ids(self, dataset, col, seen, n_rows: int) -> np.ndarray:
+        """Spark's subset semantics (ALS.scala:379-429): take the id
+        column, DISTINCT it, and keep only ids with a trained factor row
+        (the join against the factor frame) — unseen ids silently drop,
+        they do not error."""
+        ids = np.asarray(
+            dataset[col] if isinstance(dataset, dict) else dataset,
+            np.int64,
+        )
+        ids = np.unique(ids)
+        ids = ids[(ids >= 0) & (ids < n_rows)]
+        if seen is not None:
+            ids = ids[np.isin(ids, seen)]
+        return ids
+
+    def recommendForUserSubset(self, dataset, numItems: int,
+                               withScores: bool = False):
+        """Top-N items for the users in ``dataset`` (a dict with the
+        userCol, or a bare id array) — ml.recommendation.ALSModel
+        .recommendForUserSubset.  Returns (user_ids, item_ids[, scores]):
+        row j of the matrices belongs to user_ids[j] (one row per
+        distinct trained user, Spark's distinct-and-join semantics)."""
+        ids = self._subset_ids(
+            dataset, self._userCol, self._seenUsers,
+            self._inner.user_factors_.shape[0],
+        )
+        out = self._inner.recommend_for_users(
+            ids, numItems, with_scores=withScores
+        )
+        return (ids, *out) if withScores else (ids, out)
+
+    def recommendForItemSubset(self, dataset, numUsers: int,
+                               withScores: bool = False):
+        """Top-N users for the items in ``dataset`` — ml.recommendation
+        .ALSModel.recommendForItemSubset; shape contract as
+        recommendForUserSubset."""
+        ids = self._subset_ids(
+            dataset, self._itemCol, self._seenItems,
+            self._inner.item_factors_.shape[0],
+        )
+        out = self._inner.recommend_for_items(
+            ids, numUsers, with_scores=withScores
+        )
+        return (ids, *out) if withScores else (ids, out)
+
     def save(self, path: str) -> None:
         """Persist factors AND the compat surface: column names,
         coldStartStrategy, and the seen-id sets — Spark's cold-start

@@ -37,8 +37,12 @@ def _top_k_pairs(q: jax.Array, targets: jax.Array, n: int):
     """Top-n (scores, ids) for a block of query rows — module-level so
     the compiled program caches across recommend_for_all_* calls (a
     per-call jit lambda would recompile every time AND constant-fold the
-    whole factor matrix into the executable)."""
-    return jax.lax.top_k(jnp.matmul(q, targets.T), n)
+    whole factor matrix into the executable).  HIGHEST precision: the
+    returned scores are the model's predicted preferences and must match
+    predict() (TPU's default bf16 matmul drifts them ~1e-3 and can swap
+    near-tie rankings — caught on hardware, round 5)."""
+    scores = jnp.matmul(q, targets.T, precision=jax.lax.Precision.HIGHEST)
+    return jax.lax.top_k(scores, n)
 
 
 class ALSModel:
@@ -194,6 +198,55 @@ class ALSModel:
             with_scores=with_scores,
         )
         return (ids, scores) if with_scores else ids
+
+    def _recommend_subset(self, query_ids, query_table, target_table,
+                          n: int, with_scores: bool):
+        """Shared subset recommender: row j of the result is the top-n
+        for query_ids[j] (callers pass ids already validated/deduped)."""
+        q = query_table[np.asarray(query_ids, np.int64)]
+        ids, scores = self._top_k_scores(
+            q, target_table, n, with_scores=with_scores
+        )
+        return (ids, scores) if with_scores else ids
+
+    def recommend_for_users(self, user_ids, num_items: int,
+                            with_scores: bool = False):
+        """Top-N item ids for a SUBSET of users
+        (~ ALSModel.recommendForUserSubset, reference
+        spark-3.1.1/ml/recommendation/ALS.scala:379-403).  Row j is the
+        recommendation list for ``user_ids[j]`` (ids must be in range;
+        the compat layer applies Spark's distinct-and-join semantics)."""
+        user_ids = np.asarray(user_ids, np.int64)
+        n_u = self.user_factors_.shape[0]
+        if len(user_ids) and (
+            user_ids.min() < 0 or user_ids.max() >= n_u
+        ):
+            raise ValueError(
+                f"user ids must be in [0, {n_u}); got range "
+                f"[{user_ids.min()}, {user_ids.max()}]"
+            )
+        return self._recommend_subset(
+            user_ids, self.user_factors_, self.item_factors_, num_items,
+            with_scores,
+        )
+
+    def recommend_for_items(self, item_ids, num_users: int,
+                            with_scores: bool = False):
+        """Top-N user ids for a SUBSET of items
+        (~ ALSModel.recommendForItemSubset, ALS.scala:405-429)."""
+        item_ids = np.asarray(item_ids, np.int64)
+        n_i = self.item_factors_.shape[0]
+        if len(item_ids) and (
+            item_ids.min() < 0 or item_ids.max() >= n_i
+        ):
+            raise ValueError(
+                f"item ids must be in [0, {n_i}); got range "
+                f"[{item_ids.min()}, {item_ids.max()}]"
+            )
+        return self._recommend_subset(
+            item_ids, self.item_factors_, self.user_factors_, num_users,
+            with_scores,
+        )
 
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)

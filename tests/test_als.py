@@ -198,6 +198,36 @@ class TestBehavior:
             scores.ravel(), m.predict(uu, ids.ravel()), atol=1e-5
         )
 
+    def test_recommend_subsets(self, rng):
+        """recommend_for_users / recommend_for_items (the reference's
+        recommendForUserSubset / ItemSubset surface, ALS.scala:379-429):
+        subset rows equal the corresponding all-users rows; ids out of
+        range raise; scores ride along."""
+        u, i, r, nu, ni = _ratings(rng)
+        m = ALS(rank=4, max_iter=2, implicit_prefs=True).fit(
+            u, i, r, n_users=nu, n_items=ni
+        )
+        subset = np.array([3, 0, 17, 3])  # unordered + duplicate
+        all_ids, all_scores = m.recommend_for_all_users(
+            5, with_scores=True
+        )
+        ids, scores = m.recommend_for_users(subset, 5, with_scores=True)
+        assert ids.shape == (4, 5)
+        np.testing.assert_allclose(scores, all_scores[subset], atol=1e-5)
+        full = m.user_factors_[subset] @ m.item_factors_.T
+        np.testing.assert_allclose(
+            np.take_along_axis(full, ids, axis=1), scores, atol=1e-5
+        )
+        item_ids = m.recommend_for_items(np.array([1, 5]), 3)
+        assert item_ids.shape == (2, 3)
+        assert item_ids.max() < nu
+        with pytest.raises(ValueError, match="user ids"):
+            m.recommend_for_users(np.array([nu]), 3)
+        with pytest.raises(ValueError, match="item ids"):
+            m.recommend_for_items(np.array([-1]), 3)
+        # empty subset: (0, n) result, no crash
+        assert m.recommend_for_users(np.zeros((0,), np.int64), 4).shape == (0, 4)
+
     def test_param_validation(self):
         for bad in (dict(rank=0), dict(max_iter=-1), dict(reg_param=-0.1), dict(alpha=-1)):
             with pytest.raises(ValueError):

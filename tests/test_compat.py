@@ -131,6 +131,32 @@ class TestALSCompat:
         assert ru.max() < model.itemFactors.shape[0]
         assert ri.max() < model.userFactors.shape[0]
 
+    def test_recommend_subsets_distinct_and_join(self, rng):
+        """recommendForUserSubset / ItemSubset: DISTINCT the id column,
+        drop ids without a trained factor row (Spark's join semantics,
+        ALS.scala:379-429) — never an error for unseen ids — and return
+        rows aligned with the surviving ids."""
+        df = self._ratings_df(rng)
+        model = ALS().setRank(4).setMaxIter(2).setImplicitPrefs(True).fit(df)
+        nu = model.userFactors.shape[0]
+        all_recs = model.recommendForAllUsers(5)
+        subset = {"user": np.array([7, 2, 7, 999, 2])}  # dupes + unseen
+        ids, recs = model.recommendForUserSubset(subset, 5)
+        np.testing.assert_array_equal(ids, [2, 7])  # distinct, joined
+        np.testing.assert_array_equal(recs, all_recs[[2, 7]])
+        # withScores rides along; bare id arrays accepted too
+        ids2, recs2, scores = model.recommendForItemSubset(
+            np.array([1, 3]), 4, withScores=True
+        )
+        np.testing.assert_array_equal(ids2, [1, 3])
+        assert recs2.shape == scores.shape == (2, 4)
+        assert recs2.max() < nu
+        # every id unseen: empty result, not an error
+        ids3, recs3 = model.recommendForUserSubset(
+            {"user": np.array([990, 991])}, 5
+        )
+        assert len(ids3) == 0 and recs3.shape == (0, 5)
+
     def test_ndarray_input_rejected(self):
         with pytest.raises(TypeError):
             ALS().fit(np.zeros((3, 3)))

@@ -626,6 +626,20 @@ class TestALSAdapter:
         assert model.bestParams == {"regParam": 0.05}
         assert "prediction" in model.transform(training).columns
 
+    def test_recommend_subset_from_dataframe(self, rng, session):
+        """recommendForUserSubset takes a DataFrame carrying the id
+        column (the pyspark.ml signature); distinct-and-join semantics
+        ride the dict plane."""
+        training, *_ = self._ratings_df(rng, session, nu=20, ni=15)
+        model = ALS(rank=3, maxIter=2, implicitPrefs=True,
+                    userCol="userId", itemCol="movieId",
+                    ratingCol="rating").fit(training)
+        sub = _df(session, userId=[3, 0, 3, 999])
+        ids, recs = model.recommendForUserSubset(sub, 4)
+        assert list(ids) == [0, 3]
+        assert recs.shape == (2, 4)
+        assert recs.max() < model.itemFactors.shape[0]
+
     def test_implicit_mode(self, rng, session):
         training, u, i, r = self._ratings_df(rng, session)
         model = ALS(rank=4, maxIter=3, implicitPrefs=True, alpha=40.0,

@@ -110,6 +110,28 @@ class TestEstimatorCompiled:
             rmse = float(np.sqrt(np.mean((pred - r) ** 2)))
             assert rmse < 0.5 * float(np.std(r))
 
+    def test_recommend_scores_match_predict_on_hardware(self, rng):
+        """The recommend matmul must run at HIGHEST precision: TPU's
+        default bf16 matmul drifts the returned scores ~1e-3 off
+        predict() and can swap near-tie rankings — invisible to the CPU
+        suite (f32 matmuls there), caught only on hardware (round 5)."""
+        from oap_mllib_tpu.models.als import ALS
+
+        u, i, _ = _synthetic(rng, 80, 60, nnz=2500)
+        r = (rng.random(len(u)) * 4 + 1).astype(np.float32)
+        m = ALS(rank=4, max_iter=2, implicit_prefs=True, seed=1).fit(u, i, r)
+        ids, scores = m.recommend_for_all_users(5, with_scores=True)
+        uu = np.repeat(np.arange(ids.shape[0]), 5)
+        np.testing.assert_allclose(
+            scores.ravel(), m.predict(uu, ids.ravel()), atol=1e-5
+        )
+        sub = np.array([7, 3, 7])
+        sids, sscores = m.recommend_for_users(sub, 5, with_scores=True)
+        full = m.user_factors_[sub] @ m.item_factors_.T
+        np.testing.assert_allclose(
+            np.take_along_axis(full, sids, axis=1), sscores, atol=1e-5
+        )
+
 
 class TestGroupedChunkedCompiled:
     def test_chunked_scan_path_compiled(self, rng, monkeypatch):
