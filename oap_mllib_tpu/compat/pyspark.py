@@ -335,6 +335,17 @@ class KMeansModel:
     def __init__(self, inner: _compat.KMeansModel):
         self._inner = inner
 
+    def setFeaturesCol(self, v):
+        self._inner.setFeaturesCol(v)
+        return self
+
+    def setPredictionCol(self, v):
+        self._inner.setPredictionCol(v)
+        return self
+
+    def getFeaturesCol(self):    return self._inner.getFeaturesCol()
+    def getPredictionCol(self):  return self._inner.getPredictionCol()
+
     def clusterCenters(self):
         return self._inner.clusterCenters()
 
@@ -402,6 +413,17 @@ class PCA(_compat.PCA):
 class PCAModel:
     def __init__(self, inner: _compat.PCAModel):
         self._inner = inner
+
+    def setInputCol(self, v):
+        self._inner.setInputCol(v)
+        return self
+
+    def setOutputCol(self, v):
+        self._inner.setOutputCol(v)
+        return self
+
+    def getInputCol(self):   return self._inner.getInputCol()
+    def getOutputCol(self):  return self._inner.getOutputCol()
 
     @property
     def pc(self) -> np.ndarray:
@@ -489,6 +511,27 @@ class ALS(_compat.ALS):
 class ALSModel:
     def __init__(self, inner: _compat.ALSModel):
         self._inner = inner
+
+    def setUserCol(self, v):
+        self._inner.setUserCol(v)
+        return self
+
+    def setItemCol(self, v):
+        self._inner.setItemCol(v)
+        return self
+
+    def setPredictionCol(self, v):
+        self._inner.setPredictionCol(v)
+        return self
+
+    def setColdStartStrategy(self, v):
+        self._inner.setColdStartStrategy(v)
+        return self
+
+    def getUserCol(self):            return self._inner.getUserCol()
+    def getItemCol(self):            return self._inner.getItemCol()
+    def getPredictionCol(self):      return self._inner.getPredictionCol()
+    def getColdStartStrategy(self):  return self._inner.getColdStartStrategy()
 
     @property
     def rank(self) -> int:

@@ -156,6 +156,14 @@ class KMeansModel:
         self._featuresCol = features_col
         self._predictionCol = prediction_col
 
+    # Spark models re-expose their column params as setters (a fitted
+    # ml.clustering.KMeansModel can be pointed at different columns)
+    def setFeaturesCol(self, v):    self._featuresCol = v; return self
+    def setPredictionCol(self, v):  self._predictionCol = v; return self
+
+    def getFeaturesCol(self):    return self._featuresCol
+    def getPredictionCol(self):  return self._predictionCol
+
     def clusterCenters(self) -> np.ndarray:
         return self._inner.cluster_centers_
 
@@ -229,6 +237,13 @@ class PCAModel:
         self._inner = inner
         self._inputCol = input_col
         self._outputCol = output_col
+
+    # column setters on the fitted model (ml.feature.PCAModel surface)
+    def setInputCol(self, v):   self._inputCol = v; return self
+    def setOutputCol(self, v):  self._outputCol = v; return self
+
+    def getInputCol(self):   return self._inputCol
+    def getOutputCol(self):  return self._outputCol
 
     @property
     def pc(self) -> np.ndarray:
@@ -320,16 +335,23 @@ class ALS:
         """Set both numUserBlocks and numItemBlocks (ALS.scala:679-683)."""
         return self.setNumUserBlocks(v).setNumItemBlocks(v)
 
-    def setColdStartStrategy(self, v):
-        """"nan" keeps NaN predictions for ids unseen in training; "drop"
-        removes those rows from transform output (ALS.scala:119-128).
-        Validation is case-insensitive, matching the Spark param validator."""
-        if str(v).lower() not in self._supportedColdStartStrategies:
+    @staticmethod
+    def _validated_cold_start(v) -> str:
+        """ONE case-insensitive validator + normalizer for estimator and
+        model setters (Spark lowercases on read, ALS.scala:128 — storing
+        normalized makes that a no-op here)."""
+        s = str(v).lower()
+        if s not in ALS._supportedColdStartStrategies:
             raise ValueError(
                 f"coldStartStrategy must be one of "
-                f"{self._supportedColdStartStrategies}, got {v!r}"
+                f"{ALS._supportedColdStartStrategies}, got {v!r}"
             )
-        self._coldStartStrategy = v
+        return s
+
+    def setColdStartStrategy(self, v):
+        """"nan" keeps NaN predictions for ids unseen in training; "drop"
+        removes those rows from transform output (ALS.scala:119-128)."""
+        self._coldStartStrategy = self._validated_cold_start(v)
         return self
 
     def setCheckpointInterval(self, v):
@@ -363,8 +385,7 @@ class ALS:
     def getCheckpointInterval(self): return self._checkpointInterval
 
     def getColdStartStrategy(self):
-        # Spark lowercases on read (ALS.scala:128)
-        return self._coldStartStrategy.lower()
+        return self._coldStartStrategy  # stored normalized
 
     def fit(self, data: DataFrame) -> "ALSModel":
         if not isinstance(data, dict):
@@ -405,6 +426,26 @@ class ALSModel:
         # sets) degrades to range checks.
         self._seenUsers = seen_users
         self._seenItems = seen_items
+
+    # Spark's fitted ALSModel re-exposes these as model params
+    # (ml.recommendation.ALSModel.setColdStartStrategy et al.) — a
+    # loaded model can be re-pointed at different columns or switched
+    # between nan/drop without refitting
+    def setUserCol(self, v):        self._userCol = v; return self
+    def setItemCol(self, v):        self._itemCol = v; return self
+    def setPredictionCol(self, v):  self._predictionCol = v; return self
+
+    def setColdStartStrategy(self, v):
+        self._coldStartStrategy = ALS._validated_cold_start(v)
+        return self
+
+    def getUserCol(self):            return self._userCol
+    def getItemCol(self):            return self._itemCol
+    def getPredictionCol(self):      return self._predictionCol
+
+    def getColdStartStrategy(self):
+        # direct construction may carry a raw value; normalize on read
+        return self._coldStartStrategy.lower()
 
     @property
     def rank(self) -> int:

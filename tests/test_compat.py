@@ -157,6 +157,27 @@ class TestALSCompat:
         )
         assert len(ids3) == 0 and recs3.shape == (0, 5)
 
+    def test_model_setters_post_fit(self, rng):
+        """Spark's fitted models re-expose their column/strategy params
+        as setters: a loaded ALSModel can switch nan<->drop or be
+        re-pointed at different columns without refitting."""
+        df = self._ratings_df(rng)
+        model = ALS().setRank(3).setMaxIter(2).fit(df)  # default "nan"
+        probe = {"user": np.array([0, 999]), "item": np.array([0, 1]),
+                 "rating": np.array([1.0, 2.0], np.float32)}
+        out = model.transform(probe)
+        assert len(out["prediction"]) == 2 and np.isnan(out["prediction"][1])
+        model.setColdStartStrategy("drop").setPredictionCol("score")
+        out2 = model.transform(probe)
+        assert "score" in out2 and len(out2["score"]) == 1
+        assert np.isfinite(out2["score"]).all()
+        with pytest.raises(ValueError, match="coldStartStrategy"):
+            model.setColdStartStrategy("explode")
+        # column re-pointing: same data under different names
+        model.setUserCol("u2").setItemCol("i2")
+        out3 = model.transform({"u2": probe["user"], "i2": probe["item"]})
+        np.testing.assert_allclose(out3["score"], out2["score"])
+
     def test_ndarray_input_rejected(self):
         with pytest.raises(TypeError):
             ALS().fit(np.zeros((3, 3)))
