@@ -619,11 +619,26 @@ def bench_mesh(n_devices: int, backend: str = "cpu", sizes: str = "small"):
             return np.asarray(by)
 
         dt = _best_of(run_st, reps=2)
+        # one instrumented run for the prefetch split: how much of the
+        # per-iteration upload price the pipeline hides behind the
+        # moment kernels (the delta vs mesh_scaling_als is the price;
+        # overlap_efficiency is the hidden fraction)
+        from oap_mllib_tpu.utils.timing import Timings
+
+        t_st = Timings()
+        als_block_stream.als_block_run_streamed(
+            lay, x0, y0, als_iters, 0.1, 1.0, mesh, implicit=True,
+            timings=t_st,
+        )
+        eff = t_st.overlap_efficiency("als_iterations")
+        sub = t_st.subphases("als_iterations")
         _emit(
             "mesh_scaling_als_streamed", dt / als_iters, "sec/iter", 1.0,
             mesh=m, per_rank_edges=edges_per_rank,
             per_rank_users=users_per_rank, n_items=n_items, rank=r,
             item_layout="replicated", virtual_cpu=virtual,
+            overlap_efficiency=None if eff is None else round(eff, 3),
+            transfer_sec=round(sub.get("transfer", 0.0), 3),
         )
 
 
@@ -698,6 +713,24 @@ def bench_streamed(rows: int, d: int = 256, k: int = 1000,
     )
     cpu_pass = (time.perf_counter() - t0) * (rows / sub)
 
+    def _overlap_extras(timings, phase):
+        """Prefetch-pipeline report for a streamed phase: the
+        stage/transfer/compute split (data/prefetch.py) and the fraction
+        of staging hidden behind compute.  The split proves WHERE a
+        streamed pass spends its wall — a tunnel-bound environment shows
+        transfer ~= compute with high overlap; a compute-bound one shows
+        staging fully hidden."""
+        eff = timings.overlap_efficiency(phase)
+        if eff is None:
+            return {}
+        sub = timings.subphases(phase)
+        return {
+            "overlap_efficiency": round(eff, 3),
+            "stage_sec": round(sub.get("stage", 0.0), 3),
+            "transfer_sec": round(sub.get("transfer", 0.0), 3),
+            "compute_sec": round(sub.get("compute", 0.0), 3),
+        }
+
     src = ChunkSource(gen, d, chunk_rows=chunk_rows, n_rows=rows)
     t0 = time.perf_counter()
     m = KMeans(k=k, seed=1, init_mode="random", max_iter=max_iter).fit(src)
@@ -714,6 +747,7 @@ def bench_streamed(rows: int, d: int = 256, k: int = 1000,
         effective_MBps=round(bytes_per_pass / per_pass / 1e6),
         n_iter=n_iter, init_sec=round(ph.get("init_centers", 0.0), 1),
         fit_sec=round(t_fit, 1),
+        **_overlap_extras(m.summary.timings, "lloyd_loop"),
     )
 
     t0 = time.perf_counter()
@@ -728,6 +762,7 @@ def bench_streamed(rows: int, d: int = 256, k: int = 1000,
         effective_MBps=round(bytes_per_pass / per_pass_p / 1e6),
         eigh_sec=round(php.get("eigh", 0.0), 3),
         fit_sec=round(t_fit_p, 1),
+        **_overlap_extras(p.summary["timings"], "covariance_streamed"),
     )
 
 
@@ -781,10 +816,18 @@ def main():
         if args.mesh_backend == "cpu":
             # must happen before any backend initializes (env vars alone
             # are ignored when a site hook pins the platform)
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                # older jax lines have no jax_num_cpu_devices option
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={args.mesh}"
+                ).strip()
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", args.mesh)
+            if hasattr(jax.config, "jax_num_cpu_devices"):
+                jax.config.update("jax_num_cpu_devices", args.mesh)
         bench_mesh(args.mesh, args.mesh_backend, args.mesh_sizes)
         return
 

@@ -57,6 +57,13 @@ make -C oap_mllib_tpu/native -j4
 echo "== test suite (8-device CPU pseudo-cluster) =="
 python -m pytest tests/ -q
 
+echo "== streamed prefetch gates: serial parity (depth=1), deep pipeline (depth=4) =="
+# every streamed route must be bit-identical with the pipeline disabled
+# (depth=1 = the serial loop) and healthy with a deeper-than-default
+# queue; REQUIRED — the default-depth run above exercises only depth=2
+OAP_MLLIB_TPU_PREFETCH_DEPTH=1 python -m pytest tests/test_prefetch.py tests/test_stream.py -q
+OAP_MLLIB_TPU_PREFETCH_DEPTH=4 python -m pytest tests/test_prefetch.py tests/test_stream.py -q
+
 echo "== compiled-mode TPU suite (skipped unless a TPU backend is present) =="
 if python -c "import jax, sys; sys.exit(0 if jax.default_backend() == 'tpu' else 1)" 2>/dev/null; then
   python -m pytest tests_tpu/ -q

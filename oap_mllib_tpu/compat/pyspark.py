@@ -131,6 +131,23 @@ def _collect_local_partitions(df, rank: Optional[int] = None,
                 f"(world={world}); repartition the DataFrame to at "
                 "least the process count"
             )
+        # the pid % world routing assumes every process computed the SAME
+        # partitioning of the same data; a nondeterministically
+        # partitioned/ordered source (an uncached randomSplit recomputed
+        # after an executor loss, an upstream sample) can silently drop
+        # or duplicate rows globally.  One cheap extra action pins it:
+        # the per-rank kept-row counts must sum to the DataFrame's count.
+        count_fn = getattr(df, "count", None)
+        total = int(counts.sum())
+        expected = int(count_fn()) if count_fn is not None else total
+        if total != expected:
+            raise ValueError(
+                f"partition-wise ingestion kept {total} rows across "
+                f"{world} process(es) but df.count() is {expected} — the "
+                "DataFrame's partitioning is not deterministic across "
+                "processes (e.g. an uncached randomSplit/sample); "
+                ".cache() or materialize it before the fit"
+            )
     elif not rows:
         raise ValueError(
             f"process {rank} received zero partitions (world={world}); "
@@ -214,9 +231,10 @@ def _out_schema(df, name: str, kind: str):
     j = _out_pos(df, name)
 
     def _drop_first(seq, match):
-        # only the FIRST occurrence, mirroring _stripped_rows — a
-        # duplicate-name frame (Spark permits them after joins) must
-        # keep row and schema lengths consistent
+        # drop only the FIRST matching column: withColumn replaces one
+        # slot in place, and a duplicate-name frame (Spark permits them
+        # after joins) must keep row and schema lengths consistent —
+        # dropping every match would shrink the schema below the rows
         out, dropped = [], False
         for f in seq:
             if not dropped and match(f):

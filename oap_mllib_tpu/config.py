@@ -126,6 +126,15 @@ class Config:
     # 16/64 — BASELINE.md row 5).  Ignored unless pca_solver="randomized".
     pca_rand_oversample: int = 16
     pca_rand_iters: int = 8
+    # Streamed-path prefetch depth: how many chunks the background staging
+    # thread may hold ahead of the consumer (data/prefetch.py).  2 =
+    # double buffering — chunk N+1 is padded/converted/device_put while
+    # chunk N's step executes, hiding host->device transfer behind
+    # compute.  1 = today's strictly serial stage->transfer->compute loop
+    # (no thread; bit-identical results — depth never changes the math,
+    # only the overlap).  Each unit of depth holds one extra staged chunk
+    # in device memory, so HBM grows by chunk_bytes * (depth - 1).
+    prefetch_depth: int = 2
 
     @classmethod
     def from_env(cls) -> "Config":

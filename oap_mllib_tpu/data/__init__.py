@@ -15,11 +15,14 @@ from oap_mllib_tpu.data.io import (
     read_ratings,
 )
 from oap_mllib_tpu.data.stream import ChunkSource
+from oap_mllib_tpu.data.prefetch import Prefetcher, PrefetchStats
 
 __all__ = [
     "DenseTable",
     "CSRTable",
     "ChunkSource",
+    "Prefetcher",
+    "PrefetchStats",
     "read_libsvm",
     "read_csv",
     "read_ratings",

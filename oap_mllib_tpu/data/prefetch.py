@@ -1,0 +1,310 @@
+"""Double-buffered async chunk prefetch: overlap host staging with device
+compute on every streamed path.
+
+Every streamed route (K-Means/PCA passes in ops/stream_ops.py, ALS edge
+uploads in ops/als_stream.py and ops/als_block_stream.py) used to be
+strictly serial per chunk: pull from the source, pad/convert on host,
+``device_put``, dispatch the step, repeat.  The device sat idle through
+each chunk's staging and the host sat idle through each chunk's compute —
+BASELINE.md attributes the streamed numbers largely to exactly that
+host->device tunnel time.  This module is the shared communication-hiding
+stage (cf. arxiv 2112.01075's transfer/compute overlap): a bounded
+background thread runs the host half of the pipeline up to
+``Config.prefetch_depth`` chunks ahead of the consumer, so chunk N+1's
+staging and transfer issue while chunk N's step is still executing.
+
+Contracts:
+
+- **Order and math are untouched.**  Chunks reach the consumer in source
+  order whatever the depth; depth only moves WHEN staging happens, so
+  results are bit-identical across depths (and depth=1 runs the exact
+  pre-pipeline serial loop, no thread at all).
+- **Bounded memory.**  The producer owns a semaphore slot per staged
+  chunk, acquired BEFORE pulling from the source and released when the
+  consumer retires the chunk — the pipeline never holds more than
+  ``depth`` staged chunks (queued + consumer-held) nor runs the source
+  more than ``depth`` pulls ahead.
+- **Fail-fast multi-process semantics.**  A staging failure (source
+  error, conversion error, device_put OOM) is captured in the producer
+  and re-raised from the consumer's next ``__next__`` — which sits inside
+  the caller's ``_PassGuard`` block, so the error rides the next
+  collective reduction and every rank fails together instead of peers
+  hanging in process_allgather (ops/stream_ops._PassGuard).
+- **Buffer retirement.**  With ``retire=True`` the jax arrays of the
+  previously consumed chunk are ``delete()``d when the consumer advances
+  (the runtime frees them once in-flight steps finish) — the streamed
+  paths' donation analog: the consumed chunk's HBM returns to the pool
+  immediately instead of at garbage collection, keeping peak device
+  memory at O(depth x chunk) even under allocator pressure.
+- **Clean shutdown.**  ``close()`` (or the context-manager exit) cancels
+  the producer and drains it; abandoning the iterator mid-pass (an early
+  break, an exception in the consumer) cannot leave a thread blocked on
+  the queue.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from oap_mllib_tpu.config import get_config
+
+
+def resolve_depth(depth: Optional[int] = None) -> int:
+    """The effective prefetch depth: the argument if given, else
+    ``Config.prefetch_depth`` (env ``OAP_MLLIB_TPU_PREFETCH_DEPTH``)."""
+    d = get_config().prefetch_depth if depth is None else depth
+    d = int(d)
+    if d < 1:
+        raise ValueError(f"prefetch depth must be >= 1, got {d}")
+    return d
+
+
+class PrefetchStats:
+    """Per-pipeline accounting for the stage/transfer/compute split.
+
+    - ``stage_s``: host time inside the stage callable (pad/convert +
+      transfer dispatch).
+    - ``transfer_s``: the portion of ``stage_s`` spent issuing device
+      transfers (stage callables wrap their ``device_put`` in
+      :meth:`transfer`); dispatch time, not DMA completion — the async
+      runtime overlaps the DMA itself.
+    - ``wait_s``: time the CONSUMER spent blocked waiting for a staged
+      chunk.  Serial (depth=1) this equals ``stage_s``; with overlap it
+      shrinks toward zero — the visible win.
+    - ``chunks``: chunks that reached the consumer.
+
+    :meth:`finalize` writes the split into a ``Timings`` registry as
+    ``<prefix>/stage`` (host-only), ``<prefix>/transfer``,
+    ``<prefix>/compute`` (= pass wall - wait) and ``<prefix>/stream_wall``
+    so ``Timings.overlap_efficiency`` / bench.py can report how much
+    staging was hidden behind compute.
+    """
+
+    __slots__ = ("stage_s", "transfer_s", "wait_s", "chunks")
+
+    def __init__(self) -> None:
+        self.stage_s = 0.0
+        self.transfer_s = 0.0
+        self.wait_s = 0.0
+        self.chunks = 0
+
+    @contextlib.contextmanager
+    def transfer(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.transfer_s += time.perf_counter() - t0
+
+    def finalize(self, timings, prefix: str, wall: float) -> None:
+        """Record this pipeline's split under ``prefix`` (accumulates
+        across passes — Timings.as_dict sums duplicate phases)."""
+        if timings is None:
+            return
+        timings.add(prefix + "/stage", max(self.stage_s - self.transfer_s, 0.0))
+        timings.add(prefix + "/transfer", self.transfer_s)
+        timings.add(prefix + "/compute", max(wall - self.wait_s, 0.0))
+        timings.add(prefix + "/stream_wall", wall)
+
+
+def _delete_jax_arrays(item: Any) -> None:
+    """Best-effort ``delete()`` of every jax array inside a staged item
+    (tuples/lists walked recursively; host np arrays untouched).  The
+    runtime defers the actual free until in-flight steps consuming the
+    buffer complete, so retiring immediately after the consumer advances
+    is safe."""
+    if isinstance(item, (tuple, list)):
+        for v in item:
+            _delete_jax_arrays(v)
+        return
+    delete = getattr(item, "delete", None)
+    if delete is not None and hasattr(item, "is_deleted"):
+        try:
+            if not item.is_deleted():
+                delete()
+        except Exception:
+            pass  # freeing is an optimization; never fail a pass over it
+
+
+class _Serial:
+    """depth=1: the exact pre-pipeline loop — stage inline on demand, no
+    thread.  Kept as its own tiny class so the serial path shares zero
+    concurrency machinery (the bit-identical baseline the parity tests
+    pin)."""
+
+    def __init__(self, items: Iterator, stage, stats: PrefetchStats, retire):
+        self._items = items
+        self._stage = stage
+        self._stats = stats
+        self._retire = retire
+        self._prev = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._retire and self._prev is not None:
+            _delete_jax_arrays(self._prev)
+            self._prev = None
+        t0 = time.perf_counter()
+        item = next(self._items)  # StopIteration propagates
+        out = item if self._stage is None else self._stage(item)
+        dt = time.perf_counter() - t0
+        # serial staging blocks the consumer: it is both stage and wait
+        self._stats.stage_s += dt
+        self._stats.wait_s += dt
+        self._stats.chunks += 1
+        if self._retire:
+            self._prev = out
+        return out
+
+    def close(self):
+        if self._retire and self._prev is not None:
+            _delete_jax_arrays(self._prev)
+            self._prev = None
+
+
+class _Sentinel:
+    __slots__ = ("err",)
+
+    def __init__(self, err: Optional[BaseException]):
+        self.err = err
+
+
+class _Threaded:
+    """depth>=2: bounded background staging (module docstring)."""
+
+    def __init__(self, items: Iterator, stage, depth: int,
+                 stats: PrefetchStats, retire):
+        self._items = items
+        self._stage = stage
+        self._stats = stats
+        self._retire = retire
+        self._depth = depth
+        self._slots = threading.Semaphore(depth)
+        self._q: queue.Queue = queue.Queue()
+        self._cancel = threading.Event()
+        self._prev = None
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._produce, name="oap-mllib-tpu-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    # -- producer (background thread) ---------------------------------------
+
+    def _acquire_slot(self) -> bool:
+        while not self._slots.acquire(timeout=0.05):
+            if self._cancel.is_set():
+                return False
+        if self._cancel.is_set():
+            return False
+        return True
+
+    def _produce(self) -> None:
+        try:
+            while True:
+                # slot BEFORE the source pull: bounds how far the source
+                # itself runs ahead, not just the staged queue
+                if not self._acquire_slot():
+                    return
+                try:
+                    item = next(self._items)
+                except StopIteration:
+                    self._q.put(_Sentinel(None))
+                    return
+                t0 = time.perf_counter()
+                out = item if self._stage is None else self._stage(item)
+                self._stats.stage_s += time.perf_counter() - t0
+                self._q.put(out)
+        except BaseException as e:  # noqa: BLE001 — must cross the thread
+            self._q.put(_Sentinel(e))
+
+    # -- consumer ------------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        if self._prev is not None:
+            if self._retire:
+                _delete_jax_arrays(self._prev)
+            self._prev = None
+            self._slots.release()
+        t0 = time.perf_counter()
+        out = self._q.get()
+        self._stats.wait_s += time.perf_counter() - t0
+        if isinstance(out, _Sentinel):
+            self._done = True
+            self._thread.join(timeout=5.0)
+            if out.err is not None:
+                raise out.err
+            raise StopIteration
+        self._stats.chunks += 1
+        self._prev = out
+        return out
+
+    def close(self):
+        self._cancel.set()
+        # drain so a producer blocked on put/semaphore wakes and exits
+        try:
+            while True:
+                item = self._q.get_nowait()
+                if self._retire and not isinstance(item, _Sentinel):
+                    _delete_jax_arrays(item)
+                self._slots.release()
+        except queue.Empty:
+            pass
+        if self._prev is not None:
+            if self._retire:
+                _delete_jax_arrays(self._prev)
+            self._prev = None
+        self._thread.join(timeout=5.0)
+        self._done = True
+
+
+class Prefetcher:
+    """Iterate ``stage(item)`` over ``items`` with up to ``depth`` chunks
+    staged ahead by a background thread (depth=1: inline serial loop).
+
+    Use as a context manager — exit closes the pipeline so an early break
+    or consumer exception never strands the producer::
+
+        with Prefetcher(chunks, stage, stats=stats, retire=True) as pf:
+            for staged in pf:
+                ...dispatch the step...
+    """
+
+    def __init__(
+        self,
+        items: Iterable,
+        stage: Optional[Callable[[Any], Any]] = None,
+        depth: Optional[int] = None,
+        stats: Optional[PrefetchStats] = None,
+        retire: bool = False,
+    ):
+        self.stats = PrefetchStats() if stats is None else stats
+        self.depth = resolve_depth(depth)
+        it = iter(items)
+        if self.depth == 1:
+            self._impl = _Serial(it, stage, self.stats, retire)
+        else:
+            self._impl = _Threaded(it, stage, self.depth, self.stats, retire)
+
+    def __iter__(self):
+        return iter(self._impl)
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._impl.close()
+
+    def close(self) -> None:
+        self._impl.close()

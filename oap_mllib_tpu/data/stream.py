@@ -13,6 +13,14 @@ pass reuses one compiled program (XLA static-shape contract, survey §2.6).
 final partial chunk is padded with zero rows and reported via the per-chunk
 valid count — padded rows carry weight 0 through every kernel, the same
 masking contract as ``DenseTable``.
+
+Consumers do not iterate a source directly: every streamed pass pulls
+through the prefetch pipeline (``data/prefetch.py``), which stages and
+device_puts chunk N+1 on a bounded background thread while chunk N's step
+executes (``Config.prefetch_depth``; depth=1 = the serial loop).  Sources
+therefore must tolerate being advanced from a non-main thread — plain
+generators and file reads do; a source wrapping thread-affine state must
+confine it to the iterator itself.
 """
 
 from __future__ import annotations

@@ -151,9 +151,17 @@ class ALSModel:
         up against a huge target side, and a score-only bound against a
         wide query side.  ``with_scores=False`` skips the host transfer
         of the float score blocks entirely (ids-only callers should not
-        pay a second device->host copy); the scores slot is then None."""
+        pay a second device->host copy); the scores slot is then None.
+
+        ``n`` is clamped to the target count, like Spark's
+        recommendForAll* which just returns fewer rows when asked for
+        more than exist — without the clamp lax.top_k raises an opaque
+        XLA error on an oversized request."""
         from oap_mllib_tpu.ops.kmeans_ops import rows_per_chunk
 
+        if n < 0:
+            raise ValueError(f"top-k count must be >= 0, got {n}")
+        n = min(int(n), targets.shape[0])
         if query.shape[0] == 0:
             return (
                 np.zeros((0, n), np.int32),
@@ -662,7 +670,7 @@ class ALS:
             x, y = als_stream.als_run_streamed(
                 by_user, by_item, x0, y0, n_users, n_items,
                 self.max_iter, self.reg_param, self.alpha,
-                self.implicit_prefs,
+                self.implicit_prefs, timings=timings,
             )
         return ALSModel(
             x, y,
@@ -783,6 +791,7 @@ class ALS:
             x_blocks, y = als_block_stream.als_block_run_streamed(
                 lay, x0_dev, y0_dev, self.max_iter, self.reg_param,
                 self.alpha, mesh, implicit=self.implicit_prefs,
+                timings=timings,
             )
             jax.block_until_ready((x_blocks, y))
         summary = {

@@ -325,16 +325,19 @@ class KMeans:
         timings = Timings()
         with phase_timer(timings, "init_centers"):
             if self.init_mode == INIT_RANDOM:
-                centers0 = stream_ops.reservoir_sample(source, self.k, self.seed)
+                centers0 = stream_ops.reservoir_sample(
+                    source, self.k, self.seed, timings=timings
+                )
             else:
                 centers0 = stream_ops.init_kmeans_parallel_streamed(
                     source, self.k, self.seed, self.init_steps, dtype,
-                    weights=sample_weight, validated=True,
+                    weights=sample_weight, validated=True, timings=timings,
                 )
         with phase_timer(timings, "lloyd_loop"):
             centers, n_iter, cost, counts = stream_ops.lloyd_run_streamed(
                 source, centers0, self.max_iter, self.tol, dtype,
                 cfg.matmul_precision, weights=sample_weight, validated=True,
+                timings=timings,
             )
         summary = KMeansSummary(
             float(cost), int(n_iter), timings, accelerated=True,

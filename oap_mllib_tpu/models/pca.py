@@ -204,7 +204,9 @@ class PCA:
         d = source.n_features
         with phase_timer(timings, "covariance_streamed"):
             tier = "highest" if cfg.enable_x64 else cfg.matmul_precision
-            cov, _, n = stream_ops.covariance_streamed(source, dtype, tier)
+            cov, _, n = stream_ops.covariance_streamed(
+                source, dtype, tier, timings=timings
+            )
         # cov is exactly (d, d) here — no model-sharding feature pad
         vals, vecs, total, solver = self._solve_spectrum(cov, d, timings)
         ratio = vals / total if total > 0 else np.zeros(self.k)

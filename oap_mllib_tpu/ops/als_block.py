@@ -56,6 +56,7 @@ from oap_mllib_tpu.ops.als_ops import (
     normal_eq_partials_grouped,
     regularized_solve,
 )
+from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
 # Auto-crossover for als_item_layout="auto": the replicated layout
@@ -232,7 +233,7 @@ def als_block_run(
     shard = P(axis)
     rep = P()
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             rank_program,
             mesh=mesh,
             in_specs=(shard, shard, shard, shard, P(axis, None), rep),
@@ -516,7 +517,7 @@ def als_block_run_grouped(
     sh1 = P(axis)
     rep = P()
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             rank_program,
             mesh=mesh,
             in_specs=(sh2, sh2, sh2, sh1, sh2, sh2, sh2, sh1, sh2, rep),
@@ -579,7 +580,7 @@ def als_block_run_2d(
     sh1 = P(axis)
     sh2 = P(axis, None)
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             rank_program,
             mesh=mesh,
             in_specs=(sh1,) * 8 + (sh2, sh2),
@@ -633,7 +634,7 @@ def als_block_run_grouped_2d(
     sh2 = P(axis, None)
     sh1 = P(axis)
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             rank_program,
             mesh=mesh,
             in_specs=(sh2, sh2, sh2, sh1, sh2, sh2, sh2, sh1, sh2, sh2),

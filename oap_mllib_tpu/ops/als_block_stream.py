@@ -50,6 +50,7 @@ every layout (chunked segment-sums only reorder additions).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -59,6 +60,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.data.prefetch import Prefetcher, PrefetchStats
 from oap_mllib_tpu.ops.als_block import (
     _global_max,
     _global_sum,
@@ -73,6 +75,7 @@ from oap_mllib_tpu.ops.als_ops import (
     unpack_flat_moments,
 )
 from oap_mllib_tpu.ops.als_stream import groups_per_chunk
+from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
 def owned_blocks(mesh: Mesh, axis: str) -> List[int]:
@@ -361,7 +364,7 @@ def _make_programs(mesh: Mesh, axis: str, implicit: bool):
         )
 
     accum_local_fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             accum_local, mesh=mesh,
             in_specs=(sh2, sh2, sh2, sh2, sh1, rep, rep),
             out_specs=sh2, check_vma=False,
@@ -380,7 +383,7 @@ def _make_programs(mesh: Mesh, axis: str, implicit: bool):
         )[None]
 
     accum_item_rep_fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             accum_item_rep, mesh=mesh,
             in_specs=(P(axis, None, None), sh2, sh2, sh2, sh1, sh2, rep),
             out_specs=P(axis, None, None), check_vma=False,
@@ -404,7 +407,7 @@ def _make_programs(mesh: Mesh, axis: str, implicit: bool):
         )
 
     solve_local_fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             solve_local, mesh=mesh, in_specs=(sh2, rep, rep),
             out_specs=sh2, check_vma=False,
         )
@@ -429,7 +432,7 @@ def _make_programs(mesh: Mesh, axis: str, implicit: bool):
         )
 
     solve_item_rep_fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             solve_item_rep, mesh=mesh,
             in_specs=(P(axis, None, None), sh2, rep),
             out_specs=rep, check_vma=False,
@@ -453,16 +456,25 @@ def als_block_run_streamed(
     mesh: Mesh,
     *,
     implicit: bool,
+    timings=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Streamed block-parallel ALS over the mesh (both feedback modes,
     both item layouts).  Returns (X blocks, Y) in the same forms as the
-    in-memory runners (als_block_run_grouped / _grouped_2d)."""
+    in-memory runners (als_block_run_grouped / _grouped_2d).  Chunk
+    placement runs through the prefetch pipeline: each rank's NEXT chunk
+    stages onto the mesh while the current chunk's sharded accumulate
+    executes (staging is rank-local, so lookahead cannot desynchronize
+    the collective launch order — every rank still issues the same
+    accum/solve sequence).  The stage/transfer/compute split lands in
+    ``timings`` under ``als_iterations/``."""
     cfg = get_config()
     axis = cfg.data_axis
     world = mesh.shape[axis]
     r = x0.shape[1]
     width = (r + 1) * (r + 2)
     dtype = x0.dtype
+    stats = PrefetchStats()
+    t_start = time.perf_counter()
     place = _chunk_placer(mesh, axis, lay.owned)
     (accum_local_fn, accum_item_rep_fn, solve_local_fn,
      solve_item_rep_fn, replicate) = _make_programs(mesh, axis, implicit)
@@ -490,17 +502,23 @@ def als_block_run_streamed(
         cu = {b: by_side[b][1] for b in lay.owned}
         vu = {b: by_side[b][2] for b in lay.owned}
         gu = {b: by_side[b][3] for b in lay.owned}
-        for lo in range(0, g_total, gc):
+
+        def stage(lo):
             sl = slice(lo, lo + gc)
-            m = accum(
-                m,
-                place(su, sl, world),
-                place(cu, sl, world),
-                place(vu, sl, world),
-                place(gu, sl, world),
-                *factor_args,
-                alpha_j,
-            )
+            with stats.transfer():
+                return (
+                    place(su, sl, world),
+                    place(cu, sl, world),
+                    place(vu, sl, world),
+                    place(gu, sl, world),
+                )
+
+        pf = Prefetcher(
+            range(0, g_total, gc), stage=stage, stats=stats, retire=True
+        )
+        with pf:
+            for su_c, cu_c, vu_c, gu_c in pf:
+                m = accum(m, su_c, cu_c, vu_c, gu_c, *factor_args, alpha_j)
         return m
 
     x_blk, y = x0, y0
@@ -527,4 +545,6 @@ def als_block_run_streamed(
                 zeros_i(), x_blk,
             )
             y = solve_item_rep_fn(m_i, x_blk, reg_j)
+    jax.block_until_ready((x_blk, y))
+    stats.finalize(timings, "als_iterations", time.perf_counter() - t_start)
     return x_blk, y

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
 def _prec(precision: str):
@@ -352,7 +353,7 @@ def _lloyd_model_sharded_fn(mesh, dax: str, max_: str, max_iter: int,
     from jax.sharding import PartitionSpec as P
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             rank_program,
             mesh=mesh,
             in_specs=(P(dax, max_), P(dax), P(None, max_), P()),

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 from typing import Tuple
+from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
 def _cov_prec(precision: str):
@@ -92,7 +93,7 @@ def _model_sharded_cov_fn(mesh, dax: str, max_: str, precision: str):
         cov_rows = gram_rows / jnp.maximum(n - 1.0, 1.0)
         return cov_rows, mean_loc
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         tile_program,
         mesh=mesh,
         in_specs=(P(dax, max_), P(dax), P()),

@@ -24,12 +24,14 @@ Pass structure:
 from __future__ import annotations
 
 import functools
+import time
 from typing import List
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from oap_mllib_tpu.data.prefetch import Prefetcher, PrefetchStats
 from oap_mllib_tpu.data.stream import ChunkSource
 from oap_mllib_tpu.ops import kmeans_ops
 from oap_mllib_tpu.ops.pca_ops import _cov_prec
@@ -77,6 +79,38 @@ def _iter_weighted(source: ChunkSource, weights, dtype):
             "sample_weight source has more chunks than the data source — "
             "the two must be chunked identically"
         )
+
+
+def _stage_to_device(dtype, stats: PrefetchStats):
+    """Stage callable for the prefetch pipeline: pad/convert the host
+    chunk and weight vector and issue their device transfers.  Runs in
+    the producer thread at depth >= 2 — chunk N+1 stages while chunk N's
+    step executes.  The host halves ride along because the k-means||
+    loops sample/inspect rows host-side after the device fold."""
+
+    def stage(item):
+        chunk, n_valid, w = item
+        hc = np.asarray(chunk, dtype)
+        hw = np.asarray(w, dtype)
+        with stats.transfer():
+            cj = jnp.asarray(hc)
+            wj = jnp.asarray(hw)
+        return chunk, n_valid, w, cj, wj
+
+    return stage
+
+
+def _staged_chunks(source, weights, dtype, stats: PrefetchStats):
+    """Prefetched (host_chunk, n_valid, host_w, dev_chunk, dev_w) stream
+    over a (optionally weighted) ChunkSource.  The consumed chunk's
+    device buffers retire as the consumer advances (module contract in
+    data/prefetch.py)."""
+    return Prefetcher(
+        _iter_weighted(source, weights, dtype),
+        stage=_stage_to_device(dtype, stats),
+        stats=stats,
+        retire=True,
+    )
 
 
 # -- multi-host plumbing ----------------------------------------------------
@@ -256,23 +290,29 @@ def _check_weight_source(source: ChunkSource, weights) -> None:
 
 def streamed_accumulate(
     source: ChunkSource, centers, dtype, precision: str, need_cost: bool,
-    weights=None,
+    weights=None, timings=None, phase: str = "lloyd_loop",
 ):
     """One full assignment pass over this process's shard, reduced across
     processes: (sums (k,d), counts (k,), cost) as host arrays (identical
-    on every process)."""
+    on every process).  Chunks arrive through the prefetch pipeline —
+    chunk N+1 stages/transfers while chunk N's accumulate executes; the
+    pass's stage/transfer/compute split lands in ``timings`` under
+    ``phase`` when given."""
     k, d = centers.shape
     sums = jnp.zeros((k, d), dtype)
     counts = jnp.zeros((k,), dtype)
     cost = jnp.zeros((), dtype)
+    stats = PrefetchStats()
+    t0 = time.perf_counter()
     guard = _PassGuard()
     with guard:
-        for chunk, _, w in _iter_weighted(source, weights, dtype):
-            cj = jnp.asarray(np.asarray(chunk, dtype))
-            sums, counts, cost = _kmeans_chunk_accum(
-                sums, counts, cost, cj, jnp.asarray(w), centers, precision,
-                need_cost,
-            )
+        with _staged_chunks(source, weights, dtype, stats) as pf:
+            for _, _, _, cj, wj in pf:
+                sums, counts, cost = _kmeans_chunk_accum(
+                    sums, counts, cost, cj, wj, centers, precision,
+                    need_cost,
+                )
+    stats.finalize(timings, phase, time.perf_counter() - t0)
     return _psum_host([sums, counts, cost], guard=guard)
 
 
@@ -287,6 +327,7 @@ def _center_update(centers, sums, counts):
 def lloyd_run_streamed(
     source: ChunkSource, init_centers: np.ndarray, max_iter: int, tol: float,
     dtype, precision: str = "highest", weights=None, validated: bool = False,
+    timings=None,
 ):
     """Streamed Lloyd loop; same return contract as kmeans_ops.lloyd_run:
     (centers, n_iter, cost, counts).  Convergence semantics match
@@ -296,7 +337,9 @@ def lloyd_run_streamed(
     ChunkSource walked in lockstep (per-row weights); ``validated``
     skips the entry validation + its cross-rank sync when the caller
     (KMeans._fit_source) already ran it — the sync is one collective per
-    call and must not triple up inside a single fit."""
+    call and must not triple up inside a single fit.  ``timings``
+    accumulates the per-pass stage/transfer/compute split under
+    ``lloyd_loop/``."""
     if weights is not None and not validated:
         _checked_entry(lambda: _check_weight_source(source, weights))
     centers = jnp.asarray(np.asarray(init_centers, dtype))
@@ -305,14 +348,15 @@ def lloyd_run_streamed(
     for _ in range(max_iter):
         sums, counts, _ = streamed_accumulate(
             source, centers, dtype, precision, need_cost=False,
-            weights=weights,
+            weights=weights, timings=timings,
         )
         centers, max_moved = _center_update(centers, sums, counts)
         n_iter += 1
         if float(max_moved) <= tol_sq:
             break
     _, counts, cost = streamed_accumulate(
-        source, centers, dtype, "highest", need_cost=True, weights=weights
+        source, centers, dtype, "highest", need_cost=True, weights=weights,
+        timings=timings,
     )
     return centers, n_iter, cost, counts
 
@@ -322,10 +366,14 @@ def lloyd_run_streamed(
 # ---------------------------------------------------------------------------
 
 
-def reservoir_sample(source: ChunkSource, k: int, seed: int) -> np.ndarray:
+def reservoir_sample(
+    source: ChunkSource, k: int, seed: int, timings=None,
+) -> np.ndarray:
     """Uniform k-row sample in one pass (Algorithm R, vectorized per chunk:
     one rng draw per chunk and a Python loop only over the expected
-    O(k log(n/k)) reservoir hits, never over all n rows).
+    O(k log(n/k)) reservoir hits, never over all n rows).  The source is
+    prefetched with an identity stage — no device transfer here, but the
+    background pull overlaps file IO with the host reservoir updates.
 
     Multi-process: each process reservoirs its own shard, then the
     per-process reservoirs are merged by weighted sampling without
@@ -336,9 +384,11 @@ def reservoir_sample(source: ChunkSource, k: int, seed: int) -> np.ndarray:
     rng = np.random.default_rng(seed)
     sample: List[np.ndarray] = []
     seen = 0
+    stats = PrefetchStats()
+    t0 = time.perf_counter()
     guard = _PassGuard()
-    with guard:
-        for chunk, n_valid in source:
+    with guard, Prefetcher(source, stats=stats) as pf:
+        for chunk, n_valid in pf:
             start = 0
             if len(sample) < k:  # head-fill straight into the reservoir
                 take = min(k - len(sample), n_valid)
@@ -351,6 +401,7 @@ def reservoir_sample(source: ChunkSource, k: int, seed: int) -> np.ndarray:
                 for i in np.nonzero(j < k)[0]:  # sparse hits only
                     sample[j[i]] = chunk[start + i].copy()
             seen += n_valid
+    stats.finalize(timings, "init_centers", time.perf_counter() - t0)
     if guard.err is not None and _world() == 1:
         raise guard.err
     if _world() > 1:
@@ -413,7 +464,7 @@ def _pad_cands(cands: np.ndarray, cap: int, d: int) -> np.ndarray:
 
 def init_kmeans_parallel_streamed(
     source: ChunkSource, k: int, seed: int, init_steps: int, dtype,
-    weights=None, validated: bool = False,
+    weights=None, validated: bool = False, timings=None,
 ) -> np.ndarray:
     """Streamed k-means|| (Bahmani), host-orchestrated.
 
@@ -433,7 +484,11 @@ def init_kmeans_parallel_streamed(
     ``weights``: optional width-1 ChunkSource of per-row weights, walked
     in lockstep — they scale the sampling cost (phi = sum w*dmin, like
     the in-memory version's weighted _pll_round) and the candidate
-    ownership.  ``validated``: see lloyd_run_streamed."""
+    ownership.  ``validated``: see lloyd_run_streamed.  Every pass pulls
+    through the prefetch pipeline (chunk staging overlaps the device
+    distance fold); per-chunk dmin state stays consumer-side — it is
+    final only for chunks the consumer already passed, so the producer
+    must not read it ahead."""
     if weights is not None and not validated:
         _checked_entry(lambda: _check_weight_source(source, weights))
     d = source.n_features
@@ -444,7 +499,7 @@ def init_kmeans_parallel_streamed(
     samp_rng = np.random.default_rng(seed + 31 * jax.process_index())
     final_rng = np.random.default_rng(seed + 7777)
 
-    c0 = reservoir_sample(source, 1, seed)
+    c0 = reservoir_sample(source, 1, seed, timings=timings)
     cands = [c0[0]]
     new_block: np.ndarray = _pad_cands(c0, cap, d)  # picks awaiting dmin fold
 
@@ -463,11 +518,11 @@ def init_kmeans_parallel_streamed(
         )
         picks: List[np.ndarray] = []
         new_phi = 0.0
+        stats = PrefetchStats()
+        t0 = time.perf_counter()
         guard = _PassGuard()
-        with guard:
-            for ci, (chunk, n_valid, wv) in enumerate(
-                _iter_weighted(source, weights, dtype)
-            ):
+        with guard, _staged_chunks(source, weights, dtype, stats) as pf:
+            for ci, (chunk, n_valid, wv, cj, _) in enumerate(pf):
                 if cands_dev is not None:
                     prev = (
                         jnp.asarray(dmin_chunks[ci])
@@ -475,9 +530,7 @@ def init_kmeans_parallel_streamed(
                         else jnp.full((source.chunk_rows,), np.inf, dtype)
                     )
                     h = np.array(  # writable host copy
-                        _chunk_min_d2(
-                            jnp.asarray(np.asarray(chunk, dtype)), prev, cands_dev
-                        )
+                        _chunk_min_d2(cj, prev, cands_dev)
                     )
                     h[n_valid:] = 0.0  # padded rows carry no cost
                     if rnd > 0:
@@ -494,6 +547,7 @@ def init_kmeans_parallel_streamed(
                     hit[n_valid:] = False
                     for i in np.nonzero(hit)[0]:
                         picks.append(chunk[i].copy())
+        stats.finalize(timings, "init_centers", time.perf_counter() - t0)
         (phi_arr,) = _psum_host([np.asarray([new_phi])], guard=guard)
         phi = float(phi_arr[0])
         if _world() > 1:
@@ -521,21 +575,21 @@ def init_kmeans_parallel_streamed(
 
     cand_arr = np.stack(cands)
     if cand_arr.shape[0] <= k:
-        extra = reservoir_sample(source, k - cand_arr.shape[0] + 1, seed + 1)
+        extra = reservoir_sample(
+            source, k - cand_arr.shape[0] + 1, seed + 1, timings=timings
+        )
         return np.concatenate([cand_arr, extra], axis=0)[:k]
 
     # ownership pass: weight candidates, then host-side weighted k-means++
     cands_dev = jnp.asarray(cand_arr.astype(dtype))
     own = np.zeros((cand_arr.shape[0],), np.float64)
+    stats = PrefetchStats()
+    t0 = time.perf_counter()
     guard = _PassGuard()
-    with guard:
-        for chunk, _, wv in _iter_weighted(source, weights, dtype):
-            own += np.asarray(
-                _chunk_ownership(
-                    jnp.asarray(np.asarray(chunk, dtype)), jnp.asarray(wv),
-                    cands_dev,
-                )
-            )
+    with guard, _staged_chunks(source, weights, dtype, stats) as pf:
+        for _, _, _, cj, wj in pf:
+            own += np.asarray(_chunk_ownership(cj, wj, cands_dev))
+    stats.finalize(timings, "init_centers", time.perf_counter() - t0)
     (own,) = _psum_host([own], guard=guard)
     return kmeans_ops._weighted_kmeans_pp(cand_arr, own, k, final_rng)
 
@@ -557,37 +611,41 @@ def _gram_chunk(gram, chunk, w, mean, precision):
 
 
 def covariance_streamed(
-    source: ChunkSource, dtype, precision: str = "highest"
+    source: ChunkSource, dtype, precision: str = "highest", timings=None,
 ):
     """Two-pass streamed covariance: (cov (d,d), mean (d,), n_rows), as
     host arrays identical on every process.
 
     Pass 1 accumulates column sums (mean), pass 2 the mean-centered Gram —
     identical numerics to ops.pca_ops.covariance, O(chunk) device memory;
-    multi-process shards reduce across processes after each pass.
+    multi-process shards reduce across processes after each pass.  Both
+    passes pull through the prefetch pipeline; the split lands in
+    ``timings`` under ``covariance_streamed/``.
     """
     d = source.n_features
     total = jnp.zeros((d,), dtype)
     n = 0
+    stats = PrefetchStats()
+    t0 = time.perf_counter()
     guard = _PassGuard()
-    with guard:
-        for chunk, n_valid in source:
-            w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
-            total = _colsum_chunk(total, jnp.asarray(np.asarray(chunk, dtype)), w)
+    with guard, _staged_chunks(source, None, dtype, stats) as pf:
+        for _, n_valid, _, cj, wj in pf:
+            total = _colsum_chunk(total, cj, wj)
             n += n_valid
+    stats.finalize(timings, "covariance_streamed", time.perf_counter() - t0)
     total, n_arr = _psum_host([total, np.asarray([n], np.int64)], guard=guard)
     n = int(n_arr[0])
     if n < 1:
         raise ValueError("empty source")
     mean = jnp.asarray(total.astype(dtype) / n)
     gram = jnp.zeros((d, d), dtype)
+    stats = PrefetchStats()
+    t0 = time.perf_counter()
     guard = _PassGuard()
-    with guard:
-        for chunk, n_valid in source:
-            w = jnp.asarray(_chunk_weights(n_valid, source.chunk_rows, dtype))
-            gram = _gram_chunk(
-                gram, jnp.asarray(np.asarray(chunk, dtype)), w, mean, precision
-            )
+    with guard, _staged_chunks(source, None, dtype, stats) as pf:
+        for _, _, _, cj, wj in pf:
+            gram = _gram_chunk(gram, cj, wj, mean, precision)
+    stats.finalize(timings, "covariance_streamed", time.perf_counter() - t0)
     (gram,) = _psum_host([gram], guard=guard)
     cov = gram.astype(np.float64 if dtype == np.float64 else np.float32)
     cov = cov / max(n - 1.0, 1.0)

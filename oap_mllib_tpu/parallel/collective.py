@@ -21,13 +21,14 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
 def _shard_map(f, mesh, in_specs, out_specs):
     # check_vma=False: outputs of all_gather/psum ARE replicated over the
     # data axis, but the static replication checker can't always prove it
     # for P(None, ...) out_specs on a multi-axis mesh.
-    return jax.shard_map(
+    return shard_map(
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
     )
 

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from oap_mllib_tpu.config import get_config
 from oap_mllib_tpu.data.table import CSRTable
+from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
 @dataclasses.dataclass
@@ -186,7 +187,7 @@ def exchange_ratings(
         return rows[order[:cap]]
 
     compacted = jax.jit(
-        jax.shard_map(
+        shard_map(
             compact, mesh=mesh,
             in_specs=P(axis, None), out_specs=P(axis, None),
             check_vma=False,

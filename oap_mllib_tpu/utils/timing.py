@@ -16,7 +16,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from oap_mllib_tpu.config import get_config
 
@@ -42,6 +42,31 @@ class Timings:
 
     def total(self) -> float:
         return sum(sec for _, sec in self._records)
+
+    def subphases(self, prefix: str) -> Dict[str, float]:
+        """The ``<prefix>/<sub>`` records as ``{sub: seconds}`` — the
+        streamed pipeline's stage/transfer/compute split lives under the
+        owning phase name (``lloyd_loop/stage`` etc.,
+        data/prefetch.PrefetchStats.finalize)."""
+        out: Dict[str, float] = {}
+        pre = prefix + "/"
+        for phase, sec in self.as_dict().items():
+            if phase.startswith(pre):
+                out[phase[len(pre):]] = sec
+        return out
+
+    def overlap_efficiency(self, prefix: str) -> Optional[float]:
+        """Fraction of a streamed phase's staging (stage + transfer) that
+        was hidden behind device compute, in [0, 1]: 0 = fully serial
+        (the consumer waited out every stage), 1 = fully hidden.  None
+        when the phase recorded no streamed split (not a streamed fit, or
+        staging was too fast to measure)."""
+        sub = self.subphases(prefix)
+        staging = sub.get("stage", 0.0) + sub.get("transfer", 0.0)
+        if "stream_wall" not in sub or staging <= 0.0:
+            return None
+        wait = max(sub["stream_wall"] - sub.get("compute", 0.0), 0.0)
+        return max(0.0, min(1.0, 1.0 - wait / staging))
 
     def __repr__(self) -> str:
         parts = ", ".join(f"{p}={s:.3f}s" for p, s in self._records)

@@ -21,7 +21,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+if hasattr(jax.config, "jax_num_cpu_devices"):
+    # newer jax lines expose the device count as a config option; older
+    # ones only honor the XLA_FLAGS env set above
+    jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

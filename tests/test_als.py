@@ -228,6 +228,28 @@ class TestBehavior:
         # empty subset: (0, n) result, no crash
         assert m.recommend_for_users(np.zeros((0,), np.int64), 4).shape == (0, 4)
 
+    def test_recommend_oversized_n_clamps_like_spark(self, rng):
+        """ADVICE low #4 regression: num_items/num_users beyond the
+        trained table must clamp to the table size (Spark returns fewer
+        rows) instead of hitting an opaque lax.top_k XLA error — on every
+        recommender surface, scores riding along."""
+        u, i, r, nu, ni = _ratings(rng)
+        m = ALS(rank=4, max_iter=2).fit(u, i, r, n_users=nu, n_items=ni)
+        ids, scores = m.recommend_for_all_users(ni + 100, with_scores=True)
+        assert ids.shape == scores.shape == (nu, ni)
+        exact, _ = m.recommend_for_all_users(ni, with_scores=True)
+        np.testing.assert_array_equal(ids, exact)
+        assert m.recommend_for_all_items(nu + 7).shape == (ni, nu)
+        sub = m.recommend_for_users(np.array([0, 2]), ni + 1)
+        assert sub.shape == (2, ni)
+        assert m.recommend_for_items(np.array([1]), nu * 3).shape == (1, nu)
+        # empty query x oversized n: clamped width, still no crash
+        assert m.recommend_for_users(
+            np.zeros((0,), np.int64), ni + 5
+        ).shape == (0, ni)
+        with pytest.raises(ValueError, match=">= 0"):
+            m.recommend_for_all_users(-1)
+
     def test_param_validation(self):
         for bad in (dict(rank=0), dict(max_iter=-1), dict(reg_param=-0.1), dict(alpha=-1)):
             with pytest.raises(ValueError):

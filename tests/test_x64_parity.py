@@ -15,9 +15,15 @@ REPO = os.path.dirname(HERE)
 _SCRIPT = textwrap.dedent("""
     import sys
     sys.path.insert(0, %r)
+    import os
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    if hasattr(jax.config, "jax_num_cpu_devices"):
+        jax.config.update("jax_num_cpu_devices", 8)
     jax.config.update("jax_enable_x64", True)
     import numpy as np
     from oap_mllib_tpu.config import set_config
