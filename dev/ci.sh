@@ -64,6 +64,10 @@ echo "== streamed prefetch gates: serial parity (depth=1), deep pipeline (depth=
 OAP_MLLIB_TPU_PREFETCH_DEPTH=1 python -m pytest tests/test_prefetch.py tests/test_stream.py -q
 OAP_MLLIB_TPU_PREFETCH_DEPTH=4 python -m pytest tests/test_prefetch.py tests/test_stream.py -q
 
+echo "== compile-amortization gate: 10-size sweep, <=3 XLA compiles bucketed,"
+echo "   exact padding restored with shape_bucketing=off =="
+python dev/compile_gate.py
+
 echo "== compiled-mode TPU suite (skipped unless a TPU backend is present) =="
 if python -c "import jax, sys; sys.exit(0 if jax.default_backend() == 'tpu' else 1)" 2>/dev/null; then
   python -m pytest tests_tpu/ -q

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""CI gate: compile amortization must actually amortize.
+
+Runs the 10-size fit sweep (bench.bench_compile_sweep — same d/k, ten
+distinct row counts, shape bucketing off then on, real XLA backend
+compiles counted via the jax monitoring event) and asserts:
+
+- bucketing ON: after the per-mode warm-up fit, the remaining nine
+  fits add <= 3 XLA compiles (one bucket = one program set);
+- bucketing OFF restores today's behavior: every distinct size pays
+  its own compiles (strictly more than the ON tail — at least one per
+  remaining size);
+- the two modes' per-fit centers agree to 1e-6 (padding rows are
+  weight-0; bucketing must not change results).
+
+Exit 1 with the offending numbers on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_SIZES = 10
+MAX_STEADY_ON = 3
+PARITY_TOL = 1e-6
+
+
+def main() -> int:
+    import bench
+
+    res = bench.bench_compile_sweep(n_sizes=N_SIZES, emit=False)
+    report = {k: v for k, v in res.items() if k != "sizes"}
+    print(json.dumps(report), flush=True)
+
+    failures = []
+    if res["steady_compiles_on"] > MAX_STEADY_ON:
+        failures.append(
+            f"bucketing on: {res['steady_compiles_on']} XLA compiles after "
+            f"the warm-up fit (gate: <= {MAX_STEADY_ON})"
+        )
+    if res["steady_compiles_off"] < N_SIZES - 1:
+        failures.append(
+            f"bucketing off: {res['steady_compiles_off']} XLA compiles for "
+            f"{N_SIZES - 1} fresh sizes — expected >= one per size "
+            "(off no longer restores exact padding?)"
+        )
+    if res["parity_max_dev"] > PARITY_TOL:
+        failures.append(
+            f"bucketed vs unbucketed centers deviate "
+            f"{res['parity_max_dev']:.2e} (> {PARITY_TOL})"
+        )
+    for f in failures:
+        print(f"FAIL: {f}")
+    print(f"compile gate: {'FAIL' if failures else 'OK'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

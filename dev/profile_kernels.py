@@ -233,6 +233,23 @@ def markdown_als(rows) -> str:
     return "\n".join(out)
 
 
+def _print_progcache_stats() -> None:
+    """Program-cache hit/miss report for the profiled run: the ops
+    entries register every launch with utils/progcache, so after a
+    shoot-out this shows how many distinct programs the sweep compiled
+    and how much the repeat windows reused (the misses column is the
+    compile bill a cold service would pay for these shapes)."""
+    from oap_mllib_tpu.utils import progcache
+
+    s = progcache.stats()
+    print()
+    print(json.dumps({"progcache": {
+        k: s[k] for k in ("hits", "misses", "evictions", "hit_rate")
+    }}))
+    for algo, c in sorted(s["by_algo"].items()):
+        print(f"# progcache {algo}: hits={c['hits']} misses={c['misses']}")
+
+
 if __name__ == "__main__":
     if "--als" in sys.argv:
         rows = profile_als()
@@ -242,3 +259,4 @@ if __name__ == "__main__":
         rows = profile()
         print()
         print(markdown(rows))
+    _print_progcache_stats()

@@ -126,6 +126,24 @@ class Config:
     # 16/64 — BASELINE.md row 5).  Ignored unless pca_solver="randomized".
     pca_rand_oversample: int = 16
     pca_rand_iters: int = 8
+    # Shape bucketing (data/bucketing.py): round padded row counts up to
+    # geometric buckets so one compiled program serves a RANGE of input
+    # sizes — a service fitting many differently-sized datasets stops
+    # paying seconds of XLA compile per request shape.  "on" (default) =
+    # x2 steps anchored at the shard multiple; "off" = exact padding
+    # (today's shapes); a numeric string (e.g. "1.25") = gentler growth.
+    # Padding rows carry mask/weight 0, so per-fit results match the
+    # unbucketed path (k-means|| init draws are the one shape-dependent
+    # RNG — docs/user-guide.md "Compile amortization" has the caveat and
+    # the memory/FLOP cost table).
+    shape_bucketing: str = "on"
+    # Persistent XLA compilation cache directory (jax
+    # compilation_cache_dir, wired by utils/progcache
+    # .ensure_persistent_cache at dispatch time).  Non-empty = compiled
+    # executables serialize to this dir and a warm process skips XLA
+    # compilation entirely — the cross-process half of compile
+    # amortization.  Empty (default) = no persistence.
+    compilation_cache_dir: str = ""
     # Streamed-path prefetch depth: how many chunks the background staging
     # thread may hold ahead of the consumer (data/prefetch.py).  2 =
     # double buffering — chunk N+1 is padded/converted/device_put while

@@ -1,0 +1,77 @@
+"""Shape bucketing: round padded row counts up to geometric buckets.
+
+XLA specializes every program on its input shapes, so a service fitting
+many differently-sized datasets recompiles the entire Lloyd / covariance
+/ ALS program per distinct row count — seconds of XLA latency per
+request shape (the DrJAX observation, PAPERS.md: MapReduce-style JAX
+programs amortize precisely when traced shapes are stable).  Bucketing
+collapses the shape space: padded row counts round up to a geometric
+series (default x2 steps anchored at the shard multiple), so every fit
+whose rows land in one bucket reuses one compiled program.  Padding
+rows carry mask/weight 0 — the same contract the kernels already rely
+on for shard padding — so results match the unbucketed path.
+
+Cost model (docs/user-guide.md "Compile amortization"): a x2 bucket
+wastes at most half its rows as masked padding, which costs memory and
+per-pass FLOPs proportionally; the win is that the 2nd-through-Nth fit
+of ANY size in the bucket pays zero XLA compiles.  ``Config
+.shape_bucketing`` tunes the trade: ``"off"`` restores exact padding,
+a numeric value sets a gentler growth factor (e.g. ``"1.25"``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from oap_mllib_tpu.config import get_config
+
+
+def bucket_factor(value: Optional[str] = None) -> Optional[float]:
+    """Resolve ``Config.shape_bucketing`` to a growth factor.
+
+    ``"on"``/``"x2"`` = 2.0 (the default geometric step), ``"off"`` =
+    None (exact padding, today's behavior), a numeric string = custom
+    factor (must be > 1).  Unknown values raise — a typo must not
+    silently disable amortization (the kmeans_kernel/als_kernel
+    contract)."""
+    raw = get_config().shape_bucketing if value is None else value
+    s = str(raw).strip().lower()
+    if s == "off":
+        return None
+    if s in ("on", "x2"):
+        return 2.0
+    try:
+        factor = float(s.lstrip("x"))
+    except ValueError:
+        raise ValueError(
+            "shape_bucketing must be 'on', 'off', 'x2', or a numeric "
+            f"growth factor > 1, got {raw!r}"
+        ) from None
+    if factor <= 1.0:
+        raise ValueError(
+            f"shape_bucketing factor must be > 1, got {factor}"
+        )
+    return factor
+
+
+def bucket_rows(n: int, multiple: int = 1,
+                factor: Optional[float] = None) -> int:
+    """Smallest bucket >= ``n`` from the geometric series anchored at
+    ``multiple`` (each bucket is ceil(prev * factor) rounded up to the
+    multiple, so bucketed counts stay shard-divisible).  ``factor``
+    None reads the config; bucketing off returns ``n`` rounded up to
+    the multiple (exact padding)."""
+    if n < 0:
+        raise ValueError(f"row count must be >= 0, got {n}")
+    multiple = max(1, int(multiple))
+    if factor is None:
+        factor = bucket_factor()
+    exact = -(-max(n, 1) // multiple) * multiple
+    if factor is None:
+        return exact
+    bucket = multiple
+    while bucket < n:
+        bucket = max(
+            bucket + multiple, -(-int(bucket * factor) // multiple) * multiple
+        )
+    return bucket

@@ -59,7 +59,17 @@ class ChunkSource:
             raise ValueError("n_features must be >= 1")
         self._make_iter = make_iter
         self.n_features = int(n_features)
-        self.chunk_rows = int(chunk_rows)
+        # shape-bucket the chunk width (data/bucketing.py): every
+        # compiled per-chunk program is keyed on (chunk_rows, d), so
+        # rounding requested widths up to geometric buckets lets sources
+        # with nearby chunk sizes share one program instead of each
+        # compiling its own.  The padding contract is unchanged — a
+        # wider buffer just means the tail chunk reports a smaller
+        # n_valid; results are identical.  Power-of-two requests (the
+        # 1 << 16 default included) land on themselves.
+        from oap_mllib_tpu.data.bucketing import bucket_rows
+
+        self.chunk_rows = bucket_rows(int(chunk_rows))
         self._n_rows = None if n_rows is None else int(n_rows)
         # buffer at the source's own precision: re-buffering f32 data at
         # f64 would triple host memory traffic on exactly the pass-heavy

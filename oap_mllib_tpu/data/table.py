@@ -25,10 +25,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from oap_mllib_tpu.data.bucketing import bucket_rows
 from oap_mllib_tpu.parallel.mesh import data_sharding, pad_rows
 
 # rows are padded per shard to this multiple (cheap: padding is masked)
 _ROW_MULTIPLE = 256
+
+
+def _padded_row_target(n: int, multiple: int) -> int:
+    """Padded row count for an n-row table: the shape-bucketed target
+    (geometric x2 buckets anchored at the shard multiple, so one
+    compiled program serves every size in a bucket — data/bucketing.py)
+    or the exact multiple when bucketing is off.  Bucketed counts are
+    multiple * 2^j, i.e. highly divisible — which is exactly what
+    auto_row_chunks / _accumulate_chunked want to see."""
+    return bucket_rows(n, multiple)
 
 
 @dataclasses.dataclass
@@ -65,12 +76,15 @@ class DenseTable:
             raise ValueError(f"expected 2-D data, got shape {x.shape}")
         if dtype is not None:
             x = x.astype(dtype)
-        # pad so every data-axis shard has equal rows AND the row count has
-        # power-of-two chunk factors (the chunked Lloyd needs a divisor;
-        # an odd row count would silently lose chunking and rematerialize
-        # the (n, k) buffer chunking exists to avoid)
+        # pad so every data-axis shard has equal rows AND, with bucketing
+        # on (the default), so the padded count lands on a geometric
+        # bucket — every fit whose rows share a bucket reuses one
+        # compiled program, and the bucketed count's power-of-two chunk
+        # factors feed the chunked Lloyd cleanly
         n_data = mesh.shape[mesh.axis_names[0]]
-        padded, n_valid = pad_rows(x, n_data * _ROW_MULTIPLE)
+        padded, n_valid = pad_rows(
+            x, _padded_row_target(x.shape[0], n_data * _ROW_MULTIPLE)
+        )
         mask = np.zeros((padded.shape[0],), dtype=padded.dtype)
         mask[:n_valid] = 1.0
         sharding2 = data_sharding(mesh, 2)
@@ -107,7 +121,15 @@ class DenseTable:
         from oap_mllib_tpu.parallel.mesh import data_sharding
 
         local_devices = max(1, n_data // n_proc)
-        padded, n_valid_local = pad_rows(x_local, local_devices * _ROW_MULTIPLE)
+        # bucket per-process shards too: the allgathered max below then
+        # lands on a bucket, so multi-host tables amortize exactly like
+        # single-host ones (every process re-pads to the common max)
+        padded, n_valid_local = pad_rows(
+            x_local,
+            _padded_row_target(
+                x_local.shape[0], local_devices * _ROW_MULTIPLE
+            ),
+        )
         # Per-process shards pad independently, so valid-row counts landing
         # in different padding buckets (e.g. 100 vs 1100 rows) would yield
         # UNEQUAL local shapes — breaking both the global-shape inference of

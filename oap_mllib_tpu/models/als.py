@@ -28,6 +28,7 @@ import numpy as np
 
 from oap_mllib_tpu.fallback import als_np
 from oap_mllib_tpu.ops import als_ops
+from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.dispatch import should_accelerate
 from oap_mllib_tpu.utils.timing import Timings, phase_timer
 
@@ -401,6 +402,7 @@ class ALS:
             "ALS", guard_ok=not self.nonnegative, reason="nonnegative=True"
         )
         timings = Timings()
+        cache_before = progcache.stats()
         if init is not None:
             x0, y0 = np.array(init[0], np.float32), np.array(init[1], np.float32)
         else:
@@ -459,9 +461,11 @@ class ALS:
             # by user block, X block-sharded, Y replicated (~ the
             # reference's full cShuffleData + 4-step pipeline, survey §3.3;
             # round 1 left explicit ALS on the unsharded global program)
-            return self._fit_block_parallel(
+            model = self._fit_block_parallel(
                 users, items, ratings, n_users, n_items, x0, y0, mesh, timings
             )
+            model.summary["progcache"] = progcache.delta(cache_before)
+            return model
         if x0 is None:
             x0 = als_np.init_factors(n_users, self.rank, self.seed)
             y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
@@ -489,7 +493,14 @@ class ALS:
                 )
                 dev = tuple(jnp.asarray(a) for a in (*by_user, *by_item))
             else:
-                pad = (-nnz) % 2048
+                # COO nnz pads to a shape bucket (data/bucketing.py,
+                # anchored at the 2048 edge-chunk multiple): the COO
+                # programs are keyed on padded nnz, so refits of a
+                # growing ratings set within one bucket reuse the
+                # compiled loop; padding edges carry valid=0
+                from oap_mllib_tpu.data.bucketing import bucket_rows
+
+                pad = bucket_rows(nnz, 2048) - nnz
                 u = jnp.asarray(np.pad(users, (0, pad)).astype(np.int32))
                 i = jnp.asarray(np.pad(items, (0, pad)).astype(np.int32))
                 c = jnp.asarray(np.pad(ratings, (0, pad)))
@@ -501,17 +512,19 @@ class ALS:
                 x, y = als_ops.als_run_grouped(
                     *dev, jnp.asarray(x0), jnp.asarray(y0),
                     n_users, n_items, self.max_iter, self.reg_param,
-                    self.alpha, self.implicit_prefs,
+                    self.alpha, self.implicit_prefs, timings=timings,
                 )
             elif self.implicit_prefs:
                 x, y = als_ops.als_implicit_run(
                     u, i, c, valid, jnp.asarray(x0), jnp.asarray(y0),
-                    n_users, n_items, self.max_iter, self.reg_param, self.alpha,
+                    n_users, n_items, self.max_iter, self.reg_param,
+                    self.alpha, timings=timings,
                 )
             else:
                 x, y = als_ops.als_explicit_run(
                     u, i, c, valid, jnp.asarray(x0), jnp.asarray(y0),
                     n_users, n_items, self.max_iter, self.reg_param,
+                    timings=timings,
                 )
             x = np.asarray(x)
             y = np.asarray(y)
@@ -520,6 +533,7 @@ class ALS:
             {"timings": timings, "accelerated": True,
              "als_kernel": "grouped" if grouped_ok else "coo",
              "item_layout": "replicated",
+             "progcache": progcache.delta(cache_before),
              **self._block_summary(1)},
         )
 
@@ -651,6 +665,7 @@ class ALS:
         from oap_mllib_tpu.ops import als_stream
 
         timings = Timings()
+        cache_before = progcache.stats()
         if init is not None:
             x0 = np.array(init[0], np.float32)
             y0 = np.array(init[1], np.float32)
@@ -676,6 +691,7 @@ class ALS:
             x, y,
             {"timings": timings, "accelerated": True, "streamed": True,
              "als_kernel": "grouped", "item_layout": "replicated",
+             "progcache": progcache.delta(cache_before),
              **self._block_summary(1)},
         )
 
@@ -759,6 +775,7 @@ class ALS:
                 init=init,
             )
         timings = Timings()
+        cache_before = progcache.stats()
         x0 = None if init is None else np.array(init[0], np.float32)
         y0 = None if init is None else np.array(init[1], np.float32)
         with phase_timer(timings, "table_convert"):
@@ -799,6 +816,7 @@ class ALS:
             "block_parallel": True, "sharded_factors": True,
             "als_kernel": "grouped",
             "item_layout": "sharded" if item_sharded else "replicated",
+            "progcache": progcache.delta(cache_before),
             **self._block_summary(world),
         }
         if item_sharded:

@@ -28,6 +28,7 @@ from oap_mllib_tpu.data.table import DenseTable
 from oap_mllib_tpu.fallback.kmeans_np import lloyd_np, predict_np
 from oap_mllib_tpu.ops import kmeans_ops
 from oap_mllib_tpu.parallel.mesh import get_mesh
+from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.dispatch import should_accelerate
 from oap_mllib_tpu.utils.timing import Timings, phase_timer
 
@@ -323,6 +324,7 @@ class KMeans:
             cfg.matmul_precision, dtype,
         )
         timings = Timings()
+        cache_before = progcache.stats()
         with phase_timer(timings, "init_centers"):
             if self.init_mode == INIT_RANDOM:
                 centers0 = stream_ops.reservoir_sample(
@@ -344,6 +346,7 @@ class KMeans:
             cluster_sizes=np.asarray(counts),
         )
         summary.streamed = True
+        summary.progcache = progcache.delta(cache_before)
         return KMeansModel(np.asarray(centers), self.distance_measure, summary)
 
     # -- accelerated path (~ KMeansDALImpl.train, KMeansDALImpl.scala:35) ----
@@ -358,6 +361,7 @@ class KMeans:
     def _fit_tpu_inner(self, x, sample_weight, dtype) -> KMeansModel:
         cfg = get_config()
         timings = Timings()
+        cache_before = progcache.stats()
         mesh = get_mesh()
         mp = mesh.shape[cfg.model_axis]
         d_orig = x.shape[1]
@@ -395,7 +399,7 @@ class KMeans:
                 ).astype(dtype)
         with phase_timer(timings, "lloyd_loop"):
             centers, n_iter, cost, counts = self._run_lloyd(
-                table, weights, centers0, dtype, cfg, mesh
+                table, weights, centers0, dtype, cfg, mesh, timings
             )
             centers = np.asarray(centers)[:, :d_orig]
             n_iter = int(n_iter)
@@ -404,9 +408,11 @@ class KMeans:
             cost, n_iter, timings, accelerated=True,
             cluster_sizes=np.asarray(counts),
         )
+        summary.progcache = progcache.delta(cache_before)
         return KMeansModel(centers, self.distance_measure, summary)
 
-    def _run_lloyd(self, table, weights, centers0, dtype, cfg, mesh):
+    def _run_lloyd(self, table, weights, centers0, dtype, cfg, mesh,
+                   timings=None):
         """Dispatch the hot loop to the configured kernel.
 
         ``auto`` picks the fastest measured path for the shape/tier
@@ -441,19 +447,29 @@ class KMeans:
                 cfg.data_axis,
                 cfg.model_axis,
                 precision=cfg.matmul_precision,
+                timings=timings,
             )
         single_device = len(jax.devices()) == 1 and jax.process_count() == 1
         if use_pallas:
             from oap_mllib_tpu.ops.pallas.kmeans_kernel import lloyd_run_pallas
 
-            return lloyd_run_pallas(
-                table.data,
-                weights,
-                jnp.asarray(centers0),
-                self.max_iter,
-                self.tol,
-                mode=cfg.matmul_precision,
+            key = (
+                progcache.backend_fingerprint(),
+                progcache.array_key(table.data, weights),
+                np.asarray(centers0).shape, self.max_iter,
+                cfg.matmul_precision,
             )
+            with progcache.launch(
+                "kmeans.lloyd_pallas", key, timings, "lloyd_loop"
+            ):
+                return lloyd_run_pallas(
+                    table.data,
+                    weights,
+                    jnp.asarray(centers0),
+                    self.max_iter,
+                    self.tol,
+                    mode=cfg.matmul_precision,
+                )
         row_chunks = (
             kmeans_ops.auto_row_chunks(table.n_padded, self.k)
             if single_device
@@ -467,6 +483,7 @@ class KMeans:
             jnp.asarray(self.tol, dtype),
             row_chunks=row_chunks,
             precision=cfg.matmul_precision,
+            timings=timings,
         )
 
     # -- fallback path (~ trainWithML, KMeans.scala:355) ---------------------

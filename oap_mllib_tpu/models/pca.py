@@ -26,6 +26,7 @@ from oap_mllib_tpu.data.table import DenseTable
 from oap_mllib_tpu.fallback.pca_np import pca_np
 from oap_mllib_tpu.ops import pca_ops
 from oap_mllib_tpu.parallel.mesh import get_mesh
+from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.dispatch import MAX_PCA_FEATURES, should_accelerate
 from oap_mllib_tpu.utils.timing import Timings, phase_timer
 
@@ -201,6 +202,7 @@ class PCA:
         from oap_mllib_tpu.ops import stream_ops
 
         timings = Timings()
+        cache_before = progcache.stats()
         d = source.n_features
         with phase_timer(timings, "covariance_streamed"):
             tier = "highest" if cfg.enable_x64 else cfg.matmul_precision
@@ -216,6 +218,7 @@ class PCA:
             "streamed": True,
             "n_rows": n,
             "pca_solver": solver,
+            "progcache": progcache.delta(cache_before),
         }
         return PCAModel(vecs, ratio, summary)
 
@@ -232,6 +235,7 @@ class PCA:
 
     def _fit_tpu_inner(self, x, dtype, jax) -> PCAModel:
         timings = Timings()
+        cache_before = progcache.stats()
         cfg = get_config()
         mesh = get_mesh()
         mp = mesh.shape[cfg.model_axis]
@@ -255,11 +259,12 @@ class PCA:
             tier = "highest" if cfg.enable_x64 else cfg.matmul_precision
             if mp > 1:
                 cov, _ = pca_ops.covariance_model_sharded(
-                    table.data, table.mask, n_rows, mesh, tier
+                    table.data, table.mask, n_rows, mesh, tier,
+                    timings=timings,
                 )
             else:
                 cov, _ = pca_ops.covariance(
-                    table.data, table.mask, n_rows, tier
+                    table.data, table.mask, n_rows, tier, timings=timings
                 )
         vals, vecs, total, solver = self._solve_spectrum(cov, d, timings)
         ratio = vals / total if total > 0 else np.zeros(self.k)
@@ -268,6 +273,7 @@ class PCA:
             "accelerated": True,
             "mesh_shape": dict(mesh.shape),
             "pca_solver": solver,
+            "progcache": progcache.delta(cache_before),
         }
         return PCAModel(vecs, ratio, summary)
 

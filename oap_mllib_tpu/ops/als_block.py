@@ -56,6 +56,7 @@ from oap_mllib_tpu.ops.als_ops import (
     normal_eq_partials_grouped,
     regularized_solve,
 )
+from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
@@ -214,34 +215,50 @@ def als_block_run(
     world = mesh.shape[axis]
     upb = x0.shape[0] // world  # users per block (padded)
     n_items, r = y0.shape
-    eye = jnp.eye(r, dtype=y0.dtype)
 
-    def rank_program(u_loc, i_glob, cf, vl, x_blk, y):
-        # x_blk: (upb, r) this rank's users; y: (n_items, r) replicated
-        body = _block_body(
-            lambda y_: normal_eq_partials(
-                u_loc, i_glob, cf, vl, y_, upb, alpha, implicit
-            ),
-            lambda x_: normal_eq_partials(
-                i_glob, u_loc, cf, vl, x_, n_items, alpha, implicit
-            ),
-            reg, implicit, axis, eye,
-        )
-        (x_blk, y), _ = lax.scan(body, (x_blk, y), None, length=max_iter)
-        return x_blk, y
+    # the jitted shard_map program is registry-cached (utils/progcache):
+    # rebuilding the closure per fit — the pattern every runner in this
+    # module had — re-jitted and recompiled on each call even for
+    # identical layouts.  reg/alpha ARE key components here (unlike the
+    # single-device entries' traced scalars): they bake into the traced
+    # program as closure constants.
+    def build():
+        eye = jnp.eye(r, dtype=y0.dtype)
 
-    shard = P(axis)
-    rep = P()
-    fn = jax.jit(
-        shard_map(
-            rank_program,
-            mesh=mesh,
-            in_specs=(shard, shard, shard, shard, P(axis, None), rep),
-            out_specs=(P(axis, None), rep),
-            check_vma=False,
+        def rank_program(u_loc, i_glob, cf, vl, x_blk, y):
+            # x_blk: (upb, r) this rank's users; y: (n_items, r) replicated
+            body = _block_body(
+                lambda y_: normal_eq_partials(
+                    u_loc, i_glob, cf, vl, y_, upb, alpha, implicit
+                ),
+                lambda x_: normal_eq_partials(
+                    i_glob, u_loc, cf, vl, x_, n_items, alpha, implicit
+                ),
+                reg, implicit, axis, eye,
+            )
+            (x_blk, y), _ = lax.scan(body, (x_blk, y), None, length=max_iter)
+            return x_blk, y
+
+        shard = P(axis)
+        rep = P()
+        return jax.jit(
+            shard_map(
+                rank_program,
+                mesh=mesh,
+                in_specs=(shard, shard, shard, shard, P(axis, None), rep),
+                out_specs=(P(axis, None), rep),
+                check_vma=False,
+            )
         )
+
+    key = (
+        progcache.mesh_fingerprint(mesh), axis, upb, n_items, r,
+        max_iter, reg, alpha, implicit, str(y0.dtype),
     )
-    return fn(u_local, i_global, conf, valid, x0, y0)
+    fn = progcache.get_or_build("als_block.coo", key, build)
+    launch_key = key + (progcache.array_key(u_local, x0),)
+    with progcache.launch("als_block.coo.run", launch_key):
+        return fn(u_local, i_global, conf, valid, x0, y0)
 
 
 # ---------------------------------------------------------------------------
@@ -498,38 +515,48 @@ def als_block_run_grouped(
     world = mesh.shape[axis]
     upb = x0.shape[0] // world
     n_items, r = y0.shape
-    eye = jnp.eye(r, dtype=y0.dtype)
 
-    def rank_program(su, cu, vu, gu, si, ci, vi, gi, x_blk, y):
-        body = _block_body(
-            lambda y_: normal_eq_partials_grouped(
-                su, cu, vu, gu, y_, upb, alpha, implicit
-            ),
-            lambda x_: normal_eq_partials_grouped(
-                si, ci, vi, gi, x_, n_items, alpha, implicit
-            ),
-            reg, implicit, axis, eye,
-        )
-        (x_blk, y), _ = lax.scan(body, (x_blk, y), None, length=max_iter)
-        return x_blk, y
+    def build():
+        eye = jnp.eye(r, dtype=y0.dtype)
 
-    sh2 = P(axis, None)
-    sh1 = P(axis)
-    rep = P()
-    fn = jax.jit(
-        shard_map(
-            rank_program,
-            mesh=mesh,
-            in_specs=(sh2, sh2, sh2, sh1, sh2, sh2, sh2, sh1, sh2, rep),
-            out_specs=(sh2, rep),
-            check_vma=False,
+        def rank_program(su, cu, vu, gu, si, ci, vi, gi, x_blk, y):
+            body = _block_body(
+                lambda y_: normal_eq_partials_grouped(
+                    su, cu, vu, gu, y_, upb, alpha, implicit
+                ),
+                lambda x_: normal_eq_partials_grouped(
+                    si, ci, vi, gi, x_, n_items, alpha, implicit
+                ),
+                reg, implicit, axis, eye,
+            )
+            (x_blk, y), _ = lax.scan(body, (x_blk, y), None, length=max_iter)
+            return x_blk, y
+
+        sh2 = P(axis, None)
+        sh1 = P(axis)
+        rep = P()
+        return jax.jit(
+            shard_map(
+                rank_program,
+                mesh=mesh,
+                in_specs=(sh2, sh2, sh2, sh1, sh2, sh2, sh2, sh1, sh2, rep),
+                out_specs=(sh2, rep),
+                check_vma=False,
+            )
         )
+
+    key = (
+        progcache.mesh_fingerprint(mesh), axis, upb, n_items, r,
+        max_iter, reg, alpha, implicit, str(y0.dtype),
     )
-    return fn(
-        gb.u_src, gb.u_conf, gb.u_valid, gb.u_dst,
-        gb.i_src, gb.i_conf, gb.i_valid, gb.i_dst,
-        x0, y0,
-    )
+    fn = progcache.get_or_build("als_block.grouped", key, build)
+    launch_key = key + (progcache.array_key(gb.u_src, gb.i_src, x0),)
+    with progcache.launch("als_block.grouped.run", launch_key):
+        return fn(
+            gb.u_src, gb.u_conf, gb.u_valid, gb.u_dst,
+            gb.i_src, gb.i_conf, gb.i_valid, gb.i_dst,
+            x0, y0,
+        )
 
 
 def als_block_run_2d(
@@ -562,36 +589,48 @@ def als_block_run_2d(
     upb = x0.shape[0] // world
     ipb = y0.shape[0] // world
     r = y0.shape[1]
-    eye = jnp.eye(r, dtype=y0.dtype)
 
-    def rank_program(ul, ir, cu, vu, il, ur, ci, vi, x_blk, y_blk):
-        body = _block_body_2d(
-            lambda y_full: normal_eq_partials(
-                ul, ir, cu, vu, y_full, upb, alpha, implicit
-            ),
-            lambda x_full: normal_eq_partials(
-                il, ur, ci, vi, x_full, ipb, alpha, implicit
-            ),
-            reg, implicit, axis, eye,
-        )
-        (x_blk, y_blk), _ = lax.scan(body, (x_blk, y_blk), None, length=max_iter)
-        return x_blk, y_blk
+    def build():
+        eye = jnp.eye(r, dtype=y0.dtype)
 
-    sh1 = P(axis)
-    sh2 = P(axis, None)
-    fn = jax.jit(
-        shard_map(
-            rank_program,
-            mesh=mesh,
-            in_specs=(sh1,) * 8 + (sh2, sh2),
-            out_specs=(sh2, sh2),
-            check_vma=False,
+        def rank_program(ul, ir, cu, vu, il, ur, ci, vi, x_blk, y_blk):
+            body = _block_body_2d(
+                lambda y_full: normal_eq_partials(
+                    ul, ir, cu, vu, y_full, upb, alpha, implicit
+                ),
+                lambda x_full: normal_eq_partials(
+                    il, ur, ci, vi, x_full, ipb, alpha, implicit
+                ),
+                reg, implicit, axis, eye,
+            )
+            (x_blk, y_blk), _ = lax.scan(
+                body, (x_blk, y_blk), None, length=max_iter
+            )
+            return x_blk, y_blk
+
+        sh1 = P(axis)
+        sh2 = P(axis, None)
+        return jax.jit(
+            shard_map(
+                rank_program,
+                mesh=mesh,
+                in_specs=(sh1,) * 8 + (sh2, sh2),
+                out_specs=(sh2, sh2),
+                check_vma=False,
+            )
         )
+
+    key = (
+        progcache.mesh_fingerprint(mesh), axis, upb, ipb, r,
+        max_iter, reg, alpha, implicit, str(y0.dtype),
     )
-    return fn(
-        u_local, i_row, conf_u, valid_u, i_local, u_row, conf_i, valid_i,
-        x0, y0,
-    )
+    fn = progcache.get_or_build("als_block.coo_2d", key, build)
+    launch_key = key + (progcache.array_key(u_local, i_local, x0),)
+    with progcache.launch("als_block.coo_2d.run", launch_key):
+        return fn(
+            u_local, i_row, conf_u, valid_u, i_local, u_row, conf_i,
+            valid_i, x0, y0,
+        )
 
 
 def als_block_run_grouped_2d(
@@ -616,37 +655,49 @@ def als_block_run_grouped_2d(
     upb = x0.shape[0] // world
     ipb = y0.shape[0] // world
     r = y0.shape[1]
-    eye = jnp.eye(r, dtype=y0.dtype)
 
-    def rank_program(su, cu, vu, gu, si, ci, vi, gi, x_blk, y_blk):
-        body = _block_body_2d(
-            lambda y_full: normal_eq_partials_grouped(
-                su, cu, vu, gu, y_full, upb, alpha, implicit
-            ),
-            lambda x_full: normal_eq_partials_grouped(
-                si, ci, vi, gi, x_full, ipb, alpha, implicit
-            ),
-            reg, implicit, axis, eye,
-        )
-        (x_blk, y_blk), _ = lax.scan(body, (x_blk, y_blk), None, length=max_iter)
-        return x_blk, y_blk
+    def build():
+        eye = jnp.eye(r, dtype=y0.dtype)
 
-    sh2 = P(axis, None)
-    sh1 = P(axis)
-    fn = jax.jit(
-        shard_map(
-            rank_program,
-            mesh=mesh,
-            in_specs=(sh2, sh2, sh2, sh1, sh2, sh2, sh2, sh1, sh2, sh2),
-            out_specs=(sh2, sh2),
-            check_vma=False,
+        def rank_program(su, cu, vu, gu, si, ci, vi, gi, x_blk, y_blk):
+            body = _block_body_2d(
+                lambda y_full: normal_eq_partials_grouped(
+                    su, cu, vu, gu, y_full, upb, alpha, implicit
+                ),
+                lambda x_full: normal_eq_partials_grouped(
+                    si, ci, vi, gi, x_full, ipb, alpha, implicit
+                ),
+                reg, implicit, axis, eye,
+            )
+            (x_blk, y_blk), _ = lax.scan(
+                body, (x_blk, y_blk), None, length=max_iter
+            )
+            return x_blk, y_blk
+
+        sh2 = P(axis, None)
+        sh1 = P(axis)
+        return jax.jit(
+            shard_map(
+                rank_program,
+                mesh=mesh,
+                in_specs=(sh2, sh2, sh2, sh1, sh2, sh2, sh2, sh1, sh2, sh2),
+                out_specs=(sh2, sh2),
+                check_vma=False,
+            )
         )
+
+    key = (
+        progcache.mesh_fingerprint(mesh), axis, upb, ipb, r,
+        max_iter, reg, alpha, implicit, str(y0.dtype),
     )
-    return fn(
-        gb.u_src, gb.u_conf, gb.u_valid, gb.u_dst,
-        gb.i_src, gb.i_conf, gb.i_valid, gb.i_dst,
-        x0, y0,
-    )
+    fn = progcache.get_or_build("als_block.grouped_2d", key, build)
+    launch_key = key + (progcache.array_key(gb.u_src, gb.i_src, x0),)
+    with progcache.launch("als_block.grouped_2d.run", launch_key):
+        return fn(
+            gb.u_src, gb.u_conf, gb.u_valid, gb.u_dst,
+            gb.i_src, gb.i_conf, gb.i_valid, gb.i_dst,
+            x0, y0,
+        )
 
 
 def _side_padded_per_block(ids: np.ndarray, kpb: int, world: int, p: int):

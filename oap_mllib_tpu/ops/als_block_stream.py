@@ -75,6 +75,7 @@ from oap_mllib_tpu.ops.als_ops import (
     unpack_flat_moments,
 )
 from oap_mllib_tpu.ops.als_stream import groups_per_chunk
+from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
@@ -348,8 +349,20 @@ def _chunk_placer(mesh: Mesh, axis: str, owned: List[int]):
 
 
 def _make_programs(mesh: Mesh, axis: str, implicit: bool):
-    """The four compiled building blocks (closures cache compilations
-    across chunks and iterations)."""
+    """The four compiled building blocks, registry-cached per (mesh
+    fingerprint, axis, implicit) — utils/progcache — so repeat fits on
+    one mesh reuse the jitted closures instead of rebuilding (and
+    re-tracing) them every call; within a fit they already cached
+    compilations across chunks and iterations."""
+    key = (progcache.mesh_fingerprint(mesh), axis, implicit)
+    return progcache.get_or_build(
+        "als_block_stream.programs", key,
+        lambda: _build_programs(mesh, axis, implicit),
+    )
+
+
+def _build_programs(mesh: Mesh, axis: str, implicit: bool):
+    """Build the four jitted building blocks (cached above)."""
     sh2 = P(axis, None)
     sh1 = P(axis)
     rep = P()
@@ -482,20 +495,24 @@ def als_block_run_streamed(
     reg_j = jnp.asarray(reg, dtype)
     sh2 = NamedSharding(mesh, P(axis, None))
     sh3 = NamedSharding(mesh, P(axis, None, None))
-    zeros_u = jax.jit(
-        lambda: jnp.zeros((world * lay.upb, width), dtype),
-        out_shardings=sh2,
-    )
+    mesh_fp = progcache.mesh_fingerprint(mesh)
+
+    def _zeros_fn(shape, sharding):
+        # registry-cached: a fresh jit(lambda) per fit would recompile
+        # the (tiny) init program every call
+        return progcache.get_or_build(
+            "als_block_stream.zeros",
+            (mesh_fp, shape, str(np.dtype(dtype))),
+            lambda: jax.jit(
+                lambda: jnp.zeros(shape, dtype), out_shardings=sharding
+            ),
+        )
+
+    zeros_u = _zeros_fn((world * lay.upb, width), sh2)
     if lay.item_sharded:
-        zeros_i = jax.jit(
-            lambda: jnp.zeros((world * lay.ipb, width), dtype),
-            out_shardings=sh2,
-        )
+        zeros_i = _zeros_fn((world * lay.ipb, width), sh2)
     else:
-        zeros_i = jax.jit(
-            lambda: jnp.zeros((world, lay.n_items, width), dtype),
-            out_shardings=sh3,
-        )
+        zeros_i = _zeros_fn((world, lay.n_items, width), sh3)
 
     def stream_side(by_side, g_total, gc, accum, m, *factor_args):
         su = {b: by_side[b][0] for b in lay.owned}
@@ -513,12 +530,22 @@ def als_block_run_streamed(
                     place(gu, sl, world),
                 )
 
+        step_key = (
+            mesh_fp, (gc, su[lay.owned[0]].shape[1] if lay.owned else 0),
+            tuple(getattr(m, "shape", ())), implicit,
+        )
         pf = Prefetcher(
             range(0, g_total, gc), stage=stage, stats=stats, retire=True
         )
         with pf:
             for su_c, cu_c, vu_c, gu_c in pf:
-                m = accum(m, su_c, cu_c, vu_c, gu_c, *factor_args, alpha_j)
+                with progcache.launch(
+                    "als_block_stream.accum", step_key, timings,
+                    "als_iterations", record_execute=False,
+                ):
+                    m = accum(
+                        m, su_c, cu_c, vu_c, gu_c, *factor_args, alpha_j
+                    )
         return m
 
     x_blk, y = x0, y0

@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from oap_mllib_tpu.utils import progcache
+
 
 def _edge_chunks(nnz: int, r: int, budget_elems: int = 1 << 24) -> int:
     """Chunk count for the (chunk, r, r) per-edge outer-product buffer.
@@ -474,7 +476,7 @@ def normal_eq_partials_grouped(
 @functools.partial(
     jax.jit, static_argnames=("n_users", "n_items", "max_iter", "implicit")
 )
-def als_run_grouped(
+def _als_run_grouped_jit(
     u_src_g, u_conf_g, u_valid_g, u_group_dst,  # item ids grouped by user
     i_src_g, i_conf_g, i_valid_g, i_group_dst,  # user ids grouped by item
     x0: jax.Array,
@@ -486,10 +488,6 @@ def als_run_grouped(
     alpha: float,
     implicit: bool,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Full ALS loop on the grouped-edge layout (both feedback modes).
-
-    ~15x the COO path at MovieLens-1M scale on v5e: scatter-free partials
-    + Cholesky solves (BASELINE.md round 3)."""
     r = x0.shape[1]
     eye = jnp.eye(r, dtype=x0.dtype)
 
@@ -513,6 +511,41 @@ def als_run_grouped(
 
     (x, y), _ = lax.scan(body, (x0, y0), None, length=max_iter)
     return x, y
+
+
+def als_run_grouped(
+    u_src_g, u_conf_g, u_valid_g, u_group_dst,
+    i_src_g, i_conf_g, i_valid_g, i_group_dst,
+    x0: jax.Array,
+    y0: jax.Array,
+    n_users: int,
+    n_items: int,
+    max_iter: int,
+    reg: float,
+    alpha: float,
+    implicit: bool,
+    timings=None,
+    phase: str = "als_iterations",
+) -> Tuple[jax.Array, jax.Array]:
+    """Full ALS loop on the grouped-edge layout (both feedback modes).
+
+    ~15x the COO path at MovieLens-1M scale on v5e: scatter-free partials
+    + Cholesky solves (BASELINE.md round 3).  The launch registers with
+    the program-cache registry (utils/progcache); ``timings`` receives
+    the ``<phase>/compile`` / ``<phase>/execute`` wall split."""
+    # reg/alpha are traced scalars, not statics — they do not key a new
+    # program and so stay out of the cache key
+    key = (
+        progcache.backend_fingerprint(),
+        progcache.array_key(u_src_g, i_src_g, x0, y0),
+        n_users, n_items, max_iter, implicit,
+    )
+    with progcache.launch("als.run_grouped", key, timings, phase):
+        return _als_run_grouped_jit(
+            u_src_g, u_conf_g, u_valid_g, u_group_dst,
+            i_src_g, i_conf_g, i_valid_g, i_group_dst,
+            x0, y0, n_users, n_items, max_iter, reg, alpha, implicit,
+        )
 
 
 def _half_update(
@@ -541,7 +574,7 @@ def _half_update(
 @functools.partial(
     jax.jit, static_argnames=("n_users", "n_items", "max_iter")
 )
-def als_implicit_run(
+def _als_implicit_run_jit(
     u_idx: jax.Array,
     i_idx: jax.Array,
     conf: jax.Array,
@@ -554,8 +587,6 @@ def als_implicit_run(
     reg: float,
     alpha: float,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Full training loop: alternating user/item updates under lax.scan
-    (the reference's trainModel loop, ALSDALImpl.cpp:318-438)."""
 
     def body(carry, _):
         x, y = carry
@@ -567,10 +598,30 @@ def als_implicit_run(
     return x, y
 
 
+def als_implicit_run(
+    u_idx, i_idx, conf, valid, x0, y0,
+    n_users: int, n_items: int, max_iter: int, reg: float, alpha: float,
+    timings=None, phase: str = "als_iterations",
+) -> Tuple[jax.Array, jax.Array]:
+    """Full training loop: alternating user/item updates under lax.scan
+    (the reference's trainModel loop, ALSDALImpl.cpp:318-438).
+    Registry-tracked (utils/progcache), like :func:`als_run_grouped`."""
+    key = (
+        progcache.backend_fingerprint(),
+        progcache.array_key(u_idx, x0, y0),
+        n_users, n_items, max_iter,
+    )
+    with progcache.launch("als.implicit_coo", key, timings, phase):
+        return _als_implicit_run_jit(
+            u_idx, i_idx, conf, valid, x0, y0,
+            n_users, n_items, max_iter, reg, alpha,
+        )
+
+
 @functools.partial(
     jax.jit, static_argnames=("n_users", "n_items", "max_iter")
 )
-def als_explicit_run(
+def _als_explicit_run_jit(
     u_idx: jax.Array,
     i_idx: jax.Array,
     rating: jax.Array,
@@ -582,8 +633,6 @@ def als_explicit_run(
     max_iter: int,
     reg: float,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Explicit-feedback ALS (beyond the reference's accelerated surface —
-    it falls back to Spark for explicit; we accelerate both)."""
 
     def half(dst_idx, src_idx, src_factors, n_dst):
         r = src_factors.shape[1]
@@ -603,6 +652,26 @@ def als_explicit_run(
 
     (x, y), _ = lax.scan(body, (x0, y0), None, length=max_iter)
     return x, y
+
+
+def als_explicit_run(
+    u_idx, i_idx, rating, valid, x0, y0,
+    n_users: int, n_items: int, max_iter: int, reg: float,
+    timings=None, phase: str = "als_iterations",
+) -> Tuple[jax.Array, jax.Array]:
+    """Explicit-feedback ALS (beyond the reference's accelerated surface —
+    it falls back to Spark for explicit; we accelerate both).
+    Registry-tracked (utils/progcache), like :func:`als_run_grouped`."""
+    key = (
+        progcache.backend_fingerprint(),
+        progcache.array_key(u_idx, x0, y0),
+        n_users, n_items, max_iter,
+    )
+    with progcache.launch("als.explicit_coo", key, timings, phase):
+        return _als_explicit_run_jit(
+            u_idx, i_idx, rating, valid, x0, y0,
+            n_users, n_items, max_iter, reg,
+        )
 
 
 @jax.jit

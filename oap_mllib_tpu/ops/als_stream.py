@@ -47,6 +47,7 @@ from oap_mllib_tpu.ops.als_ops import (
     regularized_solve,
     unpack_flat_moments,
 )
+from oap_mllib_tpu.utils import progcache
 
 
 def groups_per_chunk(P: int, r: int) -> int:
@@ -138,13 +139,16 @@ def _stage_group_chunk(grouped_host, gc: int, stats: PrefetchStats):
 
 def _half_update_streamed(
     grouped_host, factors_dev: jax.Array, n_dst: int, gc: int, reg, alpha,
-    implicit: bool, stats: Optional[PrefetchStats] = None,
+    implicit: bool, stats: Optional[PrefetchStats] = None, timings=None,
+    phase: str = "als_iterations",
 ) -> jax.Array:
     """One side's update: walk the host-resident grouped layout (already
     padded to a multiple of ``gc`` group rows) through the device in
     chunks — prefetched, so each chunk's upload overlaps the previous
     chunk's moment kernel — then solve.  Returns the (n_dst, r)
-    factors."""
+    factors.  Chunk launches register with the program-cache registry
+    (compile wall books under ``<phase>/compile``; steady-state device
+    time is the prefetch ``compute`` split)."""
     r = factors_dev.shape[1]
     src_g = grouped_host[0]
     width = (r + 1) * (r + 2)
@@ -152,6 +156,10 @@ def _half_update_streamed(
     alpha_j = jnp.asarray(alpha, factors_dev.dtype)
     if stats is None:
         stats = PrefetchStats()
+    step_key = (
+        progcache.backend_fingerprint(),
+        (gc, src_g.shape[1], n_dst, r), str(factors_dev.dtype), implicit,
+    )
     pf = Prefetcher(
         range(0, src_g.shape[0], gc),
         stage=_stage_group_chunk(grouped_host, gc, stats),
@@ -160,13 +168,21 @@ def _half_update_streamed(
     )
     with pf:
         for src_c, conf_c, valid_c, gdst_c in pf:
-            m = _accum_moments(
-                m, src_c, conf_c, valid_c, gdst_c,
-                factors_dev, alpha_j, n_dst, implicit,
-            )
-    return _solve_side(
-        m, factors_dev, jnp.asarray(reg, factors_dev.dtype), implicit
-    )
+            with progcache.launch(
+                "als_stream.accum_moments", step_key, timings, phase,
+                record_execute=False,
+            ):
+                m = _accum_moments(
+                    m, src_c, conf_c, valid_c, gdst_c,
+                    factors_dev, alpha_j, n_dst, implicit,
+                )
+    with progcache.launch(
+        "als_stream.solve_side", step_key, timings, phase,
+        record_execute=False,
+    ):
+        return _solve_side(
+            m, factors_dev, jnp.asarray(reg, factors_dev.dtype), implicit
+        )
 
 
 def als_run_streamed(
@@ -203,10 +219,12 @@ def als_run_streamed(
     t0 = time.perf_counter()
     for _ in range(max_iter):
         x = _half_update_streamed(
-            by_user, y, n_users, gc_u, reg, alpha, implicit, stats=stats
+            by_user, y, n_users, gc_u, reg, alpha, implicit, stats=stats,
+            timings=timings,
         )
         y = _half_update_streamed(
-            by_item, x, n_items, gc_i, reg, alpha, implicit, stats=stats
+            by_item, x, n_items, gc_i, reg, alpha, implicit, stats=stats,
+            timings=timings,
         )
     jax.block_until_ready((x, y))
     stats.finalize(timings, "als_iterations", time.perf_counter() - t0)

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
@@ -209,14 +210,18 @@ def rows_per_chunk(*widths: int, budget: int = SCORE_BUDGET_ELEMS) -> int:
 
 
 def auto_row_chunks(n: int, k: int, budget_elems: int = SCORE_BUDGET_ELEMS) -> int:
-    """Pick a chunk count dividing ``n`` so the live (chunk, k) distance
-    buffer stays under ``budget_elems`` (default 32M f32 = 128 MB HBM).
+    """Pick a chunk count so the live (chunk, k) distance buffer stays
+    under ``budget_elems`` (default 32M f32 = 128 MB HBM).
 
-    Single-chip sizing for ``_accumulate_chunked``; the bench shape
-    (1M x 256, k=1000) gets 32 chunks, small fits get 1 (no scan overhead).
+    The budget is a HARD bound now: the count no longer needs to divide
+    ``n`` — ``lloyd_run`` pads rows (weight 0) to the next chunk
+    multiple.  (Previously an odd / non-power-of-two-divisible ``n``
+    silently returned 1 chunk, letting the (n, k) buffer blow straight
+    past the budget it exists to enforce.)  The bench shape (1M x 256,
+    k=1000) gets 32 chunks, small fits 1 (no scan overhead).
     """
     chunks = 1
-    while (n // chunks) * k > budget_elems and n % (chunks * 2) == 0:
+    while chunks < max(n, 1) and (-(-n // chunks)) * k > budget_elems:
         chunks *= 2
     return chunks
 
@@ -259,7 +264,7 @@ def _lloyd_loop(accum, moved_reduce, init_centers, max_iter, tol_sq):
 
 
 @functools.partial(jax.jit, static_argnames=("max_iter", "row_chunks", "precision"))
-def lloyd_run(
+def _lloyd_run_jit(
     x: jax.Array,
     weights: jax.Array,
     init_centers: jax.Array,
@@ -268,11 +273,17 @@ def lloyd_run(
     row_chunks: int = 1,
     precision: str = "highest",
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Full Lloyd optimization: returns (centers, n_iter, cost, counts).
-
-    Semantics in :func:`_lloyd_loop` (the reference's convergence contract,
-    KMeansDALImpl.cpp:135-168).
-    """
+    # rows that don't divide the chunk count pad with weight-0 rows HERE
+    # — once per compiled program, outside the while_loop, so the copy
+    # cannot re-run per iteration — keeping auto_row_chunks' budget a
+    # hard bound for any n (bucketed tables are already divisible and
+    # skip this)
+    pad = (-x.shape[0]) % row_chunks
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]), x.dtype)])
+        weights = jnp.concatenate(
+            [weights, jnp.zeros((pad,), weights.dtype)]
+        )
 
     def accum(centers, prec):
         # prec None = loop-body mode: no cost (recomputed at "highest" after
@@ -288,11 +299,57 @@ def lloyd_run(
     )
 
 
-@functools.lru_cache(maxsize=8)
+def lloyd_run(
+    x: jax.Array,
+    weights: jax.Array,
+    init_centers: jax.Array,
+    max_iter: int,
+    tol: jax.Array,
+    row_chunks: int = 1,
+    precision: str = "highest",
+    timings=None,
+    phase: str = "lloyd_loop",
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Full Lloyd optimization: returns (centers, n_iter, cost, counts).
+
+    Semantics in :func:`_lloyd_loop` (the reference's convergence contract,
+    KMeansDALImpl.cpp:135-168).  The launch is registered with the
+    program-cache registry (utils/progcache) so fits report how many
+    programs they compiled vs reused; ``timings`` (when given) receives
+    the ``<phase>/compile`` / ``<phase>/execute`` wall split.
+    """
+    key = (
+        progcache.backend_fingerprint(),
+        progcache.array_key(x, weights, init_centers),
+        max_iter, row_chunks, precision,
+    )
+    with progcache.launch("kmeans.lloyd_run", key, timings, phase):
+        return _lloyd_run_jit(
+            x, weights, init_centers, max_iter, tol,
+            row_chunks=row_chunks, precision=precision,
+        )
+
+
 def _lloyd_model_sharded_fn(mesh, dax: str, max_: str, max_iter: int,
                             precision: str):
-    """Compiled model-sharded Lloyd program, cached per (mesh, shape-free
-    statics) — a fresh jit(shard_map) closure per fit would recompile.
+    """Compiled model-sharded Lloyd program, cached in the process-wide
+    program registry (utils/progcache — this function's old private
+    functools.lru_cache is the pattern the registry generalizes) per
+    (mesh fingerprint, shape-free statics): a fresh jit(shard_map)
+    closure per fit would recompile."""
+    key = (
+        progcache.mesh_fingerprint(mesh), dax, max_, max_iter, precision
+    )
+    return progcache.get_or_build(
+        "kmeans.lloyd_model_sharded", key,
+        lambda: _build_lloyd_model_sharded(mesh, dax, max_, max_iter,
+                                           precision),
+    )
+
+
+def _build_lloyd_model_sharded(mesh, dax: str, max_: str, max_iter: int,
+                               precision: str):
+    """Build the jitted model-sharded Lloyd program (cached above).
 
     Mesh-sharded linalg (survey §5): on a (data, model) mesh each device
     holds a (rows/data, d/model) tile of X and a (k, d/model) tile of the
@@ -373,6 +430,8 @@ def lloyd_run_model_sharded(
     data_axis: str,
     model_axis: str,
     precision: str = "highest",
+    timings=None,
+    phase: str = "lloyd_loop",
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Lloyd loop with centroids feature-sharded over the MODEL axis.
 
@@ -383,7 +442,14 @@ def lloyd_run_model_sharded(
     """
     fn = _lloyd_model_sharded_fn(mesh, data_axis, model_axis, max_iter,
                                  precision)
-    return fn(x, weights, jnp.asarray(init_centers), tol * tol)
+    key = (
+        progcache.mesh_fingerprint(mesh),
+        progcache.array_key(x, weights),
+        np.asarray(init_centers).shape, max_iter, precision,
+    )
+    with progcache.launch("kmeans.lloyd_model_sharded.run", key, timings,
+                          phase):
+        return fn(x, weights, jnp.asarray(init_centers), tol * tol)
 
 
 @jax.jit
@@ -466,11 +532,23 @@ def init_random(
 
 def _slot_chunk_size(cap: int, target: int = 1024) -> int:
     """Largest divisor of ``cap`` that is <= target (slot-chunking the
-    min-distance update bounds the live (n, chunk) buffer)."""
+    min-distance update bounds the live (n, chunk) buffer).
+
+    Direct paired-divisor enumeration up to sqrt(cap): every divisor d
+    <= sqrt(cap) pairs with cap // d, so scanning the square root covers
+    them all — O(sqrt cap) where the old loop scanned all of [1, cap]."""
+    if cap <= target:
+        return max(cap, 1)
     best = 1
-    for c in range(1, cap + 1):
-        if cap % c == 0 and c <= target:
-            best = c
+    d = 1
+    while d * d <= cap:
+        if cap % d == 0:
+            if best < d <= target:
+                best = d
+            q = cap // d
+            if best < q <= target:
+                best = q
+        d += 1
     return best
 
 

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 from typing import Tuple
+from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
@@ -44,7 +45,7 @@ def _cov_prec(precision: str):
 
 
 @functools.partial(jax.jit, static_argnames=("precision",))
-def covariance(
+def _covariance_jit(
     x: jax.Array, mask: jax.Array, n_rows: jax.Array,
     precision: str = "highest",
 ) -> Tuple[jax.Array, jax.Array]:
@@ -72,10 +73,38 @@ def covariance(
     return 0.5 * (cov + cov.T), mean
 
 
-@functools.lru_cache(maxsize=8)
+def covariance(
+    x: jax.Array, mask: jax.Array, n_rows: jax.Array,
+    precision: str = "highest",
+    timings=None, phase: str = "covariance",
+) -> Tuple[jax.Array, jax.Array]:
+    """Registry-tracked entry over :func:`_covariance_jit` (semantics in
+    its docstring): the launch is noted with the program-cache registry
+    (utils/progcache) and, when ``timings`` is given, its wall is booked
+    under ``<phase>/compile`` (first program) or ``<phase>/execute``."""
+    key = (
+        progcache.backend_fingerprint(),
+        progcache.array_key(x, mask),
+        precision,
+    )
+    with progcache.launch("pca.covariance", key, timings, phase):
+        return _covariance_jit(x, mask, n_rows, precision)
+
+
 def _model_sharded_cov_fn(mesh, dax: str, max_: str, precision: str):
-    """Compiled model-sharded covariance program, cached per mesh (a fresh
-    jit(shard_map) closure per fit would retrace/recompile every time).
+    """Compiled model-sharded covariance program, cached in the
+    process-wide program registry (utils/progcache; formerly a private
+    functools.lru_cache) per mesh fingerprint — a fresh jit(shard_map)
+    closure per fit would retrace/recompile every time."""
+    key = (progcache.mesh_fingerprint(mesh), dax, max_, precision)
+    return progcache.get_or_build(
+        "pca.covariance_model_sharded", key,
+        lambda: _build_model_sharded_cov(mesh, dax, max_, precision),
+    )
+
+
+def _build_model_sharded_cov(mesh, dax: str, max_: str, precision: str):
+    """Build the jitted model-sharded covariance program (cached above).
     Tier semantics match :func:`covariance`: fast tiers center on device
     before the Gram (no raw-moment cancellation amplification)."""
 
@@ -112,6 +141,7 @@ def _model_sharded_cov_fn(mesh, dax: str, max_: str, precision: str):
 def covariance_model_sharded(
     x: jax.Array, mask: jax.Array, n_rows: jax.Array, mesh,
     precision: str = "highest",
+    timings=None, phase: str = "covariance",
 ) -> Tuple[jax.Array, jax.Array]:
     """Covariance with the (d, d) accumulation sharded over the MODEL axis.
 
@@ -130,9 +160,17 @@ def covariance_model_sharded(
     from oap_mllib_tpu.config import get_config
 
     cfg = get_config()
-    return _model_sharded_cov_fn(
+    fn = _model_sharded_cov_fn(
         mesh, cfg.data_axis, cfg.model_axis, precision
-    )(x, mask, n_rows)
+    )
+    key = (
+        progcache.mesh_fingerprint(mesh),
+        progcache.array_key(x, mask), precision,
+    )
+    with progcache.launch(
+        "pca.covariance_model_sharded.run", key, timings, phase
+    ):
+        return fn(x, mask, n_rows)
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
@@ -152,14 +190,24 @@ def mark_padded_features(cov: jax.Array, d_valid: int) -> jax.Array:
 
 
 @jax.jit
-def eigh_descending(cov: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Eigenvalues (descending) and matching eigenvectors (columns) of a
-    symmetric matrix — the finalizeCompute analog (PCADALImpl.cpp:122-153).
-    """
+def _eigh_descending_jit(cov: jax.Array) -> Tuple[jax.Array, jax.Array]:
     vals, vecs = jnp.linalg.eigh(cov)  # ascending
     vals = vals[::-1]
     vecs = vecs[:, ::-1]
     return vals, vecs
+
+
+def eigh_descending(cov: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Eigenvalues (descending) and matching eigenvectors (columns) of a
+    symmetric matrix — the finalizeCompute analog (PCADALImpl.cpp:122-153).
+    Launches register with the program-cache registry (counters only —
+    eigh is the large-d wall and its reuse should show in hit rates).
+    """
+    progcache.note(
+        "pca.eigh",
+        (progcache.backend_fingerprint(), progcache.array_key(cov)),
+    )
+    return _eigh_descending_jit(cov)
 
 
 @functools.partial(

@@ -35,6 +35,7 @@ from oap_mllib_tpu.data.prefetch import Prefetcher, PrefetchStats
 from oap_mllib_tpu.data.stream import ChunkSource
 from oap_mllib_tpu.ops import kmeans_ops
 from oap_mllib_tpu.ops.pca_ops import _cov_prec
+from oap_mllib_tpu.utils import progcache
 
 
 def _chunk_weights(n_valid: int, chunk_rows: int, dtype) -> np.ndarray:
@@ -303,15 +304,27 @@ def streamed_accumulate(
     counts = jnp.zeros((k,), dtype)
     cost = jnp.zeros((), dtype)
     stats = PrefetchStats()
+    # one key per pass (shapes are static across chunks): the per-chunk
+    # program registers with the program-cache registry — record_execute
+    # off, the device time is already the prefetch ``compute`` split
+    step_key = (
+        progcache.backend_fingerprint(),
+        (source.chunk_rows, d, k), str(np.dtype(dtype)),
+        precision, need_cost,
+    )
     t0 = time.perf_counter()
     guard = _PassGuard()
     with guard:
         with _staged_chunks(source, weights, dtype, stats) as pf:
             for _, _, _, cj, wj in pf:
-                sums, counts, cost = _kmeans_chunk_accum(
-                    sums, counts, cost, cj, wj, centers, precision,
-                    need_cost,
-                )
+                with progcache.launch(
+                    "kmeans.stream_accum", step_key, timings, phase,
+                    record_execute=False,
+                ):
+                    sums, counts, cost = _kmeans_chunk_accum(
+                        sums, counts, cost, cj, wj, centers, precision,
+                        need_cost,
+                    )
     stats.finalize(timings, phase, time.perf_counter() - t0)
     return _psum_host([sums, counts, cost], guard=guard)
 
@@ -529,6 +542,11 @@ def init_kmeans_parallel_streamed(
                         if rnd > 0
                         else jnp.full((source.chunk_rows,), np.inf, dtype)
                     )
+                    progcache.note(
+                        "kmeans.stream_pll_fold",
+                        (progcache.backend_fingerprint(),
+                         progcache.array_key(cj, cands_dev)),
+                    )
                     h = np.array(  # writable host copy
                         _chunk_min_d2(cj, prev, cands_dev)
                     )
@@ -588,6 +606,11 @@ def init_kmeans_parallel_streamed(
     guard = _PassGuard()
     with guard, _staged_chunks(source, weights, dtype, stats) as pf:
         for _, _, _, cj, wj in pf:
+            progcache.note(
+                "kmeans.stream_pll_own",
+                (progcache.backend_fingerprint(),
+                 progcache.array_key(cj, cands_dev)),
+            )
             own += np.asarray(_chunk_ownership(cj, wj, cands_dev))
     stats.finalize(timings, "init_centers", time.perf_counter() - t0)
     (own,) = _psum_host([own], guard=guard)
@@ -626,11 +649,19 @@ def covariance_streamed(
     total = jnp.zeros((d,), dtype)
     n = 0
     stats = PrefetchStats()
+    base_key = (
+        progcache.backend_fingerprint(),
+        (source.chunk_rows, d), str(np.dtype(dtype)), precision,
+    )
     t0 = time.perf_counter()
     guard = _PassGuard()
     with guard, _staged_chunks(source, None, dtype, stats) as pf:
         for _, n_valid, _, cj, wj in pf:
-            total = _colsum_chunk(total, cj, wj)
+            with progcache.launch(
+                "pca.stream_colsum", base_key, timings,
+                "covariance_streamed", record_execute=False,
+            ):
+                total = _colsum_chunk(total, cj, wj)
             n += n_valid
     stats.finalize(timings, "covariance_streamed", time.perf_counter() - t0)
     total, n_arr = _psum_host([total, np.asarray([n], np.int64)], guard=guard)
@@ -644,7 +675,11 @@ def covariance_streamed(
     guard = _PassGuard()
     with guard, _staged_chunks(source, None, dtype, stats) as pf:
         for _, _, _, cj, wj in pf:
-            gram = _gram_chunk(gram, cj, wj, mean, precision)
+            with progcache.launch(
+                "pca.stream_gram", base_key, timings,
+                "covariance_streamed", record_execute=False,
+            ):
+                gram = _gram_chunk(gram, cj, wj, mean, precision)
     stats.finalize(timings, "covariance_streamed", time.perf_counter() - t0)
     (gram,) = _psum_host([gram], guard=guard)
     cov = gram.astype(np.float64 if dtype == np.float64 else np.float32)

@@ -60,8 +60,17 @@ def platform_compatible() -> bool:
 
 
 def should_accelerate(algo: str, guard_ok: bool, reason: str = "") -> bool:
-    """Decide accelerated vs. fallback path; raise if fallback disabled."""
+    """Decide accelerated vs. fallback path; raise if fallback disabled.
+
+    Every estimator fit funnels through here, so this is also where the
+    persistent XLA compilation cache is wired (Config
+    .compilation_cache_dir -> jax compilation_cache_dir, idempotent) —
+    before the first program of the fit traces."""
     cfg = get_config()
+    if cfg.compilation_cache_dir:
+        from oap_mllib_tpu.utils.progcache import ensure_persistent_cache
+
+        ensure_persistent_cache(cfg.compilation_cache_dir)
     ok = platform_compatible() and guard_ok
     if ok:
         return True

@@ -1,0 +1,324 @@
+"""Process-wide compiled-program registry: the compile-amortization core.
+
+The reference pays a JNI + oneDAL kernel dispatch per phase; this port
+pays XLA *compiles* instead — seconds of latency the first time any
+program shape is seen.  Three things keep that cost amortized across the
+many differently-sized fits of a long-lived service (the ROADMAP north
+star), and this module is their shared registry:
+
+1. **Program cache** — generalizes the ad-hoc ``functools.lru_cache``
+   pattern that grew around the shard_map closures
+   (``kmeans_ops._lloyd_model_sharded_fn``, ``pca_ops
+   ._model_sharded_cov_fn``; the block-ALS runners rebuilt theirs every
+   call): :func:`get_or_build` caches built callables process-wide,
+   keyed by (algo, statics, mesh fingerprint), with LRU eviction and
+   hit/miss/evict counters.
+2. **Launch accounting** — the jitted entry points :func:`note` every
+   launch under the same key space, so a fit summary can report how many
+   programs it compiled vs reused, and :func:`launch` attributes the
+   wall of first-seen launches to ``<phase>/compile`` and cache-hit
+   launches to ``<phase>/execute`` in a :class:`~oap_mllib_tpu.utils
+   .timing.Timings` (first-call wall = trace + XLA compile + first
+   dispatch; hit wall = dispatch only for async launches).
+3. **XLA ground truth** — :func:`xla_compile_count` counts actual
+   backend compiles via jax.monitoring's
+   ``/jax/core/compile/backend_compile_duration`` event, so benches and
+   CI gates assert on what XLA really did, not what the registry thinks.
+
+The persistent half lives in :func:`ensure_persistent_cache`: wiring
+``Config.compilation_cache_dir`` through ``jax_compilation_cache_dir``
+so a warm *process* skips XLA compilation entirely (DrJAX's
+amortization argument, PAPERS.md, applied across process lifetimes).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional
+
+# -- registry ---------------------------------------------------------------
+
+
+class ProgramCache:
+    """Keyed registry of built programs + launch counters.
+
+    Two kinds of entries share one key space ``(algo, key)``:
+
+    - *built* entries hold a value (a compiled/jit-wrapped callable) and
+      are LRU-evicted past ``maxsize``;
+    - *noted* entries hold no value — they only record that a jitted
+      entry point has launched this program shape before (jit owns the
+      executable; the registry owns the accounting).
+    """
+
+    def __init__(self, maxsize: int = 128, note_maxsize: int = 4096):
+        self.maxsize = maxsize
+        self.note_maxsize = note_maxsize
+        self._lock = threading.RLock()
+        self._built: "OrderedDict[tuple, Any]" = OrderedDict()
+        self._noted: "OrderedDict[tuple, int]" = OrderedDict()
+        self._counts: Dict[str, Dict[str, int]] = {}
+
+    def _algo(self, algo: str) -> Dict[str, int]:
+        return self._counts.setdefault(
+            algo, {"hits": 0, "misses": 0, "evictions": 0}
+        )
+
+    def get_or_build(self, algo: str, key: tuple, build: Callable[[], Any]):
+        """Return the cached value for ``(algo, key)``, building (and
+        counting a miss) on first use.  The build runs outside the lock —
+        building traces/compiles and must not serialize unrelated
+        lookups; a racing duplicate build is benign (last one wins)."""
+        full = (algo, key)
+        with self._lock:
+            if full in self._built:
+                self._built.move_to_end(full)
+                self._algo(algo)["hits"] += 1
+                return self._built[full]
+            self._algo(algo)["misses"] += 1
+        value = build()
+        with self._lock:
+            self._built[full] = value
+            self._built.move_to_end(full)
+            while len(self._built) > self.maxsize:
+                (ev_algo, _), _ = self._built.popitem(last=False)
+                self._algo(ev_algo)["evictions"] += 1
+        return value
+
+    def note(self, algo: str, key: tuple) -> bool:
+        """Record one launch of a jit-managed program; True = first seen
+        (the launch that pays trace + XLA compile)."""
+        full = (algo, key)
+        with self._lock:
+            if full in self._noted:
+                self._noted.move_to_end(full)
+                self._noted[full] += 1
+                self._algo(algo)["hits"] += 1
+                return False
+            self._noted[full] = 1
+            self._algo(algo)["misses"] += 1
+            while len(self._noted) > self.note_maxsize:
+                (ev_algo, _), _ = self._noted.popitem(last=False)
+                self._algo(ev_algo)["evictions"] += 1
+            return True
+
+    def stats(self) -> Dict[str, Any]:
+        """Aggregate + per-algo counters.  ``hit_rate`` is per-launch:
+        of everything that went through the registry, the fraction that
+        reused an existing program."""
+        with self._lock:
+            by_algo = {a: dict(c) for a, c in self._counts.items()}
+        hits = sum(c["hits"] for c in by_algo.values())
+        misses = sum(c["misses"] for c in by_algo.values())
+        total = hits + misses
+        return {
+            "hits": hits,
+            "misses": misses,
+            "evictions": sum(c["evictions"] for c in by_algo.values()),
+            "entries": len(self._built) + len(self._noted),
+            "hit_rate": (hits / total) if total else None,
+            "by_algo": by_algo,
+        }
+
+    def clear(self) -> None:
+        """Drop every entry AND counter (tests; a cleared registry makes
+        the next launch of everything a miss, but jit's own executable
+        cache is untouched — only the accounting resets)."""
+        with self._lock:
+            self._built.clear()
+            self._noted.clear()
+            self._counts.clear()
+
+
+_CACHE = ProgramCache()
+
+
+def get_or_build(algo: str, key: tuple, build: Callable[[], Any]):
+    return _CACHE.get_or_build(algo, key, build)
+
+
+def note(algo: str, key: tuple) -> bool:
+    return _CACHE.note(algo, key)
+
+
+def stats() -> Dict[str, Any]:
+    return _CACHE.stats()
+
+
+def clear() -> None:
+    _CACHE.clear()
+
+
+def delta(before: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-fit registry activity: ``stats() - before`` for the scalar
+    counters (models snapshot ``stats()`` at fit entry and attach the
+    delta to the training summary)."""
+    now = stats()
+    out = {
+        k: now[k] - before.get(k, 0) for k in ("hits", "misses", "evictions")
+    }
+    total = out["hits"] + out["misses"]
+    out["hit_rate"] = (out["hits"] / total) if total else None
+    return out
+
+
+@contextlib.contextmanager
+def launch(algo: str, key: tuple, timings=None, phase: Optional[str] = None,
+           record_execute: bool = True):
+    """Count one program launch and attribute its wall time.
+
+    A first-seen key books the wall under ``<phase>/compile`` (for a jit
+    entry the first call is where trace + XLA compile happen,
+    synchronously, before the async dispatch); a hit books under
+    ``<phase>/execute``.  ``record_execute=False`` is the per-chunk
+    streamed-loop mode: misses still book compile, but the thousands of
+    async per-chunk dispatch walls would be noise (the real device time
+    is already recorded as the prefetch pipeline's ``compute`` split),
+    so hits only count."""
+    miss = _CACHE.note(algo, key)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if timings is not None and phase is not None:
+            if miss:
+                timings.add(phase + "/compile", time.perf_counter() - t0)
+            elif record_execute:
+                timings.add(phase + "/execute", time.perf_counter() - t0)
+
+
+# -- key helpers ------------------------------------------------------------
+
+
+def array_key(*arrays) -> tuple:
+    """Hashable signature of array arguments: (shape, dtype, sharding).
+
+    Sharding rides along because jit specializes on it — the same shapes
+    on a different mesh layout are a different executable."""
+    out = []
+    for a in arrays:
+        try:  # tracers (an entry called inside an outer jit) may not
+            shard = str(getattr(a, "sharding", ""))  # carry a sharding
+        except Exception:
+            shard = ""
+        out.append((
+            tuple(getattr(a, "shape", ())),
+            str(getattr(a, "dtype", type(a).__name__)),
+            shard,
+        ))
+    return tuple(out)
+
+
+def mesh_fingerprint(mesh) -> tuple:
+    """Stable hashable identity of a mesh: axis layout + device ids +
+    platform.  Two fits on meshes with this fingerprint can share one
+    compiled shard_map program."""
+    devs = [d for d in mesh.devices.flat]
+    return (
+        tuple(mesh.axis_names),
+        tuple(mesh.devices.shape),
+        tuple(d.id for d in devs),
+        devs[0].platform if devs else "none",
+    )
+
+
+def backend_fingerprint() -> tuple:
+    """Identity of the default-device world, for single-program entry
+    points that jit without an explicit mesh (GSPMD decides placement
+    from the argument shardings, which array_key captures)."""
+    import jax
+
+    return (jax.default_backend(), len(jax.devices()), jax.process_count())
+
+
+# -- XLA compile ground truth ----------------------------------------------
+
+_XLA_EVENTS = {"count": 0, "secs": 0.0}
+_xla_listener_installed = False
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _install_xla_listener() -> None:
+    global _xla_listener_installed
+    if _xla_listener_installed:
+        return
+    try:
+        from jax import monitoring
+
+        def _on_event(event, duration_secs, **kwargs):
+            if event == _BACKEND_COMPILE_EVENT:
+                _XLA_EVENTS["count"] += 1
+                _XLA_EVENTS["secs"] += float(duration_secs)
+
+        monitoring.register_event_duration_secs_listener(_on_event)
+        _xla_listener_installed = True
+    except Exception:  # monitoring API absent on this jax: counter stays 0
+        pass
+
+
+def xla_compile_count() -> int:
+    """Monotone count of real XLA backend compiles in this process (the
+    ``/jax/core/compile/backend_compile_duration`` event).  Snapshot
+    before/after a region and subtract — that difference is the ground
+    truth the compile-sweep bench and the CI gate assert on (the
+    registry's miss count is what *we* think; this is what XLA did)."""
+    _install_xla_listener()
+    return _XLA_EVENTS["count"]
+
+
+def xla_compile_secs() -> float:
+    """Cumulative seconds spent in XLA backend compilation (same event
+    stream as :func:`xla_compile_count`)."""
+    _install_xla_listener()
+    return _XLA_EVENTS["secs"]
+
+
+# install at import so compiles that happen before the first explicit
+# snapshot (e.g. a warm-up fit) are still counted into the baseline
+_install_xla_listener()
+
+
+# -- persistent (cross-process) compilation cache ---------------------------
+
+_persist_applied: Optional[str] = None
+
+
+def ensure_persistent_cache(cache_dir: str) -> None:
+    """Wire ``Config.compilation_cache_dir`` into jax's persistent
+    compilation cache (idempotent; re-applies only when the dir
+    changes).  With a dir set, XLA executables serialize to disk keyed
+    by (HLO, compile options, backend version) — a warm process skips
+    backend compilation entirely, which is the cross-process half of
+    compile amortization (shape bucketing is the within-process half).
+
+    The min-size/min-time thresholds are zeroed so the small per-chunk
+    streamed programs persist too — jax's defaults only persist
+    programs that took >1s to compile, which would exclude most of this
+    framework's kernels on a warm CPU tier."""
+    global _persist_applied
+    if not cache_dir or _persist_applied == cache_dir:
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for opt, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(opt, val)
+        except Exception:  # older jax lines lack the knob; dir alone works
+            pass
+    # jax pins its cache object to the first dir it initialized with;
+    # drop it so the (possibly changed) dir takes effect — it re-creates
+    # lazily on the next compile
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+    _persist_applied = cache_dir

@@ -68,6 +68,23 @@ class Timings:
         wait = max(sub["stream_wall"] - sub.get("compute", 0.0), 0.0)
         return max(0.0, min(1.0, 1.0 - wait / staging))
 
+    def compile_split(self, prefix: str) -> Optional[Dict[str, float]]:
+        """The ``{compile, execute}`` wall split the program-cache launch
+        wrappers record under a phase (utils/progcache.launch): compile =
+        first-seen-program launches (trace + XLA compile + first
+        dispatch), execute = cache-hit launches (dispatch wall for async
+        programs; streamed per-chunk hits are excluded by design — their
+        device time is the prefetch ``compute`` split).  None when the
+        phase recorded no launches through the registry (e.g. a fallback
+        fit)."""
+        sub = self.subphases(prefix)
+        if "compile" not in sub and "execute" not in sub:
+            return None
+        return {
+            "compile": sub.get("compile", 0.0),
+            "execute": sub.get("execute", 0.0),
+        }
+
     def __repr__(self) -> str:
         parts = ", ".join(f"{p}={s:.3f}s" for p, s in self._records)
         return f"Timings({parts})"

@@ -111,3 +111,40 @@ class TestConfigCoverage:
     def test_unknown_field_rejected(self):
         with pytest.raises(ValueError, match="unknown config field"):
             set_config(sead=1)
+
+    def test_shape_bucketing_typo_raises_at_fit(self, rng):
+        """The kmeans_kernel/als_kernel contract: a typo'd knob must
+        raise, not silently disable compile amortization."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        set_config(shape_bucketing="bogus")
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        with pytest.raises(ValueError, match="shape_bucketing"):
+            KMeans(k=2, init_mode="random", max_iter=1).fit(x)
+
+    def test_shape_bucketing_accepted_values(self):
+        from oap_mllib_tpu.data.bucketing import bucket_factor
+
+        assert bucket_factor("on") == 2.0
+        assert bucket_factor("x2") == 2.0
+        assert bucket_factor("off") is None
+        assert bucket_factor("1.5") == 1.5
+
+    def test_compilation_cache_dir_wires_jax_config(self, tmp_path):
+        """Config.compilation_cache_dir reaches jax's persistent cache
+        at dispatch time (the every-fit chokepoint)."""
+        import jax
+
+        from oap_mllib_tpu.utils import progcache
+        from oap_mllib_tpu.utils.dispatch import should_accelerate
+
+        prev_dir = jax.config.jax_compilation_cache_dir
+        prev_applied = progcache._persist_applied
+        try:
+            cache_dir = str(tmp_path / "xla")
+            set_config(compilation_cache_dir=cache_dir)
+            should_accelerate("PCA", True)
+            assert jax.config.jax_compilation_cache_dir == cache_dir
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+            progcache._persist_applied = prev_applied

@@ -264,13 +264,22 @@ class TestModelParallel:
         """kmeans_kernel="xla" must force the GSPMD data-parallel Lloyd
         even when model_parallel > 1 (the A/B knob), and agree with the
         model-sharded program."""
-        import oap_mllib_tpu.ops.kmeans_ops as ko
+        from oap_mllib_tpu.utils import progcache
+
+        def sharded_builds():
+            # model-sharded Lloyd programs built so far (the registry
+            # replaced the old functools.lru_cache here)
+            return (
+                progcache.stats()["by_algo"]
+                .get("kmeans.lloyd_model_sharded", {})
+                .get("misses", 0)
+            )
 
         x, _, _ = _blobs(rng, n=256, d=8, k=3)
         set_config(model_parallel=2, kmeans_kernel="xla")
-        before = ko._lloyd_model_sharded_fn.cache_info().currsize
+        before = sharded_builds()
         m1 = KMeans(k=3, max_iter=20, seed=4, init_mode="random").fit(x)
-        assert ko._lloyd_model_sharded_fn.cache_info().currsize == before
+        assert sharded_builds() == before
         set_config(kmeans_kernel="auto")
         m2 = KMeans(k=3, max_iter=20, seed=4, init_mode="random").fit(x)
         np.testing.assert_allclose(
@@ -335,15 +344,57 @@ class TestRegressions:
         # f32 cost sums reassociate across chunk boundaries -> ~1e-4 rel drift
         np.testing.assert_allclose(float(cost1), float(cost2), rtol=1e-3)
 
-    def test_chunked_rejects_indivisible_rows(self, rng):
+    def test_chunked_pads_indivisible_rows(self, rng):
+        """Rows that don't divide row_chunks pad with weight-0 rows inside
+        lloyd_run (they used to raise) — the budget stays enforceable for
+        ANY n and results match the unchunked loop."""
         import jax.numpy as jnp
         from oap_mllib_tpu.ops.kmeans_ops import lloyd_run
 
-        x = jnp.asarray(rng.normal(size=(10, 3)), jnp.float32)
-        w = jnp.ones((10,), jnp.float32)
-        c = x[:2]
-        with pytest.raises(ValueError):
-            lloyd_run(x, w, c, 2, jnp.asarray(0.0, jnp.float32), 3)
+        x, _, _ = _blobs(rng, n=101, d=5, k=3)
+        init = x[rng.choice(len(x), 3, replace=False)]
+        xj = jnp.asarray(x, jnp.float32)
+        w = jnp.ones((len(x),), jnp.float32)
+        cj = jnp.asarray(init, jnp.float32)
+        tol = jnp.asarray(1e-6, jnp.float32)
+        c1, i1, cost1, n1 = lloyd_run(xj, w, cj, 15, tol)
+        c2, i2, cost2, n2 = lloyd_run(xj, w, cj, 15, tol, 4)  # 101 % 4 != 0
+        assert int(i1) == int(i2)
+        np.testing.assert_allclose(
+            np.asarray(c1), np.asarray(c2), atol=1e-5, rtol=1e-5
+        )
+        np.testing.assert_allclose(float(cost1), float(cost2), rtol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(n1), np.asarray(n2), atol=1e-5
+        )
+
+    def test_auto_row_chunks_budget_holds_for_odd_n(self):
+        """Regression (ISSUE 2 satellite): an odd / non-power-of-two-
+        divisible n used to silently return 1 chunk, letting the (n, k)
+        distance buffer blow past the element budget.  The budget is a
+        hard bound now."""
+        from oap_mllib_tpu.ops.kmeans_ops import auto_row_chunks
+
+        budget = 4096
+        for n in (1001, 999_999, 2**15 + 1):
+            chunks = auto_row_chunks(n, 64, budget_elems=budget)
+            assert chunks > 1
+            assert (-(-n // chunks)) * 64 <= budget, (n, chunks)
+        # small fits still take the no-scan-overhead single chunk
+        assert auto_row_chunks(1000, 4) == 1
+
+    def test_slot_chunk_size_matches_brute_force(self):
+        """The O(sqrt cap) paired-divisor enumeration must agree with
+        the old exhaustive scan: largest divisor of cap <= target."""
+        from oap_mllib_tpu.ops.kmeans_ops import _slot_chunk_size
+
+        for cap in list(range(1, 700, 13)) + [1024, 1536, 2048, 4100]:
+            for target in (1, 7, 64, 1024):
+                brute = max(
+                    c for c in range(1, cap + 1)
+                    if cap % c == 0 and c <= target
+                ) if cap >= 1 else 1
+                assert _slot_chunk_size(cap, target) == brute, (cap, target)
 
     def test_bad_precision_string_raises(self, rng):
         import jax.numpy as jnp

@@ -158,13 +158,13 @@ class TestGroupedChunkedCompiled:
         # (odd split so the dummy-group padding lowers on hardware too)
         monkeypatch.setattr(als_ops, "_GROUPED_BUDGET_ELEMS", 1 << 14)
         assert als_ops._grouped_block_count(*by_user[0].shape, rank) > 1
-        als_ops.als_run_grouped.clear_cache()
+        als_ops._als_run_grouped_jit.clear_cache()
         x2, y2 = run()
         np.testing.assert_allclose(np.asarray(x1), np.asarray(x2), atol=2e-4)
         np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=2e-4)
         # monkeypatch teardown restores the budget; clearing the jit cache
         # keeps the small-budget trace from leaking into later tests
-        als_ops.als_run_grouped.clear_cache()
+        als_ops._als_run_grouped_jit.clear_cache()
 
 
 class TestStreamedALSTpu:
