@@ -745,6 +745,23 @@ def bench_streamed(rows: int, d: int = 256, k: int = 1000,
     )
     cpu_pass = (time.perf_counter() - t0) * (rows / sub)
 
+    def _resilience_extras(summary):
+        """Fault accounting for a long streamed run (utils/resilience
+        .py): at north-star scale a pass takes minutes, so retries and
+        degradations that silently stretched the wall must be visible in
+        the metric they stretched."""
+        res = (
+            summary.get("resilience") if isinstance(summary, dict)
+            else getattr(summary, "resilience", None)
+        )
+        if not res or not res.get("faults"):
+            return {}
+        return {
+            "fault_retries": res["retries"],
+            "fault_degradations": res["degradations"],
+            "fault_backoff_sec": round(res["backoff_s"], 3),
+        }
+
     def _overlap_extras(timings, phase):
         """Prefetch-pipeline report for a streamed phase: the
         stage/transfer/compute split (data/prefetch.py) and the fraction
@@ -782,6 +799,7 @@ def bench_streamed(rows: int, d: int = 256, k: int = 1000,
         **_overlap_extras(m.summary.timings, "lloyd_loop"),
         **_compile_extras(m.summary.timings, "lloyd_loop",
                           getattr(m.summary, "progcache", None)),
+        **_resilience_extras(m.summary),
     )
 
     t0 = time.perf_counter()
@@ -799,6 +817,7 @@ def bench_streamed(rows: int, d: int = 256, k: int = 1000,
         **_overlap_extras(p.summary["timings"], "covariance_streamed"),
         **_compile_extras(p.summary["timings"], "covariance_streamed",
                           p.summary.get("progcache")),
+        **_resilience_extras(p.summary),
     )
 
 

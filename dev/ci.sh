@@ -68,6 +68,11 @@ echo "== compile-amortization gate: 10-size sweep, <=3 XLA compiles bucketed,"
 echo "   exact padding restored with shape_bucketing=off =="
 python dev/compile_gate.py
 
+echo "== resilience gate: injected stream.read/prefetch.stage faults absorbed"
+echo "   with exact retry counters + 1e-6 parity; persistent OOM escalates"
+echo "   accelerated -> halved-chunk -> CPU fallback (dev/fault_gate.py) =="
+python dev/fault_gate.py
+
 echo "== compiled-mode TPU suite (skipped unless a TPU backend is present) =="
 if python -c "import jax, sys; sys.exit(0 if jax.default_backend() == 'tpu' else 1)" 2>/dev/null; then
   python -m pytest tests_tpu/ -q

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""CI gate: the resilience ladder must actually absorb injected faults.
+
+Drives the retry tiers end to end on a streamed K-Means fit
+(utils/resilience.py + utils/faults.py) and asserts:
+
+- with ``stream.read:fail=2`` + ``prefetch.stage:fail=1`` injected, the
+  fit COMPLETES on the accelerated path, matches the fault-free run to
+  1e-6, and its summary reports EXACTLY the expected counters (3
+  retries, 3 faults, 0 degradations) — injection is deterministic, so
+  anything else means a retry tier regressed;
+- the fault registry's own accounting agrees (2 + 1 faults fired);
+- a persistent device OOM at the jitted-launch site escalates
+  accelerated -> halved-chunk retry -> CPU fallback with NO user-visible
+  exception when fallback=True (summary records both rungs), and raises
+  a ResilienceError carrying the fault history when fallback=False.
+
+Exit 1 with the offending numbers on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PARITY_TOL = 1e-6
+TRANSIENT_SPEC = "stream.read:fail=2,prefetch.stage:fail=1"
+EXPECT_RETRIES = 3
+EXPECT_FAULTS = 3
+
+
+def _fit(rng_seed: int = 123):
+    import numpy as np
+
+    from oap_mllib_tpu.data.stream import ChunkSource
+    from oap_mllib_tpu.models.kmeans import KMeans
+
+    rng = np.random.default_rng(rng_seed)
+    proto = rng.normal(size=(4, 8)).astype(np.float32) * 4.0
+    x = (proto[rng.integers(4, size=2000)]
+         + rng.normal(size=(2000, 8)).astype(np.float32) * 0.2)
+    src = ChunkSource.from_array(x, chunk_rows=256)
+    return KMeans(k=4, seed=7, max_iter=10).fit(src)
+
+
+def main() -> int:
+    import numpy as np
+
+    from oap_mllib_tpu.config import set_config
+    from oap_mllib_tpu.utils import faults
+    from oap_mllib_tpu.utils.resilience import ResilienceError
+
+    failures = []
+
+    # fault-free baseline
+    set_config(fault_spec="", retry_backoff=0.001)
+    clean = _fit()
+
+    # -- tier 1: transient faults absorbed, exact counters ------------------
+    set_config(fault_spec=TRANSIENT_SPEC)
+    faults.reset()
+    faulted = _fit()
+    res = faulted.summary.resilience
+    reg = faults.stats()
+    report = {
+        "retries": res["retries"],
+        "faults": res["faults"],
+        "degradations": res["degradations"],
+        "accelerated": bool(faulted.summary.accelerated),
+        "registry": {s: st["fired"] for s, st in reg.items()},
+    }
+    dev = float(np.abs(
+        faulted.cluster_centers_ - clean.cluster_centers_
+    ).max())
+    report["parity_max_dev"] = dev
+    if not faulted.summary.accelerated:
+        failures.append("transient faults pushed the fit off the "
+                        "accelerated path")
+    if res["retries"] != EXPECT_RETRIES or res["faults"] != EXPECT_FAULTS:
+        failures.append(
+            f"expected exactly {EXPECT_RETRIES} retries / {EXPECT_FAULTS} "
+            f"faults, got {res['retries']} / {res['faults']}"
+        )
+    if res["degradations"] != 0:
+        failures.append(
+            f"transient faults must not degrade (got "
+            f"{res['degradations']} degradations)"
+        )
+    if reg.get("stream.read", {}).get("fired") != 2 \
+            or reg.get("prefetch.stage", {}).get("fired") != 1:
+        failures.append(f"registry fired counts off: {report['registry']}")
+    if dev > PARITY_TOL:
+        failures.append(
+            f"faulted vs fault-free centers deviate {dev:.2e} "
+            f"(> {PARITY_TOL})"
+        )
+
+    # -- tiers 2+3: persistent OOM -> halved chunks -> CPU fallback ---------
+    set_config(fault_spec="fit.execute:oom=*", fallback=True)
+    faults.reset()
+    try:
+        oom_fit = _fit()
+    except Exception as e:  # noqa: BLE001 — the gate reports, not raises
+        failures.append(f"persistent OOM with fallback=True raised: {e!r}")
+        oom_fit = None
+    if oom_fit is not None:
+        ores = oom_fit.summary.resilience
+        report["oom_ladder"] = {
+            "accelerated": bool(oom_fit.summary.accelerated),
+            "degradations": ores["degradations"],
+            "history_len": len(ores["history"]),
+        }
+        if oom_fit.summary.accelerated:
+            failures.append("persistent OOM did not land on the CPU path")
+        if ores["degradations"] != 2:
+            failures.append(
+                "expected 2 degradations (halved-chunk rung + CPU rung), "
+                f"got {ores['degradations']}"
+            )
+
+    set_config(fallback=False)
+    faults.reset()
+    try:
+        _fit()
+        failures.append("persistent OOM with fallback=False did NOT raise")
+    except ResilienceError as e:
+        if not e.history:
+            failures.append("ResilienceError carried no fault history")
+    except Exception as e:  # noqa: BLE001
+        failures.append(
+            f"expected ResilienceError, got {type(e).__name__}: {e}"
+        )
+    set_config(fault_spec="", fallback=True)
+
+    print(json.dumps(report), flush=True)
+    for f in failures:
+        print(f"FAIL: {f}")
+    print(f"fault gate: {'FAIL' if failures else 'OK'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

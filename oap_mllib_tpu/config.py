@@ -153,6 +153,34 @@ class Config:
     # only the overlap).  Each unit of depth holds one extra staged chunk
     # in device memory, so HBM grows by chunk_bytes * (depth - 1).
     prefetch_depth: int = 2
+    # -- resilience layer (utils/resilience.py, utils/faults.py) ------------
+    # Fault-injection spec: comma-separated "site:kind=count" entries
+    # arming deterministic faults at named runtime sites (stream.read,
+    # prefetch.stage, bootstrap.connect, fit.execute) — e.g.
+    # "stream.read:fail=2" makes the first two chunk reads raise a
+    # transient error.  Empty = no injection.  Grammar and sites:
+    # utils/faults.py; CI drives every retry tier through this
+    # (dev/fault_gate.py).
+    fault_spec: str = ""
+    # What a streamed-path numerical guardrail does when it detects
+    # NaN/Inf in a training iterate (K-Means centroids, ALS factors, the
+    # PCA Gram accumulator, checked after each pass): "raise" surfaces a
+    # NonFiniteError immediately; "fallback" degrades to the CPU/NumPy
+    # reference path (subject to Config.fallback).
+    nonfinite_policy: str = "raise"
+    # Max transient-fault retries per fit attempt ladder (exponential
+    # backoff with deterministic jitter; utils/resilience.RetryPolicy).
+    retry_limit: int = 5
+    # Backoff base in seconds: retry n sleeps ~ retry_backoff * 2^n,
+    # capped at 2 s, jittered deterministically.
+    retry_backoff: float = 0.05
+    # Retry wall-clock budget in seconds: retries stop when the next
+    # backoff would cross this deadline, even with retries left.
+    retry_deadline: float = 30.0
+    # Coordinator-connection budget for initialize_distributed, in
+    # seconds: connection attempts retry with backoff until this
+    # deadline, then fail with an error naming coordinator/rank/elapsed.
+    bootstrap_timeout: float = 60.0
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -166,6 +194,8 @@ class Config:
                 setattr(cfg, f.name, _env_bool(raw))
             elif f.type in ("int", int):
                 setattr(cfg, f.name, int(raw))
+            elif f.type in ("float", float):
+                setattr(cfg, f.name, float(raw))
             else:
                 setattr(cfg, f.name, raw)
         return cfg

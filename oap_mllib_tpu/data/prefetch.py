@@ -45,12 +45,16 @@ Contracts:
 from __future__ import annotations
 
 import contextlib
+import logging
 import queue
 import threading
 import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.utils.faults import maybe_fault
+
+log = logging.getLogger("oap_mllib_tpu")
 
 
 def resolve_depth(depth: Optional[int] = None) -> int:
@@ -76,6 +80,10 @@ class PrefetchStats:
       chunk.  Serial (depth=1) this equals ``stage_s``; with overlap it
       shrinks toward zero — the visible win.
     - ``chunks``: chunks that reached the consumer.
+    - ``leaked_threads``: producer threads that failed to join within
+      the shutdown timeout (daemon threads, so the process still exits,
+      but a nonzero count means a stage callable is wedged — logged
+      with the pending site and asserted zero in tests).
 
     :meth:`finalize` writes the split into a ``Timings`` registry as
     ``<prefix>/stage`` (host-only), ``<prefix>/transfer``,
@@ -84,13 +92,15 @@ class PrefetchStats:
     staging was hidden behind compute.
     """
 
-    __slots__ = ("stage_s", "transfer_s", "wait_s", "chunks")
+    __slots__ = ("stage_s", "transfer_s", "wait_s", "chunks",
+                 "leaked_threads")
 
     def __init__(self) -> None:
         self.stage_s = 0.0
         self.transfer_s = 0.0
         self.wait_s = 0.0
         self.chunks = 0
+        self.leaked_threads = 0
 
     @contextlib.contextmanager
     def transfer(self):
@@ -226,6 +236,20 @@ class _Threaded:
 
     # -- consumer ------------------------------------------------------------
 
+    def _join_producer(self, where: str) -> None:
+        """Join the producer; a thread still alive past the timeout is a
+        wedged stage callable (hung device_put / IO).  It used to be
+        ignored silently — now it is counted (``PrefetchStats
+        .leaked_threads``, asserted zero in tests) and logged with the
+        pending site, so leaks surface instead of accumulating."""
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            self._stats.leaked_threads += 1
+            log.warning(
+                "prefetch producer thread failed to join within 5s at %s; "
+                "leaking daemon thread %r", where, self._thread.name,
+            )
+
     def __iter__(self):
         return self
 
@@ -242,7 +266,7 @@ class _Threaded:
         self._stats.wait_s += time.perf_counter() - t0
         if isinstance(out, _Sentinel):
             self._done = True
-            self._thread.join(timeout=5.0)
+            self._join_producer("__next__ (end-of-stream drain)")
             if out.err is not None:
                 raise out.err
             raise StopIteration
@@ -265,7 +289,7 @@ class _Threaded:
             if self._retire:
                 _delete_jax_arrays(self._prev)
             self._prev = None
-        self._thread.join(timeout=5.0)
+        self._join_producer("close() (cancel drain)")
         self._done = True
 
 
@@ -292,10 +316,20 @@ class Prefetcher:
         self.stats = PrefetchStats() if stats is None else stats
         self.depth = resolve_depth(depth)
         it = iter(items)
+        # every stage call is a fault-injection site ("prefetch.stage",
+        # utils/faults.py) — stageless pipelines included, so staging
+        # faults are drillable on identity passes like reservoir
+        # sampling; unarmed, maybe_fault is a dict miss
+        inner = stage
+
+        def staged(item):
+            maybe_fault("prefetch.stage")
+            return item if inner is None else inner(item)
+
         if self.depth == 1:
-            self._impl = _Serial(it, stage, self.stats, retire)
+            self._impl = _Serial(it, staged, self.stats, retire)
         else:
-            self._impl = _Threaded(it, stage, self.depth, self.stats, retire)
+            self._impl = _Threaded(it, staged, self.depth, self.stats, retire)
 
     def __iter__(self):
         return iter(self._impl)

@@ -87,12 +87,28 @@ class ChunkSource:
         CPU reference semantics assume host-RAM-resident data)."""
         return np.concatenate([c[:v] for c, v in self], axis=0)
 
+    def with_chunk_rows(self, chunk_rows: int) -> "ChunkSource":
+        """The same source re-chunked at a different width — the halved
+        -chunk rung of the resilience ladder rebuilds a fit's sources at
+        ``chunk_rows // 2`` after a device OOM (utils/resilience.py).
+        Row content and order are identical; only the block shape (and
+        therefore per-step device memory) changes."""
+        return ChunkSource(
+            self._make_iter, self.n_features, chunk_rows,
+            n_rows=self._n_rows, dtype=self.dtype,
+        )
+
     def __iter__(self) -> Iterator[Tuple[np.ndarray, int]]:
         """Yield (chunk (chunk_rows, d), n_valid) blocks; re-iterable."""
+        from oap_mllib_tpu.utils.faults import maybe_fault
+
         buf = np.zeros((self.chunk_rows, self.n_features), self.dtype)
         fill = 0
         total = 0
         for piece in self._make_iter():
+            # the host-I/O fault-injection site: one call per piece the
+            # underlying reader yields (utils/faults.py "stream.read")
+            maybe_fault("stream.read")
             piece = np.atleast_2d(np.asarray(piece, self.dtype))
             if piece.shape[1] != self.n_features:
                 raise ValueError(

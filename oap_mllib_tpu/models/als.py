@@ -401,8 +401,6 @@ class ALS:
         accelerated = should_accelerate(
             "ALS", guard_ok=not self.nonnegative, reason="nonnegative=True"
         )
-        timings = Timings()
-        cache_before = progcache.stats()
         if init is not None:
             x0, y0 = np.array(init[0], np.float32), np.array(init[1], np.float32)
         else:
@@ -412,25 +410,8 @@ class ALS:
             x0 = y0 = None
 
         if not accelerated:
-            if x0 is None:
-                x0 = als_np.init_factors(n_users, self.rank, self.seed)
-                y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
-            if self.nonnegative:
-                # the nonnegative contract must hold even at max_iter=0 or
-                # with a user-supplied signed init
-                x0, y0 = np.abs(x0), np.abs(y0)
-            with phase_timer(timings, "als_np"):
-                x, y = als_np.als_np(
-                    users, items, ratings, n_users, n_items, self.rank,
-                    self.max_iter, self.reg_param, self.alpha,
-                    self.implicit_prefs, self.seed, init=(x0, y0),
-                    nonnegative=self.nonnegative,
-                )
-            return ALSModel(
-                x, y,
-                {"timings": timings, "accelerated": False,
-                 "item_layout": "replicated",
-                 **self._block_summary(1)},
+            return self._fit_fallback_np(
+                users, items, ratings, n_users, n_items, x0, y0
             )
 
         # accelerated path (~ ALSDALImpl.train, ALSDALImpl.scala:58)
@@ -439,6 +420,7 @@ class ALS:
         from oap_mllib_tpu.parallel.mesh import get_mesh
 
         from oap_mllib_tpu.ops.als_block import als_item_layout_cfg
+        from oap_mllib_tpu.utils import resilience
 
         als_item_layout_cfg()  # typo'd layout raises on every path
         mesh = get_mesh()
@@ -456,16 +438,86 @@ class ALS:
             mp = mesh.shape[mesh.axis_names[1]] if len(mesh.axis_names) > 1 else 1
             mesh = get_mesh(n_devices=self.num_user_blocks * mp)
             world = mesh.shape[mesh.axis_names[0]]
+        # degradation ladder (utils/resilience.py): transient faults
+        # retry the fit; the single-device grouped path maps the OOM
+        # rung to the streamed (bounded-HBM) kernels; the final rung is
+        # the same NumPy path the static gate falls back to
+        stats = resilience.ResilienceStats()
+
+        def fallback():
+            return self._fit_fallback_np(
+                users, items, ratings, n_users, n_items, x0, y0
+            )
+
         if world > 1 or jax.process_count() > 1:
             # distributed 2-D block layout for BOTH modes: ratings shuffled
             # by user block, X block-sharded, Y replicated (~ the
             # reference's full cShuffleData + 4-step pipeline, survey §3.3;
             # round 1 left explicit ALS on the unsharded global program)
-            model = self._fit_block_parallel(
-                users, items, ratings, n_users, n_items, x0, y0, mesh, timings
+            def attempt(degraded):
+                timings = Timings()
+                cache_before = progcache.stats()
+                model = self._fit_block_parallel(
+                    users, items, ratings, n_users, n_items, x0, y0, mesh,
+                    timings,
+                )
+                model.summary["progcache"] = progcache.delta(cache_before)
+                return model
+
+            model = resilience.resilient_fit(
+                "ALS", attempt, fallback, stats=stats
             )
-            model.summary["progcache"] = progcache.delta(cache_before)
+            resilience.merge_stats(model.summary, stats)
             return model
+
+        def attempt(degraded):
+            return self._fit_single_device(
+                users, items, ratings, n_users, n_items, x0, y0, degraded
+            )
+
+        model = resilience.resilient_fit("ALS", attempt, fallback, stats=stats)
+        resilience.merge_stats(model.summary, stats)
+        return model
+
+    def _fit_fallback_np(self, users, items, ratings, n_users, n_items,
+                         x0, y0) -> ALSModel:
+        """The CPU/NumPy reference path — both the static fallback
+        (failed dispatch predicate) and the resilience ladder's final
+        rung reach the fit through here."""
+        timings = Timings()
+        if x0 is None:
+            x0 = als_np.init_factors(n_users, self.rank, self.seed)
+            y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
+        if self.nonnegative:
+            # the nonnegative contract must hold even at max_iter=0 or
+            # with a user-supplied signed init
+            x0, y0 = np.abs(x0), np.abs(y0)
+        with phase_timer(timings, "als_np"):
+            x, y = als_np.als_np(
+                users, items, ratings, n_users, n_items, self.rank,
+                self.max_iter, self.reg_param, self.alpha,
+                self.implicit_prefs, self.seed, init=(x0, y0),
+                nonnegative=self.nonnegative,
+            )
+        return ALSModel(
+            x, y,
+            {"timings": timings, "accelerated": False,
+             "item_layout": "replicated",
+             **self._block_summary(1)},
+        )
+
+    def _fit_single_device(self, users, items, ratings, n_users, n_items,
+                           x0, y0, degraded: bool = False) -> ALSModel:
+        """The single-device accelerated fit (grouped or COO layouts).
+        ``degraded`` is the ladder's OOM rung: the grouped path re-runs
+        through the streamed kernels (ops/als_stream.py) at halved
+        upload blocks — host-resident edges, O(chunk + factors +
+        moments) HBM — which is exactly the memory-shedding retry a
+        device OOM calls for; the COO path has no equivalent knob and
+        re-runs unchanged (a persistent OOM then falls through to the
+        NumPy rung)."""
+        timings = Timings()
+        cache_before = progcache.stats()
         if x0 is None:
             x0 = als_np.init_factors(n_users, self.rank, self.seed)
             y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
@@ -491,7 +543,10 @@ class ALS:
                 by_item = als_ops.build_grouped_edges(
                     items, users, ratings, n_items
                 )
-                dev = tuple(jnp.asarray(a) for a in (*by_user, *by_item))
+                if not degraded:
+                    # degraded keeps the layouts HOST-resident for the
+                    # streamed kernels instead of uploading both whole
+                    dev = tuple(jnp.asarray(a) for a in (*by_user, *by_item))
             else:
                 # COO nnz pads to a shape bucket (data/bucketing.py,
                 # anchored at the 2048 edge-chunk multiple): the COO
@@ -508,7 +563,15 @@ class ALS:
         from oap_mllib_tpu.utils.profiling import maybe_trace
 
         with phase_timer(timings, "als_iterations"), maybe_trace():
-            if grouped_ok:
+            if grouped_ok and degraded:
+                from oap_mllib_tpu.ops import als_stream
+
+                x, y = als_stream.als_run_streamed(
+                    by_user, by_item, x0, y0, n_users, n_items,
+                    self.max_iter, self.reg_param, self.alpha,
+                    self.implicit_prefs, timings=timings, degraded=True,
+                )
+            elif grouped_ok:
                 x, y = als_ops.als_run_grouped(
                     *dev, jnp.asarray(x0), jnp.asarray(y0),
                     n_users, n_items, self.max_iter, self.reg_param,
@@ -528,14 +591,16 @@ class ALS:
                 )
             x = np.asarray(x)
             y = np.asarray(y)
-        return ALSModel(
-            x, y,
-            {"timings": timings, "accelerated": True,
-             "als_kernel": "grouped" if grouped_ok else "coo",
-             "item_layout": "replicated",
-             "progcache": progcache.delta(cache_before),
-             **self._block_summary(1)},
-        )
+        summary = {
+            "timings": timings, "accelerated": True,
+            "als_kernel": "grouped" if grouped_ok else "coo",
+            "item_layout": "replicated",
+            "progcache": progcache.delta(cache_before),
+            **self._block_summary(1),
+        }
+        if degraded and grouped_ok:
+            summary["streamed"] = True  # the OOM rung ran the streamed kernels
+        return ALSModel(x, y, summary)
 
     @staticmethod
     def _validate_resolve(users, items, ratings, n_users, n_items):
@@ -601,19 +666,33 @@ class ALS:
         accumulator — the flat-moment trick is grouped-only)."""
         import jax
 
+        from oap_mllib_tpu.utils import resilience
+
         if source.n_features != 3:
             raise ValueError(
                 "ALS source must have width 3 (user, item, rating); "
                 f"got {source.n_features}"
             )
-        us, its, rs = [], [], []
-        for chunk, n_valid in source:
-            us.append(np.asarray(chunk[:n_valid, 0], np.int64))
-            its.append(np.asarray(chunk[:n_valid, 1], np.int64))
-            rs.append(np.asarray(chunk[:n_valid, 2], np.float32))
-        users = np.concatenate(us) if us else np.zeros((0,), np.int64)
-        items = np.concatenate(its) if its else np.zeros((0,), np.int64)
-        ratings = np.concatenate(rs) if rs else np.zeros((0,), np.float32)
+        stats = resilience.ResilienceStats()
+
+        def ingest():
+            us, its, rs = [], [], []
+            for chunk, n_valid in source:
+                us.append(np.asarray(chunk[:n_valid, 0], np.int64))
+                its.append(np.asarray(chunk[:n_valid, 1], np.int64))
+                rs.append(np.asarray(chunk[:n_valid, 2], np.float32))
+            return (
+                np.concatenate(us) if us else np.zeros((0,), np.int64),
+                np.concatenate(its) if its else np.zeros((0,), np.int64),
+                np.concatenate(rs) if rs else np.zeros((0,), np.float32),
+            )
+
+        # the ingestion pass sits BEFORE any fit ladder, so transient
+        # source faults (the stream.read site) get their own retry tier
+        # here; its counters merge into the same per-fit stats
+        users, items, ratings = resilience.run_with_retry(
+            ingest, stats=stats, site="ALS.ingest"
+        )
 
         accelerated = should_accelerate(
             "ALS", guard_ok=not self.nonnegative, reason="nonnegative=True"
@@ -650,10 +729,24 @@ class ALS:
             # out-of-core COMPOSED with the mesh: per-rank streamed
             # grouped accumulation inside the block layout
             # (ops/als_block_stream.py) — a multi-device world no longer
-            # silently falls back to fully-resident device layouts
-            return self._fit_source_block(
-                users, items, ratings, n_users, n_items, init, mesh
+            # silently falls back to fully-resident device layouts.
+            # Ladder: transient retries + the NumPy final rung (the
+            # block chunking has no halved-chunk knob; single-process
+            # worlds only — resilient_fit bypasses itself multi-process)
+            model = resilience.resilient_fit(
+                "ALS",
+                lambda degraded: self._fit_source_block(
+                    users, items, ratings, n_users, n_items, init, mesh
+                ),
+                lambda: self._fit_fallback_np(
+                    users, items, ratings, n_users, n_items,
+                    None if init is None else np.array(init[0], np.float32),
+                    None if init is None else np.array(init[1], np.float32),
+                ),
+                stats=stats,
             )
+            resilience.merge_stats(model.summary, stats)
+            return model
         if not _grouped_ok_single(kernel, users, items, n_users, n_items):
             # in-memory COO fallback (the guard re-runs inside fit — an
             # O(nnz) native bincount, cheap next to the fit itself)
@@ -664,36 +757,49 @@ class ALS:
 
         from oap_mllib_tpu.ops import als_stream
 
-        timings = Timings()
-        cache_before = progcache.stats()
         if init is not None:
             x0 = np.array(init[0], np.float32)
             y0 = np.array(init[1], np.float32)
         else:
             x0 = als_np.init_factors(n_users, self.rank, self.seed)
             y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
-        with phase_timer(timings, "table_convert"):
-            by_user = als_ops.build_grouped_edges(
-                users, items, ratings, n_users
-            )
-            by_item = als_ops.build_grouped_edges(
-                items, users, ratings, n_items
-            )
-        from oap_mllib_tpu.utils.profiling import maybe_trace
 
-        with phase_timer(timings, "als_iterations"), maybe_trace():
-            x, y = als_stream.als_run_streamed(
-                by_user, by_item, x0, y0, n_users, n_items,
-                self.max_iter, self.reg_param, self.alpha,
-                self.implicit_prefs, timings=timings,
+        def attempt(degraded):
+            timings = Timings()
+            cache_before = progcache.stats()
+            with phase_timer(timings, "table_convert"):
+                by_user = als_ops.build_grouped_edges(
+                    users, items, ratings, n_users
+                )
+                by_item = als_ops.build_grouped_edges(
+                    items, users, ratings, n_items
+                )
+            from oap_mllib_tpu.utils.profiling import maybe_trace
+
+            with phase_timer(timings, "als_iterations"), maybe_trace():
+                x, y = als_stream.als_run_streamed(
+                    by_user, by_item, x0, y0, n_users, n_items,
+                    self.max_iter, self.reg_param, self.alpha,
+                    self.implicit_prefs, timings=timings,
+                    degraded=degraded,
+                )
+            return ALSModel(
+                x, y,
+                {"timings": timings, "accelerated": True, "streamed": True,
+                 "als_kernel": "grouped", "item_layout": "replicated",
+                 "progcache": progcache.delta(cache_before),
+                 **self._block_summary(1)},
             )
-        return ALSModel(
-            x, y,
-            {"timings": timings, "accelerated": True, "streamed": True,
-             "als_kernel": "grouped", "item_layout": "replicated",
-             "progcache": progcache.delta(cache_before),
-             **self._block_summary(1)},
+
+        model = resilience.resilient_fit(
+            "ALS", attempt,
+            lambda: self._fit_fallback_np(
+                users, items, ratings, n_users, n_items, x0, y0
+            ),
+            stats=stats,
         )
+        resilience.merge_stats(model.summary, stats)
+        return model
 
     def _block_dispatch(self, users, items, n_users, n_items, world):
         """(item_sharded, use_grouped, sizes) — ONE decision point for

@@ -248,10 +248,26 @@ class KMeans:
             "KMeans", guard_ok, reason=f"distance_measure={self.distance_measure}"
         )
         if accelerated:
+            from oap_mllib_tpu.utils import resilience
             from oap_mllib_tpu.utils.profiling import maybe_trace
 
-            with maybe_trace():
-                return self._fit_tpu(x, sample_weight)
+            # degradation ladder (utils/resilience.py): transient faults
+            # retry the fit, a device OOM retries once with doubled Lloyd
+            # chunking (half the live distance buffer), and the final
+            # rung is the same CPU path the static gate falls back to
+            stats = resilience.ResilienceStats()
+
+            def attempt(degraded):
+                with maybe_trace():
+                    return self._fit_tpu(x, sample_weight, degraded)
+
+            model = resilience.resilient_fit(
+                "KMeans", attempt,
+                lambda: self._fit_fallback(x, sample_weight),
+                stats=stats,
+            )
+            resilience.merge_stats(model.summary, stats)
+            return model
         return self._fit_fallback(x, sample_weight)
 
     # -- streamed (out-of-core) path -----------------------------------------
@@ -305,13 +321,42 @@ class KMeans:
                 if sample_weight is not None else None
             )
             return self._fit_fallback(source.to_array(), w_arr)
+        from oap_mllib_tpu.utils import resilience
         from oap_mllib_tpu.utils.profiling import maybe_trace
         from oap_mllib_tpu.utils.timing import x64_scope
 
         cfg = get_config()
         dtype = np.float64 if cfg.enable_x64 else np.float32
-        with maybe_trace(), x64_scope(cfg.enable_x64):
-            return self._fit_stream_inner(source, sample_weight, dtype, cfg)
+        # degradation ladder: transient source/staging faults retry the
+        # fit, a device OOM re-chunks the source (and its lockstep weight
+        # source) at chunk_rows/2 for one degraded retry, then the CPU
+        # path (which materializes the source) is the final rung.  Multi
+        # -process worlds bypass the ladder — the fail-fast static-world
+        # contract (docs/distributed.md) — resilient_fit handles that.
+        stats = resilience.ResilienceStats()
+
+        def attempt(degraded):
+            src, w = source, sample_weight
+            if degraded:
+                half = max(1, source.chunk_rows // 2)
+                src = source.with_chunk_rows(half)
+                if w is not None:
+                    w = w.with_chunk_rows(half)
+            with maybe_trace(), x64_scope(cfg.enable_x64):
+                return self._fit_stream_inner(src, w, dtype, cfg)
+
+        def fallback():
+            w_arr = (
+                sample_weight.to_array().reshape(-1)
+                if sample_weight is not None else None
+            )
+            return self._fit_fallback(source.to_array(), w_arr)
+
+        model = resilience.resilient_fit(
+            "KMeans", attempt, fallback, stats=stats
+        )
+        resilience.merge_stats(model.summary, stats)
+        return model
 
     def _fit_stream_inner(self, source, sample_weight, dtype, cfg) -> KMeansModel:
         from oap_mllib_tpu.ops import stream_ops
@@ -350,15 +395,17 @@ class KMeans:
         return KMeansModel(np.asarray(centers), self.distance_measure, summary)
 
     # -- accelerated path (~ KMeansDALImpl.train, KMeansDALImpl.scala:35) ----
-    def _fit_tpu(self, x: np.ndarray, sample_weight: Optional[np.ndarray]) -> KMeansModel:
+    def _fit_tpu(self, x: np.ndarray, sample_weight: Optional[np.ndarray],
+                 degraded: bool = False) -> KMeansModel:
         from oap_mllib_tpu.utils.timing import x64_scope
 
         cfg = get_config()
         dtype = np.float64 if cfg.enable_x64 else np.float32
         with x64_scope(cfg.enable_x64):
-            return self._fit_tpu_inner(x, sample_weight, dtype)
+            return self._fit_tpu_inner(x, sample_weight, dtype, degraded)
 
-    def _fit_tpu_inner(self, x, sample_weight, dtype) -> KMeansModel:
+    def _fit_tpu_inner(self, x, sample_weight, dtype,
+                       degraded: bool = False) -> KMeansModel:
         cfg = get_config()
         timings = Timings()
         cache_before = progcache.stats()
@@ -399,7 +446,8 @@ class KMeans:
                 ).astype(dtype)
         with phase_timer(timings, "lloyd_loop"):
             centers, n_iter, cost, counts = self._run_lloyd(
-                table, weights, centers0, dtype, cfg, mesh, timings
+                table, weights, centers0, dtype, cfg, mesh, timings,
+                degraded=degraded,
             )
             centers = np.asarray(centers)[:, :d_orig]
             n_iter = int(n_iter)
@@ -412,7 +460,7 @@ class KMeans:
         return KMeansModel(centers, self.distance_measure, summary)
 
     def _run_lloyd(self, table, weights, centers0, dtype, cfg, mesh,
-                   timings=None):
+                   timings=None, degraded=False):
         """Dispatch the hot loop to the configured kernel.
 
         ``auto`` picks the fastest measured path for the shape/tier
@@ -436,6 +484,12 @@ class KMeans:
             cfg.kmeans_kernel, table.data.shape[1], self.k,
             cfg.matmul_precision, dtype,
         )
+        if degraded:
+            # the halved-chunk rung after a device OOM: route off the
+            # fused Pallas kernel (whole-table VMEM residency is exactly
+            # what OOMed) onto the chunked XLA Lloyd at doubled chunk
+            # count — half the live distance buffer per step
+            use_pallas = False
         if mesh.shape[cfg.model_axis] > 1 and cfg.kmeans_kernel != "xla":
             return kmeans_ops.lloyd_run_model_sharded(
                 table.data,
@@ -475,6 +529,10 @@ class KMeans:
             if single_device
             else 1
         )
+        if degraded and single_device:
+            # auto_row_chunks returns a chunk COUNT — doubling it halves
+            # the rows (and the live (chunk, k) buffer) per scan step
+            row_chunks = min(row_chunks * 2, max(table.n_padded, 1))
         return kmeans_ops.lloyd_run(
             table.data,
             weights,

@@ -160,10 +160,24 @@ class PCA:
             raise ValueError(f"k={self.k} exceeds n_features={d}")
         guard_ok = d < MAX_PCA_FEATURES
         if should_accelerate("PCA", guard_ok, reason=f"n_features={d}"):
+            from oap_mllib_tpu.utils import resilience
             from oap_mllib_tpu.utils.profiling import maybe_trace
 
-            with maybe_trace():
-                return self._fit_tpu(x)
+            # degradation ladder: transient faults retry; the in-memory
+            # covariance has no chunk knob, so the OOM rung re-runs the
+            # same program once (a persistent OOM then falls through to
+            # the CPU path — the rung that actually sheds memory here)
+            stats = resilience.ResilienceStats()
+
+            def attempt(degraded):
+                with maybe_trace():
+                    return self._fit_tpu(x)
+
+            model = resilience.resilient_fit(
+                "PCA", attempt, lambda: self._fit_fallback(x), stats=stats
+            )
+            resilience.merge_stats(model.summary, stats)
+            return model
         return self._fit_fallback(x)
 
     # -- streamed (out-of-core) path -----------------------------------------
@@ -190,13 +204,33 @@ class PCA:
                     "path or fit in-memory"
                 )
             return self._fit_fallback(source.to_array())
+        from oap_mllib_tpu.utils import resilience
         from oap_mllib_tpu.utils.profiling import maybe_trace
         from oap_mllib_tpu.utils.timing import x64_scope
 
         cfg = get_config()
         dtype = np.float64 if cfg.enable_x64 else np.float32
-        with maybe_trace(), x64_scope(cfg.enable_x64):
-            return self._fit_stream_inner(source, dtype, cfg)
+        # degradation ladder: transient source/staging faults retry the
+        # two-pass covariance, a device OOM re-chunks the source at
+        # chunk_rows/2 for one degraded retry, then the CPU path (which
+        # materializes the source) — single-process only (resilient_fit)
+        stats = resilience.ResilienceStats()
+
+        def attempt(degraded):
+            src = (
+                source.with_chunk_rows(max(1, source.chunk_rows // 2))
+                if degraded else source
+            )
+            with maybe_trace(), x64_scope(cfg.enable_x64):
+                return self._fit_stream_inner(src, dtype, cfg)
+
+        model = resilience.resilient_fit(
+            "PCA", attempt,
+            lambda: self._fit_fallback(source.to_array()),
+            stats=stats,
+        )
+        resilience.merge_stats(model.summary, stats)
+        return model
 
     def _fit_stream_inner(self, source, dtype, cfg) -> PCAModel:
         from oap_mllib_tpu.ops import stream_ops

@@ -196,6 +196,7 @@ def als_run_streamed(
     alpha: float,
     implicit: bool,
     timings=None,
+    degraded: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Full streamed ALS loop (both feedback modes), host-driven.
 
@@ -207,17 +208,26 @@ def als_run_streamed(
     ``als_iterations/``).  Same alternating math as als_run_grouped.
     Chunk padding is hoisted here, ONCE per side — padding inside the
     half-update would re-copy the whole (possibly multi-GB) host layout
-    every iteration."""
+    every iteration.  ``degraded`` is the resilience ladder's halved
+    -chunk rung (utils/resilience.py): upload blocks shrink to half the
+    budgeted group count, halving the per-step live HBM after a device
+    OOM — the math is chunk-size-invariant (segment-sums only reorder
+    additions)."""
+    from oap_mllib_tpu.utils.resilience import check_finite
+
     r = np.asarray(x0).shape[1]
     gc_u = groups_per_chunk(by_user[0].shape[1], r)
     gc_i = groups_per_chunk(by_item[0].shape[1], r)
+    if degraded:
+        gc_u = max(1, gc_u // 2)
+        gc_i = max(1, gc_i // 2)
     by_user = _pad_group_rows(by_user, gc_u, n_users)
     by_item = _pad_group_rows(by_item, gc_i, n_items)
     x = jnp.asarray(np.asarray(x0, np.float32))
     y = jnp.asarray(np.asarray(y0, np.float32))
     stats = PrefetchStats()
     t0 = time.perf_counter()
-    for _ in range(max_iter):
+    for it in range(max_iter):
         x = _half_update_streamed(
             by_user, y, n_users, gc_u, reg, alpha, implicit, stats=stats,
             timings=timings,
@@ -226,6 +236,11 @@ def als_run_streamed(
             by_item, x, n_items, gc_i, reg, alpha, implicit, stats=stats,
             timings=timings,
         )
+        # iterate-level guardrail (Config.nonfinite_policy): a singular
+        # normal-equation solve yields NaN factors that contaminate every
+        # later half-iteration — detect at the iteration that produced it
+        check_finite(x, f"ALS user factors (streamed iteration {it + 1})")
+        check_finite(y, f"ALS item factors (streamed iteration {it + 1})")
     jax.block_until_ready((x, y))
     stats.finalize(timings, "als_iterations", time.perf_counter() - t0)
     return np.asarray(x), np.asarray(y)

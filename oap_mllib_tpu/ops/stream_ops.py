@@ -355,6 +355,8 @@ def lloyd_run_streamed(
     ``lloyd_loop/``."""
     if weights is not None and not validated:
         _checked_entry(lambda: _check_weight_source(source, weights))
+    from oap_mllib_tpu.utils.resilience import check_finite
+
     centers = jnp.asarray(np.asarray(init_centers, dtype))
     tol_sq = float(tol) ** 2
     n_iter = 0
@@ -365,6 +367,10 @@ def lloyd_run_streamed(
         )
         centers, max_moved = _center_update(centers, sums, counts)
         n_iter += 1
+        # iterate-level guardrail (Config.nonfinite_policy): a NaN/Inf
+        # centroid poisons every later pass silently — catch it at the
+        # iteration that produced it, while the cause is still nearby
+        check_finite(centers, f"K-Means centroids (streamed pass {n_iter})")
         if float(max_moved) <= tol_sq:
             break
     _, counts, cost = streamed_accumulate(
@@ -665,6 +671,11 @@ def covariance_streamed(
             n += n_valid
     stats.finalize(timings, "covariance_streamed", time.perf_counter() - t0)
     total, n_arr = _psum_host([total, np.asarray([n], np.int64)], guard=guard)
+    from oap_mllib_tpu.utils.resilience import check_finite
+
+    # per-pass guardrails (Config.nonfinite_policy): an overflowed f32
+    # column sum or Gram silently yields Inf/NaN eigenvectors passes later
+    check_finite(total, "PCA column sums (streamed mean pass)")
     n = int(n_arr[0])
     if n < 1:
         raise ValueError("empty source")
@@ -682,6 +693,7 @@ def covariance_streamed(
                 gram = _gram_chunk(gram, cj, wj, mean, precision)
     stats.finalize(timings, "covariance_streamed", time.perf_counter() - t0)
     (gram,) = _psum_host([gram], guard=guard)
+    check_finite(gram, "PCA Gram accumulator (streamed Gram pass)")
     cov = gram.astype(np.float64 if dtype == np.float64 else np.float32)
     cov = cov / max(n - 1.0, 1.0)
     cov = 0.5 * (cov + cov.T)

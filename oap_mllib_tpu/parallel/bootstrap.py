@@ -22,7 +22,9 @@ same behavior as the reference's 1-rank world (Utils.scala:119-121).
 from __future__ import annotations
 
 import logging
+import os
 import socket
+import time
 from typing import Optional
 
 from oap_mllib_tpu.config import get_config
@@ -60,6 +62,11 @@ def free_port(ip: str = "", start: int = 3000) -> int:
     for p in range(start, 65536):
         s = socket.socket()
         try:
+            # SO_REUSEADDR: without it a just-closed coordinator port
+            # lingers in TIME_WAIT and the probe skips a port the real
+            # bind (which sets the option) could take — tests restarting
+            # worlds back-to-back then drift to ever-higher ports
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             s.bind((ip or "", p))
             return p
         except OSError:
@@ -103,21 +110,65 @@ def initialize_distributed(
         elif process_id == 0:
             coordinator_address = default_coordinator()
         else:
+            # name the env values actually seen: a misconfigured world
+            # (typo'd var, value exported on the wrong host) fails with
+            # the evidence instead of a generic instruction
             raise ValueError(
                 "non-zero process_id requires a coordinator address "
-                "(set OAP_MLLIB_TPU_COORDINATOR_ADDRESS / _PORT)"
+                "(set OAP_MLLIB_TPU_COORDINATOR_ADDRESS / _PORT); saw "
+                "OAP_MLLIB_TPU_COORDINATOR_ADDRESS="
+                f"{os.environ.get('OAP_MLLIB_TPU_COORDINATOR_ADDRESS')!r}, "
+                "OAP_MLLIB_TPU_COORDINATOR_PORT="
+                f"{os.environ.get('OAP_MLLIB_TPU_COORDINATOR_PORT')!r}, "
+                f"config.coordinator_address={cfg.coordinator_address!r}, "
+                f"process_id={process_id}, num_processes={num_processes}"
             )
 
     import jax
+
+    from oap_mllib_tpu.utils import faults, resilience
 
     log.info(
         "joining world: coordinator=%s size=%d rank=%d",
         coordinator_address, num_processes, process_id,
     )
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    # Coordinator connection retries with backoff under Config
+    # .bootstrap_timeout: ranks routinely come up before the coordinator
+    # (process 0 may still be importing jax), and the reference's KVS
+    # connect blocks/retries the same way (OneCCL.cpp:47-86).  Only
+    # TRANSIENT faults (connection refused / Unavailable / injected
+    # "bootstrap.connect" faults) retry; anything else propagates.
+    timeout_s = max(float(cfg.bootstrap_timeout), 0.0)
+    policy = resilience.RetryPolicy.from_config()
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            faults.maybe_fault("bootstrap.connect")
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            break
+        except Exception as e:
+            elapsed = time.monotonic() - t0
+            kind = resilience.classify_fault(e)
+            delay = policy.delay_s(attempt, "bootstrap.connect")
+            if kind != resilience.TRANSIENT or elapsed + delay > timeout_s:
+                raise RuntimeError(
+                    f"failed to join world: coordinator="
+                    f"{coordinator_address} rank={process_id}/"
+                    f"{num_processes} after {elapsed:.1f}s "
+                    f"({attempt} connection retries, bootstrap_timeout="
+                    f"{timeout_s:g}s): {e}"
+                ) from e
+            attempt += 1
+            log.warning(
+                "bootstrap connect to %s failed (%s); retry %d in %.2fs "
+                "(%.1fs of %gs budget elapsed)",
+                coordinator_address, e, attempt, delay, elapsed, timeout_s,
+            )
+            time.sleep(delay)
     _initialized = True
     return True

@@ -84,3 +84,22 @@ def should_accelerate(algo: str, guard_ok: bool, reason: str = "") -> bool:
         )
     log.info("%s: falling back to CPU reference path (%s)", algo, why)
     return False
+
+
+def allow_fallback(algo: str, why: str) -> bool:
+    """The DYNAMIC half of the fallback contract: may a fit that already
+    passed :func:`should_accelerate` but then faulted at runtime degrade
+    to the CPU reference path?
+
+    ``should_accelerate`` is the static gate (decided once, up front);
+    this is its runtime twin, consulted by the resilience ladder
+    (utils/resilience.resilient_fit) as its final rung after transient
+    retries and the halved-chunk OOM rung are exhausted.  Same knob
+    (``Config.fallback``), same logging shape — so the escalation is
+    visible in logs exactly like a static fallback, just with the fault
+    that caused it."""
+    cfg = get_config()
+    if not cfg.fallback:
+        return False
+    log.warning("%s: degrading to CPU reference path (%s)", algo, why)
+    return True

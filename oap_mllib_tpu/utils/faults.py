@@ -1,0 +1,212 @@
+"""Deterministic fault-injection registry: every retry tier testable
+without real hardware faults.
+
+The resilience layer (utils/resilience.py) only earns its keep if every
+rung — transient retry, the halved-chunk OOM rung, the CPU fallback —
+can be driven in CI.  Real faults (a flaky disk, a device OOM mid-fit, a
+coordinator that is not up yet) are not reproducible on demand, so this
+module plants named *sites* at the runtime's fragile edges and arms them
+from config:
+
+====================  =====================================================
+site                  fires at
+====================  =====================================================
+``stream.read``       every piece pulled from a ``ChunkSource`` iterator
+                      (data/stream.py) — host I/O faults
+``prefetch.stage``    every stage call of the prefetch pipeline
+                      (data/prefetch.py), i.e. in the producer thread at
+                      depth >= 2 — staging/transfer faults
+``bootstrap.connect`` each coordinator-connection attempt in
+                      ``initialize_distributed`` (parallel/bootstrap.py)
+``fit.execute``       every jitted-program launch that goes through
+                      ``progcache.launch`` (utils/progcache.py) — the
+                      jitted-fit chokepoint, where a device OOM surfaces
+====================  =====================================================
+
+Arming: ``Config.fault_spec`` / env ``OAP_MLLIB_TPU_FAULT_SPEC``, a
+comma-separated list of ``site:kind=count`` entries::
+
+    stream.read:fail=2,prefetch.stage:fail=1   # first 2 reads + first
+                                               # stage call raise transient
+    fit.execute:oom=*                          # EVERY launch raises OOM
+                                               # (persistent fault)
+
+Kinds: ``fail`` = transient (classified TRANSIENT — the retry tier),
+``oom`` = device memory exhaustion (classified OOM — the halved-chunk
+rung), ``err`` = permanent (classified as no fault — propagates raw).
+``count`` is a positive int (the first N calls raise) or ``*``
+(persistent).  The registry is deterministic: same spec + same call
+sequence = same faults, so gates can assert exact retry counters
+(dev/fault_gate.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from oap_mllib_tpu.config import get_config
+
+SITES = ("stream.read", "prefetch.stage", "bootstrap.connect", "fit.execute")
+
+KIND_FAIL = "fail"
+KIND_OOM = "oom"
+KIND_ERR = "err"
+_KINDS = (KIND_FAIL, KIND_OOM, KIND_ERR)
+
+
+class FaultInjected(Exception):
+    """Marker base for injected faults (classify_fault checks it first,
+    so injected faults never depend on message parsing)."""
+
+    kind = KIND_ERR
+
+
+class InjectedTransientError(FaultInjected, OSError):
+    """Injected transient fault (an ``OSError`` — the host-I/O shape the
+    classifier treats as retryable even without the marker)."""
+
+    kind = KIND_FAIL
+
+
+class InjectedOOMError(FaultInjected, MemoryError):
+    """Injected device-OOM fault; the message carries the XLA
+    ``RESOURCE_EXHAUSTED`` phrase the classifier keys on for real ones."""
+
+    kind = KIND_OOM
+
+
+class InjectedPermanentError(FaultInjected, RuntimeError):
+    """Injected permanent fault — NOT classified as transient/OOM; the
+    ladder must re-raise it unchanged."""
+
+    kind = KIND_ERR
+
+
+def _make_fault(kind: str, site: str, nth: int) -> FaultInjected:
+    if kind == KIND_OOM:
+        return InjectedOOMError(
+            f"RESOURCE_EXHAUSTED: injected device OOM at {site} (call {nth})"
+        )
+    if kind == KIND_FAIL:
+        return InjectedTransientError(
+            f"injected transient fault at {site} (call {nth})"
+        )
+    return InjectedPermanentError(
+        f"injected permanent fault at {site} (call {nth})"
+    )
+
+
+class _SiteState:
+    __slots__ = ("kind", "limit", "calls", "fired")
+
+    def __init__(self, kind: str, limit: int):
+        self.kind = kind
+        self.limit = limit  # -1 = persistent
+        self.calls = 0
+        self.fired = 0
+
+
+def parse_spec(spec: str) -> Dict[str, _SiteState]:
+    """Parse the fault-spec grammar; raises ValueError naming the valid
+    sites/kinds on any malformed entry (a typo'd spec must fail loudly,
+    not silently inject nothing)."""
+    out: Dict[str, _SiteState] = {}
+    for entry in spec.replace(";", ",").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        try:
+            site, action = entry.split(":", 1)
+            kind, count = action.split("=", 1)
+        except ValueError:
+            raise ValueError(
+                f"malformed fault_spec entry {entry!r} — expected "
+                "'site:kind=count' (e.g. 'stream.read:fail=2')"
+            ) from None
+        site, kind, count = site.strip(), kind.strip(), count.strip()
+        if site not in SITES:
+            raise ValueError(
+                f"unknown fault site {site!r}; valid sites: {', '.join(SITES)}"
+            )
+        if kind not in _KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r}; valid kinds: {', '.join(_KINDS)}"
+            )
+        if count == "*":
+            limit = -1
+        else:
+            try:
+                limit = int(count)
+            except ValueError:
+                raise ValueError(
+                    f"fault count must be an int or '*', got {count!r}"
+                ) from None
+            if limit < 0:
+                raise ValueError(f"fault count must be >= 0, got {limit}")
+        out[site] = _SiteState(kind, limit)
+    return out
+
+
+class FaultRegistry:
+    """Process-wide armed-site table.  ``maybe_fault`` re-arms lazily
+    whenever ``Config.fault_spec`` changes, so tests and services drive
+    injection purely through config/env."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._spec: Optional[str] = None
+        self._sites: Dict[str, _SiteState] = {}
+
+    def arm(self, spec: str) -> None:
+        sites = parse_spec(spec)  # validate before swapping state
+        with self._lock:
+            self._spec = spec
+            self._sites = sites
+
+    def maybe_fault(self, site: str) -> None:
+        spec = get_config().fault_spec
+        if spec != self._spec:  # unlocked read: a racing double-arm is
+            self.arm(spec)  # idempotent (same spec, fresh counters)
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None:
+                return
+            st.calls += 1
+            if st.limit == -1 or st.fired < st.limit:
+                st.fired += 1
+                raise _make_fault(st.kind, site, st.fired)
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-armed-site counters: calls seen, faults fired, the limit."""
+        with self._lock:
+            return {
+                s: {"calls": st.calls, "fired": st.fired, "limit": st.limit,
+                    "kind": st.kind}
+                for s, st in self._sites.items()
+            }
+
+    def reset(self) -> None:
+        """Re-arm the current spec with fresh counters (gates run the
+        same injection sequence twice and need call counts to restart)."""
+        with self._lock:
+            spec = self._spec
+        if spec is not None:
+            self.arm(spec)
+
+
+_REGISTRY = FaultRegistry()
+
+
+def maybe_fault(site: str) -> None:
+    """Raise the armed fault for ``site`` if its budget remains; no-op
+    when the site is unarmed.  Call at every site the spec names."""
+    _REGISTRY.maybe_fault(site)
+
+
+def stats() -> Dict[str, Dict[str, int]]:
+    return _REGISTRY.stats()
+
+
+def reset() -> None:
+    _REGISTRY.reset()

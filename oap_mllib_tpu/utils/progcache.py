@@ -178,6 +178,14 @@ def launch(algo: str, key: tuple, timings=None, phase: Optional[str] = None,
     async per-chunk dispatch walls would be noise (the real device time
     is already recorded as the prefetch pipeline's ``compute`` split),
     so hits only count."""
+    # the jitted-fit launch chokepoint doubles as the ``fit.execute``
+    # fault-injection site (utils/faults.py): an armed device-OOM fault
+    # raises here, BEFORE the launch is noted, exactly where a real XLA
+    # RESOURCE_EXHAUSTED would surface — so the resilience ladder's
+    # halved-chunk rung is testable without real hardware pressure
+    from oap_mllib_tpu.utils.faults import maybe_fault
+
+    maybe_fault("fit.execute")
     miss = _CACHE.note(algo, key)
     t0 = time.perf_counter()
     try:

@@ -1,0 +1,349 @@
+"""Fault classification, retry/backoff, and the degradation ladder.
+
+The reference earns its "drop-in" claim by never surfacing accelerator
+failures to user code: a failed platform gate silently falls back to
+vanilla MLlib (Utils.scala:98-115).  ``utils/dispatch.should_accelerate``
+replicates the *static* half of that contract — one decision, up front.
+This module adds the dynamic half: any fault AFTER that point (a
+transient chunk-read error, a device OOM mid-fit, a coordinator that is
+not up yet) is classified, retried with backoff, degraded gracefully,
+counted, and — via utils/faults.py — injectable in tests.
+
+The ladder, per accelerated fit (single-process; see below)::
+
+    accelerated fit
+      │ transient fault (I/O error, Unavailable, connection refused)
+      ├──> retry the attempt under RetryPolicy (exponential backoff +
+      │    deterministic jitter, bounded by retries AND deadline)
+      │ device OOM (XLA RESOURCE_EXHAUSTED / MemoryError)
+      ├──> ONE degraded retry: halved chunks (streamed sources re-chunk
+      │    at chunk_rows/2; in-memory K-Means doubles its Lloyd chunk
+      │    count; streamed ALS halves its upload blocks)
+      │ still failing / retries exhausted / non-finite iterate under
+      │ nonfinite_policy="fallback"
+      └──> the CPU/NumPy fallback path when Config.fallback is True;
+           otherwise ResilienceError carrying the full fault history.
+
+Non-faults (ValueError, TypeError, API misuse) are never retried or
+masked — they propagate unchanged from the first attempt.
+
+**Multi-process worlds bypass the ladder entirely** (the static-world
+contract, docs/distributed.md): a rank-local retry would desync the
+collective schedule and strand peers, so faults there keep the
+fail-fast-together semantics of ``_PassGuard`` and recovery stays
+restart-level.
+
+Per-fit :class:`ResilienceStats` (retries, degradations, faults seen,
+history) merge into the fit summaries next to the ``progcache`` delta.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+import zlib
+from typing import Callable, List, Optional
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.utils.faults import FaultInjected
+
+log = logging.getLogger("oap_mllib_tpu")
+
+# fault kinds (classify_fault return values)
+TRANSIENT = "transient"
+OOM = "oom"
+NONFINITE = "nonfinite"
+
+# message markers for faults that only identify themselves textually
+# (jaxlib's XlaRuntimeError carries gRPC/XLA status names in the string)
+_OOM_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "allocation failure",
+    "failed to allocate",
+)
+_TRANSIENT_MARKERS = (
+    "unavailable",
+    "connection refused",
+    "connection reset",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "temporarily unavailable",
+    "broken pipe",
+    "socket closed",
+)
+
+
+class NonFiniteError(FloatingPointError):
+    """NaN/Inf detected in a training iterate (K-Means centroids, ALS
+    factors, the PCA Gram accumulator) by a streamed-path guardrail."""
+
+
+class ResilienceError(RuntimeError):
+    """A fit exhausted the degradation ladder with fallback disabled.
+    ``history`` is the recorded fault sequence (site/kind/message)."""
+
+    def __init__(self, algo: str, history: List[str]):
+        self.history = list(history)
+        trail = "; ".join(history) if history else "no faults recorded"
+        super().__init__(
+            f"{algo}: accelerated fit failed after exhausting the "
+            f"degradation ladder and fallback is disabled — fault "
+            f"history: {trail}"
+        )
+
+
+def classify_fault(exc: BaseException) -> Optional[str]:
+    """Classify an exception into a fault kind, or None for non-faults.
+
+    - Injected faults (utils/faults.py) carry their kind explicitly.
+    - :class:`NonFiniteError` -> NONFINITE (guardrail detections).
+    - ``MemoryError`` or XLA ``RESOURCE_EXHAUSTED``/OOM messages -> OOM.
+    - ``ConnectionError``/``OSError`` (host I/O, refused sockets) and
+      Unavailable/DeadlineExceeded-style messages -> TRANSIENT.
+    - Everything else -> None (a programming error or bad input; the
+      ladder must re-raise it unchanged, never mask it).
+    """
+    if isinstance(exc, FaultInjected):
+        from oap_mllib_tpu.utils import faults
+
+        return {
+            faults.KIND_FAIL: TRANSIENT,
+            faults.KIND_OOM: OOM,
+        }.get(exc.kind)
+    if isinstance(exc, NonFiniteError):
+        return NONFINITE
+    msg = str(exc).lower()
+    if isinstance(exc, MemoryError) or any(m in msg for m in _OOM_MARKERS):
+        return OOM
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return TRANSIENT
+    if isinstance(exc, OSError):
+        return TRANSIENT
+    if any(m in msg for m in _TRANSIENT_MARKERS):
+        return TRANSIENT
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with deterministic jitter and a deadline.
+
+    ``max_retries`` bounds the retry COUNT; ``deadline_s`` bounds the
+    retry WALL (a fit that keeps failing slowly must not retry past its
+    budget even with retries left).  Jitter is deterministic — a hash of
+    (site, attempt) — so retry schedules are reproducible in tests while
+    still de-synchronizing many concurrent fits retrying the same
+    shared resource.
+    """
+
+    max_retries: int = 5
+    backoff_s: float = 0.05
+    multiplier: float = 2.0
+    max_backoff_s: float = 2.0
+    deadline_s: float = 30.0
+    jitter: float = 0.1
+
+    @classmethod
+    def from_config(cls) -> "RetryPolicy":
+        cfg = get_config()
+        return cls(
+            max_retries=max(int(cfg.retry_limit), 0),
+            backoff_s=max(float(cfg.retry_backoff), 0.0),
+            deadline_s=max(float(cfg.retry_deadline), 0.0),
+        )
+
+    def delay_s(self, attempt: int, site: str = "") -> float:
+        """Backoff before retry ``attempt`` (0-based), jittered."""
+        base = min(
+            self.backoff_s * (self.multiplier ** attempt), self.max_backoff_s
+        )
+        frac = zlib.crc32(f"{site}:{attempt}".encode()) / 0xFFFFFFFF
+        return base * (1.0 + self.jitter * frac)
+
+
+class ResilienceStats:
+    """Per-fit fault accounting, merged into fit summaries next to the
+    ``progcache`` delta (see :func:`merge_stats`)."""
+
+    __slots__ = ("retries", "degradations", "faults", "backoff_s", "history")
+
+    def __init__(self) -> None:
+        self.retries = 0  # transient retries taken
+        self.degradations = 0  # ladder rungs stepped (halved-chunk, fallback)
+        self.faults = 0  # faults observed (classified exceptions)
+        self.backoff_s = 0.0  # total wall slept in backoff
+        self.history: List[str] = []  # "<site>[<kind>]: <message>" entries
+
+    def record(self, site: str, kind: Optional[str], exc: BaseException) -> None:
+        self.faults += 1
+        self.history.append(f"{site}[{kind or 'unclassified'}]: {exc}")
+
+    def as_dict(self) -> dict:
+        return {
+            "retries": self.retries,
+            "degradations": self.degradations,
+            "faults": self.faults,
+            "backoff_s": self.backoff_s,
+            "history": list(self.history),
+        }
+
+
+def merge_stats(summary, stats: ResilienceStats) -> None:
+    """Attach a fit's resilience counters to its summary — dict summaries
+    (PCA/ALS) get a ``"resilience"`` key, object summaries (KMeansSummary)
+    a ``.resilience`` attribute; both sit next to the ``progcache`` delta."""
+    if summary is None:
+        return
+    if isinstance(summary, dict):
+        summary["resilience"] = stats.as_dict()
+    else:
+        summary.resilience = stats.as_dict()
+
+
+def nonfinite_policy_cfg() -> str:
+    """Validated ``Config.nonfinite_policy`` — a typo must raise, not
+    silently behave like either valid value (the als_kernel contract)."""
+    policy = get_config().nonfinite_policy
+    if policy not in ("raise", "fallback"):
+        raise ValueError(
+            f"nonfinite_policy must be raise|fallback, got {policy!r}"
+        )
+    return policy
+
+
+def check_finite(value, what: str) -> None:
+    """Iterate-level numerical guardrail: raise :class:`NonFiniteError`
+    if ``value`` contains NaN/Inf.  Works on np and jax arrays (one
+    device->host bool sync for the latter); the ladder (or the caller's
+    configured ``nonfinite_policy``) decides raise-vs-fallback."""
+    import numpy as np
+
+    nonfinite_policy_cfg()  # fail fast on a typo'd policy
+    if bool(np.all(np.isfinite(np.asarray(value)))):
+        return
+    raise NonFiniteError(
+        f"non-finite values detected in {what} "
+        "(nonfinite_policy governs whether this raises or degrades "
+        "to the CPU fallback path)"
+    )
+
+
+def _world() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def run_with_retry(
+    fn: Callable[[], object],
+    *,
+    policy: Optional[RetryPolicy] = None,
+    stats: Optional[ResilienceStats] = None,
+    site: str = "",
+):
+    """Run ``fn`` retrying TRANSIENT faults under ``policy``; any other
+    exception propagates immediately.  The single-tier helper for edges
+    that sit outside a fit ladder (source ingestion, port probes);
+    multi-process worlds run ``fn`` once (static-world contract)."""
+    policy = policy or RetryPolicy.from_config()
+    stats = stats or ResilienceStats()
+    if _world() > 1:
+        return fn()
+    deadline = time.monotonic() + policy.deadline_s
+    while True:
+        try:
+            return fn()
+        except Exception as e:
+            kind = classify_fault(e)
+            stats.record(site, kind, e)
+            delay = policy.delay_s(stats.retries, site)
+            if (
+                kind != TRANSIENT
+                or stats.retries >= policy.max_retries
+                or time.monotonic() + delay > deadline
+            ):
+                raise
+            stats.retries += 1
+            stats.backoff_s += delay
+            log.warning(
+                "%s: transient fault (%s); retry %d/%d in %.2fs",
+                site or "retry", e, stats.retries, policy.max_retries, delay,
+            )
+            time.sleep(delay)
+
+
+def resilient_fit(
+    algo: str,
+    attempt: Callable[[bool], object],
+    fallback: Optional[Callable[[], object]],
+    *,
+    stats: Optional[ResilienceStats] = None,
+    policy: Optional[RetryPolicy] = None,
+):
+    """Run an accelerated fit under the full degradation ladder.
+
+    ``attempt(degraded)`` runs the accelerated fit; ``degraded=True`` is
+    the halved-chunk rung (estimators map it to their chunk knob; paths
+    without one run the same program again — a persistent fault then
+    falls through to the next rung).  ``fallback()`` is the CPU/NumPy
+    path, consulted only when ``Config.fallback`` is True (via
+    ``dispatch.allow_fallback``, the same gate the static predicate
+    uses).  Multi-process worlds run ``attempt(False)`` once — the
+    ladder is a single-process facility (module docstring).
+
+    Fault routing: TRANSIENT retries under ``policy`` (count + deadline
+    bounded); the first OOM steps to the degraded rung (transient
+    retries still available there); NONFINITE honors
+    ``Config.nonfinite_policy`` (``raise`` propagates immediately,
+    ``fallback`` escalates straight to the CPU rung); unclassified
+    exceptions propagate unchanged.  Exhausted ladders raise
+    :class:`ResilienceError` with the recorded history when fallback is
+    unavailable.
+    """
+    stats = stats or ResilienceStats()
+    if _world() > 1:
+        return attempt(False)
+    policy = policy or RetryPolicy.from_config()
+    deadline = time.monotonic() + policy.deadline_s
+    degraded = False
+    while True:
+        try:
+            return attempt(degraded)
+        except Exception as e:
+            kind = classify_fault(e)
+            if kind is None:
+                raise  # not a fault: API misuse/bugs are never masked
+            site = f"{algo}.fit" + (".degraded" if degraded else "")
+            stats.record(site, kind, e)
+            if kind == TRANSIENT and stats.retries < policy.max_retries:
+                delay = policy.delay_s(stats.retries, site)
+                if time.monotonic() + delay <= deadline:
+                    stats.retries += 1
+                    stats.backoff_s += delay
+                    log.warning(
+                        "%s: transient fault (%s); retry %d/%d in %.2fs",
+                        site, e, stats.retries, policy.max_retries, delay,
+                    )
+                    time.sleep(delay)
+                    continue
+            if kind == OOM and not degraded:
+                degraded = True
+                stats.degradations += 1
+                log.warning(
+                    "%s: device OOM (%s); retrying once with halved chunks",
+                    site, e,
+                )
+                continue
+            if kind == NONFINITE and nonfinite_policy_cfg() == "raise":
+                raise
+            # final rung: the CPU/NumPy reference path
+            from oap_mllib_tpu.utils.dispatch import allow_fallback
+
+            why = f"{kind} fault: {e}"
+            if fallback is not None and allow_fallback(algo, why):
+                stats.degradations += 1
+                return fallback()
+            raise ResilienceError(algo, stats.history) from e

@@ -53,16 +53,17 @@ class TestConfigCoverage:
     def test_env_override_every_field(self, monkeypatch):
         """OAP_MLLIB_TPU_<FIELD> overrides each field with the right
         type coercion."""
-        samples = {bool: "true", int: "7", str: "xyz"}
+        types = {"bool": bool, "int": int, "float": float, "str": str}
+        samples = {bool: "true", int: "7", float: "2.5", str: "xyz"}
         for f in dataclasses.fields(Config):
-            t = {"bool": bool, "int": int, "str": str}.get(str(f.type), str)
+            t = types.get(str(f.type), str)
             monkeypatch.setenv(
                 "OAP_MLLIB_TPU_" + f.name.upper(), samples[t]
             )
         cfg = Config.from_env()
         for f in dataclasses.fields(Config):
-            t = {"bool": bool, "int": int, "str": str}.get(str(f.type), str)
-            expected = {bool: True, int: 7, str: "xyz"}[t]
+            t = types.get(str(f.type), str)
+            expected = {bool: True, int: 7, float: 2.5, str: "xyz"}[t]
             assert getattr(cfg, f.name) == expected, f.name
 
     def test_seed_default_flows_to_estimators(self):
@@ -129,6 +130,45 @@ class TestConfigCoverage:
         assert bucket_factor("x2") == 2.0
         assert bucket_factor("off") is None
         assert bucket_factor("1.5") == 1.5
+
+    def test_fault_spec_typo_raises(self):
+        """A typo'd fault_spec must raise naming the valid sites — a spec
+        that silently injects nothing defeats the point of fault gates
+        (the kmeans_kernel/als_kernel/shape_bucketing contract)."""
+        from oap_mllib_tpu.utils import faults
+
+        set_config(fault_spec="stream.reed:fail=2")
+        with pytest.raises(ValueError, match="stream.read"):
+            faults.maybe_fault("stream.read")
+        set_config(fault_spec="stream.read:boom=2")
+        with pytest.raises(ValueError, match="kind"):
+            faults.maybe_fault("stream.read")
+        set_config(fault_spec="garbage")
+        with pytest.raises(ValueError, match="site:kind=count"):
+            faults.maybe_fault("stream.read")
+
+    def test_nonfinite_policy_typo_raises_at_fit(self, rng):
+        """The same contract for nonfinite_policy: a typo raises at the
+        first streamed guardrail, not silently behaving like 'raise'."""
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        set_config(nonfinite_policy="bogus")
+        x = rng.normal(size=(128, 4)).astype(np.float32)
+        src = ChunkSource.from_array(x, chunk_rows=64)
+        with pytest.raises(ValueError, match="nonfinite_policy"):
+            KMeans(k=2, init_mode="random", max_iter=1).fit(src)
+
+    def test_retry_knobs_reach_policy(self):
+        """retry_limit / retry_backoff / retry_deadline flow into
+        RetryPolicy.from_config with float coercion intact."""
+        from oap_mllib_tpu.utils.resilience import RetryPolicy
+
+        set_config(retry_limit=2, retry_backoff=0.25, retry_deadline=9.0)
+        p = RetryPolicy.from_config()
+        assert p.max_retries == 2
+        assert p.backoff_s == 0.25
+        assert p.deadline_s == 9.0
 
     def test_compilation_cache_dir_wires_jax_config(self, tmp_path):
         """Config.compilation_cache_dir reaches jax's persistent cache

@@ -251,6 +251,67 @@ class TestErrorsAndShutdown:
             assert list(pf) == [0, 1, 2, 3]
         assert not pf._impl._thread.is_alive()
 
+    def test_no_leaked_threads_on_clean_paths(self):
+        """``PrefetchStats.leaked_threads`` counts producer threads that
+        failed to join at shutdown — it must be zero on every clean
+        path: exhaustion, early close, and consumer exception."""
+        stats = PrefetchStats()
+        with Prefetcher(range(8), depth=2, stats=stats) as pf:
+            list(pf)
+        assert stats.leaked_threads == 0
+
+        stats = PrefetchStats()
+        pf = Prefetcher(range(50), depth=3, stats=stats)
+        next(iter(pf))
+        pf.close()
+        assert stats.leaked_threads == 0
+
+        stats = PrefetchStats()
+        with pytest.raises(KeyError):
+            with Prefetcher(range(50), depth=2, stats=stats) as pf:
+                for _ in pf:
+                    raise KeyError("consumer bug")
+        assert stats.leaked_threads == 0
+
+    def test_wedged_producer_counts_as_leaked(self):
+        """A stage callable that never returns must be COUNTED (and the
+        daemon thread abandoned), not silently ignored — the satellite
+        contract.  The wedged thread holds no queue slot the consumer
+        needs, so close() returns promptly with leaked_threads == 1."""
+        release = threading.Event()
+
+        def wedge(item):
+            if item == 1:
+                release.wait(timeout=30.0)  # far past the 5 s join budget
+            return item
+
+        stats = PrefetchStats()
+        pf = Prefetcher(range(4), stage=wedge, depth=2, stats=stats)
+        it = iter(pf)
+        assert next(it) == 0  # item 1 is now staging (wedged) in producer
+        t0 = time.perf_counter()
+        pf.close()
+        release.set()  # let the thread die after the verdict
+        assert stats.leaked_threads == 1
+        assert time.perf_counter() - t0 < 20.0  # close() did not hang
+
+    def test_streamed_fit_leaks_no_threads(self, rng):
+        """The estimator surface: a streamed fit's summary reports zero
+        leaked prefetch threads (counter wired end to end)."""
+        from oap_mllib_tpu import KMeans
+
+        x = rng.normal(size=(400, 5)).astype(np.float32)
+        src = ChunkSource.from_array(x, chunk_rows=128)
+        m = KMeans(k=3, max_iter=3, seed=0).fit(src)
+        assert m.summary.accelerated
+        import threading as _threading
+
+        leftover = [
+            t for t in _threading.enumerate()
+            if t.name.startswith("oap-mllib-tpu-prefetch") and t.is_alive()
+        ]
+        assert leftover == []
+
 
 @pytest.mark.slow
 class TestWallClock:

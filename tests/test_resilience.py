@@ -1,0 +1,523 @@
+"""Resilience subsystem tests (utils/resilience.py, utils/faults.py).
+
+Covers the tentpole contracts: the transient-error classifier, the
+deterministic RetryPolicy, fault-registry determinism, each rung of the
+degradation ladder (transient retry -> halved-chunk OOM retry -> CPU
+fallback -> ResilienceError with history), the streamed numerical
+guardrails, the bootstrap hardening, and fallback-vs-accelerated result
+parity under ``device=cpu``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.data.stream import ChunkSource
+from oap_mllib_tpu.utils import faults, resilience
+from oap_mllib_tpu.utils.resilience import (
+    NONFINITE,
+    OOM,
+    TRANSIENT,
+    NonFiniteError,
+    ResilienceError,
+    ResilienceStats,
+    RetryPolicy,
+    classify_fault,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries():
+    """Keep injected-fault tests snappy: near-zero backoff (the schedule
+    logic is exercised either way), and a re-armed registry per test."""
+    set_config(retry_backoff=0.001, retry_deadline=10.0)
+    yield
+    set_config(fault_spec="")
+    faults.reset()
+
+
+def _blobs(rng, n=600, d=6):
+    proto = rng.normal(size=(3, d)).astype(np.float32) * 4.0
+    return (proto[rng.integers(3, size=n)]
+            + rng.normal(size=(n, d)).astype(np.float32) * 0.2)
+
+
+class TestClassifier:
+    def test_os_and_connection_errors_are_transient(self):
+        assert classify_fault(OSError("disk hiccup")) == TRANSIENT
+        assert classify_fault(ConnectionRefusedError("nope")) == TRANSIENT
+        assert classify_fault(TimeoutError("slow")) == TRANSIENT
+        assert classify_fault(RuntimeError("UNAVAILABLE: backend")) == TRANSIENT
+
+    def test_oom_shapes(self):
+        # the jaxlib XlaRuntimeError carries its status in the message —
+        # the classifier must key on RESOURCE_EXHAUSTED textually
+        assert classify_fault(
+            RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating")
+        ) == OOM
+        assert classify_fault(MemoryError("host")) == OOM
+        assert classify_fault(
+            RuntimeError("failed to allocate 16.00G")
+        ) == OOM
+
+    def test_non_faults_are_none(self):
+        assert classify_fault(ValueError("bad k")) is None
+        assert classify_fault(TypeError("wrong arg")) is None
+        assert classify_fault(KeyError("x")) is None
+
+    def test_injected_faults_carry_their_kind(self):
+        assert classify_fault(
+            faults.InjectedTransientError("x")) == TRANSIENT
+        assert classify_fault(faults.InjectedOOMError("x")) == OOM
+        assert classify_fault(faults.InjectedPermanentError("x")) is None
+
+    def test_nonfinite(self):
+        assert classify_fault(NonFiniteError("NaN centroids")) == NONFINITE
+
+
+class TestRetryPolicy:
+    def test_backoff_grows_and_caps(self):
+        p = RetryPolicy(backoff_s=0.1, multiplier=2.0, max_backoff_s=0.5,
+                        jitter=0.0)
+        delays = [p.delay_s(i) for i in range(5)]
+        assert delays[0] == pytest.approx(0.1)
+        assert delays[1] == pytest.approx(0.2)
+        assert delays[2] == pytest.approx(0.4)
+        assert delays[3] == pytest.approx(0.5)  # capped
+        assert delays == sorted(delays)
+
+    def test_jitter_is_deterministic_and_site_dependent(self):
+        p = RetryPolicy(backoff_s=0.1, jitter=0.5)
+        a = p.delay_s(1, "stream.read")
+        assert a == p.delay_s(1, "stream.read")  # reproducible
+        assert a != p.delay_s(1, "fit.execute")  # de-synchronized
+        base = RetryPolicy(backoff_s=0.1, jitter=0.0).delay_s(1)
+        assert base <= a <= base * 1.5
+
+    def test_run_with_retry_counts_and_gives_up(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        stats = ResilienceStats()
+        out = resilience.run_with_retry(
+            flaky, policy=RetryPolicy(backoff_s=0.001), stats=stats,
+            site="t",
+        )
+        assert out == "ok" and stats.retries == 2 and stats.faults == 2
+
+        stats = ResilienceStats()
+        with pytest.raises(OSError):
+            resilience.run_with_retry(
+                lambda: (_ for _ in ()).throw(OSError("always")),
+                policy=RetryPolicy(max_retries=2, backoff_s=0.001),
+                stats=stats, site="t",
+            )
+        assert stats.retries == 2  # exhausted, then re-raised
+
+    def test_run_with_retry_never_retries_non_faults(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("API misuse")
+
+        with pytest.raises(ValueError):
+            resilience.run_with_retry(bad, site="t")
+        assert len(calls) == 1
+
+    def test_deadline_bounds_wall(self):
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            resilience.run_with_retry(
+                lambda: (_ for _ in ()).throw(OSError("always")),
+                policy=RetryPolicy(
+                    max_retries=100, backoff_s=0.2, deadline_s=0.3
+                ),
+                site="t",
+            )
+        assert time.monotonic() - t0 < 2.0
+
+
+class TestFaultRegistry:
+    def test_grammar_and_determinism(self):
+        set_config(fault_spec="stream.read:fail=2")
+        fired = []
+        for i in range(5):
+            try:
+                faults.maybe_fault("stream.read")
+                fired.append(False)
+            except faults.InjectedTransientError:
+                fired.append(True)
+        # exactly the FIRST TWO calls fault — deterministic by call index
+        assert fired == [True, True, False, False, False]
+        st = faults.stats()["stream.read"]
+        assert st["fired"] == 2 and st["calls"] == 5 and st["limit"] == 2
+
+    def test_reset_restarts_counters(self):
+        set_config(fault_spec="prefetch.stage:fail=1")
+        with pytest.raises(faults.InjectedTransientError):
+            faults.maybe_fault("prefetch.stage")
+        faults.maybe_fault("prefetch.stage")  # budget spent
+        faults.reset()
+        with pytest.raises(faults.InjectedTransientError):
+            faults.maybe_fault("prefetch.stage")  # budget restored
+
+    def test_unarmed_sites_never_fire(self):
+        set_config(fault_spec="stream.read:fail=99")
+        faults.maybe_fault("fit.execute")
+        faults.maybe_fault("prefetch.stage")
+
+    def test_persistent_and_oom_kinds(self):
+        set_config(fault_spec="fit.execute:oom=*")
+        for _ in range(3):
+            with pytest.raises(faults.InjectedOOMError, match="RESOURCE"):
+                faults.maybe_fault("fit.execute")
+
+    def test_spec_change_rearms(self):
+        set_config(fault_spec="stream.read:fail=1")
+        with pytest.raises(faults.InjectedTransientError):
+            faults.maybe_fault("stream.read")
+        set_config(fault_spec="")
+        faults.maybe_fault("stream.read")  # disarmed by config change
+
+
+class TestLadderRungs:
+    """Each rung driven end to end through a real streamed K-Means fit."""
+
+    def _fit(self, rng, **kw):
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = _blobs(rng)
+        src = ChunkSource.from_array(x, chunk_rows=128)
+        return KMeans(k=3, seed=7, max_iter=8, **kw).fit(src)
+
+    def test_transient_faults_absorbed_with_parity(self, rng):
+        baseline = self._fit(rng)
+        set_config(fault_spec="stream.read:fail=2,prefetch.stage:fail=1")
+        faults.reset()
+        m = self._fit(np.random.default_rng(42))
+        res = m.summary.resilience
+        assert res["retries"] == 3 and res["faults"] == 3
+        assert res["degradations"] == 0
+        assert m.summary.accelerated
+        np.testing.assert_allclose(
+            m.cluster_centers_, baseline.cluster_centers_, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            m.summary.training_cost, baseline.summary.training_cost,
+            rtol=1e-6,
+        )
+
+    def test_oom_steps_to_halved_chunks_then_succeeds(self, rng):
+        baseline = self._fit(rng)
+        # exactly one OOM: the degraded (halved-chunk) retry completes
+        set_config(fault_spec="fit.execute:oom=1")
+        faults.reset()
+        m = self._fit(np.random.default_rng(42))
+        res = m.summary.resilience
+        assert res["degradations"] == 1 and res["retries"] == 0
+        assert m.summary.accelerated  # the DEGRADED rung, not fallback
+        # halved chunks only re-block the passes; results match
+        np.testing.assert_allclose(
+            m.summary.training_cost, baseline.summary.training_cost,
+            rtol=1e-5,
+        )
+
+    def test_persistent_oom_escalates_to_fallback(self, rng):
+        set_config(fault_spec="fit.execute:oom=*", fallback=True)
+        faults.reset()
+        m = self._fit(rng)  # no user-visible exception
+        assert not m.summary.accelerated  # CPU reference path ran
+        res = m.summary.resilience
+        assert res["degradations"] == 2  # halved-chunk rung + CPU rung
+        assert len(res["history"]) == 2
+
+    def test_fallback_disabled_raises_with_history(self, rng):
+        set_config(fault_spec="fit.execute:oom=*", fallback=False)
+        faults.reset()
+        with pytest.raises(ResilienceError, match="fault history"):
+            self._fit(rng)
+
+    def test_permanent_injected_fault_propagates_unmasked(self, rng):
+        set_config(fault_spec="stream.read:err=1")
+        faults.reset()
+        with pytest.raises(faults.InjectedPermanentError):
+            self._fit(rng)
+
+    def test_streamed_pca_absorbs_transients(self, rng):
+        from oap_mllib_tpu.models.pca import PCA
+
+        x = _blobs(rng)
+        baseline = PCA(k=2).fit(ChunkSource.from_array(x, chunk_rows=128))
+        set_config(fault_spec="stream.read:fail=1,prefetch.stage:fail=1")
+        faults.reset()
+        m = PCA(k=2).fit(ChunkSource.from_array(x, chunk_rows=128))
+        assert m.summary["resilience"]["retries"] == 2
+        np.testing.assert_allclose(
+            m.explained_variance_, baseline.explained_variance_, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.abs(m.components_), np.abs(baseline.components_), atol=1e-6
+        )
+
+    def test_streamed_als_absorbs_transients(self, rng):
+        from oap_mllib_tpu.models.als import ALS
+
+        u = rng.integers(30, size=400).astype(np.float64)
+        i = rng.integers(20, size=400).astype(np.float64)
+        r = rng.random(400)
+        tri = np.stack([u, i, r], axis=1)
+
+        def fit():
+            return ALS(rank=3, max_iter=2, seed=3).fit(
+                ChunkSource.from_array(tri, chunk_rows=128)
+            )
+
+        baseline = fit()
+        set_config(fault_spec="stream.read:fail=2,prefetch.stage:fail=1")
+        faults.reset()
+        m = fit()
+        assert m.summary["resilience"]["retries"] == 3
+        assert m.summary["accelerated"]
+        np.testing.assert_allclose(
+            m.user_factors_, baseline.user_factors_, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            m.item_factors_, baseline.item_factors_, atol=1e-6
+        )
+
+    def test_als_degraded_rung_matches(self, rng):
+        """One OOM routes the ALS fit to the streamed kernels at halved
+        blocks; factors must match the clean grouped fit (chunked
+        segment-sums only reorder additions)."""
+        from oap_mllib_tpu.models.als import ALS
+
+        u = rng.integers(30, size=400)
+        i = rng.integers(20, size=400)
+        r = rng.random(400).astype(np.float32)
+        baseline = ALS(rank=3, max_iter=2, seed=3).fit(u, i, r)
+        set_config(fault_spec="fit.execute:oom=1")
+        faults.reset()
+        m = ALS(rank=3, max_iter=2, seed=3).fit(u, i, r)
+        assert m.summary["resilience"]["degradations"] == 1
+        assert m.summary["accelerated"]
+        np.testing.assert_allclose(
+            m.user_factors_, baseline.user_factors_, atol=2e-5, rtol=2e-5
+        )
+
+
+class TestNumericalGuardrails:
+    def test_kmeans_nan_data_raises_by_default(self, rng):
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = _blobs(rng, n=256)
+        x[7, 2] = np.nan
+        src = ChunkSource.from_array(x, chunk_rows=64)
+        with pytest.raises(NonFiniteError, match="centroids"):
+            KMeans(k=3, seed=1, max_iter=3, init_mode="random").fit(src)
+
+    def test_pca_overflow_gram_detected(self, rng):
+        """f32 Gram overflow (x ~ 3e19 squares past f32 max) must trip
+        the Gram-pass guardrail, not silently produce Inf components."""
+        from oap_mllib_tpu.models.pca import PCA
+
+        x = (rng.normal(size=(256, 4)) * 3e19).astype(np.float32)
+        src = ChunkSource.from_array(x, chunk_rows=64)
+        with pytest.raises(NonFiniteError, match="Gram"):
+            PCA(k=2).fit(src)
+
+    def test_pca_overflow_falls_back_when_configured(self, rng):
+        """nonfinite_policy="fallback": the same overflow degrades to the
+        f64 NumPy path, which handles the magnitudes fine."""
+        from oap_mllib_tpu.models.pca import PCA
+
+        set_config(nonfinite_policy="fallback")
+        x = (rng.normal(size=(256, 4)) * 3e19).astype(np.float32)
+        src = ChunkSource.from_array(x, chunk_rows=64)
+        m = PCA(k=2).fit(src)
+        assert not m.summary["accelerated"]
+        assert np.all(np.isfinite(m.components_))
+        assert m.summary["resilience"]["degradations"] == 1
+
+    def test_nonfinite_raise_beats_fallback_config(self, rng):
+        """policy="raise" surfaces the NonFiniteError even when
+        Config.fallback would allow degrading — masking NaNs behind a
+        CPU rerun is exactly what the knob exists to prevent."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        set_config(nonfinite_policy="raise", fallback=True)
+        x = _blobs(rng, n=256)
+        x[3, 0] = np.inf
+        src = ChunkSource.from_array(x, chunk_rows=64)
+        with pytest.raises(NonFiniteError):
+            KMeans(k=3, seed=1, max_iter=3, init_mode="random").fit(src)
+
+
+class TestBootstrapHardening:
+    def test_nonzero_rank_error_names_env_seen(self, monkeypatch):
+        from oap_mllib_tpu.parallel import bootstrap
+
+        monkeypatch.delenv(
+            "OAP_MLLIB_TPU_COORDINATOR_ADDRESS", raising=False
+        )
+        set_config(num_processes=2, process_id=1, coordinator_address="")
+        with pytest.raises(ValueError) as ei:
+            bootstrap.initialize_distributed()
+        msg = str(ei.value)
+        assert "OAP_MLLIB_TPU_COORDINATOR_ADDRESS=None" in msg
+        assert "process_id=1" in msg and "num_processes=2" in msg
+
+    def test_connect_retries_under_budget(self, monkeypatch):
+        """bootstrap.connect transient faults retry with backoff; the
+        stubbed initialize then succeeds on the third attempt."""
+        import jax
+
+        from oap_mllib_tpu.parallel import bootstrap
+
+        calls = []
+        monkeypatch.setattr(
+            jax.distributed, "initialize",
+            lambda **kw: calls.append(kw),
+        )
+        monkeypatch.setattr(bootstrap, "_initialized", False)
+        set_config(
+            fault_spec="bootstrap.connect:fail=2", bootstrap_timeout=30.0
+        )
+        faults.reset()
+        assert bootstrap.initialize_distributed(
+            "127.0.0.1:9999", num_processes=2, process_id=0
+        )
+        assert len(calls) == 1  # two faulted attempts never reached jax
+        monkeypatch.setattr(bootstrap, "_initialized", False)
+
+    def test_connect_timeout_names_coordinator_rank_elapsed(
+        self, monkeypatch
+    ):
+        from oap_mllib_tpu.parallel import bootstrap
+
+        monkeypatch.setattr(bootstrap, "_initialized", False)
+        set_config(
+            fault_spec="bootstrap.connect:fail=*", bootstrap_timeout=0.05
+        )
+        faults.reset()
+        with pytest.raises(RuntimeError) as ei:
+            bootstrap.initialize_distributed(
+                "10.9.9.9:321", num_processes=4, process_id=2
+            )
+        msg = str(ei.value)
+        assert "10.9.9.9:321" in msg
+        assert "rank=2/4" in msg
+        assert "bootstrap_timeout" in msg
+
+    def test_free_port_returns_bindable_port(self):
+        import socket
+
+        from oap_mllib_tpu.parallel.bootstrap import free_port
+
+        p = free_port("127.0.0.1", 23000)
+        assert p >= 23000
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind(("127.0.0.1", p))
+        finally:
+            s.close()
+
+
+class TestFallbackParity:
+    """device=cpu forces the NumPy reference path; its results must
+    agree with the accelerated (XLA-on-CPU) path on small fixtures —
+    the contract that makes the ladder's final rung a safe landing."""
+
+    def test_kmeans_cost_parity(self, rng):
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = _blobs(rng)
+        acc = KMeans(k=3, seed=7, max_iter=25).fit(x)
+        assert acc.summary.accelerated
+        set_config(device="cpu")
+        fb = KMeans(k=3, seed=7, max_iter=25).fit(x)
+        assert not fb.summary.accelerated
+        # different init RNG streams, same well-separated optimum
+        np.testing.assert_allclose(
+            fb.summary.training_cost, acc.summary.training_cost, rtol=1e-3
+        )
+
+    def test_pca_parity(self, rng):
+        from oap_mllib_tpu.models.pca import PCA
+
+        x = rng.normal(size=(400, 8)).astype(np.float32) @ np.diag(
+            [5, 4, 3, 2, 1, 0.5, 0.2, 0.1]
+        ).astype(np.float32)
+        acc = PCA(k=3).fit(x)
+        assert acc.summary["accelerated"]
+        set_config(device="cpu")
+        fb = PCA(k=3).fit(x)
+        assert not fb.summary["accelerated"]
+        np.testing.assert_allclose(
+            fb.explained_variance_, acc.explained_variance_, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.abs(fb.components_), np.abs(acc.components_), atol=1e-3
+        )
+
+    def test_als_factor_parity_with_shared_init(self, rng):
+        from oap_mllib_tpu.fallback import als_np
+        from oap_mllib_tpu.models.als import ALS
+
+        nu, ni, rank = 25, 18, 3
+        u = rng.integers(nu, size=500)
+        i = rng.integers(ni, size=500)
+        u[0], i[0] = nu - 1, ni - 1
+        r = rng.random(500).astype(np.float32) * 4 + 1
+        init = (
+            als_np.init_factors(nu, rank, 3),
+            als_np.init_factors(ni, rank, 4),
+        )
+        acc = ALS(rank=rank, max_iter=3, seed=3).fit(u, i, r, init=init)
+        assert acc.summary["accelerated"]
+        set_config(device="cpu")
+        fb = ALS(rank=rank, max_iter=3, seed=3).fit(u, i, r, init=init)
+        assert not fb.summary["accelerated"]
+        np.testing.assert_allclose(
+            fb.user_factors_, acc.user_factors_, atol=2e-3, rtol=2e-3
+        )
+        np.testing.assert_allclose(
+            fb.item_factors_, acc.item_factors_, atol=2e-3, rtol=2e-3
+        )
+
+
+class TestStatsSurface:
+    def test_summaries_carry_resilience_next_to_progcache(self, rng):
+        """Every accelerated fit summary reports the resilience counters
+        beside the progcache delta — the observability contract."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+        from oap_mllib_tpu.models.pca import PCA
+
+        x = _blobs(rng, n=300)
+        km = KMeans(k=3, seed=1, max_iter=3).fit(x)
+        assert hasattr(km.summary, "progcache")
+        assert km.summary.resilience["faults"] == 0
+        pc = PCA(k=2).fit(x)
+        assert "progcache" in pc.summary and "resilience" in pc.summary
+
+    def test_merge_stats_handles_both_summary_shapes(self):
+        stats = ResilienceStats()
+        stats.retries = 2
+        d = {}
+        resilience.merge_stats(d, stats)
+        assert d["resilience"]["retries"] == 2
+
+        class S:
+            pass
+
+        s = S()
+        resilience.merge_stats(s, stats)
+        assert s.resilience["retries"] == 2
