@@ -801,6 +801,11 @@ def bench_streamed(rows: int, d: int = 256, k: int = 1000,
                           getattr(m.summary, "progcache", None)),
         **_resilience_extras(m.summary),
     )
+    # span-tree view of the same fit (telemetry/export.report): per-phase
+    # walls, overlap, compile split — the human cross-check of the JSON
+    from oap_mllib_tpu import telemetry
+
+    print(telemetry.report(m.summary), flush=True)
 
     t0 = time.perf_counter()
     p = PCA(k=16).fit(src)
@@ -819,6 +824,7 @@ def bench_streamed(rows: int, d: int = 256, k: int = 1000,
                           p.summary.get("progcache")),
         **_resilience_extras(p.summary),
     )
+    print(telemetry.report(p.summary), flush=True)
 
 
 # ---------------------------------------------------------------------------

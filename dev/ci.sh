@@ -73,6 +73,11 @@ echo "   with exact retry counters + 1e-6 parity; persistent OOM escalates"
 echo "   accelerated -> halved-chunk -> CPU fallback (dev/fault_gate.py) =="
 python dev/fault_gate.py
 
+echo "== telemetry gate: JSONL sink parses line-by-line, span trees match the"
+echo "   expected shape per estimator, collective op counters fire on the"
+echo "   pseudo-mesh ALS fit, resilience counters zero (dev/telemetry_gate.py) =="
+python dev/telemetry_gate.py
+
 echo "== compiled-mode TPU suite (skipped unless a TPU backend is present) =="
 if python -c "import jax, sys; sys.exit(0 if jax.default_backend() == 'tpu' else 1)" 2>/dev/null; then
   python -m pytest tests_tpu/ -q

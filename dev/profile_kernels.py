@@ -248,6 +248,12 @@ def _print_progcache_stats() -> None:
     }}))
     for algo, c in sorted(s["by_algo"].items()):
         print(f"# progcache {algo}: hits={c['hits']} misses={c['misses']}")
+    # process-wide telemetry digest (XLA compiles, collective/stream
+    # totals) — the registry view of the same sweep
+    from oap_mllib_tpu import telemetry
+
+    print()
+    print(telemetry.report())
 
 
 if __name__ == "__main__":

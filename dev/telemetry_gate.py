@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""CI gate: the telemetry layer must tell the truth.
+
+Runs one fit per estimator surface on the 8-device CPU pseudo-cluster —
+K-Means in-memory, K-Means streamed, PCA in-memory, ALS (block-parallel
+on the pseudo-mesh) — with the JSONL sink armed and fallback disabled
+(the accelerated path must actually run), then asserts:
+
+- every JSONL line parses, and each fit's span records reproduce
+  exactly the span tree attached to that fit's summary (paths AND
+  durations);
+- span trees have the expected shape per estimator (kmeans.fit ->
+  table_convert/init_centers/lloyd_loop, streamed lloyd_loop ->
+  stage/transfer/compute/stream_wall, pca.fit -> covariance + a solver
+  phase, als.fit -> table_convert + als_iterations);
+- required metrics are present and consistent: XLA compiles were
+  counted (the monitoring-event ground truth), the streamed fit moved
+  its rows through the prefetch counters, the pseudo-mesh ALS fit drove
+  the collective facade (nonzero op count), and the resilience counters
+  are zero on this fault-free run — in the registry AND in each fit's
+  summary.
+
+Exit 1 with the offending evidence on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+failures = []
+
+
+def check(ok: bool, what: str) -> None:
+    if not ok:
+        failures.append(what)
+        print(f"FAIL: {what}")
+
+
+def span_index(tree: dict, prefix: str = "") -> dict:
+    """{path: node} over a summary's span tree."""
+    path = prefix + tree["name"]
+    out = {path: tree}
+    for c in tree.get("children", []):
+        out.update(span_index(c, path + "/"))
+    return out
+
+
+def read_new_lines(path: str, offset: int):
+    with open(path) as f:
+        text = f.read()
+    lines = [ln for ln in text.splitlines()[offset:] if ln]
+    records = []
+    for i, ln in enumerate(lines):
+        try:
+            records.append(json.loads(ln))
+        except json.JSONDecodeError as e:
+            check(False, f"JSONL line {offset + i} does not parse: {e}")
+    return records, offset + len(lines)
+
+
+def get_summary_field(summary, key):
+    return summary.get(key) if isinstance(summary, dict) else getattr(
+        summary, key, None
+    )
+
+
+def verify_fit(name, summary, records, expect_children, expect_sub=()):
+    tele = get_summary_field(summary, "telemetry")
+    check(tele is not None, f"{name}: summary exposes no telemetry")
+    if tele is None:
+        return
+    tree = tele["spans"]
+    check(tree["name"] == name, f"{name}: root span is {tree['name']!r}")
+    idx = span_index(tree)
+    for child in expect_children:
+        check(
+            f"{name}/{child}" in idx,
+            f"{name}: missing expected phase span {child!r} "
+            f"(has {sorted(idx)})",
+        )
+    for sub in expect_sub:
+        check(
+            f"{name}/{sub}" in idx,
+            f"{name}: missing expected streamed sub-span {sub!r}",
+        )
+    # the JSONL batch for this fit must reproduce the summary tree
+    span_recs = {
+        r["path"]: r for r in records
+        if r["type"] == "span" and r["fit"] == name
+    }
+    check(
+        set(span_recs) == set(idx),
+        f"{name}: JSONL span paths != summary span paths "
+        f"(jsonl-only: {sorted(set(span_recs) - set(idx))}, "
+        f"summary-only: {sorted(set(idx) - set(span_recs))})",
+    )
+    for path, rec in span_recs.items():
+        if path in idx:
+            check(
+                abs(rec["duration_s"] - idx[path]["duration_s"]) < 1e-9,
+                f"{name}: {path} duration differs between JSONL and summary",
+            )
+    metrics_recs = [
+        r for r in records if r["type"] == "metrics" and r.get("fit") == name
+    ]
+    check(
+        len(metrics_recs) == 1,
+        f"{name}: expected exactly one metrics record in the fit batch, "
+        f"got {len(metrics_recs)}",
+    )
+    # fault-free run: resilience counters must be zero in the summary
+    res = get_summary_field(summary, "resilience")
+    if res is not None:
+        check(
+            res["faults"] == 0 and res["retries"] == 0
+            and res["degradations"] == 0,
+            f"{name}: nonzero resilience counters on a fault-free run: {res}",
+        )
+    return metrics_recs[0]["metrics"] if metrics_recs else None
+
+
+def series_total(snap, metric):
+    return sum(
+        (v["sum"] if isinstance(v, dict) else v)
+        for v in snap.get(metric, {}).values()
+    )
+
+
+def main() -> int:
+    from oap_mllib_tpu import ALS, KMeans, PCA, set_config, telemetry
+    from oap_mllib_tpu.data.stream import ChunkSource
+
+    sink = os.path.join(
+        tempfile.mkdtemp(prefix="oap-telemetry-gate-"), "telemetry.jsonl"
+    )
+    set_config(fallback=False, telemetry_log=sink)
+    rng = np.random.default_rng(0)
+    offset = 0
+
+    # -- K-Means in-memory ---------------------------------------------------
+    x = rng.normal(size=(512, 8)).astype(np.float32)
+    m = KMeans(k=4, max_iter=4, seed=0).fit(x)
+    records, offset = read_new_lines(sink, offset)
+    verify_fit(
+        "kmeans.fit", m.summary, records,
+        ("table_convert", "init_centers", "lloyd_loop"),
+    )
+
+    # -- K-Means streamed ----------------------------------------------------
+    src = ChunkSource.from_array(x, chunk_rows=128)
+    ms = KMeans(k=4, max_iter=4, seed=0).fit(src)
+    records, offset = read_new_lines(sink, offset)
+    snap = verify_fit(
+        "kmeans.fit", ms.summary, records,
+        ("init_centers", "lloyd_loop"),
+        expect_sub=(
+            "lloyd_loop/stage", "lloyd_loop/transfer",
+            "lloyd_loop/compute", "lloyd_loop/stream_wall",
+        ),
+    )
+    if snap is not None:
+        check(
+            series_total(snap, "oap_prefetch_chunks_total") > 0,
+            "streamed fit recorded no prefetch chunks",
+        )
+        check(
+            series_total(snap, "oap_stream_rows_total") > 0,
+            "streamed fit recorded no staged rows",
+        )
+
+    # -- PCA -----------------------------------------------------------------
+    p = PCA(k=3).fit(x)
+    records, offset = read_new_lines(sink, offset)
+    verify_fit(
+        "pca.fit", p.summary, records, ("table_convert", "covariance")
+    )
+    solver = p.summary.get("pca_solver")
+    tele = p.summary["telemetry"]
+    check(
+        any(
+            path.endswith("eigh") or path.endswith("randomized_topk")
+            for path in span_index(tele["spans"])
+        ),
+        f"pca.fit: no solver span for solver={solver!r}",
+    )
+
+    # -- ALS on the pseudo-mesh (collective facade must fire) ----------------
+    before_coll = series_total(
+        telemetry.snapshot(), "oap_collective_ops_total"
+    )
+    nnz = 4000
+    u = rng.integers(0, 64, nnz)
+    i = rng.integers(0, 48, nnz)
+    r = (rng.random(nnz) * 4 + 1).astype(np.float32)
+    a = ALS(rank=4, max_iter=2, seed=0).fit(u, i, r)
+    records, offset = read_new_lines(sink, offset)
+    snap = verify_fit(
+        "als.fit", a.summary, records, ("table_convert", "als_iterations")
+    )
+    check(
+        bool(a.summary.get("block_parallel")),
+        "als fit did not take the block-parallel (pseudo-mesh) path",
+    )
+    if snap is not None:
+        after_coll = series_total(snap, "oap_collective_ops_total")
+        check(
+            after_coll > before_coll,
+            "pseudo-mesh ALS fit drove no collective facade ops "
+            f"(before={before_coll}, after={after_coll})",
+        )
+        check(
+            series_total(snap, "oap_collective_bytes_total") > 0,
+            "collective facade counted no payload bytes",
+        )
+
+    # -- process-wide registry invariants ------------------------------------
+    snap = telemetry.snapshot()
+    check(
+        series_total(snap, "oap_xla_compiles_total") > 0,
+        "no XLA backend compiles counted across four accelerated fits",
+    )
+    check(
+        series_total(snap, "oap_resilience_faults_total") == 0,
+        "resilience fault counter nonzero on a fault-free gate run",
+    )
+    check(
+        series_total(snap, "oap_fit_total") == 4,
+        f"expected 4 finalized fits, registry says "
+        f"{series_total(snap, 'oap_fit_total')}",
+    )
+    # the Prometheus dump must render and carry the headline families
+    prom = telemetry.render_prometheus()
+    for family in (
+        "oap_fit_seconds_bucket", "oap_progcache_", "oap_collective_ops_total",
+    ):
+        check(family in prom, f"prometheus rendering lacks {family}")
+
+    print(f"telemetry gate: {'FAIL' if failures else 'OK'} "
+          f"({offset} JSONL records, sink={sink})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

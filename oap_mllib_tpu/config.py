@@ -181,6 +181,22 @@ class Config:
     # seconds: connection attempts retry with backoff until this
     # deadline, then fail with an error naming coordinator/rank/elapsed.
     bootstrap_timeout: float = 60.0
+    # -- telemetry layer (oap_mllib_tpu/telemetry/) --------------------------
+    # jax.profiler trace directory: non-empty wraps every estimator fit
+    # in a profiler trace written there (utils/profiling.maybe_trace),
+    # and the span tree emits a TraceAnnotation per phase while the
+    # trace is live.  Promoted from the raw OAP_MLLIB_TPU_PROFILE_DIR
+    # env read so Config.set/scoped overrides work like every other
+    # knob; the env var still applies through the standard coercion.
+    profile_dir: str = ""
+    # JSON-lines telemetry sink: non-empty appends one record per span
+    # close plus a registry snapshot at every fit finalization (and a
+    # final snapshot at process exit).  Multi-process worlds write
+    # per-rank files (<path>.rank<r>), each record rank-tagged, so a
+    # world's files concatenate into one mergeable stream
+    # (telemetry/export.py; docs/observability.md).  Empty = off (the
+    # near-zero-overhead default: no file is ever opened).
+    telemetry_log: str = ""
 
     @classmethod
     def from_env(cls) -> "Config":

@@ -52,6 +52,7 @@ import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.telemetry import metrics as _tm
 from oap_mllib_tpu.utils.faults import maybe_fault
 
 log = logging.getLogger("oap_mllib_tpu")
@@ -80,6 +81,10 @@ class PrefetchStats:
       chunk.  Serial (depth=1) this equals ``stage_s``; with overlap it
       shrinks toward zero — the visible win.
     - ``chunks``: chunks that reached the consumer.
+    - ``bytes_staged`` / ``rows``: payload staged through the pipeline —
+      total array bytes of every staged item and the (padded) row count
+      of its leading 2-D array — the per-pass throughput denominators
+      the telemetry registry exports.
     - ``leaked_threads``: producer threads that failed to join within
       the shutdown timeout (daemon threads, so the process still exits,
       but a nonzero count means a stage callable is wedged — logged
@@ -89,17 +94,21 @@ class PrefetchStats:
     ``<prefix>/stage`` (host-only), ``<prefix>/transfer``,
     ``<prefix>/compute`` (= pass wall - wait) and ``<prefix>/stream_wall``
     so ``Timings.overlap_efficiency`` / bench.py can report how much
-    staging was hidden behind compute.
+    staging was hidden behind compute — and mirrors the whole split into
+    the process metrics registry (telemetry/metrics.py,
+    ``oap_prefetch_*`` / ``oap_stream_*`` labelled by phase).
     """
 
     __slots__ = ("stage_s", "transfer_s", "wait_s", "chunks",
-                 "leaked_threads")
+                 "bytes_staged", "rows", "leaked_threads")
 
     def __init__(self) -> None:
         self.stage_s = 0.0
         self.transfer_s = 0.0
         self.wait_s = 0.0
         self.chunks = 0
+        self.bytes_staged = 0
+        self.rows = 0
         self.leaked_threads = 0
 
     @contextlib.contextmanager
@@ -110,15 +119,68 @@ class PrefetchStats:
         finally:
             self.transfer_s += time.perf_counter() - t0
 
+    def note_staged(self, item: Any) -> None:
+        """Account one staged item's payload (producer side): sum the
+        array bytes it carries and the row count of its leading 2-D
+        array (padded rows — what the device actually processes)."""
+        b, r = _payload_size(item)
+        self.bytes_staged += b
+        self.rows += r
+
     def finalize(self, timings, prefix: str, wall: float) -> None:
         """Record this pipeline's split under ``prefix`` (accumulates
-        across passes — Timings.as_dict sums duplicate phases)."""
+        across passes — Timings.as_dict sums duplicate phases) and
+        mirror it into the process metrics registry."""
+        lab = {"phase": prefix}
+        _tm.counter("oap_prefetch_stage_seconds_total", lab,
+                    help="Host staging wall (pad/convert, transfer excluded)"
+                    ).inc(max(self.stage_s - self.transfer_s, 0.0))
+        _tm.counter("oap_prefetch_transfer_seconds_total", lab,
+                    help="Device-transfer dispatch wall inside staging"
+                    ).inc(self.transfer_s)
+        _tm.counter("oap_prefetch_wait_seconds_total", lab,
+                    help="Consumer wall blocked waiting for a staged chunk"
+                    ).inc(self.wait_s)
+        _tm.counter("oap_prefetch_compute_seconds_total", lab,
+                    help="Pass wall not spent waiting on staging"
+                    ).inc(max(wall - self.wait_s, 0.0))
+        _tm.counter("oap_prefetch_chunks_total", lab,
+                    help="Chunks that reached the consumer").inc(self.chunks)
+        _tm.counter("oap_stream_bytes_staged_total", lab,
+                    help="Array bytes staged through the pipeline"
+                    ).inc(self.bytes_staged)
+        _tm.counter("oap_stream_rows_total", lab,
+                    help="Padded rows staged through the pipeline"
+                    ).inc(self.rows)
         if timings is None:
             return
         timings.add(prefix + "/stage", max(self.stage_s - self.transfer_s, 0.0))
         timings.add(prefix + "/transfer", self.transfer_s)
         timings.add(prefix + "/compute", max(wall - self.wait_s, 0.0))
         timings.add(prefix + "/stream_wall", wall)
+
+
+def _payload_size(item: Any) -> tuple:
+    """(total array bytes, leading-2-D-array rows) of a staged item —
+    tuples/lists walked recursively, scalars ignored.  Rows count the
+    FIRST matrix found (the data chunk; masks/weights ride along but do
+    not double-count rows)."""
+    nbytes = 0
+    rows = 0
+    stack = [item]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, (tuple, list)):
+            stack.extend(reversed(v))
+            continue
+        b = getattr(v, "nbytes", None)
+        shape = getattr(v, "shape", None)
+        if b is None or shape is None:
+            continue
+        nbytes += int(b)
+        if rows == 0 and len(shape) >= 2:
+            rows = int(shape[0])
+    return nbytes, rows
 
 
 def _delete_jax_arrays(item: Any) -> None:
@@ -245,6 +307,10 @@ class _Threaded:
         self._thread.join(timeout=5.0)
         if self._thread.is_alive():
             self._stats.leaked_threads += 1
+            _tm.counter(
+                "oap_prefetch_leaked_threads_total",
+                help="Producer threads that failed to join at shutdown",
+            ).inc()
             log.warning(
                 "prefetch producer thread failed to join within 5s at %s; "
                 "leaking daemon thread %r", where, self._thread.name,
@@ -321,10 +387,13 @@ class Prefetcher:
         # faults are drillable on identity passes like reservoir
         # sampling; unarmed, maybe_fault is a dict miss
         inner = stage
+        stats_ref = self.stats
 
         def staged(item):
             maybe_fault("prefetch.stage")
-            return item if inner is None else inner(item)
+            out = item if inner is None else inner(item)
+            stats_ref.note_staged(out)
+            return out
 
         if self.depth == 1:
             self._impl = _Serial(it, staged, self.stats, retire)

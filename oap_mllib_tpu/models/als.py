@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from oap_mllib_tpu import telemetry
 from oap_mllib_tpu.fallback import als_np
 from oap_mllib_tpu.ops import als_ops
 from oap_mllib_tpu.utils import progcache
@@ -455,7 +456,7 @@ class ALS:
             # reference's full cShuffleData + 4-step pipeline, survey §3.3;
             # round 1 left explicit ALS on the unsharded global program)
             def attempt(degraded):
-                timings = Timings()
+                timings = Timings("als.fit")
                 cache_before = progcache.stats()
                 model = self._fit_block_parallel(
                     users, items, ratings, n_users, n_items, x0, y0, mesh,
@@ -468,6 +469,7 @@ class ALS:
                 "ALS", attempt, fallback, stats=stats
             )
             resilience.merge_stats(model.summary, stats)
+            telemetry.finalize_fit(model.summary)
             return model
 
         def attempt(degraded):
@@ -477,6 +479,7 @@ class ALS:
 
         model = resilience.resilient_fit("ALS", attempt, fallback, stats=stats)
         resilience.merge_stats(model.summary, stats)
+        telemetry.finalize_fit(model.summary)
         return model
 
     def _fit_fallback_np(self, users, items, ratings, n_users, n_items,
@@ -484,7 +487,7 @@ class ALS:
         """The CPU/NumPy reference path — both the static fallback
         (failed dispatch predicate) and the resilience ladder's final
         rung reach the fit through here."""
-        timings = Timings()
+        timings = Timings("als.fit")
         if x0 is None:
             x0 = als_np.init_factors(n_users, self.rank, self.seed)
             y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
@@ -516,7 +519,7 @@ class ALS:
         device OOM calls for; the COO path has no equivalent knob and
         re-runs unchanged (a persistent OOM then falls through to the
         NumPy rung)."""
-        timings = Timings()
+        timings = Timings("als.fit")
         cache_before = progcache.stats()
         if x0 is None:
             x0 = als_np.init_factors(n_users, self.rank, self.seed)
@@ -746,6 +749,7 @@ class ALS:
                 stats=stats,
             )
             resilience.merge_stats(model.summary, stats)
+            telemetry.finalize_fit(model.summary)
             return model
         if not _grouped_ok_single(kernel, users, items, n_users, n_items):
             # in-memory COO fallback (the guard re-runs inside fit — an
@@ -765,7 +769,7 @@ class ALS:
             y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
 
         def attempt(degraded):
-            timings = Timings()
+            timings = Timings("als.fit")
             cache_before = progcache.stats()
             with phase_timer(timings, "table_convert"):
                 by_user = als_ops.build_grouped_edges(
@@ -799,6 +803,7 @@ class ALS:
             stats=stats,
         )
         resilience.merge_stats(model.summary, stats)
+        telemetry.finalize_fit(model.summary)
         return model
 
     def _block_dispatch(self, users, items, n_users, n_items, world):
@@ -880,7 +885,7 @@ class ALS:
                 users, items, ratings, n_users=n_users, n_items=n_items,
                 init=init,
             )
-        timings = Timings()
+        timings = Timings("als.fit")
         cache_before = progcache.stats()
         x0 = None if init is None else np.array(init[0], np.float32)
         y0 = None if init is None else np.array(init[1], np.float32)

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu import telemetry
 from oap_mllib_tpu.data.table import DenseTable
 from oap_mllib_tpu.fallback.kmeans_np import lloyd_np, predict_np
 from oap_mllib_tpu.ops import kmeans_ops
@@ -267,6 +268,7 @@ class KMeans:
                 stats=stats,
             )
             resilience.merge_stats(model.summary, stats)
+            telemetry.finalize_fit(model.summary)
             return model
         return self._fit_fallback(x, sample_weight)
 
@@ -356,6 +358,7 @@ class KMeans:
             "KMeans", attempt, fallback, stats=stats
         )
         resilience.merge_stats(model.summary, stats)
+        telemetry.finalize_fit(model.summary)
         return model
 
     def _fit_stream_inner(self, source, sample_weight, dtype, cfg) -> KMeansModel:
@@ -368,7 +371,7 @@ class KMeans:
             cfg.kmeans_kernel, source.n_features, self.k,
             cfg.matmul_precision, dtype,
         )
-        timings = Timings()
+        timings = Timings("kmeans.fit")
         cache_before = progcache.stats()
         with phase_timer(timings, "init_centers"):
             if self.init_mode == INIT_RANDOM:
@@ -407,7 +410,7 @@ class KMeans:
     def _fit_tpu_inner(self, x, sample_weight, dtype,
                        degraded: bool = False) -> KMeansModel:
         cfg = get_config()
-        timings = Timings()
+        timings = Timings("kmeans.fit")
         cache_before = progcache.stats()
         mesh = get_mesh()
         mp = mesh.shape[cfg.model_axis]
@@ -546,7 +549,7 @@ class KMeans:
 
     # -- fallback path (~ trainWithML, KMeans.scala:355) ---------------------
     def _fit_fallback(self, x: np.ndarray, sample_weight: Optional[np.ndarray]) -> KMeansModel:
-        timings = Timings()
+        timings = Timings("kmeans.fit")
         x = x.astype(np.float64)
         with phase_timer(timings, "init_centers"):
             if self.init_mode == INIT_RANDOM:

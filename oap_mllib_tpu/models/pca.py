@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu import telemetry
 from oap_mllib_tpu.data.table import DenseTable
 from oap_mllib_tpu.fallback.pca_np import pca_np
 from oap_mllib_tpu.ops import pca_ops
@@ -177,6 +178,7 @@ class PCA:
                 "PCA", attempt, lambda: self._fit_fallback(x), stats=stats
             )
             resilience.merge_stats(model.summary, stats)
+            telemetry.finalize_fit(model.summary)
             return model
         return self._fit_fallback(x)
 
@@ -230,12 +232,13 @@ class PCA:
             stats=stats,
         )
         resilience.merge_stats(model.summary, stats)
+        telemetry.finalize_fit(model.summary)
         return model
 
     def _fit_stream_inner(self, source, dtype, cfg) -> PCAModel:
         from oap_mllib_tpu.ops import stream_ops
 
-        timings = Timings()
+        timings = Timings("pca.fit")
         cache_before = progcache.stats()
         d = source.n_features
         with phase_timer(timings, "covariance_streamed"):
@@ -268,7 +271,7 @@ class PCA:
             return self._fit_tpu_inner(x, dtype, jax)
 
     def _fit_tpu_inner(self, x, dtype, jax) -> PCAModel:
-        timings = Timings()
+        timings = Timings("pca.fit")
         cache_before = progcache.stats()
         cfg = get_config()
         mesh = get_mesh()
@@ -313,7 +316,7 @@ class PCA:
 
     # -- fallback path (~ vanilla mllib.feature.PCA, PCA.scala:110-116) ------
     def _fit_fallback(self, x: np.ndarray) -> PCAModel:
-        timings = Timings()
+        timings = Timings("pca.fit")
         with phase_timer(timings, "pca_np"):
             comps, ratio = pca_np(x, self.k)
         # the fallback always factorizes fully; recording it keeps a

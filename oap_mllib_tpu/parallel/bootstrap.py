@@ -138,18 +138,28 @@ def initialize_distributed(
     # connect blocks/retries the same way (OneCCL.cpp:47-86).  Only
     # TRANSIENT faults (connection refused / Unavailable / injected
     # "bootstrap.connect" faults) retry; anything else propagates.
+    from oap_mllib_tpu.telemetry import metrics as _tm
+
     timeout_s = max(float(cfg.bootstrap_timeout), 0.0)
     policy = resilience.RetryPolicy.from_config()
     t0 = time.monotonic()
     attempt = 0
     while True:
         try:
+            _tm.counter(
+                "oap_bootstrap_connect_attempts_total",
+                help="Coordinator connection attempts",
+            ).inc()
             faults.maybe_fault("bootstrap.connect")
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id=process_id,
             )
+            _tm.counter(
+                "oap_bootstrap_connect_seconds_total",
+                help="Wall from first attempt to a joined world",
+            ).inc(time.monotonic() - t0)
             break
         except Exception as e:
             elapsed = time.monotonic() - t0
@@ -164,6 +174,10 @@ def initialize_distributed(
                     f"{timeout_s:g}s): {e}"
                 ) from e
             attempt += 1
+            _tm.counter(
+                "oap_bootstrap_connect_retries_total",
+                help="Coordinator connection retries",
+            ).inc()
             log.warning(
                 "bootstrap connect to %s failed (%s); retry %d in %.2fs "
                 "(%.1fs of %gs budget elapsed)",

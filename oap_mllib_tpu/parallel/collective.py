@@ -12,15 +12,29 @@ These wrappers are `shard_map`-based so they can be called eagerly on
 sharded arrays (useful in drivers and tests); inside jitted estimator
 kernels the same collectives are emitted implicitly by XLA from sharding
 annotations, or explicitly via `lax.psum` etc. under `shard_map`.
+
+Every facade dispatch is instrumented (ISSUE 4): per-op invocation
+counts, payload bytes, and dispatch wall go to the process metrics
+registry (``oap_collective_*``, telemetry/metrics.py) and onto the
+thread's active span (telemetry/spans.current_span) — DrJAX and the
+array-redistribution work (PAPERS.md) both name collectives as the
+dominant, hardest-to-see cost at scale, and scattered wall prints can't
+see them at all.  The wall is dispatch time (trace + compile on the
+first shape, async dispatch after), not on-wire DMA — the profiler
+trace layer owns that.
 """
 
 from __future__ import annotations
+
+import time
 
 import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.telemetry.spans import current_span
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
@@ -31,6 +45,30 @@ def _shard_map(f, mesh, in_specs, out_specs):
     return shard_map(
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
     )
+
+
+def _instrumented(op: str, x: jax.Array, dispatch):
+    """Run one facade dispatch with telemetry: invocation count, payload
+    bytes (the GLOBAL array — what crosses the fabric is layout-
+    dependent, so the operand size is the stable, comparable number),
+    and dispatch wall, booked to the registry and the active span."""
+    nbytes = int(getattr(x, "nbytes", 0) or 0)
+    t0 = time.perf_counter()
+    out = dispatch()
+    dt = time.perf_counter() - t0
+    lab = {"op": op}
+    _tm.counter("oap_collective_ops_total", lab,
+                help="Collective facade dispatches by op").inc()
+    _tm.counter("oap_collective_bytes_total", lab,
+                help="Operand bytes through the collective facade"
+                ).inc(nbytes)
+    _tm.histogram("oap_collective_dispatch_seconds", lab,
+                  help="Per-dispatch wall (compile included on first shape)"
+                  ).observe(dt)
+    sp = current_span()
+    if sp is not None:
+        sp.note_collective(op, nbytes, dt)
+    return out
 
 
 def broadcast(x: jax.Array, mesh: Mesh, root: int = 0) -> jax.Array:
@@ -49,7 +87,10 @@ def broadcast(x: jax.Array, mesh: Mesh, root: int = 0) -> jax.Array:
         return lax.dynamic_slice_in_dim(full, root * size, size, axis=0)
 
     spec = P(axis, *([None] * (x.ndim - 1)))
-    return _shard_map(_bcast, mesh, (spec,), spec)(x)
+    return _instrumented(
+        "broadcast", x,
+        lambda: _shard_map(_bcast, mesh, (spec,), spec)(x),
+    )
 
 
 def allgather_rows(x: jax.Array, mesh: Mesh) -> jax.Array:
@@ -65,7 +106,10 @@ def allgather_rows(x: jax.Array, mesh: Mesh) -> jax.Array:
         return lax.all_gather(shard, axis, tiled=True)
 
     in_spec = P(axis, *([None] * (x.ndim - 1)))
-    return _shard_map(_ag, mesh, (in_spec,), P(*([None] * x.ndim)))(x)
+    return _instrumented(
+        "allgather_rows", x,
+        lambda: _shard_map(_ag, mesh, (in_spec,), P(*([None] * x.ndim)))(x),
+    )
 
 
 def allreduce_sum(x: jax.Array, mesh: Mesh) -> jax.Array:
@@ -83,7 +127,10 @@ def allreduce_sum(x: jax.Array, mesh: Mesh) -> jax.Array:
 
     in_spec = P(axis, *([None] * (x.ndim - 1)))
     out_spec = P(*([None] * x.ndim))
-    return _shard_map(_ar, mesh, (in_spec,), out_spec)(x)
+    return _instrumented(
+        "allreduce_sum", x,
+        lambda: _shard_map(_ar, mesh, (in_spec,), out_spec)(x),
+    )
 
 
 def alltoall_rows(x: jax.Array, mesh: Mesh) -> jax.Array:
@@ -104,4 +151,7 @@ def alltoall_rows(x: jax.Array, mesh: Mesh) -> jax.Array:
         return out.reshape(shard.shape)
 
     spec = P(axis, *([None] * (x.ndim - 1)))
-    return _shard_map(_a2a, mesh, (spec,), spec)(x)
+    return _instrumented(
+        "alltoall_rows", x,
+        lambda: _shard_map(_a2a, mesh, (spec,), spec)(x),
+    )

@@ -1,0 +1,47 @@
+"""Unified telemetry: span-tree tracing, metrics registry, exporters.
+
+The one coherent observability layer the scattered per-PR stats objects
+grew into (ISSUE 4): ``spans`` is the storage under every fit's
+``Timings`` (utils/timing.py is now a view over it), ``metrics`` is the
+process-wide counter/gauge/histogram registry every subsystem feeds, and
+``export`` turns both into a JSONL event stream, a Prometheus dump, and
+a human per-fit report.
+
+Entry points::
+
+    from oap_mllib_tpu import telemetry
+
+    model = KMeans(k=8).fit(x)
+    print(telemetry.report(model.summary))        # per-fit span tree
+    print(telemetry.render_prometheus())          # scrapeable registry
+    model.summary.telemetry["spans"]              # the raw tree
+    model.summary.telemetry["metrics"]            # registry snapshot
+
+    set_config(telemetry_log="/tmp/fits.jsonl")   # arm the JSONL sink
+"""
+
+from oap_mllib_tpu.telemetry import metrics
+from oap_mllib_tpu.telemetry.export import (
+    emit_fit,
+    finalize_fit,
+    report,
+    sink_path,
+)
+from oap_mllib_tpu.telemetry.metrics import (
+    render_prometheus,
+    snapshot,
+)
+from oap_mllib_tpu.telemetry.spans import Span, current_span, enter
+
+__all__ = [
+    "Span",
+    "current_span",
+    "emit_fit",
+    "enter",
+    "finalize_fit",
+    "metrics",
+    "render_prometheus",
+    "report",
+    "sink_path",
+    "snapshot",
+]

@@ -1,0 +1,262 @@
+"""Process-wide metrics registry: counters, gauges, log-bucket histograms.
+
+Absorbs the ad-hoc per-subsystem counters that PRs 1-3 grew (progcache
+hits/misses/evictions + XLA compile ground truth, prefetch
+stage/transfer/wait splits + leaked threads, resilience
+retries/degradations/faults, streamed bytes/rows, collective op
+counts/bytes/dispatch wall) into one registry a dashboard, a bench
+harness, and a CI gate can all read.  The legacy objects
+(``ProgramCache.stats``, ``PrefetchStats``, ``ResilienceStats``) keep
+their shapes — they now *also* feed this registry at the same increment
+points, so nothing downstream of them moved.
+
+Design constraints:
+
+- **Deterministic**: no wall-clock timestamps anywhere — histograms have
+  FIXED log-scale bucket bounds chosen at import time, observations use
+  values measured with the monotonic clock by the caller.  Two runs of
+  the same workload produce identical bucket layouts (and identical
+  counters when the workload is deterministic).
+- **Cheap**: an increment is a dict lookup + a float add under one
+  registry lock (the lock exists because prefetch producer threads
+  increment concurrently with the consumer; contention is nil next to
+  what is being measured).  Telemetry "off" needs no guard here — the
+  registry IS the accounting the summaries already paid for.
+- **Prometheus-ready**: :func:`render_prometheus` emits the standard
+  text exposition (``# TYPE``/``# HELP``, ``_bucket{le=...}``/``_sum``/
+  ``_count`` for histograms) so the dump can be scraped or diffed as-is.
+
+Naming follows Prometheus conventions: ``oap_<subsystem>_<what>_total``
+for counters, ``_seconds``/``_bytes`` units spelled out.  The full
+catalog is docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+# Fixed log-scale bucket bounds (factor-4 geometric series).  Durations
+# span 1 µs .. ~67 s; bytes span 256 B .. ~17 GB.  Everything past the
+# last bound lands in the +Inf overflow bucket.
+DURATION_BUCKETS: Tuple[float, ...] = tuple(1e-6 * 4.0 ** i for i in range(14))
+BYTES_BUCKETS: Tuple[float, ...] = tuple(256.0 * 4.0 ** i for i in range(14))
+COUNT_BUCKETS: Tuple[float, ...] = tuple(1.0 * 4.0 ** i for i in range(14))
+
+LabelSet = Tuple[Tuple[str, str], ...]
+
+
+def _labelset(labels: Optional[Dict[str, str]]) -> LabelSet:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with _LOCK:
+            self.value += n
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        with _LOCK:
+            self.value = float(v)
+
+
+class Histogram:
+    """Cumulative-bucket histogram over fixed log-scale bounds.
+
+    ``counts[i]`` is the number of observations ``<= bounds[i]`` in that
+    bucket (non-cumulative storage; the Prometheus renderer emits the
+    cumulative form); ``counts[-1]`` is the +Inf overflow."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Tuple[float, ...] = DURATION_BUCKETS):
+        self.bounds = tuple(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with _LOCK:
+            self.counts[bisect.bisect_left(self.bounds, v)] += 1
+            self.sum += v
+            self.count += 1
+
+
+_LOCK = threading.Lock()
+
+
+class Registry:
+    """Name+labels -> metric instance, with per-name type/help metadata."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[Tuple[str, LabelSet], Any] = {}
+        self._meta: Dict[str, Tuple[str, str]] = {}  # name -> (type, help)
+
+    def _get(self, name: str, labels, kind: str, help_: str, make):
+        key = (name, _labelset(labels))
+        with _LOCK:
+            prev = self._meta.get(name)
+            if prev is not None and prev[0] != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {prev[0]}, "
+                    f"not {kind}"
+                )
+            m = self._metrics.get(key)
+            if m is None:
+                m = self._metrics[key] = make()
+                self._meta.setdefault(name, (kind, help_))
+            return m
+
+    def counter(self, name: str, labels: Optional[Dict[str, str]] = None,
+                help: str = "") -> Counter:
+        return self._get(name, labels, "counter", help, Counter)
+
+    def gauge(self, name: str, labels: Optional[Dict[str, str]] = None,
+              help: str = "") -> Gauge:
+        return self._get(name, labels, "gauge", help, Gauge)
+
+    def histogram(self, name: str, labels: Optional[Dict[str, str]] = None,
+                  bounds: Tuple[float, ...] = DURATION_BUCKETS,
+                  help: str = "") -> Histogram:
+        return self._get(
+            name, labels, "histogram", help, lambda: Histogram(bounds)
+        )
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready dump of every metric: ``{name: {labels-json:
+        value-or-histogram-dict}}`` with labels rendered ``k=v,...``
+        (empty string for unlabelled series).  Deterministically ordered
+        (sorted names, sorted label sets)."""
+        with _LOCK:
+            items = sorted(
+                self._metrics.items(),
+                key=lambda kv: (kv[0][0], kv[0][1]),
+            )
+            out: Dict[str, Any] = {}
+            for (name, labels), m in items:
+                lab = ",".join(f"{k}={v}" for k, v in labels)
+                series = out.setdefault(name, {})
+                if isinstance(m, Histogram):
+                    series[lab] = {
+                        "buckets": dict(
+                            zip([_fmt(b) for b in m.bounds] + ["+Inf"],
+                                m.counts)
+                        ),
+                        "sum": m.sum,
+                        "count": m.count,
+                    }
+                else:
+                    series[lab] = m.value
+            return out
+
+    def render_prometheus(self) -> str:
+        """Standard Prometheus text exposition of the whole registry."""
+        with _LOCK:
+            items = sorted(
+                self._metrics.items(),
+                key=lambda kv: (kv[0][0], kv[0][1]),
+            )
+            lines: List[str] = []
+            seen_meta = set()
+            for (name, labels), m in items:
+                if name not in seen_meta:
+                    seen_meta.add(name)
+                    kind, help_ = self._meta.get(name, ("untyped", ""))
+                    if help_:
+                        lines.append(f"# HELP {name} {help_}")
+                    lines.append(f"# TYPE {name} {kind}")
+                lab = _render_labels(labels)
+                if isinstance(m, Histogram):
+                    cum = 0
+                    for b, c in zip(m.bounds, m.counts):
+                        cum += c
+                        lines.append(
+                            f"{name}_bucket{_render_labels(labels, le=_fmt(b))}"
+                            f" {cum}"
+                        )
+                    cum += m.counts[-1]
+                    lines.append(
+                        f'{name}_bucket{_render_labels(labels, le="+Inf")}'
+                        f" {cum}"
+                    )
+                    lines.append(f"{name}_sum{lab} {_fmt(m.sum)}")
+                    lines.append(f"{name}_count{lab} {m.count}")
+                else:
+                    lines.append(f"{name}{lab} {_fmt(m.value)}")
+            return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Drop every metric AND its metadata (tests; the per-fit delta
+        consumers snapshot-and-subtract instead)."""
+        with _LOCK:
+            self._metrics.clear()
+            self._meta.clear()
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _render_labels(labels: LabelSet, le: Optional[str] = None) -> str:
+    parts = [f'{k}="{v}"' for k, v in labels]
+    if le is not None:
+        parts.append(f'le="{le}"')
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+# -- module-level singleton (the process registry) ---------------------------
+
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    return _REGISTRY
+
+
+def counter(name: str, labels: Optional[Dict[str, str]] = None,
+            help: str = "") -> Counter:
+    return _REGISTRY.counter(name, labels, help)
+
+
+def gauge(name: str, labels: Optional[Dict[str, str]] = None,
+          help: str = "") -> Gauge:
+    return _REGISTRY.gauge(name, labels, help)
+
+
+def histogram(name: str, labels: Optional[Dict[str, str]] = None,
+              bounds: Tuple[float, ...] = DURATION_BUCKETS,
+              help: str = "") -> Histogram:
+    return _REGISTRY.histogram(name, labels, bounds, help)
+
+
+def snapshot() -> Dict[str, Any]:
+    return _REGISTRY.snapshot()
+
+
+def render_prometheus() -> str:
+    return _REGISTRY.render_prometheus()
+
+
+def reset() -> None:
+    _REGISTRY.reset()

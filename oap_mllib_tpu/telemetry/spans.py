@@ -1,0 +1,177 @@
+"""Span-tree tracing: the storage layer under every fit's phase timings.
+
+PRs 1-3 each grew a flat stats object (``Timings`` record list,
+``PrefetchStats``, progcache counters, ``ResilienceStats``) with no
+shared model.  This module is the shared model's skeleton: a fit is a
+tree of named :class:`Span` nodes — the root is the fit itself
+(``kmeans.fit``, ``pca.fit``, ``als.fit``), its children are the phases
+the estimators already time (``table_convert``, ``init_centers``,
+``lloyd_loop``, ...), and *their* children are the per-pass splits the
+streamed pipeline records (``stage``/``transfer``/``compute``) and the
+program-cache launch attribution (``compile``/``execute``).
+
+``utils/timing.Timings`` is now a **view** over this tree — its
+``as_dict``/``subphases``/``overlap_efficiency``/``compile_split``
+accessors return exactly what the flat record list returned, so every
+existing caller and test keeps working — and the tree itself is what the
+exporters (telemetry/export.py) serialize.
+
+Clocks are monotonic only (``time.perf_counter``): span durations and
+orderings are deterministic accounting, never wall-clock timestamps.
+
+A thread-local *active span* stack lets deeper layers attach to whatever
+phase is running without threading a handle through every signature —
+the collective facade (parallel/collective.py) books its per-op bytes
+and dispatch wall onto ``current_span()``.  When a ``jax.profiler``
+trace is active (utils/profiling.py), entering a span also emits a
+``jax.profiler.TraceAnnotation`` so the same names line up in
+TensorBoard/XProf; with no trace running the annotation is skipped
+behind one module-level bool — the telemetry-off cheap-guard contract.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_SEP = "/"
+
+
+class Span:
+    """One named node in a fit's span tree.
+
+    ``duration_s`` accumulates across repeated entries of the same path
+    (streamed passes re-enter their phase once per pass — the flat
+    ``Timings.as_dict`` summed duplicate phases, the tree accumulates on
+    the node, same totals).  ``count`` is the number of explicit
+    recordings; implicitly-created path containers keep ``count == 0``
+    and are excluded from the flat views, matching the old record list
+    (which only ever held explicitly-added phases).
+    """
+
+    __slots__ = ("name", "duration_s", "count", "attrs", "children")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.duration_s = 0.0
+        self.count = 0
+        self.attrs: Dict[str, Any] = {}
+        self.children: List["Span"] = []
+
+    def child(self, name: str) -> "Span":
+        """Find-or-create the child span ``name`` (first match wins, so
+        repeated phases accumulate onto one node in first-seen order)."""
+        for c in self.children:
+            if c.name == name:
+                return c
+        c = Span(name)
+        self.children.append(c)
+        return c
+
+    def node(self, path: str) -> "Span":
+        """Find-or-create the descendant at ``a/b/c``-style ``path``."""
+        n = self
+        for part in path.split(_SEP):
+            n = n.child(part)
+        return n
+
+    def record(self, seconds: float) -> None:
+        self.duration_s += seconds
+        self.count += 1
+
+    def note_collective(self, op: str, nbytes: int, dispatch_s: float) -> None:
+        """Accumulate one collective dispatch onto this span's attributes
+        (parallel/collective.py calls this on ``current_span()``)."""
+        per = self.attrs.setdefault("collectives", {}).setdefault(
+            op, {"ops": 0, "bytes": 0, "dispatch_s": 0.0}
+        )
+        per["ops"] += 1
+        per["bytes"] += int(nbytes)
+        per["dispatch_s"] += float(dispatch_s)
+
+    # -- flat views (the Timings compatibility surface) ----------------------
+
+    def flat(self) -> Dict[str, float]:
+        """``{path: seconds}`` over explicitly-recorded descendants, in
+        first-recorded order — exactly the old ``Timings.as_dict``."""
+        out: Dict[str, float] = {}
+        stack = [("", c) for c in reversed(self.children)]
+        while stack:
+            prefix, n = stack.pop()
+            path = prefix + n.name
+            if n.count > 0:
+                out[path] = out.get(path, 0.0) + n.duration_s
+            stack.extend(
+                (path + _SEP, c) for c in reversed(n.children)
+            )
+        return out
+
+    def as_dict(self) -> Dict[str, Any]:
+        """JSON-ready tree (exporters; ``summary["telemetry"]["spans"]``)."""
+        out: Dict[str, Any] = {
+            "name": self.name,
+            "duration_s": self.duration_s,
+            "count": self.count,
+        }
+        if self.attrs:
+            out["attrs"] = self.attrs
+        if self.children:
+            out["children"] = [c.as_dict() for c in self.children]
+        return out
+
+    def walk(self, prefix: str = ""):
+        """Yield ``(path, span)`` depth-first, self included."""
+        path = prefix + self.name
+        yield path, self
+        for c in self.children:
+            yield from c.walk(path + _SEP)
+
+    def __repr__(self) -> str:
+        return (
+            f"Span({self.name!r}, {self.duration_s:.3f}s, "
+            f"children={len(self.children)})"
+        )
+
+
+# -- thread-local active-span stack ------------------------------------------
+
+_tls = threading.local()
+
+
+def current_span() -> Optional[Span]:
+    """The innermost span currently entered on THIS thread, or None.
+    Deeper layers (collectives) attach measurements here without a
+    handle threaded through the call chain."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def enter(span: Span, annotate: bool = True):
+    """Time one entry of ``span``: push it as the thread's active span,
+    record the monotonic wall on exit, and — only when a jax.profiler
+    trace is running (one bool check) — emit a TraceAnnotation so the
+    span shows up on the XProf timeline under the same name."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(span)
+    ann = None
+    if annotate:
+        from oap_mllib_tpu.utils import profiling
+
+        if profiling.trace_active():
+            import jax
+
+            ann = jax.profiler.TraceAnnotation(span.name)
+            ann.__enter__()
+    t0 = time.perf_counter()
+    try:
+        yield span
+    finally:
+        span.record(time.perf_counter() - t0)
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        stack.pop()

@@ -70,11 +70,14 @@ def print_table(data, title: str = "", max_rows: int = 10,
     print(format_table(data, title, max_rows, max_cols, precision))
 
 
-def format_csr(table, title: str = "", max_rows: int = 10) -> str:
+def format_csr(table, title: str = "", max_rows: int = 10,
+               precision: int = 4) -> str:
     """Format a CSRTable row-wise (Service.printCSRNumericTable analog):
     one line per row with its (col, value) pairs from the CSR offsets.
-    Transfers are bounded to the printed head: only max_rows+1 offsets and
-    the nnz they span are fetched (so device/sharded tables print cheaply)."""
+    ``precision`` controls the value decimals like ``format_table``'s
+    (default keeps the historical 4).  Transfers are bounded to the
+    printed head: only max_rows+1 offsets and the nnz they span are
+    fetched (so device/sharded tables print cheaply)."""
     offsets = _fetch_head(table.row_offsets, min(max_rows, table.n_rows) + 1)
     head_nnz = int(offsets[-1])
     cols = _fetch_head(table.cols, head_nnz)
@@ -84,12 +87,16 @@ def format_csr(table, title: str = "", max_rows: int = 10) -> str:
     ]
     for r in range(min(max_rows, table.n_rows)):
         lo, hi = int(offsets[r]), int(offsets[r + 1])
-        pairs = " ".join(f"{int(c)}:{v:.4f}" for c, v in zip(cols[lo:hi], vals[lo:hi]))
+        pairs = " ".join(
+            f"{int(c)}:{v:.{precision}f}"
+            for c, v in zip(cols[lo:hi], vals[lo:hi])
+        )
         lines.append(f"  [{r}] {pairs}")
     if table.n_rows > max_rows:
         lines.append(f"  ... ({table.n_rows - max_rows} more rows)")
     return "\n".join(lines)
 
 
-def print_csr(table, title: str = "", max_rows: int = 10) -> None:
-    print(format_csr(table, title, max_rows))
+def print_csr(table, title: str = "", max_rows: int = 10,
+              precision: int = 4) -> None:
+    print(format_csr(table, title, max_rows, precision))

@@ -39,7 +39,19 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional
 
+from oap_mllib_tpu.telemetry import metrics as _tm
+
 # -- registry ---------------------------------------------------------------
+
+
+def _count(what: str, algo: str) -> None:
+    """Mirror one registry increment into the process metrics registry
+    (telemetry/metrics.py) — the summaries keep reading ``stats()``,
+    exporters read ``oap_progcache_*_total{algo=...}``."""
+    _tm.counter(
+        f"oap_progcache_{what}_total", {"algo": algo},
+        help=f"Program-cache {what} by algo key",
+    ).inc()
 
 
 class ProgramCache:
@@ -77,8 +89,10 @@ class ProgramCache:
             if full in self._built:
                 self._built.move_to_end(full)
                 self._algo(algo)["hits"] += 1
+                _count("hits", algo)
                 return self._built[full]
             self._algo(algo)["misses"] += 1
+            _count("misses", algo)
         value = build()
         with self._lock:
             self._built[full] = value
@@ -86,6 +100,7 @@ class ProgramCache:
             while len(self._built) > self.maxsize:
                 (ev_algo, _), _ = self._built.popitem(last=False)
                 self._algo(ev_algo)["evictions"] += 1
+                _count("evictions", ev_algo)
         return value
 
     def note(self, algo: str, key: tuple) -> bool:
@@ -97,12 +112,15 @@ class ProgramCache:
                 self._noted.move_to_end(full)
                 self._noted[full] += 1
                 self._algo(algo)["hits"] += 1
+                _count("hits", algo)
                 return False
             self._noted[full] = 1
             self._algo(algo)["misses"] += 1
+            _count("misses", algo)
             while len(self._noted) > self.note_maxsize:
                 (ev_algo, _), _ = self._noted.popitem(last=False)
                 self._algo(ev_algo)["evictions"] += 1
+                _count("evictions", ev_algo)
             return True
 
     def stats(self) -> Dict[str, Any]:
@@ -260,6 +278,18 @@ def _install_xla_listener() -> None:
             if event == _BACKEND_COMPILE_EVENT:
                 _XLA_EVENTS["count"] += 1
                 _XLA_EVENTS["secs"] += float(duration_secs)
+                _tm.counter(
+                    "oap_xla_compiles_total",
+                    help="Real XLA backend compiles (jax monitoring event)",
+                ).inc()
+                _tm.counter(
+                    "oap_xla_compile_seconds_total",
+                    help="Wall spent in XLA backend compilation",
+                ).inc(float(duration_secs))
+                _tm.histogram(
+                    "oap_xla_compile_seconds",
+                    help="Per-program XLA backend compile wall",
+                ).observe(float(duration_secs))
 
         monitoring.register_event_duration_secs_listener(_on_event)
         _xla_listener_installed = True

@@ -46,6 +46,7 @@ import zlib
 from typing import Callable, List, Optional
 
 from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.telemetry import metrics as _tm
 from oap_mllib_tpu.utils.faults import FaultInjected
 
 log = logging.getLogger("oap_mllib_tpu")
@@ -180,6 +181,33 @@ class ResilienceStats:
     def record(self, site: str, kind: Optional[str], exc: BaseException) -> None:
         self.faults += 1
         self.history.append(f"{site}[{kind or 'unclassified'}]: {exc}")
+        _tm.counter(
+            "oap_resilience_faults_total",
+            {"kind": kind or "unclassified"},
+            help="Classified exceptions observed by the resilience layer",
+        ).inc()
+
+    def note_retry(self, delay_s: float) -> None:
+        """Book one transient retry + its backoff, here AND in the
+        process metrics registry."""
+        self.retries += 1
+        self.backoff_s += delay_s
+        _tm.counter(
+            "oap_resilience_retries_total",
+            help="Transient-fault retries taken",
+        ).inc()
+        _tm.counter(
+            "oap_resilience_backoff_seconds_total",
+            help="Wall slept in retry backoff",
+        ).inc(delay_s)
+
+    def note_degradation(self) -> None:
+        """Book one ladder rung stepped (halved-chunk or CPU fallback)."""
+        self.degradations += 1
+        _tm.counter(
+            "oap_resilience_degradations_total",
+            help="Degradation-ladder rungs stepped",
+        ).inc()
 
     def as_dict(self) -> dict:
         return {
@@ -266,8 +294,7 @@ def run_with_retry(
                 or time.monotonic() + delay > deadline
             ):
                 raise
-            stats.retries += 1
-            stats.backoff_s += delay
+            stats.note_retry(delay)
             log.warning(
                 "%s: transient fault (%s); retry %d/%d in %.2fs",
                 site or "retry", e, stats.retries, policy.max_retries, delay,
@@ -321,8 +348,7 @@ def resilient_fit(
             if kind == TRANSIENT and stats.retries < policy.max_retries:
                 delay = policy.delay_s(stats.retries, site)
                 if time.monotonic() + delay <= deadline:
-                    stats.retries += 1
-                    stats.backoff_s += delay
+                    stats.note_retry(delay)
                     log.warning(
                         "%s: transient fault (%s); retry %d/%d in %.2fs",
                         site, e, stats.retries, policy.max_retries, delay,
@@ -331,7 +357,7 @@ def resilient_fit(
                     continue
             if kind == OOM and not degraded:
                 degraded = True
-                stats.degradations += 1
+                stats.note_degradation()
                 log.warning(
                     "%s: device OOM (%s); retrying once with halved chunks",
                     site, e,
@@ -344,6 +370,6 @@ def resilient_fit(
 
             why = f"{kind} fault: {e}"
             if fallback is not None and allow_fallback(algo, why):
-                stats.degradations += 1
+                stats.note_degradation()
                 return fallback()
             raise ResilienceError(algo, stats.history) from e

@@ -170,6 +170,42 @@ class TestConfigCoverage:
         assert p.backoff_s == 0.25
         assert p.deadline_s == 9.0
 
+    def test_profile_dir_respects_config_overrides(self, monkeypatch,
+                                                   tmp_path):
+        """Config.profile_dir (the promoted OAP_MLLIB_TPU_PROFILE_DIR)
+        drives utils/profiling.maybe_trace through the config layer, so
+        set_config/scoped overrides work — not just the raw env var."""
+        from oap_mllib_tpu.utils import profiling
+
+        traced = []
+
+        @__import__("contextlib").contextmanager
+        def fake_trace(log_dir):
+            traced.append(log_dir)
+            yield
+
+        monkeypatch.setattr(profiling, "trace", fake_trace)
+        with profiling.maybe_trace():
+            pass
+        assert traced == []  # default: off
+        set_config(profile_dir=str(tmp_path))
+        with profiling.maybe_trace():
+            pass
+        assert traced == [str(tmp_path)]
+
+    def test_profile_dir_env_coerced(self, monkeypatch):
+        """The env var now flows through the standard coercion like
+        every other knob."""
+        monkeypatch.setenv("OAP_MLLIB_TPU_PROFILE_DIR", "/tmp/x")
+        assert Config.from_env().profile_dir == "/tmp/x"
+
+    def test_telemetry_log_arms_the_jsonl_sink(self, tmp_path):
+        from oap_mllib_tpu.telemetry.export import sink_path
+
+        assert sink_path() is None  # default: off
+        set_config(telemetry_log=str(tmp_path / "t.jsonl"))
+        assert sink_path() == str(tmp_path / "t.jsonl")
+
     def test_compilation_cache_dir_wires_jax_config(self, tmp_path):
         """Config.compilation_cache_dir reaches jax's persistent cache
         at dispatch time (the every-fit chokepoint)."""

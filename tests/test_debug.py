@@ -49,3 +49,14 @@ class TestFormatCsr:
         assert lines[1].startswith("  [0]") and "1:1.5000" in lines[1]
         assert lines[2] == "  [1] "  # empty row
         assert "0:3.5000" in lines[3]
+
+    def test_precision_threads_through_like_format_table(self):
+        """format_csr honors a precision arg for its values exactly like
+        format_table does (default keeps the historical 4 decimals)."""
+        t = CSRTable.from_coo(
+            np.array([0]), np.array([2]),
+            np.array([1.23456789], np.float32), n_rows=1, n_cols=3,
+        )
+        assert "2:1.2346" in format_csr(t)  # default unchanged
+        assert "2:1.23" in format_csr(t, precision=2)
+        assert "2:1.234568" in format_csr(t, precision=6)

@@ -1,0 +1,426 @@
+"""Telemetry subsystem tests (ISSUE 4): span tree + Timings views,
+metrics registry, counter absorption from the existing subsystems,
+exporters, and the telemetry-off no-op contract."""
+
+import json
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu import telemetry
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.telemetry import metrics as tm
+from oap_mllib_tpu.telemetry.spans import Span, current_span, enter
+from oap_mllib_tpu.utils.timing import Timings, phase_timer
+
+
+class TestSpans:
+    def test_nesting_and_paths(self):
+        root = Span("fit")
+        root.node("a/b").record(1.0)
+        root.node("a").record(2.0)
+        root.node("a/b").record(0.5)
+        a = root.child("a")
+        assert [c.name for c in root.children] == ["a"]
+        assert [c.name for c in a.children] == ["b"]
+        assert a.duration_s == pytest.approx(2.0)
+        assert a.child("b").duration_s == pytest.approx(1.5)
+        assert a.child("b").count == 2
+
+    def test_flat_excludes_unrecorded_containers(self):
+        """Implicit path containers (count=0) must not appear in the
+        flat view — the old record list only held explicit adds."""
+        root = Span("fit")
+        root.node("phase/compile").record(0.25)
+        assert root.flat() == {"phase/compile": pytest.approx(0.25)}
+
+    def test_walk_and_as_dict(self):
+        root = Span("fit")
+        root.node("x/y").record(1.0)
+        paths = [p for p, _ in root.walk()]
+        assert paths == ["fit", "fit/x", "fit/x/y"]
+        d = root.as_dict()
+        assert d["name"] == "fit"
+        assert d["children"][0]["children"][0]["name"] == "y"
+
+    def test_attributes_and_collective_notes(self):
+        sp = Span("phase")
+        sp.note_collective("allreduce_sum", 1024, 0.01)
+        sp.note_collective("allreduce_sum", 1024, 0.02)
+        sp.note_collective("broadcast", 64, 0.001)
+        coll = sp.attrs["collectives"]
+        assert coll["allreduce_sum"]["ops"] == 2
+        assert coll["allreduce_sum"]["bytes"] == 2048
+        assert coll["broadcast"]["ops"] == 1
+
+    def test_enter_stack_and_timing(self):
+        sp = Span("outer")
+        inner = sp.child("inner")
+        assert current_span() is None
+        with enter(sp):
+            assert current_span() is sp
+            with enter(inner):
+                assert current_span() is inner
+            assert current_span() is sp
+        assert current_span() is None
+        assert sp.count == 1 and sp.duration_s > 0
+        assert inner.duration_s <= sp.duration_s
+
+    def test_enter_records_on_exception(self):
+        sp = Span("s")
+        with pytest.raises(RuntimeError):
+            with enter(sp):
+                raise RuntimeError("boom")
+        assert sp.count == 1
+        assert current_span() is None
+
+
+class TestTimingsViews:
+    """Timings accessors must return exactly what the flat record list
+    returned (the backward-compat contract of the storage swap)."""
+
+    def test_add_and_as_dict_sum_duplicates(self):
+        t = Timings()
+        t.add("a", 1.0)
+        t.add("b/c", 0.5)
+        t.add("a", 0.25)
+        assert t.as_dict() == {
+            "a": pytest.approx(1.25), "b/c": pytest.approx(0.5)
+        }
+        assert t.total() == pytest.approx(1.75)
+
+    def test_subphases(self):
+        t = Timings()
+        t.add("lloyd_loop", 2.0)
+        t.add("lloyd_loop/stage", 0.3)
+        t.add("lloyd_loop/compute", 1.6)
+        assert t.subphases("lloyd_loop") == {
+            "stage": pytest.approx(0.3), "compute": pytest.approx(1.6)
+        }
+
+    def test_overlap_efficiency_matches_pre_span_formula(self):
+        t = Timings()
+        t.add("p/stage", 0.3)
+        t.add("p/transfer", 0.2)
+        t.add("p/compute", 0.9)
+        t.add("p/stream_wall", 1.0)
+        # wait = 1.0 - 0.9 = 0.1 of 0.5 staging -> 80% hidden
+        assert t.overlap_efficiency("p") == pytest.approx(0.8)
+        assert t.overlap_efficiency("absent") is None
+
+    def test_compile_split(self):
+        t = Timings()
+        assert t.compile_split("p") is None
+        t.add("p/compile", 0.7)
+        assert t.compile_split("p") == {
+            "compile": pytest.approx(0.7), "execute": 0.0
+        }
+
+    def test_phase_timer_records_into_tree(self):
+        t = Timings("kmeans.fit")
+        with phase_timer(t, "lloyd_loop"):
+            pass
+        assert t.root.name == "kmeans.fit"
+        assert "lloyd_loop" in t.as_dict()
+        assert t.root.child("lloyd_loop").count == 1
+
+    def test_phase_log_names_owner_and_rank(self, caplog):
+        """The ISSUE 4 satellite: concurrent fits' phase lines must be
+        attributable — the root name (and the rank, multi-process) ride
+        the log line."""
+        import logging
+
+        set_config(timing=True)
+        t = Timings("pca.fit")
+        with caplog.at_level(logging.INFO, logger="oap_mllib_tpu"):
+            t.add("covariance", 0.5)
+        assert "pca.fit" in caplog.text and "covariance" in caplog.text
+        set_config(num_processes=4, process_id=2)
+        with caplog.at_level(logging.INFO, logger="oap_mllib_tpu"):
+            t.add("eigh", 0.1)
+        assert "pca.fit[r2]" in caplog.text
+
+
+class TestMetricsRegistry:
+    def setup_method(self):
+        tm.reset()
+
+    def test_counter_and_gauge(self):
+        tm.counter("t_total").inc()
+        tm.counter("t_total").inc(2.5)
+        tm.gauge("t_gauge").set(7)
+        snap = tm.snapshot()
+        assert snap["t_total"][""] == pytest.approx(3.5)
+        assert snap["t_gauge"][""] == 7
+
+    def test_labels_are_distinct_series(self):
+        tm.counter("ops", {"op": "a"}).inc()
+        tm.counter("ops", {"op": "b"}).inc(3)
+        snap = tm.snapshot()
+        assert snap["ops"] == {"op=a": 1, "op=b": 3}
+
+    def test_histogram_bucket_edges(self):
+        """Fixed log-scale bounds: a value equal to a bound lands IN
+        that bound's bucket (le semantics); past the last bound lands
+        in +Inf."""
+        h = tm.histogram("h", bounds=(1.0, 4.0, 16.0))
+        for v in (0.5, 1.0, 1.0001, 4.0, 16.0, 17.0):
+            h.observe(v)
+        assert h.counts == [2, 2, 1, 1]  # [<=1, <=4, <=16, +Inf]
+        assert h.count == 6
+        assert h.sum == pytest.approx(0.5 + 1.0 + 1.0001 + 4.0 + 16.0 + 17.0)
+
+    def test_default_buckets_are_log_scale(self):
+        bs = tm.DURATION_BUCKETS
+        assert all(
+            bs[i + 1] / bs[i] == pytest.approx(4.0)
+            for i in range(len(bs) - 1)
+        )
+
+    def test_type_conflict_raises(self):
+        tm.counter("conflicted")
+        with pytest.raises(ValueError, match="already registered"):
+            tm.gauge("conflicted")
+
+    def test_prometheus_rendering(self):
+        tm.counter("c_total", {"algo": "kmeans"}, help="a counter").inc(2)
+        h = tm.histogram("lat_seconds", bounds=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        text = tm.render_prometheus()
+        assert "# HELP c_total a counter" in text
+        assert "# TYPE c_total counter" in text
+        assert 'c_total{algo="kmeans"} 2' in text
+        assert "# TYPE lat_seconds histogram" in text
+        # cumulative buckets: 1 at <=0.1, still 1 at <=1.0, 2 at +Inf
+        assert 'lat_seconds_bucket{le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{le="1"} 1' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 2' in text
+        assert "lat_seconds_count 2" in text
+
+
+class TestCounterAbsorption:
+    """The pre-existing stats objects must mirror into the registry at
+    their native increment points."""
+
+    def setup_method(self):
+        tm.reset()
+
+    def test_progcache_feeds_registry(self):
+        from oap_mllib_tpu.utils.progcache import ProgramCache
+
+        pc = ProgramCache()
+        pc.note("algoX", (1,))
+        pc.note("algoX", (1,))
+        pc.get_or_build("algoX", (2,), lambda: "prog")
+        snap = tm.snapshot()
+        assert snap["oap_progcache_misses_total"]["algo=algoX"] == 2
+        assert snap["oap_progcache_hits_total"]["algo=algoX"] == 1
+
+    def test_prefetch_feeds_registry(self):
+        from oap_mllib_tpu.data.prefetch import Prefetcher, PrefetchStats
+
+        stats = PrefetchStats()
+        chunks = [np.zeros((16, 4), np.float32) for _ in range(3)]
+        with Prefetcher(chunks, depth=2, stats=stats) as pf:
+            list(pf)
+        stats.finalize(None, "test_phase", wall=0.5)
+        snap = tm.snapshot()
+        assert snap["oap_prefetch_chunks_total"]["phase=test_phase"] == 3
+        assert snap["oap_stream_rows_total"]["phase=test_phase"] == 48
+        assert (
+            snap["oap_stream_bytes_staged_total"]["phase=test_phase"]
+            == 3 * 16 * 4 * 4
+        )
+        assert stats.bytes_staged == 3 * 16 * 4 * 4
+        assert stats.rows == 48
+
+    def test_resilience_feeds_registry(self):
+        from oap_mllib_tpu.utils.resilience import ResilienceStats
+
+        stats = ResilienceStats()
+        stats.record("site", "transient", RuntimeError("x"))
+        stats.note_retry(0.25)
+        stats.note_degradation()
+        snap = tm.snapshot()
+        assert snap["oap_resilience_faults_total"]["kind=transient"] == 1
+        assert snap["oap_resilience_retries_total"][""] == 1
+        assert snap["oap_resilience_backoff_seconds_total"][""] == 0.25
+        assert snap["oap_resilience_degradations_total"][""] == 1
+        # the per-fit object kept its own view too
+        assert stats.retries == 1 and stats.backoff_s == 0.25
+
+    def test_collective_facade_feeds_registry_and_span(self, rng):
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.parallel.collective import allreduce_sum
+        from oap_mllib_tpu.parallel.mesh import get_mesh
+
+        x = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+        sp = Span("phase")
+        with enter(sp, annotate=False):
+            allreduce_sum(x, get_mesh())
+        snap = tm.snapshot()
+        assert snap["oap_collective_ops_total"]["op=allreduce_sum"] == 1
+        assert (
+            snap["oap_collective_bytes_total"]["op=allreduce_sum"]
+            == x.nbytes
+        )
+        assert sp.attrs["collectives"]["allreduce_sum"]["ops"] == 1
+
+
+class TestFitSummaryTelemetry:
+    def test_in_memory_fit_exposes_span_tree_and_metrics(self, rng):
+        from oap_mllib_tpu import KMeans
+
+        x = rng.normal(size=(256, 6)).astype(np.float32)
+        m = KMeans(k=3, max_iter=3, seed=0).fit(x)
+        tele = m.summary.telemetry
+        assert tele["fit"] == "kmeans.fit"
+        names = {c["name"] for c in tele["spans"]["children"]}
+        assert {"table_convert", "init_centers", "lloyd_loop"} <= names
+        assert tele["spans"]["duration_s"] > 0
+        assert "oap_fit_total" in tele["metrics"]
+        # the flat views still work off the same storage
+        assert m.summary.timings.total() > 0
+
+    def test_pca_and_streamed_fit_summaries(self, rng):
+        from oap_mllib_tpu import PCA, KMeans
+        from oap_mllib_tpu.data.stream import ChunkSource
+
+        x = rng.normal(size=(400, 6)).astype(np.float32)
+        p = PCA(k=2).fit(x)
+        assert p.summary["telemetry"]["fit"] == "pca.fit"
+        src = ChunkSource.from_array(x, chunk_rows=128)
+        m = KMeans(k=3, max_iter=2, seed=0).fit(src)
+        paths = {
+            pth for pth, _ in
+            _tree_paths(m.summary.telemetry["spans"])
+        }
+        assert "kmeans.fit/lloyd_loop/stage" in paths
+        assert "kmeans.fit/lloyd_loop/compute" in paths
+
+
+class TestCompatSurfaces:
+    def test_drop_in_summary_exposes_telemetry(self, rng):
+        """The compat layers proxy the inner summaries, so the span tree
+        + metrics snapshot must reach unmodified user code through the
+        drop-in surface too (the ISSUE 4 contract)."""
+        from oap_mllib_tpu.compat import KMeans as CompatKMeans
+
+        x = rng.normal(size=(256, 5)).astype(np.float32)
+        m = CompatKMeans().setK(3).setSeed(1).fit({"features": x})
+        assert m.summary.telemetry["fit"] == "kmeans.fit"
+        assert "oap_fit_total" in m.summary.telemetry["metrics"]
+        names = {
+            c["name"] for c in m.summary.telemetry["spans"]["children"]
+        }
+        assert "lloyd_loop" in names
+
+
+def _tree_paths(tree, prefix=""):
+    path = prefix + tree["name"]
+    yield path, tree
+    for c in tree.get("children", []):
+        yield from _tree_paths(c, path + "/")
+
+
+class TestExporters:
+    def test_jsonl_round_trip(self, rng, tmp_path):
+        from oap_mllib_tpu import KMeans
+
+        sink = tmp_path / "t.jsonl"
+        set_config(telemetry_log=str(sink))
+        x = rng.normal(size=(128, 4)).astype(np.float32)
+        m = KMeans(k=2, max_iter=2, seed=0).fit(x)
+        lines = sink.read_text().splitlines()
+        assert lines
+        records = [json.loads(ln) for ln in lines]  # every line parses
+        spans = [r for r in records if r["type"] == "span"]
+        metrics_recs = [r for r in records if r["type"] == "metrics"]
+        assert len(metrics_recs) == 1
+        assert all(r["rank"] == 0 for r in records)
+        seqs = [r["seq"] for r in records]
+        assert seqs == sorted(seqs)
+        # the span records reproduce the summary tree exactly
+        summary_paths = {
+            p: n["duration_s"]
+            for p, n in _tree_paths(m.summary.telemetry["spans"])
+        }
+        jsonl_paths = {r["path"]: r["duration_s"] for r in spans}
+        assert jsonl_paths == summary_paths
+
+    def test_multi_process_sink_is_rank_suffixed(self, tmp_path):
+        from oap_mllib_tpu.telemetry.export import sink_path
+
+        set_config(telemetry_log=str(tmp_path / "w.jsonl"))
+        assert sink_path() == str(tmp_path / "w.jsonl")
+        set_config(num_processes=4, process_id=3)
+        assert sink_path() == str(tmp_path / "w.jsonl") + ".rank3"
+
+    def test_report_renders_fit_and_process_views(self, rng):
+        from oap_mllib_tpu import KMeans
+
+        x = rng.normal(size=(128, 4)).astype(np.float32)
+        m = KMeans(k=2, max_iter=2, seed=0).fit(x)
+        text = telemetry.report(m.summary)
+        assert "kmeans.fit" in text and "lloyd_loop" in text
+        proc = telemetry.report()
+        assert "process metrics" in proc
+
+    def test_render_prometheus_reexport(self):
+        tm.counter("oap_reexport_check_total").inc()
+        assert "oap_reexport_check_total 1" in telemetry.render_prometheus()
+
+
+class TestTelemetryOff:
+    def test_no_sink_no_file(self, rng, tmp_path, monkeypatch):
+        """With telemetry_log empty nothing is written anywhere and the
+        fit still carries its summary telemetry (the in-memory layer is
+        the accounting the summary always paid for)."""
+        from oap_mllib_tpu import KMeans
+        from oap_mllib_tpu.telemetry import export
+
+        monkeypatch.chdir(tmp_path)
+        calls = []
+        monkeypatch.setattr(
+            export, "_write_lines",
+            lambda *a, **k: calls.append(a),
+        )
+        x = rng.normal(size=(128, 4)).astype(np.float32)
+        m = KMeans(k=2, max_iter=2, seed=0).fit(x)
+        assert calls == []  # sink off -> the writer is never invoked
+        assert list(tmp_path.iterdir()) == []
+        assert m.summary.telemetry["fit"] == "kmeans.fit"
+
+    def test_span_annotation_guard_off_by_default(self):
+        from oap_mllib_tpu.utils import profiling
+
+        assert profiling.trace_active() is False
+
+    def test_off_overhead_is_bounded(self, rng):
+        """20 tiny fits with telemetry fully off: the span/registry layer
+        must not dominate the fit wall.  This is a smoke bound (the real
+        ≤2% gate is a bench comparison, not a unit test): the telemetry
+        bookkeeping for a fit is a handful of dict ops, so 20 fits'
+        TOTAL finalize+span cost must stay far under one fit's wall."""
+        import time
+
+        from oap_mllib_tpu import KMeans
+
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        KMeans(k=2, max_iter=2, seed=0).fit(x)  # warm compile
+        t0 = time.perf_counter()
+        for _ in range(20):
+            KMeans(k=2, max_iter=2, seed=0).fit(x)
+        fit_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(2000):
+            t = Timings("kmeans.fit")
+            with phase_timer(t, "lloyd_loop"):
+                pass
+            telemetry.finalize_fit({"timings": t})
+        tele_wall = (time.perf_counter() - t0) / 100  # per-20-fits cost
+        assert tele_wall < max(0.02 * fit_wall, 0.005), (
+            tele_wall, fit_wall
+        )
