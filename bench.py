@@ -103,7 +103,8 @@ def _compile_extras(timings, phase, cache_delta=None):
 # ---------------------------------------------------------------------------
 
 
-def bench_kmeans(precision="highest", cpu_ips=None, extra=None):
+def bench_kmeans(precision="highest", cpu_ips=None, extra=None,
+                 policy="f32"):
     import jax
     import jax.numpy as jnp
 
@@ -145,7 +146,7 @@ def bench_kmeans(precision="highest", cpu_ips=None, extra=None):
             c, it, cost, _ = lloyd_run_pallas(xj, wj, cj, iters, tol, mode=precision)
         else:
             c, it, cost, _ = kmeans_ops.lloyd_run(
-                xj, wj, cj, iters, tol, chunks, precision
+                xj, wj, cj, iters, tol, chunks, precision, policy=policy
             )
         # fetch centers: on remote-execution backends block_until_ready can
         # be a no-op, so only a host transfer truly synchronizes
@@ -180,6 +181,9 @@ def bench_kmeans(precision="highest", cpu_ips=None, extra=None):
         cpu_ips = 1.0 / (t_cpu_sub * (n / sub))
 
     suffix = "" if precision == "high" else f"_{precision}"
+    # the recorded precision follows the COMPUTE POLICY (no longer
+    # hardwired to a tier): an f32 policy keeps the legacy tier string
+    # for BASELINE.md row continuity, a reduced policy names itself
     _emit(
         f"kmeans_1Mx256_k1000_iters_per_sec{suffix}",
         iters_per_sec,
@@ -187,7 +191,9 @@ def bench_kmeans(precision="highest", cpu_ips=None, extra=None):
         iters_per_sec / cpu_ips,
         tflops=round(tflops, 1),
         mfu=round(tflops * 1e12 / _peak_flops(), 3),
-        precision=precision,
+        precision=precision if policy == "f32" else policy,
+        compute_precision=policy,
+        matmul_tier=precision,
         n_iter=n_iter,
         kernel="pallas" if use_pallas else "xla",
         compile_sec=round(max(t_first - dt, 0.0), 2),
@@ -914,6 +920,103 @@ def bench_compile_sweep(n_sizes: int = 10, d: int = 16, k: int = 8,
     return out
 
 
+# ---------------------------------------------------------------------------
+# Mixed-precision policy sweep (bench.py --precision-sweep)
+# ---------------------------------------------------------------------------
+
+
+def bench_precision_sweep(emit: bool = True) -> dict:
+    """Fit all three estimators under each compute-precision policy
+    (utils/precision.py) on fixed seeds, reporting throughput
+    (iters/sec for K-Means, fits/sec for PCA, iters/sec for ALS) AND
+    parity vs the f32 policy — the same metrics dev/precision_gate.py
+    asserts, recorded instead of gated, so a BASELINE row can show what
+    each policy buys and costs on this backend.  CI-affordable shapes;
+    on a real TPU the bf16 rows are the MFU-movers (half the operand
+    HBM bytes, 2x MXU throughput)."""
+    from oap_mllib_tpu.config import get_config, set_config
+    from oap_mllib_tpu.models.als import ALS
+    from oap_mllib_tpu.models.kmeans import KMeans
+    from oap_mllib_tpu.models.pca import PCA
+    from oap_mllib_tpu.utils.precision import TIERS
+
+    rng = np.random.default_rng(17)
+    n, d, k = 1 << 15, 64, 32
+    proto = rng.normal(size=(k, d)).astype(np.float32) * 4.0
+    x = (proto[rng.integers(k, size=n)]
+         + rng.normal(size=(n, d)).astype(np.float32) * 0.3)
+    nu, ni, nnz, rank = 1500, 900, 60_000, 8
+    users = rng.integers(nu, size=nnz).astype(np.int64)
+    items = rng.integers(ni, size=nnz).astype(np.int64)
+    ratings = (rng.random(nnz) * 4 + 1).astype(np.float32)
+    km_iters, als_iters = 10, 5
+    scale = float(np.abs(x).max())
+
+    prior = get_config().compute_precision
+    out = {}
+    ref = {}
+    try:
+        for pol in TIERS:  # f32 first: the parity reference
+            set_config(compute_precision=pol)
+            km = KMeans(k=k, seed=5, init_mode="random", max_iter=km_iters)
+            t_km = _best_of(lambda: km.fit(x), reps=2)
+            m = km.fit(x)
+            t_pca = _best_of(lambda: PCA(k=8).fit(x), reps=2)
+            p = PCA(k=8).fit(x)
+            als = ALS(rank=rank, max_iter=als_iters, seed=3,
+                      implicit_prefs=True, alpha=10.0)
+            t_als = _best_of(lambda: als.fit(users, items, ratings), reps=2)
+            a = als.fit(users, items, ratings)
+            pred = a.predict(users[:2000], items[:2000])
+            row = {
+                "kmeans_iters_per_sec": round(
+                    max(int(m.summary.num_iter), 1) / t_km, 3
+                ),
+                "pca_fits_per_sec": round(1.0 / t_pca, 3),
+                "als_iters_per_sec": round(als_iters / t_als, 3),
+                "policy_recorded": m.summary.precision,
+            }
+            if pol == "f32":
+                ref = {
+                    "centers": np.sort(m.cluster_centers_, axis=0),
+                    "cost": m.summary.training_cost,
+                    "pc": p.components_,
+                    "pred": pred,
+                }
+            else:
+                row["kmeans_centroid_rel_dev"] = float(
+                    np.abs(
+                        np.sort(m.cluster_centers_, axis=0) - ref["centers"]
+                    ).max() / scale
+                )
+                row["kmeans_cost_rel_dev"] = float(
+                    abs(m.summary.training_cost - ref["cost"])
+                    / max(ref["cost"], 1e-30)
+                )
+                # principal-subspace angle via the singular values of
+                # the cross-projection (order/sign-free)
+                s = np.linalg.svd(ref["pc"].T @ p.components_,
+                                  compute_uv=False)
+                row["pca_subspace_rad"] = float(
+                    np.arccos(np.clip(s.min(), 0.0, 1.0))
+                )
+                row["als_pred_rel_rmse"] = float(
+                    np.sqrt(np.mean((pred - ref["pred"]) ** 2))
+                    / max(float(np.sqrt(np.mean(ref["pred"] ** 2))), 1e-30)
+                )
+            out[pol] = row
+            if emit:
+                _emit(
+                    "precision_sweep", row["kmeans_iters_per_sec"],
+                    "kmeans iters/sec", 1.0, precision=pol,
+                    **{k2: v for k2, v in row.items()
+                       if k2 != "kmeans_iters_per_sec"},
+                )
+    finally:
+        set_config(compute_precision=prior)
+    return out
+
+
 def _tests_tpu_status(timeout=900):
     """Run the compiled-mode TPU suite and report its outcome, so the
     bench artifact itself proves whether compiled-Pallas coverage ran on
@@ -958,7 +1061,15 @@ def main():
                     help="compile-amortization sweep: K-Means fits at 10 "
                          "distinct row counts, shape bucketing off vs on, "
                          "counting real XLA compiles + checking parity")
+    ap.add_argument("--precision-sweep", action="store_true",
+                    help="mixed-precision policy sweep: the three "
+                         "estimators under f32/tf32/bf16, reporting "
+                         "throughput + parity vs f32 per policy")
     args = ap.parse_args()
+
+    if args.precision_sweep:
+        bench_precision_sweep()
+        return
 
     if args.compile_sweep:
         bench_compile_sweep()
@@ -992,24 +1103,34 @@ def main():
         extra["tests_tpu"] = _tests_tpu_status()
 
     from oap_mllib_tpu.config import get_config
+    from oap_mllib_tpu.utils import precision as psn
 
-    # Headline tier: "high" — bf16_3x sums + bf16 assignment, validated
-    # within the 1e-4 parity bar by tests_tpu (whose status rides along in
-    # the same JSON line).  An explicit env override still wins.
-    precision = (
-        get_config().matmul_precision
-        if "OAP_MLLIB_TPU_MATMUL_PRECISION" in os.environ
-        else "high"
-    )
+    # The compute-precision POLICY resolves first (Config
+    # .compute_precision / kmeans_precision — utils/precision.py): a
+    # reduced policy maps the kernel tier itself and is what the JSON's
+    # `precision` field records.  Under the default f32 policy the
+    # headline tier stays "high" — bf16_3x sums + bf16 assignment,
+    # validated within the 1e-4 parity bar by tests_tpu (whose status
+    # rides along in the same JSON line) — and an explicit env override
+    # of matmul_precision still wins.
+    pol = psn.resolve("kmeans")
+    if pol.name != "f32":
+        precision = psn.kernel_tier(pol.name, get_config().matmul_precision)
+    else:
+        precision = (
+            get_config().matmul_precision
+            if "OAP_MLLIB_TPU_MATMUL_PRECISION" in os.environ
+            else "high"
+        )
     if args.all:
-        _, cpu_ips = bench_kmeans("high", extra=extra)
-        bench_kmeans("highest", cpu_ips=cpu_ips)  # same CPU denominator
+        _, cpu_ips = bench_kmeans("high", extra=extra, policy=pol.name)
+        bench_kmeans("highest", cpu_ips=cpu_ips, policy=pol.name)
         bench_pca(n=1 << 20, d=128)
         bench_pca(n=1 << 17, d=2048)  # largest-d single-chip proxy
         bench_als()
         bench_als_large()
     else:
-        bench_kmeans(precision, extra=extra)
+        bench_kmeans(precision, extra=extra, policy=pol.name)
 
 
 if __name__ == "__main__":

@@ -73,6 +73,13 @@ echo "   with exact retry counters + 1e-6 parity; persistent OOM escalates"
 echo "   accelerated -> halved-chunk -> CPU fallback (dev/fault_gate.py) =="
 python dev/fault_gate.py
 
+echo "== precision gate: compute_precision='f32' is bit-compatible with the"
+echo "   pre-policy kernels, bf16 holds the registered parity bounds on all"
+echo "   three estimators, the chosen policy lands in summaries/span trees,"
+echo "   and an injected non-finite iterate under bf16 degrades the fit to"
+echo "   f32 via the resilience ladder's precision rung (dev/precision_gate.py) =="
+python dev/precision_gate.py
+
 echo "== telemetry gate: JSONL sink parses line-by-line, span trees match the"
 echo "   expected shape per estimator, collective op counters fire on the"
 echo "   pseudo-mesh ALS fit, resilience counters zero (dev/telemetry_gate.py) =="

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""CI gate: the mixed-precision compute policy must hold its contracts.
+
+Drives the policy subsystem (utils/precision.py) end to end and asserts:
+
+- **f32 bit-compatibility** — ``compute_precision="f32"`` (the default)
+  reproduces pre-policy numerics EXACTLY: op-level, the policy-threaded
+  kernels called with ``policy="f32"`` match their default-argument
+  (pre-policy) invocations bit-for-bit; fit-level, a default-config fit
+  and an explicit-f32 fit produce identical models;
+- **bf16 parity** — all three estimators fit at ``bf16`` on fixed seeds
+  match their f32 fits within the registered bounds
+  (``precision.PARITY_BOUNDS``): K-Means centroids/cost, PCA principal
+  subspace angle + explained-variance ratios, ALS factor/prediction
+  RMSE.  Streamed K-Means/PCA run the bf16-STAGED pipeline (the
+  cast-at-staging path), in-memory ALS the bf16 moment kernels;
+- **observability** — the chosen policy lands in the fit summary
+  (``precision``), on the span-tree root (``attrs["precision"]``, the
+  telemetry exporters' source), and follows the per-algorithm override;
+- **degradation** — an injected non-finite iterate (``fit.execute:nan``)
+  under bf16 steps the resilience ladder's precision rung: the fit
+  COMPLETES at f32 (summary records the rung), accelerated.
+
+Exit 1 with the offending numbers on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _blobs(rng, n, d, k, spread=6.0, noise=0.2):
+    import numpy as np
+
+    proto = rng.normal(size=(k, d)).astype(np.float32) * spread
+    x = (proto[rng.integers(k, size=n)]
+         + rng.normal(size=(n, d)).astype(np.float32) * noise)
+    return x, proto
+
+
+def main() -> int:
+    import numpy as np
+
+    from oap_mllib_tpu.config import set_config
+    from oap_mllib_tpu.data.stream import ChunkSource
+    from oap_mllib_tpu.models.als import ALS
+    from oap_mllib_tpu.models.kmeans import KMeans
+    from oap_mllib_tpu.models.pca import PCA
+    from oap_mllib_tpu.utils import faults
+    from oap_mllib_tpu.utils.precision import PARITY_BOUNDS
+
+    failures = []
+    report = {}
+    rng = np.random.default_rng(11)
+
+    # -- 1) op-level f32 bit-compat: policy="f32" == pre-policy defaults ----
+    import jax.numpy as jnp
+
+    from oap_mllib_tpu.ops import als_ops, kmeans_ops, pca_ops
+
+    x, _ = _blobs(rng, 512, 16, 4)
+    xj = jnp.asarray(x)
+    w = jnp.ones((512,), jnp.float32)
+    c = jnp.asarray(x[:4])
+    for tier in ("highest", "high", "default"):
+        a = kmeans_ops._accumulate(xj, w, c, tier, True)
+        b = kmeans_ops._accumulate(xj, w, c, tier, True, "f32")
+        if not all(np.array_equal(np.asarray(u), np.asarray(v))
+                   for u, v in zip(a, b)):
+            failures.append(f"kmeans._accumulate policy=f32 != default @ {tier}")
+    cov_a = pca_ops._covariance_jit(xj, w, jnp.asarray(512.0), "highest")
+    cov_b = pca_ops._covariance_jit(xj, w, jnp.asarray(512.0), "highest", "f32")
+    if not np.array_equal(np.asarray(cov_a[0]), np.asarray(cov_b[0])):
+        failures.append("pca._covariance_jit policy=f32 != default")
+    ys = jnp.asarray(rng.normal(size=(40, 8)).astype(np.float32))
+    src_g = jnp.asarray(rng.integers(40, size=(8, 16)).astype(np.int32))
+    gm_a = als_ops.grouped_block_moments(
+        src_g, jnp.ones((8, 16), jnp.float32), jnp.ones((8, 16), jnp.float32),
+        ys, jnp.asarray(10.0), True,
+    )
+    gm_b = als_ops.grouped_block_moments(
+        src_g, jnp.ones((8, 16), jnp.float32), jnp.ones((8, 16), jnp.float32),
+        ys, jnp.asarray(10.0), True, "f32",
+    )
+    if not np.array_equal(np.asarray(gm_a), np.asarray(gm_b)):
+        failures.append("als.grouped_block_moments policy=f32 != default")
+
+    # -- 2) fit-level f32 bit-compat: default config == explicit f32 --------
+    n, d, k = 4096, 16, 4
+    x, proto = _blobs(rng, n, d, k)
+    set_config(compute_precision="f32")
+    km_f32 = KMeans(k=k, seed=7, max_iter=12).fit(x)
+    # the true default path: a FRESH config (compute_precision never set)
+    import oap_mllib_tpu.config as cfgmod
+
+    with cfgmod._lock:
+        cfgmod._config = None
+    km_def = KMeans(k=k, seed=7, max_iter=12).fit(x)
+    if not np.array_equal(km_f32.cluster_centers_, km_def.cluster_centers_):
+        failures.append("fit under compute_precision='f32' != default-config fit")
+    if km_f32.summary.precision != "f32":
+        failures.append(
+            f"f32 summary records {km_f32.summary.precision!r}, not 'f32'"
+        )
+
+    # -- 3) bf16 parity within the registered bounds ------------------------
+    scale = float(np.abs(x).max())
+    src = ChunkSource.from_array(x, chunk_rows=512)
+    km_ref = KMeans(k=k, seed=7, max_iter=12).fit(src)  # streamed f32
+    # k-1 components: 4 well-separated protos span a rank-3 between-
+    # cluster subspace — component 4 would be an ill-defined isotropic
+    # noise direction no precision reproduces
+    p_ref = PCA(k=3).fit(src)
+    nu, ni, nnz, rank = 800, 500, 40_000, 8
+    users = rng.integers(nu, size=nnz).astype(np.int64)
+    items = rng.integers(ni, size=nnz).astype(np.int64)
+    ratings = (rng.random(nnz) * 4 + 1).astype(np.float32)
+    als_ref = ALS(rank=rank, max_iter=5, seed=3, implicit_prefs=True,
+                  alpha=10.0).fit(users, items, ratings)
+    pred_ref = als_ref.predict(users[:4000], items[:4000])
+
+    set_config(compute_precision="bf16")
+    km_bf = KMeans(k=k, seed=7, max_iter=12).fit(src)
+    p_bf = PCA(k=3).fit(src)
+    als_bf = ALS(rank=rank, max_iter=5, seed=3, implicit_prefs=True,
+                 alpha=10.0).fit(users, items, ratings)
+    pred_bf = als_bf.predict(users[:4000], items[:4000])
+
+    kb = PARITY_BOUNDS["kmeans"]
+    # match centroids by nearest-reference (same seed/init, so the
+    # pairing is stable on well-separated blobs)
+    d2 = ((km_bf.cluster_centers_[:, None, :]
+           - km_ref.cluster_centers_[None, :, :]) ** 2).sum(-1)
+    cen_dev = float(np.sqrt(d2.min(axis=1)).max()) / scale
+    cost_dev = abs(km_bf.summary.training_cost - km_ref.summary.training_cost)
+    cost_dev /= max(km_ref.summary.training_cost, 1e-30)
+    report["kmeans"] = {"centroid_rel": cen_dev, "cost_rel": cost_dev}
+    if cen_dev > kb["centroid_rel"] or cost_dev > kb["cost_rel"]:
+        failures.append(f"kmeans bf16 parity out of bounds: {report['kmeans']}")
+    if km_bf.summary.precision != "bf16":
+        failures.append("bf16 streamed kmeans summary missing precision")
+
+    pb = PARITY_BOUNDS["pca"]
+    s = np.linalg.svd(p_ref.components_.T @ p_bf.components_, compute_uv=False)
+    angle = float(np.arccos(np.clip(s.min(), 0.0, 1.0)))
+    ratio_dev = float(
+        np.abs(p_bf.explained_variance_ - p_ref.explained_variance_).max()
+    )
+    report["pca"] = {"subspace_rad": angle, "ratio_abs": ratio_dev}
+    if angle > pb["subspace_rad"] or ratio_dev > pb["ratio_abs"]:
+        failures.append(f"pca bf16 parity out of bounds: {report['pca']}")
+
+    ab = PARITY_BOUNDS["als"]
+    f_dev = float(np.abs(als_bf.user_factors_ - als_ref.user_factors_).max())
+    f_dev /= max(float(np.abs(als_ref.user_factors_).max()), 1e-30)
+    rmse = float(np.sqrt(np.mean((pred_bf - pred_ref) ** 2)))
+    rmse /= max(float(np.sqrt(np.mean(pred_ref ** 2))), 1e-30)
+    report["als"] = {"factor_rel": f_dev, "rmse_rel": rmse}
+    if f_dev > ab["factor_rel"] or rmse > ab["rmse_rel"]:
+        failures.append(f"als bf16 parity out of bounds: {report['als']}")
+
+    # -- 4) observability: summary + span attrs + per-algo override ---------
+    spans = km_bf.summary.timings.root.attrs
+    if spans.get("precision") != "bf16":
+        failures.append(f"span-tree root attrs missing precision: {spans}")
+    if p_bf.summary.get("precision") != "bf16":
+        failures.append("pca summary missing precision=bf16")
+    if als_bf.summary.get("precision") != "bf16":
+        failures.append("als summary missing precision=bf16")
+    set_config(compute_precision="bf16", kmeans_precision="f32")
+    km_ov = KMeans(k=k, seed=7, max_iter=2).fit(x)
+    if km_ov.summary.precision != "f32":
+        failures.append(
+            "kmeans_precision override ignored: "
+            f"{km_ov.summary.precision!r}"
+        )
+    set_config(kmeans_precision="")
+
+    # -- 5) the precision-degradation rung ----------------------------------
+    set_config(compute_precision="bf16", fault_spec="fit.execute:nan=1",
+               retry_backoff=0.001)
+    faults.reset()
+    km_rung = KMeans(k=k, seed=7, max_iter=6).fit(src)
+    res = km_rung.summary.resilience
+    report["rung"] = {
+        "precision": km_rung.summary.precision,
+        "degradations": res["degradations"],
+        "accelerated": bool(km_rung.summary.accelerated),
+    }
+    if km_rung.summary.precision != "f32":
+        failures.append(
+            "precision rung did not degrade to f32: "
+            f"{report['rung']}"
+        )
+    if res["degradations"] != 1 or not km_rung.summary.accelerated:
+        failures.append(f"precision rung counters wrong: {report['rung']}")
+    set_config(fault_spec="", compute_precision="f32")
+
+    print(json.dumps(report, indent=2, sort_keys=True))
+    for f in failures:
+        print(f"FAIL: {f}")
+    print(f"precision gate: {'FAIL' if failures else 'OK'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

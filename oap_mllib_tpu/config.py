@@ -181,6 +181,28 @@ class Config:
     # seconds: connection attempts retry with backoff until this
     # deadline, then fail with an error naming coordinator/rank/elapsed.
     bootstrap_timeout: float = 60.0
+    # -- mixed-precision compute policy (utils/precision.py) -----------------
+    # Process-wide input/accumulation precision for the matmul-dominated
+    # hot paths (K-Means Lloyd distances + centroid sums, PCA
+    # Gram/colsum, ALS normal-equation moments), in-memory AND streamed:
+    # "f32" = today's behavior, bit-compatible (operands stay f32, dots
+    # run at matmul_precision); "tf32" = f32 operands, bf16_3x dots
+    # (lax.Precision.HIGH — the TPU analog of TF32, ~1e-5 of full f32);
+    # "bf16" = operands cast to bfloat16 (at STAGING time on streamed
+    # paths, halving host->device bytes) with f32 accumulators — solves,
+    # norms, and convergence state stay f32; "auto" = bf16 where a
+    # parity bound is registered for the algorithm and the backend has
+    # fast bf16 MXUs, else f32.  enable_x64 pins every fit to f32.  A
+    # non-finite iterate under a reduced policy degrades the fit to f32
+    # via the resilience ladder's precision rung instead of failing.
+    # Parity bounds + gate: utils/precision.py, dev/precision_gate.py.
+    compute_precision: str = "f32"
+    # Per-algorithm overrides of compute_precision (same vocabulary,
+    # including "auto"); empty = inherit.  E.g. kmeans_precision="bf16"
+    # runs only K-Means reduced while PCA/ALS stay at the global policy.
+    kmeans_precision: str = ""
+    pca_precision: str = ""
+    als_precision: str = ""
     # -- telemetry layer (oap_mllib_tpu/telemetry/) --------------------------
     # jax.profiler trace directory: non-empty wraps every estimator fit
     # in a profiler trace written there (utils/profiling.maybe_trace),

@@ -29,6 +29,7 @@ import numpy as np
 from oap_mllib_tpu import telemetry
 from oap_mllib_tpu.fallback import als_np
 from oap_mllib_tpu.ops import als_ops
+from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.dispatch import should_accelerate
 from oap_mllib_tpu.utils.timing import Timings, phase_timer
@@ -521,6 +522,9 @@ class ALS:
         NumPy rung)."""
         timings = Timings("als.fit")
         cache_before = progcache.stats()
+        # compute-precision policy (utils/precision.py), resolved per
+        # attempt so the ladder's f32-degradation scope applies on retry
+        pol = psn.resolve("als")
         if x0 is None:
             x0 = als_np.init_factors(n_users, self.rank, self.seed)
             y0 = als_np.init_factors(n_items, self.rank, self.seed + 1)
@@ -573,24 +577,26 @@ class ALS:
                     by_user, by_item, x0, y0, n_users, n_items,
                     self.max_iter, self.reg_param, self.alpha,
                     self.implicit_prefs, timings=timings, degraded=True,
+                    policy=pol.name,
                 )
             elif grouped_ok:
                 x, y = als_ops.als_run_grouped(
                     *dev, jnp.asarray(x0), jnp.asarray(y0),
                     n_users, n_items, self.max_iter, self.reg_param,
                     self.alpha, self.implicit_prefs, timings=timings,
+                    policy=pol.name,
                 )
             elif self.implicit_prefs:
                 x, y = als_ops.als_implicit_run(
                     u, i, c, valid, jnp.asarray(x0), jnp.asarray(y0),
                     n_users, n_items, self.max_iter, self.reg_param,
-                    self.alpha, timings=timings,
+                    self.alpha, timings=timings, policy=pol.name,
                 )
             else:
                 x, y = als_ops.als_explicit_run(
                     u, i, c, valid, jnp.asarray(x0), jnp.asarray(y0),
                     n_users, n_items, self.max_iter, self.reg_param,
-                    timings=timings,
+                    timings=timings, policy=pol.name,
                 )
             x = np.asarray(x)
             y = np.asarray(y)
@@ -603,6 +609,7 @@ class ALS:
         }
         if degraded and grouped_ok:
             summary["streamed"] = True  # the OOM rung ran the streamed kernels
+        psn.record(summary, timings, pol)
         return ALSModel(x, y, summary)
 
     @staticmethod
@@ -771,6 +778,7 @@ class ALS:
         def attempt(degraded):
             timings = Timings("als.fit")
             cache_before = progcache.stats()
+            pol = psn.resolve("als")
             with phase_timer(timings, "table_convert"):
                 by_user = als_ops.build_grouped_edges(
                     users, items, ratings, n_users
@@ -785,15 +793,16 @@ class ALS:
                     by_user, by_item, x0, y0, n_users, n_items,
                     self.max_iter, self.reg_param, self.alpha,
                     self.implicit_prefs, timings=timings,
-                    degraded=degraded,
+                    degraded=degraded, policy=pol.name,
                 )
-            return ALSModel(
-                x, y,
-                {"timings": timings, "accelerated": True, "streamed": True,
-                 "als_kernel": "grouped", "item_layout": "replicated",
-                 "progcache": progcache.delta(cache_before),
-                 **self._block_summary(1)},
-            )
+            summary = {
+                "timings": timings, "accelerated": True, "streamed": True,
+                "als_kernel": "grouped", "item_layout": "replicated",
+                "progcache": progcache.delta(cache_before),
+                **self._block_summary(1),
+            }
+            psn.record(summary, timings, pol)
+            return ALSModel(x, y, summary)
 
         model = resilience.resilient_fit(
             "ALS", attempt,
@@ -887,6 +896,7 @@ class ALS:
             )
         timings = Timings("als.fit")
         cache_before = progcache.stats()
+        pol = psn.resolve("als")
         x0 = None if init is None else np.array(init[0], np.float32)
         y0 = None if init is None else np.array(init[1], np.float32)
         with phase_timer(timings, "table_convert"):
@@ -919,7 +929,7 @@ class ALS:
             x_blocks, y = als_block_stream.als_block_run_streamed(
                 lay, x0_dev, y0_dev, self.max_iter, self.reg_param,
                 self.alpha, mesh, implicit=self.implicit_prefs,
-                timings=timings,
+                timings=timings, policy=pol.name,
             )
             jax.block_until_ready((x_blocks, y))
         summary = {
@@ -930,6 +940,7 @@ class ALS:
             "progcache": progcache.delta(cache_before),
             **self._block_summary(world),
         }
+        psn.record(summary, timings, pol)
         if item_sharded:
             return ALSModel(
                 None, None, summary,
@@ -962,6 +973,7 @@ class ALS:
         cfg = get_config()
         axis = cfg.data_axis
         world = mesh.shape[axis]
+        pol = psn.resolve("als")
         # item-factor layout (replicated-Y vs the full 2-D grid) and the
         # pre-shuffle grouped-vs-COO guard — the shared decision point
         # (_block_dispatch): a COO decision pays neither the grouped
@@ -1031,26 +1043,26 @@ class ALS:
                     x_blocks, y = als_block.als_block_run_grouped_2d(
                         grouped, x0_dev, y0_dev,
                         self.max_iter, self.reg_param, self.alpha, mesh,
-                        implicit=self.implicit_prefs,
+                        implicit=self.implicit_prefs, policy=pol.name,
                     )
                 else:
                     x_blocks, y = als_block.als_block_run_2d(
                         u_loc, i_glob, conf, valid, *item_shuffle,
                         x0_dev, y0_dev,
                         self.max_iter, self.reg_param, self.alpha, mesh,
-                        implicit=self.implicit_prefs,
+                        implicit=self.implicit_prefs, policy=pol.name,
                     )
             elif grouped is not None:
                 x_blocks, y = als_block.als_block_run_grouped(
                     grouped, x0_dev, y0_dev,
                     self.max_iter, self.reg_param, self.alpha, mesh,
-                    implicit=self.implicit_prefs,
+                    implicit=self.implicit_prefs, policy=pol.name,
                 )
             else:
                 x_blocks, y = als_block.als_block_run(
                     u_loc, i_glob, conf, valid, x0_dev, y0_dev,
                     self.max_iter, self.reg_param, self.alpha, mesh,
-                    implicit=self.implicit_prefs,
+                    implicit=self.implicit_prefs, policy=pol.name,
                 )
             jax.block_until_ready((x_blocks, y))
         # X stays block-sharded on device; the model gathers on demand
@@ -1064,6 +1076,7 @@ class ALS:
             "item_layout": "sharded" if item_sharded else "replicated",
             **self._block_summary(world),
         }
+        psn.record(summary, timings, pol)
         if item_sharded:
             return ALSModel(
                 None, None, summary,

@@ -29,6 +29,7 @@ from oap_mllib_tpu.data.table import DenseTable
 from oap_mllib_tpu.fallback.kmeans_np import lloyd_np, predict_np
 from oap_mllib_tpu.ops import kmeans_ops
 from oap_mllib_tpu.parallel.mesh import get_mesh
+from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.dispatch import should_accelerate
 from oap_mllib_tpu.utils.timing import Timings, phase_timer
@@ -364,12 +365,16 @@ class KMeans:
     def _fit_stream_inner(self, source, sample_weight, dtype, cfg) -> KMeansModel:
         from oap_mllib_tpu.ops import stream_ops
 
+        # compute-precision policy (utils/precision.py): resolved per
+        # attempt so the resilience ladder's f32-degradation scope takes
+        # effect on a retry; the legacy kernel tier maps off it
+        pol = psn.resolve("kmeans")
+        tier = psn.kernel_tier(pol.name, cfg.matmul_precision)
         # kmeans_kernel validation must run on EVERY accelerated fit (the
         # _run_lloyd invariant): a typo'd value raises here too, even
         # though the streamed path always runs the chunked XLA programs
         kmeans_ops.use_pallas_path(
-            cfg.kmeans_kernel, source.n_features, self.k,
-            cfg.matmul_precision, dtype,
+            cfg.kmeans_kernel, source.n_features, self.k, tier, dtype,
         )
         timings = Timings("kmeans.fit")
         cache_before = progcache.stats()
@@ -382,12 +387,13 @@ class KMeans:
                 centers0 = stream_ops.init_kmeans_parallel_streamed(
                     source, self.k, self.seed, self.init_steps, dtype,
                     weights=sample_weight, validated=True, timings=timings,
+                    policy=pol.name,
                 )
         with phase_timer(timings, "lloyd_loop"):
             centers, n_iter, cost, counts = stream_ops.lloyd_run_streamed(
                 source, centers0, self.max_iter, self.tol, dtype,
-                cfg.matmul_precision, weights=sample_weight, validated=True,
-                timings=timings,
+                tier, weights=sample_weight, validated=True,
+                timings=timings, policy=pol.name,
             )
         summary = KMeansSummary(
             float(cost), int(n_iter), timings, accelerated=True,
@@ -395,6 +401,7 @@ class KMeans:
         )
         summary.streamed = True
         summary.progcache = progcache.delta(cache_before)
+        psn.record(summary, timings, pol)
         return KMeansModel(np.asarray(centers), self.distance_measure, summary)
 
     # -- accelerated path (~ KMeansDALImpl.train, KMeansDALImpl.scala:35) ----
@@ -410,6 +417,9 @@ class KMeans:
     def _fit_tpu_inner(self, x, sample_weight, dtype,
                        degraded: bool = False) -> KMeansModel:
         cfg = get_config()
+        # compute-precision policy, resolved per attempt (the resilience
+        # ladder's precision rung re-resolves to f32 on its retry)
+        pol = psn.resolve("kmeans")
         timings = Timings("kmeans.fit")
         cache_before = progcache.stats()
         mesh = get_mesh()
@@ -450,7 +460,7 @@ class KMeans:
         with phase_timer(timings, "lloyd_loop"):
             centers, n_iter, cost, counts = self._run_lloyd(
                 table, weights, centers0, dtype, cfg, mesh, timings,
-                degraded=degraded,
+                degraded=degraded, pol=pol,
             )
             centers = np.asarray(centers)[:, :d_orig]
             n_iter = int(n_iter)
@@ -460,10 +470,11 @@ class KMeans:
             cluster_sizes=np.asarray(counts),
         )
         summary.progcache = progcache.delta(cache_before)
+        psn.record(summary, timings, pol)
         return KMeansModel(centers, self.distance_measure, summary)
 
     def _run_lloyd(self, table, weights, centers0, dtype, cfg, mesh,
-                   timings=None, degraded=False):
+                   timings=None, degraded=False, pol=None):
         """Dispatch the hot loop to the configured kernel.
 
         ``auto`` picks the fastest measured path for the shape/tier
@@ -480,12 +491,19 @@ class KMeans:
         ``xla`` is forced, which keeps the GSPMD data-parallel program
         (centroids replicated) so the two can be A/B'd on the same mesh.
         """
+        # the compute-precision policy maps onto the legacy kernel tier
+        # (utils/precision.kernel_tier: f32 keeps matmul_precision, tf32
+        # the bf16_3x "high" tier, bf16 the single-pass "default" tier) so
+        # the kernel-dispatch rules price it like the tier it runs at —
+        # notably the bf16 policy routes off Pallas onto the chunked XLA
+        # Lloyd, where the all-bf16 single-pass pipeline measured fastest
+        pol = pol or psn.resolve("kmeans")
+        tier = psn.kernel_tier(pol.name, cfg.matmul_precision)
         # use_pallas_path is the single kmeans_kernel validation point and
         # must run on EVERY accelerated fit — a typo'd value raises even
         # when the model-sharded route below makes its answer moot
         use_pallas = kmeans_ops.use_pallas_path(
-            cfg.kmeans_kernel, table.data.shape[1], self.k,
-            cfg.matmul_precision, dtype,
+            cfg.kmeans_kernel, table.data.shape[1], self.k, tier, dtype,
         )
         if degraded:
             # the halved-chunk rung after a device OOM: route off the
@@ -503,8 +521,9 @@ class KMeans:
                 mesh,
                 cfg.data_axis,
                 cfg.model_axis,
-                precision=cfg.matmul_precision,
+                precision=tier,
                 timings=timings,
+                policy=pol.name,
             )
         single_device = len(jax.devices()) == 1 and jax.process_count() == 1
         if use_pallas:
@@ -513,8 +532,7 @@ class KMeans:
             key = (
                 progcache.backend_fingerprint(),
                 progcache.array_key(table.data, weights),
-                np.asarray(centers0).shape, self.max_iter,
-                cfg.matmul_precision,
+                np.asarray(centers0).shape, self.max_iter, tier,
             )
             with progcache.launch(
                 "kmeans.lloyd_pallas", key, timings, "lloyd_loop"
@@ -525,7 +543,7 @@ class KMeans:
                     jnp.asarray(centers0),
                     self.max_iter,
                     self.tol,
-                    mode=cfg.matmul_precision,
+                    mode=tier,
                 )
         row_chunks = (
             kmeans_ops.auto_row_chunks(table.n_padded, self.k)
@@ -543,8 +561,9 @@ class KMeans:
             self.max_iter,
             jnp.asarray(self.tol, dtype),
             row_chunks=row_chunks,
-            precision=cfg.matmul_precision,
+            precision=tier,
             timings=timings,
+            policy=pol.name,
         )
 
     # -- fallback path (~ trainWithML, KMeans.scala:355) ---------------------

@@ -27,6 +27,7 @@ from oap_mllib_tpu.data.table import DenseTable
 from oap_mllib_tpu.fallback.pca_np import pca_np
 from oap_mllib_tpu.ops import pca_ops
 from oap_mllib_tpu.parallel.mesh import get_mesh
+from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.dispatch import MAX_PCA_FEATURES, should_accelerate
 from oap_mllib_tpu.utils.timing import Timings, phase_timer
@@ -238,13 +239,19 @@ class PCA:
     def _fit_stream_inner(self, source, dtype, cfg) -> PCAModel:
         from oap_mllib_tpu.ops import stream_ops
 
+        # compute-precision policy, per attempt (the resilience ladder's
+        # precision rung re-resolves to f32 on its retry); x64 pins f32
+        pol = psn.resolve("pca")
         timings = Timings("pca.fit")
         cache_before = progcache.stats()
         d = source.n_features
         with phase_timer(timings, "covariance_streamed"):
-            tier = "highest" if cfg.enable_x64 else cfg.matmul_precision
+            tier = (
+                "highest" if cfg.enable_x64
+                else psn.kernel_tier(pol.name, cfg.matmul_precision)
+            )
             cov, _, n = stream_ops.covariance_streamed(
-                source, dtype, tier, timings=timings
+                source, dtype, tier, timings=timings, policy=pol.name
             )
         # cov is exactly (d, d) here — no model-sharding feature pad
         vals, vecs, total, solver = self._solve_spectrum(cov, d, timings)
@@ -257,6 +264,7 @@ class PCA:
             "pca_solver": solver,
             "progcache": progcache.delta(cache_before),
         }
+        psn.record(summary, timings, pol)
         return PCAModel(vecs, ratio, summary)
 
     # -- accelerated path (~ PCADALImpl.train, PCADALImpl.scala:35) ----------
@@ -274,6 +282,7 @@ class PCA:
         timings = Timings("pca.fit")
         cache_before = progcache.stats()
         cfg = get_config()
+        pol = psn.resolve("pca")
         mesh = get_mesh()
         mp = mesh.shape[cfg.model_axis]
         d = x.shape[1]
@@ -292,16 +301,21 @@ class PCA:
         with phase_timer(timings, "covariance"):
             n_rows = jnp.asarray(float(table.n_rows), dtype)
             # x64 lane pins the Gram to HIGHEST regardless of tier
-            # (f64 has no bf16 fast path to buy anything with)
-            tier = "highest" if cfg.enable_x64 else cfg.matmul_precision
+            # (f64 has no bf16 fast path to buy anything with); the
+            # compute-precision policy maps onto the tier otherwise
+            tier = (
+                "highest" if cfg.enable_x64
+                else psn.kernel_tier(pol.name, cfg.matmul_precision)
+            )
             if mp > 1:
                 cov, _ = pca_ops.covariance_model_sharded(
                     table.data, table.mask, n_rows, mesh, tier,
-                    timings=timings,
+                    timings=timings, policy=pol.name,
                 )
             else:
                 cov, _ = pca_ops.covariance(
-                    table.data, table.mask, n_rows, tier, timings=timings
+                    table.data, table.mask, n_rows, tier, timings=timings,
+                    policy=pol.name,
                 )
         vals, vecs, total, solver = self._solve_spectrum(cov, d, timings)
         ratio = vals / total if total > 0 else np.zeros(self.k)
@@ -312,6 +326,7 @@ class PCA:
             "pca_solver": solver,
             "progcache": progcache.delta(cache_before),
         }
+        psn.record(summary, timings, pol)
         return PCAModel(vecs, ratio, summary)
 
     # -- fallback path (~ vanilla mllib.feature.PCA, PCA.scala:110-116) ------

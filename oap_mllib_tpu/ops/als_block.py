@@ -201,6 +201,7 @@ def als_block_run(
     mesh: Mesh,
     *,
     implicit: bool,
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """Run block-parallel ALS (implicit or explicit) over the mesh.
 
@@ -209,6 +210,8 @@ def als_block_run(
     carry valid=0).  The explicit mode drops the Gram term and uses rating
     b-weights; both modes apply ALS-WR lambda scaling (Spark parity,
     reference ALS.scala:1794-1795) via the shared normal_eq_partials.
+    ``policy`` is the compute-precision policy (utils/precision.py) for
+    the per-edge factor matmuls; Grams and solves stay f32.
     """
     cfg = get_config()
     axis = cfg.data_axis
@@ -229,10 +232,12 @@ def als_block_run(
             # x_blk: (upb, r) this rank's users; y: (n_items, r) replicated
             body = _block_body(
                 lambda y_: normal_eq_partials(
-                    u_loc, i_glob, cf, vl, y_, upb, alpha, implicit
+                    u_loc, i_glob, cf, vl, y_, upb, alpha, implicit,
+                    policy,
                 ),
                 lambda x_: normal_eq_partials(
-                    i_glob, u_loc, cf, vl, x_, n_items, alpha, implicit
+                    i_glob, u_loc, cf, vl, x_, n_items, alpha, implicit,
+                    policy,
                 ),
                 reg, implicit, axis, eye,
             )
@@ -253,7 +258,7 @@ def als_block_run(
 
     key = (
         progcache.mesh_fingerprint(mesh), axis, upb, n_items, r,
-        max_iter, reg, alpha, implicit, str(y0.dtype),
+        max_iter, reg, alpha, implicit, str(y0.dtype), policy,
     )
     fn = progcache.get_or_build("als_block.coo", key, build)
     launch_key = key + (progcache.array_key(u_local, x0),)
@@ -504,6 +509,7 @@ def als_block_run_grouped(
     mesh: Mesh,
     *,
     implicit: bool,
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """Block-parallel ALS on the grouped-edge layout (both feedback modes).
 
@@ -522,10 +528,10 @@ def als_block_run_grouped(
         def rank_program(su, cu, vu, gu, si, ci, vi, gi, x_blk, y):
             body = _block_body(
                 lambda y_: normal_eq_partials_grouped(
-                    su, cu, vu, gu, y_, upb, alpha, implicit
+                    su, cu, vu, gu, y_, upb, alpha, implicit, policy
                 ),
                 lambda x_: normal_eq_partials_grouped(
-                    si, ci, vi, gi, x_, n_items, alpha, implicit
+                    si, ci, vi, gi, x_, n_items, alpha, implicit, policy
                 ),
                 reg, implicit, axis, eye,
             )
@@ -547,7 +553,7 @@ def als_block_run_grouped(
 
     key = (
         progcache.mesh_fingerprint(mesh), axis, upb, n_items, r,
-        max_iter, reg, alpha, implicit, str(y0.dtype),
+        max_iter, reg, alpha, implicit, str(y0.dtype), policy,
     )
     fn = progcache.get_or_build("als_block.grouped", key, build)
     launch_key = key + (progcache.array_key(gb.u_src, gb.i_src, x0),)
@@ -576,6 +582,7 @@ def als_block_run_2d(
     mesh: Mesh,
     *,
     implicit: bool,
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """COO 2-D ALS: both factor sides block-sharded (see _block_body_2d).
 
@@ -596,10 +603,10 @@ def als_block_run_2d(
         def rank_program(ul, ir, cu, vu, il, ur, ci, vi, x_blk, y_blk):
             body = _block_body_2d(
                 lambda y_full: normal_eq_partials(
-                    ul, ir, cu, vu, y_full, upb, alpha, implicit
+                    ul, ir, cu, vu, y_full, upb, alpha, implicit, policy
                 ),
                 lambda x_full: normal_eq_partials(
-                    il, ur, ci, vi, x_full, ipb, alpha, implicit
+                    il, ur, ci, vi, x_full, ipb, alpha, implicit, policy
                 ),
                 reg, implicit, axis, eye,
             )
@@ -622,7 +629,7 @@ def als_block_run_2d(
 
     key = (
         progcache.mesh_fingerprint(mesh), axis, upb, ipb, r,
-        max_iter, reg, alpha, implicit, str(y0.dtype),
+        max_iter, reg, alpha, implicit, str(y0.dtype), policy,
     )
     fn = progcache.get_or_build("als_block.coo_2d", key, build)
     launch_key = key + (progcache.array_key(u_local, i_local, x0),)
@@ -643,6 +650,7 @@ def als_block_run_grouped_2d(
     mesh: Mesh,
     *,
     implicit: bool,
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """Grouped-edge 2-D ALS: scatter-free partials on both block-sharded
     sides.  ``gb`` comes from :func:`prepare_grouped_inputs_2d` — its
@@ -662,10 +670,10 @@ def als_block_run_grouped_2d(
         def rank_program(su, cu, vu, gu, si, ci, vi, gi, x_blk, y_blk):
             body = _block_body_2d(
                 lambda y_full: normal_eq_partials_grouped(
-                    su, cu, vu, gu, y_full, upb, alpha, implicit
+                    su, cu, vu, gu, y_full, upb, alpha, implicit, policy
                 ),
                 lambda x_full: normal_eq_partials_grouped(
-                    si, ci, vi, gi, x_full, ipb, alpha, implicit
+                    si, ci, vi, gi, x_full, ipb, alpha, implicit, policy
                 ),
                 reg, implicit, axis, eye,
             )
@@ -688,7 +696,7 @@ def als_block_run_grouped_2d(
 
     key = (
         progcache.mesh_fingerprint(mesh), axis, upb, ipb, r,
-        max_iter, reg, alpha, implicit, str(y0.dtype),
+        max_iter, reg, alpha, implicit, str(y0.dtype), policy,
     )
     fn = progcache.get_or_build("als_block.grouped_2d", key, build)
     launch_key = key + (progcache.array_key(gb.u_src, gb.i_src, x0),)

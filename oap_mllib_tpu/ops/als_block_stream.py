@@ -348,20 +348,22 @@ def _chunk_placer(mesh: Mesh, axis: str, owned: List[int]):
     return place
 
 
-def _make_programs(mesh: Mesh, axis: str, implicit: bool):
+def _make_programs(mesh: Mesh, axis: str, implicit: bool,
+                   policy: str = "f32"):
     """The four compiled building blocks, registry-cached per (mesh
-    fingerprint, axis, implicit) — utils/progcache — so repeat fits on
-    one mesh reuse the jitted closures instead of rebuilding (and
-    re-tracing) them every call; within a fit they already cached
-    compilations across chunks and iterations."""
-    key = (progcache.mesh_fingerprint(mesh), axis, implicit)
+    fingerprint, axis, implicit, precision policy) — utils/progcache —
+    so repeat fits on one mesh reuse the jitted closures instead of
+    rebuilding (and re-tracing) them every call; within a fit they
+    already cached compilations across chunks and iterations."""
+    key = (progcache.mesh_fingerprint(mesh), axis, implicit, policy)
     return progcache.get_or_build(
         "als_block_stream.programs", key,
-        lambda: _build_programs(mesh, axis, implicit),
+        lambda: _build_programs(mesh, axis, implicit, policy),
     )
 
 
-def _build_programs(mesh: Mesh, axis: str, implicit: bool):
+def _build_programs(mesh: Mesh, axis: str, implicit: bool,
+                    policy: str = "f32"):
     """Build the four jitted building blocks (cached above)."""
     sh2 = P(axis, None)
     sh1 = P(axis)
@@ -369,7 +371,9 @@ def _build_programs(mesh: Mesh, axis: str, implicit: bool):
 
     def accum_local(m, src, conf, valid, gdst, factors, alpha):
         # m block: (n_loc, width); factors: FULL replicated table
-        mm = grouped_block_moments(src, conf, valid, factors, alpha, implicit)
+        mm = grouped_block_moments(
+            src, conf, valid, factors, alpha, implicit, policy
+        )
         gb = mm.shape[0]
         return m + jax.ops.segment_sum(
             mm.reshape(gb, -1), gdst, num_segments=m.shape[0],
@@ -388,7 +392,9 @@ def _build_programs(mesh: Mesh, axis: str, implicit: bool):
     def accum_item_rep(m, src, conf, valid, gdst, x_blk, alpha):
         # m block: (1, n_items, width); x_blk: this rank's (upb, r);
         # src = LOCAL user ids
-        mm = grouped_block_moments(src, conf, valid, x_blk, alpha, implicit)
+        mm = grouped_block_moments(
+            src, conf, valid, x_blk, alpha, implicit, policy
+        )
         gb = mm.shape[0]
         return m + jax.ops.segment_sum(
             mm.reshape(gb, -1), gdst, num_segments=m.shape[1],
@@ -470,6 +476,7 @@ def als_block_run_streamed(
     *,
     implicit: bool,
     timings=None,
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """Streamed block-parallel ALS over the mesh (both feedback modes,
     both item layouts).  Returns (X blocks, Y) in the same forms as the
@@ -490,7 +497,9 @@ def als_block_run_streamed(
     t_start = time.perf_counter()
     place = _chunk_placer(mesh, axis, lay.owned)
     (accum_local_fn, accum_item_rep_fn, solve_local_fn,
-     solve_item_rep_fn, replicate) = _make_programs(mesh, axis, implicit)
+     solve_item_rep_fn, replicate) = _make_programs(
+        mesh, axis, implicit, policy
+    )
     alpha_j = jnp.asarray(alpha, dtype)
     reg_j = jnp.asarray(reg, dtype)
     sh2 = NamedSharding(mesh, P(axis, None))
@@ -532,7 +541,7 @@ def als_block_run_streamed(
 
         step_key = (
             mesh_fp, (gc, su[lay.owned[0]].shape[1] if lay.owned else 0),
-            tuple(getattr(m, "shape", ())), implicit,
+            tuple(getattr(m, "shape", ())), implicit, policy,
         )
         pf = Prefetcher(
             range(0, g_total, gc), stage=stage, stats=stats, retire=True

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 
 
@@ -64,6 +65,7 @@ def normal_eq_partials(
     n_dst: int,
     alpha: float,
     implicit: bool,
+    policy: str = "f32",
 ):
     """Per-edge normal-equation partials grouped by dst id — Spark parity.
 
@@ -81,6 +83,11 @@ def normal_eq_partials(
     (als_block.py, which psums these across the mesh) so the two can never
     diverge in the weighting math.  Edge-chunked via lax.scan so the
     (chunk, r, r) outer-product intermediate never scales with nnz.
+
+    ``policy`` (utils/precision.py) governs the per-edge factor outer
+    products: bf16 casts the gathered factor rows and accumulates f32
+    (b/n segment-sums and the solves stay f32); the f32 default keeps
+    the pre-policy HIGHEST einsum bit-for-bit.
     """
     nnz = dst_idx.shape[0]
     r = src_factors.shape[1]
@@ -97,8 +104,9 @@ def normal_eq_partials(
             a_w = valid_c
             b_w = conf_c * valid_c
             n_w = valid_c
-        outer = jnp.einsum("er,es->ers", ys * a_w[:, None], ys,
-                           precision=lax.Precision.HIGHEST)  # (cs, r, r)
+        outer = psn.peinsum(
+            "er,es->ers", ys * a_w[:, None], ys, policy
+        )  # (cs, r, r) — f32 accumulation under every policy
         a_c = jax.ops.segment_sum(outer, dst_c, num_segments=n_dst)
         b_c = jax.ops.segment_sum(ys * b_w[:, None], dst_c, num_segments=n_dst)
         n_c = jax.ops.segment_sum(n_w, dst_c, num_segments=n_dst)
@@ -367,6 +375,7 @@ def grouped_block_moments(
     src_factors: jax.Array,  # (n_src, r)
     alpha,
     implicit: bool,
+    policy: str = "f32",
 ) -> jax.Array:
     """(Gb, r+1, r+2) normal-equation moment matrices for one group
     block — the MXU inner kernel shared by the in-memory grouped partials
@@ -391,9 +400,11 @@ def grouped_block_moments(
     rhs = jnp.concatenate(
         [ys * a_w[None], b_w[None], n_w[None]], axis=0
     )  # (r+2, Gb, P)
-    return jnp.einsum(
-        "agp,bgp->gab", lhs, rhs, precision=lax.Precision.HIGHEST
-    )  # (Gb, r+1, r+2)  <- batched MXU, P-lane contraction
+    return psn.peinsum(
+        "agp,bgp->gab", lhs, rhs, policy
+    )  # (Gb, r+1, r+2)  <- batched MXU, P-lane contraction; bf16 policy
+    # casts the factor-carrying lhs/rhs tiles and accumulates f32 — the
+    # per-destination moment tiles (and the solves they feed) stay f32
 
 
 def normal_eq_partials_grouped(
@@ -405,6 +416,7 @@ def normal_eq_partials_grouped(
     n_dst: int,
     alpha: float,
     implicit: bool,
+    policy: str = "f32",
 ):
     """Scatter-free normal-equation partials: same math and Spark-parity
     weighting as :func:`normal_eq_partials`, grouped-edge layout.
@@ -428,7 +440,7 @@ def normal_eq_partials_grouped(
 
     def block_moments(src_b, conf_b, valid_b):
         return grouped_block_moments(
-            src_b, conf_b, valid_b, src_factors, alpha, implicit
+            src_b, conf_b, valid_b, src_factors, alpha, implicit, policy
         )
 
     blocks = _grouped_block_count(G, P, r)
@@ -474,7 +486,8 @@ def normal_eq_partials_grouped(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_users", "n_items", "max_iter", "implicit")
+    jax.jit,
+    static_argnames=("n_users", "n_items", "max_iter", "implicit", "policy"),
 )
 def _als_run_grouped_jit(
     u_src_g, u_conf_g, u_valid_g, u_group_dst,  # item ids grouped by user
@@ -487,13 +500,15 @@ def _als_run_grouped_jit(
     reg: float,
     alpha: float,
     implicit: bool,
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     r = x0.shape[1]
     eye = jnp.eye(r, dtype=x0.dtype)
 
     def half(src_g, conf_g, valid_g, group_dst, factors, n_dst):
         a, b, n_reg = normal_eq_partials_grouped(
-            src_g, conf_g, valid_g, group_dst, factors, n_dst, alpha, implicit
+            src_g, conf_g, valid_g, group_dst, factors, n_dst, alpha,
+            implicit, policy,
         )
         gram = (
             jnp.matmul(factors.T, factors, precision=lax.Precision.HIGHEST)
@@ -526,25 +541,29 @@ def als_run_grouped(
     implicit: bool,
     timings=None,
     phase: str = "als_iterations",
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """Full ALS loop on the grouped-edge layout (both feedback modes).
 
     ~15x the COO path at MovieLens-1M scale on v5e: scatter-free partials
     + Cholesky solves (BASELINE.md round 3).  The launch registers with
     the program-cache registry (utils/progcache); ``timings`` receives
-    the ``<phase>/compile`` / ``<phase>/execute`` wall split."""
+    the ``<phase>/compile`` / ``<phase>/execute`` wall split.  ``policy``
+    is the compute-precision policy (utils/precision.py) for the moment
+    matmuls — the Gram and every solve stay f32 under all policies."""
     # reg/alpha are traced scalars, not statics — they do not key a new
     # program and so stay out of the cache key
     key = (
         progcache.backend_fingerprint(),
         progcache.array_key(u_src_g, i_src_g, x0, y0),
-        n_users, n_items, max_iter, implicit,
+        n_users, n_items, max_iter, implicit, policy,
     )
     with progcache.launch("als.run_grouped", key, timings, phase):
         return _als_run_grouped_jit(
             u_src_g, u_conf_g, u_valid_g, u_group_dst,
             i_src_g, i_conf_g, i_valid_g, i_group_dst,
             x0, y0, n_users, n_items, max_iter, reg, alpha, implicit,
+            policy,
         )
 
 
@@ -557,13 +576,16 @@ def _half_update(
     n_dst: int,
     reg: float,
     alpha: float,
+    policy: str = "f32",
 ) -> jax.Array:
     """Solve one side's factors given the other side's. Returns (n_dst, r)."""
     r = src_factors.shape[1]
-    # (r, r) <- MXU, psum over mesh
+    # (r, r) <- MXU, psum over mesh — stays full f32 under every policy
+    # (the Gram conditions the solve; its cost is O(n*r^2), not the hot path)
     gram = jnp.matmul(src_factors.T, src_factors, precision=lax.Precision.HIGHEST)
     a_part, b, n_reg = normal_eq_partials(
-        dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha, True
+        dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha, True,
+        policy,
     )
     eye = jnp.eye(r, dtype=src_factors.dtype)
     return regularized_solve(a_part, b, n_reg, reg, eye, gram).astype(
@@ -572,7 +594,7 @@ def _half_update(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_users", "n_items", "max_iter")
+    jax.jit, static_argnames=("n_users", "n_items", "max_iter", "policy")
 )
 def _als_implicit_run_jit(
     u_idx: jax.Array,
@@ -586,12 +608,17 @@ def _als_implicit_run_jit(
     max_iter: int,
     reg: float,
     alpha: float,
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
 
     def body(carry, _):
         x, y = carry
-        x = _half_update(u_idx, i_idx, conf, valid, y, n_users, reg, alpha)
-        y = _half_update(i_idx, u_idx, conf, valid, x, n_items, reg, alpha)
+        x = _half_update(
+            u_idx, i_idx, conf, valid, y, n_users, reg, alpha, policy
+        )
+        y = _half_update(
+            i_idx, u_idx, conf, valid, x, n_items, reg, alpha, policy
+        )
         return (x, y), None
 
     (x, y), _ = lax.scan(body, (x0, y0), None, length=max_iter)
@@ -601,7 +628,7 @@ def _als_implicit_run_jit(
 def als_implicit_run(
     u_idx, i_idx, conf, valid, x0, y0,
     n_users: int, n_items: int, max_iter: int, reg: float, alpha: float,
-    timings=None, phase: str = "als_iterations",
+    timings=None, phase: str = "als_iterations", policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """Full training loop: alternating user/item updates under lax.scan
     (the reference's trainModel loop, ALSDALImpl.cpp:318-438).
@@ -609,17 +636,17 @@ def als_implicit_run(
     key = (
         progcache.backend_fingerprint(),
         progcache.array_key(u_idx, x0, y0),
-        n_users, n_items, max_iter,
+        n_users, n_items, max_iter, policy,
     )
     with progcache.launch("als.implicit_coo", key, timings, phase):
         return _als_implicit_run_jit(
             u_idx, i_idx, conf, valid, x0, y0,
-            n_users, n_items, max_iter, reg, alpha,
+            n_users, n_items, max_iter, reg, alpha, policy,
         )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_users", "n_items", "max_iter")
+    jax.jit, static_argnames=("n_users", "n_items", "max_iter", "policy")
 )
 def _als_explicit_run_jit(
     u_idx: jax.Array,
@@ -632,12 +659,14 @@ def _als_explicit_run_jit(
     n_items: int,
     max_iter: int,
     reg: float,
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
 
     def half(dst_idx, src_idx, src_factors, n_dst):
         r = src_factors.shape[1]
         a_part, b, n_reg = normal_eq_partials(
-            dst_idx, src_idx, rating, valid, src_factors, n_dst, 0.0, False
+            dst_idx, src_idx, rating, valid, src_factors, n_dst, 0.0,
+            False, policy,
         )
         eye = jnp.eye(r, dtype=src_factors.dtype)
         return regularized_solve(a_part, b, n_reg, reg, eye).astype(
@@ -657,7 +686,7 @@ def _als_explicit_run_jit(
 def als_explicit_run(
     u_idx, i_idx, rating, valid, x0, y0,
     n_users: int, n_items: int, max_iter: int, reg: float,
-    timings=None, phase: str = "als_iterations",
+    timings=None, phase: str = "als_iterations", policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """Explicit-feedback ALS (beyond the reference's accelerated surface —
     it falls back to Spark for explicit; we accelerate both).
@@ -665,12 +694,12 @@ def als_explicit_run(
     key = (
         progcache.backend_fingerprint(),
         progcache.array_key(u_idx, x0, y0),
-        n_users, n_items, max_iter,
+        n_users, n_items, max_iter, policy,
     )
     with progcache.launch("als.explicit_coo", key, timings, phase):
         return _als_explicit_run_jit(
             u_idx, i_idx, rating, valid, x0, y0,
-            n_users, n_items, max_iter, reg,
+            n_users, n_items, max_iter, reg, policy,
         )
 
 

@@ -59,7 +59,8 @@ def groups_per_chunk(P: int, r: int) -> int:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_dst", "implicit"), donate_argnums=(0,)
+    jax.jit, static_argnames=("n_dst", "implicit", "policy"),
+    donate_argnums=(0,),
 )
 def _accum_moments(
     m_flat: jax.Array,  # (n_dst, (r+1)(r+2)) running moments (donated)
@@ -71,8 +72,11 @@ def _accum_moments(
     alpha: jax.Array,
     n_dst: int,
     implicit: bool,
+    policy: str = "f32",
 ) -> jax.Array:
-    m = grouped_block_moments(src_g, conf_g, valid_g, factors, alpha, implicit)
+    m = grouped_block_moments(
+        src_g, conf_g, valid_g, factors, alpha, implicit, policy
+    )
     gb = m.shape[0]
     width = m.shape[1] * m.shape[2]
     return m_flat + jax.ops.segment_sum(
@@ -140,7 +144,7 @@ def _stage_group_chunk(grouped_host, gc: int, stats: PrefetchStats):
 def _half_update_streamed(
     grouped_host, factors_dev: jax.Array, n_dst: int, gc: int, reg, alpha,
     implicit: bool, stats: Optional[PrefetchStats] = None, timings=None,
-    phase: str = "als_iterations",
+    phase: str = "als_iterations", policy: str = "f32",
 ) -> jax.Array:
     """One side's update: walk the host-resident grouped layout (already
     padded to a multiple of ``gc`` group rows) through the device in
@@ -159,6 +163,7 @@ def _half_update_streamed(
     step_key = (
         progcache.backend_fingerprint(),
         (gc, src_g.shape[1], n_dst, r), str(factors_dev.dtype), implicit,
+        policy,
     )
     pf = Prefetcher(
         range(0, src_g.shape[0], gc),
@@ -174,7 +179,7 @@ def _half_update_streamed(
             ):
                 m = _accum_moments(
                     m, src_c, conf_c, valid_c, gdst_c,
-                    factors_dev, alpha_j, n_dst, implicit,
+                    factors_dev, alpha_j, n_dst, implicit, policy,
                 )
     with progcache.launch(
         "als_stream.solve_side", step_key, timings, phase,
@@ -197,6 +202,7 @@ def als_run_streamed(
     implicit: bool,
     timings=None,
     degraded: bool = False,
+    policy: str = "f32",
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Full streamed ALS loop (both feedback modes), host-driven.
 
@@ -230,11 +236,11 @@ def als_run_streamed(
     for it in range(max_iter):
         x = _half_update_streamed(
             by_user, y, n_users, gc_u, reg, alpha, implicit, stats=stats,
-            timings=timings,
+            timings=timings, policy=policy,
         )
         y = _half_update_streamed(
             by_item, x, n_items, gc_i, reg, alpha, implicit, stats=stats,
-            timings=timings,
+            timings=timings, policy=policy,
         )
         # iterate-level guardrail (Config.nonfinite_policy): a singular
         # normal-equation solve yields NaN factors that contaminate every

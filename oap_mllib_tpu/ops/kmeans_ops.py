@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
@@ -116,12 +117,21 @@ def _assign_prec(precision: str) -> str:
 
 
 def pairwise_sq_dists(
-    x: jax.Array, centers: jax.Array, precision: str = "highest"
+    x: jax.Array, centers: jax.Array, precision: str = "highest",
+    policy: str = "f32",
 ) -> jax.Array:
-    """(n, k) squared euclidean distances via the MXU-friendly identity."""
-    x_sq = jnp.sum(x * x, axis=1, keepdims=True)  # (n, 1)
-    c_sq = jnp.sum(centers * centers, axis=1)  # (k,)
-    cross = jnp.matmul(x, centers.T, precision=_prec(precision))  # (n, k)  <- MXU
+    """(n, k) squared euclidean distances via the MXU-friendly identity.
+
+    ``policy`` (utils/precision.py) governs the cross matmul: bf16 casts
+    both operands (no-op when staging already delivered bf16 chunks) and
+    accumulates f32; the squared norms ALWAYS reduce in f32 —
+    ``psn.upcast`` is a no-op for f32/f64 inputs, so the default policy
+    is bit-compatible with the pre-policy code."""
+    xf = psn.upcast(x)
+    cf = psn.upcast(centers)
+    x_sq = jnp.sum(xf * xf, axis=1, keepdims=True)  # (n, 1)
+    c_sq = jnp.sum(cf * cf, axis=1)  # (k,)
+    cross = psn.pdot(x, centers.T, policy, precision)  # (n, k)  <- MXU
     d2 = x_sq + c_sq[None, :] - 2.0 * cross
     return jnp.maximum(d2, 0.0)
 
@@ -132,7 +142,7 @@ def assign_clusters(x: jax.Array, centers: jax.Array) -> jax.Array:
 
 
 def _accumulate(x, weights, centers, precision: str = "highest",
-                need_cost: bool = True):
+                need_cost: bool = True, policy: str = "f32"):
     """One assignment pass: per-cluster weighted sums, counts, and cost.
 
     Returns (sums (k,d), counts (k,), cost scalar).  All reductions are
@@ -143,26 +153,40 @@ def _accumulate(x, weights, centers, precision: str = "highest",
     the assignment ranks on the half-score ``|c|^2/2 - x.c`` — argmin is
     invariant to the per-row |x|^2 term — skipping the d2 assembly and the
     min reduction entirely.
+
+    ``policy`` (utils/precision.py): bf16 runs the assignment AND
+    centroid-sum matmuls on bf16 operands with f32 accumulation — the
+    one-hot/weights/counts/cost side stays f32 (``weights.dtype``), so
+    the f32 accumulator contract holds whatever dtype the chunk arrived
+    in (streamed bf16 staging included).  The default is bit-compatible
+    with the pre-policy code.
     """
     k = centers.shape[0]
     if need_cost:
-        d2 = pairwise_sq_dists(x, centers, _assign_prec(precision))  # (n, k)
+        d2 = pairwise_sq_dists(
+            x, centers, _assign_prec(precision), policy
+        )  # (n, k)
         assign = jnp.argmin(d2, axis=1)  # (n,)
         min_d2 = jnp.min(d2, axis=1)  # (n,)
         cost = jnp.sum(min_d2 * weights)
     else:
-        c_sq = jnp.sum(centers * centers, axis=1)  # (k,)
-        cross = jnp.matmul(x, centers.T, precision=_prec(_assign_prec(precision)))
+        cf = psn.upcast(centers)
+        c_sq = jnp.sum(cf * cf, axis=1)  # (k,)
+        cross = psn.pdot(x, centers.T, policy, _assign_prec(precision))
         assign = jnp.argmin(0.5 * c_sq[None, :] - cross, axis=1)  # (n,)
-        cost = jnp.asarray(0.0, x.dtype)
-    one_hot = jax.nn.one_hot(assign, k, dtype=x.dtype) * weights[:, None]  # (n, k)
-    sums = jnp.matmul(one_hot.T, x, precision=_prec(precision))  # (k, d)  <- MXU
+        cost = jnp.asarray(0.0, weights.dtype)
+    one_hot = (
+        jax.nn.one_hot(assign, k, dtype=weights.dtype)
+        * weights[:, None]
+    )  # (n, k) — accum dtype: the bf16 policy must not round counts
+    sums = psn.pdot(one_hot.T, x, policy, precision)  # (k, d)  <- MXU
     counts = jnp.sum(one_hot, axis=0)  # (k,)
     return sums, counts, cost
 
 
 def _accumulate_chunked(x, weights, centers, row_chunks: int,
-                        precision: str = "highest", need_cost: bool = True):
+                        precision: str = "highest", need_cost: bool = True,
+                        policy: str = "f32"):
     """Chunked assignment pass: bounds the live (chunk, k) distance/one-hot
     buffers so n*k never materializes in HBM (needed for bench-scale runs
     like 1M x 256 with k=1000, where (n, k) f32 alone is 4 GB).
@@ -181,14 +205,17 @@ def _accumulate_chunked(x, weights, centers, row_chunks: int,
     def step(carry, chunk):
         sums, counts, cost = carry
         xi, wi = chunk
-        s, c, t = _accumulate(xi, wi, centers, precision, need_cost)
+        s, c, t = _accumulate(xi, wi, centers, precision, need_cost, policy)
         return (sums + s, counts + c, cost + t), None
 
     k, d = centers.shape[0], x.shape[1]
+    # carries in the ACCUM dtype (weights), not x's: the bf16 policy's
+    # per-chunk partials are f32 and must stay f32 across chunks (for
+    # the f32/f64 paths weights.dtype == x.dtype — bit-compatible)
     zero = (
-        jnp.zeros((k, d), x.dtype),
-        jnp.zeros((k,), x.dtype),
-        jnp.asarray(0.0, x.dtype),
+        jnp.zeros((k, d), weights.dtype),
+        jnp.zeros((k,), weights.dtype),
+        jnp.asarray(0.0, weights.dtype),
     )
     (sums, counts, cost), _ = lax.scan(step, zero, (xc, wc))
     return sums, counts, cost
@@ -263,7 +290,10 @@ def _lloyd_loop(accum, moved_reduce, init_centers, max_iter, tol_sq):
     return centers, n_iter, cost, counts
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "row_chunks", "precision"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_iter", "row_chunks", "precision", "policy"),
+)
 def _lloyd_run_jit(
     x: jax.Array,
     weights: jax.Array,
@@ -272,6 +302,7 @@ def _lloyd_run_jit(
     tol: jax.Array,
     row_chunks: int = 1,
     precision: str = "highest",
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     # rows that don't divide the chunk count pad with weight-0 rows HERE
     # — once per compiled program, outside the while_loop, so the copy
@@ -287,12 +318,21 @@ def _lloyd_run_jit(
 
     def accum(centers, prec):
         # prec None = loop-body mode: no cost (recomputed at "highest" after
-        # convergence), half-score assignment
+        # convergence), half-score assignment.  The final cost pass also
+        # drops back to the f32 policy when the table itself is full
+        # precision (in-memory fits): the user-facing objective should not
+        # carry the fast policy's rounding when exact inputs are at hand —
+        # streamed bf16-staged chunks keep the policy (x IS bf16 there).
         p = prec or precision
         need_cost = prec is not None
+        pol = (
+            "f32" if need_cost and x.dtype != jnp.bfloat16 else policy
+        )
         if row_chunks > 1:
-            return _accumulate_chunked(x, weights, centers, row_chunks, p, need_cost)
-        return _accumulate(x, weights, centers, p, need_cost)
+            return _accumulate_chunked(
+                x, weights, centers, row_chunks, p, need_cost, pol
+            )
+        return _accumulate(x, weights, centers, p, need_cost, pol)
 
     return _lloyd_loop(
         accum, lambda m: m, init_centers, max_iter, tol * tol
@@ -309,6 +349,7 @@ def lloyd_run(
     precision: str = "highest",
     timings=None,
     phase: str = "lloyd_loop",
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Full Lloyd optimization: returns (centers, n_iter, cost, counts).
 
@@ -316,39 +357,42 @@ def lloyd_run(
     KMeansDALImpl.cpp:135-168).  The launch is registered with the
     program-cache registry (utils/progcache) so fits report how many
     programs they compiled vs reused; ``timings`` (when given) receives
-    the ``<phase>/compile`` / ``<phase>/execute`` wall split.
+    the ``<phase>/compile`` / ``<phase>/execute`` wall split.  ``policy``
+    is the compute-precision policy (utils/precision.py) threaded into
+    every matmul of the loop.
     """
     key = (
         progcache.backend_fingerprint(),
         progcache.array_key(x, weights, init_centers),
-        max_iter, row_chunks, precision,
+        max_iter, row_chunks, precision, policy,
     )
     with progcache.launch("kmeans.lloyd_run", key, timings, phase):
         return _lloyd_run_jit(
             x, weights, init_centers, max_iter, tol,
-            row_chunks=row_chunks, precision=precision,
+            row_chunks=row_chunks, precision=precision, policy=policy,
         )
 
 
 def _lloyd_model_sharded_fn(mesh, dax: str, max_: str, max_iter: int,
-                            precision: str):
+                            precision: str, policy: str = "f32"):
     """Compiled model-sharded Lloyd program, cached in the process-wide
     program registry (utils/progcache — this function's old private
     functools.lru_cache is the pattern the registry generalizes) per
     (mesh fingerprint, shape-free statics): a fresh jit(shard_map)
     closure per fit would recompile."""
     key = (
-        progcache.mesh_fingerprint(mesh), dax, max_, max_iter, precision
+        progcache.mesh_fingerprint(mesh), dax, max_, max_iter, precision,
+        policy,
     )
     return progcache.get_or_build(
         "kmeans.lloyd_model_sharded", key,
         lambda: _build_lloyd_model_sharded(mesh, dax, max_, max_iter,
-                                           precision),
+                                           precision, policy),
     )
 
 
 def _build_lloyd_model_sharded(mesh, dax: str, max_: str, max_iter: int,
-                               precision: str):
+                               precision: str, policy: str = "f32"):
     """Build the jitted model-sharded Lloyd program (cached above).
 
     Mesh-sharded linalg (survey §5): on a (data, model) mesh each device
@@ -363,16 +407,14 @@ def _build_lloyd_model_sharded(mesh, dax: str, max_: str, max_iter: int,
     cannot shard this dimension at all (oneDAL centroids are single-node,
     KMeansDALImpl.cpp:101-131).
     """
-    a_prec = _prec(_assign_prec(precision))
-    s_prec = _prec(precision)
-    h_prec = _prec("highest")
-
-    def accum(x_blk, w_blk, c_blk, aprec, sprec, need_cost):
+    def accum(x_blk, w_blk, c_blk, aprec, sprec, pol, need_cost):
         k = c_blk.shape[0]
-        c_sq = jnp.sum(c_blk * c_blk, axis=1)  # (k,)
-        cross = jnp.matmul(x_blk, c_blk.T, precision=aprec)  # <- MXU
+        cf = psn.upcast(c_blk)
+        c_sq = jnp.sum(cf * cf, axis=1)  # (k,)
+        cross = psn.pdot(x_blk, c_blk.T, pol, aprec)  # <- MXU
         if need_cost:
-            x_sq = jnp.sum(x_blk * x_blk, axis=1, keepdims=True)  # (n_loc, 1)
+            xf = psn.upcast(x_blk)
+            x_sq = jnp.sum(xf * xf, axis=1, keepdims=True)  # (n_loc, 1)
             # one psum carries all three feature-block partials at once
             d2 = lax.psum(x_sq + c_sq[None, :] - 2.0 * cross, max_)
             d2 = jnp.maximum(d2, 0.0)
@@ -383,22 +425,31 @@ def _build_lloyd_model_sharded(mesh, dax: str, max_: str, max_iter: int,
             # |x|^2); still ONE psum over the model axis, no d2/min passes
             score = lax.psum(0.5 * c_sq[None, :] - cross, max_)
             assign = jnp.argmin(score, axis=1)
-        one_hot = jax.nn.one_hot(assign, k, dtype=x_blk.dtype) * w_blk[:, None]
+        one_hot = (
+            jax.nn.one_hot(assign, k, dtype=w_blk.dtype) * w_blk[:, None]
+        )
         sums_blk = lax.psum(
-            jnp.matmul(one_hot.T, x_blk, precision=sprec), dax
+            psn.pdot(one_hot.T, x_blk, pol, sprec), dax
         )  # (k, d_loc) — stays feature-local
         counts = lax.psum(jnp.sum(one_hot, axis=0), dax)
         cost = (
             lax.psum(jnp.sum(min_d2 * w_blk), dax)
-            if need_cost else jnp.asarray(0.0, x_blk.dtype)
+            if need_cost else jnp.asarray(0.0, w_blk.dtype)
         )
         return sums_blk, counts, cost
 
     def rank_program(x_blk, w_blk, c0_blk, tol_sq):
         def tile_accum(c_blk, prec):
             if prec == "highest":
-                return accum(x_blk, w_blk, c_blk, h_prec, h_prec, True)
-            return accum(x_blk, w_blk, c_blk, a_prec, s_prec, False)
+                # final cost/counts pass: full precision against the f32
+                # table (the in-memory contract — see _lloyd_run_jit)
+                return accum(
+                    x_blk, w_blk, c_blk, "highest", "highest", "f32", True
+                )
+            return accum(
+                x_blk, w_blk, c_blk, _assign_prec(precision), precision,
+                policy, False,
+            )
 
         # per-center move norms are partial over the local feature block —
         # complete them over the model axis before the convergence test
@@ -432,6 +483,7 @@ def lloyd_run_model_sharded(
     precision: str = "highest",
     timings=None,
     phase: str = "lloyd_loop",
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Lloyd loop with centroids feature-sharded over the MODEL axis.
 
@@ -441,11 +493,11 @@ def lloyd_run_model_sharded(
     their centroid entries stay exactly zero).
     """
     fn = _lloyd_model_sharded_fn(mesh, data_axis, model_axis, max_iter,
-                                 precision)
+                                 precision, policy)
     key = (
         progcache.mesh_fingerprint(mesh),
         progcache.array_key(x, weights),
-        np.asarray(init_centers).shape, max_iter, precision,
+        np.asarray(init_centers).shape, max_iter, precision, policy,
     )
     with progcache.launch("kmeans.lloyd_model_sharded.run", key, timings,
                           phase):

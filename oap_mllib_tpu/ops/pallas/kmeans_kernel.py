@@ -41,6 +41,12 @@ from jax.experimental.pallas import tpu as pltpu
 _BLOCK_ROWS = 512
 _LANE = 128
 _MODES = ("highest", "high", "default")
+# compute-precision policy names (utils/precision.py) accepted as mode
+# aliases: the kernel's tiers already ARE the policy's hand-rolled bf16
+# splits — "tf32" is the bf16_3x "high" tier, "bf16" the single-pass
+# bf16 "default" tier, "f32" the full-f32 "highest" tier — so callers
+# resolving a policy can pass its name straight through.
+_MODE_ALIASES = {"f32": "highest", "tf32": "high", "bf16": "default"}
 
 
 def _split_bf16(a):
@@ -189,9 +195,17 @@ def _call(x, w, centers, mode="highest", interpret=False, need_cost=True):
     return sums, counts, cost
 
 
-def _check_mode(mode: str) -> None:
+def _check_mode(mode: str) -> str:
+    """Canonicalize a mode: legacy tier names pass through, policy names
+    map via _MODE_ALIASES, anything else raises (typos must not silently
+    run a different tier)."""
+    mode = _MODE_ALIASES.get(mode, mode)
     if mode not in _MODES:
-        raise ValueError(f"mode must be one of {_MODES}, got {mode!r}")
+        raise ValueError(
+            f"mode must be one of {_MODES} (or a policy alias "
+            f"{tuple(_MODE_ALIASES)}), got {mode!r}"
+        )
+    return mode
 
 
 def lloyd_accumulate_pallas(
@@ -207,7 +221,7 @@ def lloyd_accumulate_pallas(
     centers are placed at 1e15 so no real row selects them; their
     counts/sums come back zero and are sliced off.
     """
-    _check_mode(mode)
+    mode = _check_mode(mode)
     n, d = x.shape
     k = centers.shape[0]
     x_p, w_p, c_p = _pad_operands(x, weights, centers)
@@ -267,7 +281,7 @@ def lloyd_run_pallas(x, weights, init_centers, max_iter, tol,
     """Fused-kernel Lloyd loop; same contract as ops.kmeans_ops.lloyd_run
     (f32, adds per-cluster counts). Pads once outside the loop, slices the
     result back."""
-    _check_mode(mode)
+    mode = _check_mode(mode)
     d = x.shape[1]
     k = init_centers.shape[0]
     x_p, w_p, c_p = _pad_operands(x, weights, init_centers)

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 from typing import Tuple
+from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
@@ -44,10 +45,10 @@ def _cov_prec(precision: str):
         ) from None
 
 
-@functools.partial(jax.jit, static_argnames=("precision",))
+@functools.partial(jax.jit, static_argnames=("precision", "policy"))
 def _covariance_jit(
     x: jax.Array, mask: jax.Array, n_rows: jax.Array,
-    precision: str = "highest",
+    precision: str = "highest", policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """Sample covariance (d, d) and mean (d,) of the valid rows.
 
@@ -63,11 +64,15 @@ def _covariance_jit(
     ("highest" = full f32, the parity contract; "high" = bf16_3x ~2x
     faster within ~1e-5; "default" = bf16, ~1e-4).
     """
-    xm = x * mask[:, None]
+    xf = psn.upcast(x)  # colsum/centering reduce in f32 whatever the
+    xm = xf * mask[:, None]  # input dtype (no-op for f32/f64 — bit-compat)
     total = jnp.sum(xm, axis=0)  # psum over data axis
     mean = total / n_rows
-    xc = (x - mean[None, :]) * mask[:, None]
-    gram = jnp.matmul(xc.T, xc, precision=_cov_prec(precision))  # <- MXU
+    xc = (xf - mean[None, :]) * mask[:, None]
+    # policy-aware Gram (utils/precision.py): bf16 casts the centered
+    # chunk — centering happened in f32 first, so the cast rounds ONCE —
+    # and accumulates f32; f32 keeps the legacy tier bit-for-bit
+    gram = psn.pdot(xc.T, xc, policy, precision)  # <- MXU
     cov = gram / jnp.maximum(n_rows - 1.0, 1.0)
     # numerical symmetry guard before eigh
     return 0.5 * (cov + cov.T), mean
@@ -77,47 +82,53 @@ def covariance(
     x: jax.Array, mask: jax.Array, n_rows: jax.Array,
     precision: str = "highest",
     timings=None, phase: str = "covariance",
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """Registry-tracked entry over :func:`_covariance_jit` (semantics in
     its docstring): the launch is noted with the program-cache registry
     (utils/progcache) and, when ``timings`` is given, its wall is booked
-    under ``<phase>/compile`` (first program) or ``<phase>/execute``."""
+    under ``<phase>/compile`` (first program) or ``<phase>/execute``.
+    ``policy`` is the compute-precision policy (utils/precision.py)."""
     key = (
         progcache.backend_fingerprint(),
         progcache.array_key(x, mask),
-        precision,
+        precision, policy,
     )
     with progcache.launch("pca.covariance", key, timings, phase):
-        return _covariance_jit(x, mask, n_rows, precision)
+        return _covariance_jit(x, mask, n_rows, precision, policy)
 
 
-def _model_sharded_cov_fn(mesh, dax: str, max_: str, precision: str):
+def _model_sharded_cov_fn(mesh, dax: str, max_: str, precision: str,
+                          policy: str = "f32"):
     """Compiled model-sharded covariance program, cached in the
     process-wide program registry (utils/progcache; formerly a private
     functools.lru_cache) per mesh fingerprint — a fresh jit(shard_map)
     closure per fit would retrace/recompile every time."""
-    key = (progcache.mesh_fingerprint(mesh), dax, max_, precision)
+    key = (progcache.mesh_fingerprint(mesh), dax, max_, precision, policy)
     return progcache.get_or_build(
         "pca.covariance_model_sharded", key,
-        lambda: _build_model_sharded_cov(mesh, dax, max_, precision),
+        lambda: _build_model_sharded_cov(mesh, dax, max_, precision,
+                                         policy),
     )
 
 
-def _build_model_sharded_cov(mesh, dax: str, max_: str, precision: str):
+def _build_model_sharded_cov(mesh, dax: str, max_: str, precision: str,
+                             policy: str = "f32"):
     """Build the jitted model-sharded covariance program (cached above).
     Tier semantics match :func:`covariance`: fast tiers center on device
     before the Gram (no raw-moment cancellation amplification)."""
 
     def tile_program(x_blk, mask_blk, n):
-        xm = x_blk * mask_blk[:, None]
+        xf = psn.upcast(x_blk)
+        xm = xf * mask_blk[:, None]
         col_sum = lax.psum(jnp.sum(xm, axis=0), dax)  # (d_loc,)
         mean_loc = col_sum / n
         # centered Gram at every tier (see covariance: the raw-moment
         # form cancels catastrophically for large-mean data)
-        xc = (x_blk - mean_loc[None, :]) * mask_blk[:, None]
+        xc = (xf - mean_loc[None, :]) * mask_blk[:, None]
         xc_full = lax.all_gather(xc, max_, axis=1, tiled=True)  # (n_loc, d)
         gram_rows = lax.psum(
-            jnp.matmul(xc.T, xc_full, precision=_cov_prec(precision)), dax
+            psn.pdot(xc.T, xc_full, policy, precision), dax
         )  # (d_loc, d)
         cov_rows = gram_rows / jnp.maximum(n - 1.0, 1.0)
         return cov_rows, mean_loc
@@ -142,6 +153,7 @@ def covariance_model_sharded(
     x: jax.Array, mask: jax.Array, n_rows: jax.Array, mesh,
     precision: str = "highest",
     timings=None, phase: str = "covariance",
+    policy: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """Covariance with the (d, d) accumulation sharded over the MODEL axis.
 
@@ -161,11 +173,11 @@ def covariance_model_sharded(
 
     cfg = get_config()
     fn = _model_sharded_cov_fn(
-        mesh, cfg.data_axis, cfg.model_axis, precision
+        mesh, cfg.data_axis, cfg.model_axis, precision, policy
     )
     key = (
         progcache.mesh_fingerprint(mesh),
-        progcache.array_key(x, mask), precision,
+        progcache.array_key(x, mask), precision, policy,
     )
     with progcache.launch(
         "pca.covariance_model_sharded.run", key, timings, phase

@@ -34,7 +34,7 @@ import numpy as np
 from oap_mllib_tpu.data.prefetch import Prefetcher, PrefetchStats
 from oap_mllib_tpu.data.stream import ChunkSource
 from oap_mllib_tpu.ops import kmeans_ops
-from oap_mllib_tpu.ops.pca_ops import _cov_prec
+from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 
 
@@ -82,16 +82,23 @@ def _iter_weighted(source: ChunkSource, weights, dtype):
         )
 
 
-def _stage_to_device(dtype, stats: PrefetchStats):
+def _stage_to_device(dtype, stats: PrefetchStats, stage_dtype=None):
     """Stage callable for the prefetch pipeline: pad/convert the host
     chunk and weight vector and issue their device transfers.  Runs in
     the producer thread at depth >= 2 — chunk N+1 stages while chunk N's
     step executes.  The host halves ride along because the k-means||
-    loops sample/inspect rows host-side after the device fold."""
+    loops sample/inspect rows host-side after the device fold.
+
+    ``stage_dtype`` is the DATA chunk's staging dtype — under the bf16
+    compute policy (utils/precision.staging_dtype) the cast happens HERE,
+    in the producer thread, so the pad/convert output and the
+    host->device transfer both carry half the bytes; weights stay at the
+    accumulation dtype (they weight f32 accumulators)."""
+    stage_dtype = dtype if stage_dtype is None else stage_dtype
 
     def stage(item):
         chunk, n_valid, w = item
-        hc = np.asarray(chunk, dtype)
+        hc = np.asarray(chunk, stage_dtype)
         hw = np.asarray(w, dtype)
         with stats.transfer():
             cj = jnp.asarray(hc)
@@ -101,14 +108,15 @@ def _stage_to_device(dtype, stats: PrefetchStats):
     return stage
 
 
-def _staged_chunks(source, weights, dtype, stats: PrefetchStats):
+def _staged_chunks(source, weights, dtype, stats: PrefetchStats,
+                   stage_dtype=None):
     """Prefetched (host_chunk, n_valid, host_w, dev_chunk, dev_w) stream
     over a (optionally weighted) ChunkSource.  The consumed chunk's
     device buffers retire as the consumer advances (module contract in
-    data/prefetch.py)."""
+    data/prefetch.py).  ``stage_dtype``: see :func:`_stage_to_device`."""
     return Prefetcher(
         _iter_weighted(source, weights, dtype),
-        stage=_stage_to_device(dtype, stats),
+        stage=_stage_to_device(dtype, stats, stage_dtype),
         stats=stats,
         retire=True,
     )
@@ -259,11 +267,14 @@ def _checked_entry(validate) -> None:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("precision", "need_cost"),
+    static_argnames=("precision", "need_cost", "policy"),
     donate_argnums=(0, 1, 2),
 )
-def _kmeans_chunk_accum(sums, counts, cost, chunk, w, centers, precision, need_cost):
-    s, c, t = kmeans_ops._accumulate(chunk, w, centers, precision, need_cost)
+def _kmeans_chunk_accum(sums, counts, cost, chunk, w, centers, precision,
+                        need_cost, policy="f32"):
+    s, c, t = kmeans_ops._accumulate(
+        chunk, w, centers, precision, need_cost, policy
+    )
     return sums + s, counts + c, cost + t
 
 
@@ -292,14 +303,17 @@ def _check_weight_source(source: ChunkSource, weights) -> None:
 def streamed_accumulate(
     source: ChunkSource, centers, dtype, precision: str, need_cost: bool,
     weights=None, timings=None, phase: str = "lloyd_loop",
+    policy: str = "f32",
 ):
     """One full assignment pass over this process's shard, reduced across
     processes: (sums (k,d), counts (k,), cost) as host arrays (identical
     on every process).  Chunks arrive through the prefetch pipeline —
     chunk N+1 stages/transfers while chunk N's accumulate executes; the
     pass's stage/transfer/compute split lands in ``timings`` under
-    ``phase`` when given."""
+    ``phase`` when given.  Under the bf16 ``policy`` chunks stage at
+    bfloat16 (half the transfer bytes); accumulators stay ``dtype``."""
     k, d = centers.shape
+    stage_dtype = psn.staging_dtype(policy, dtype)
     sums = jnp.zeros((k, d), dtype)
     counts = jnp.zeros((k,), dtype)
     cost = jnp.zeros((), dtype)
@@ -310,12 +324,14 @@ def streamed_accumulate(
     step_key = (
         progcache.backend_fingerprint(),
         (source.chunk_rows, d, k), str(np.dtype(dtype)),
-        precision, need_cost,
+        str(stage_dtype), precision, need_cost, policy,
     )
     t0 = time.perf_counter()
     guard = _PassGuard()
     with guard:
-        with _staged_chunks(source, weights, dtype, stats) as pf:
+        with _staged_chunks(
+            source, weights, dtype, stats, stage_dtype
+        ) as pf:
             for _, _, _, cj, wj in pf:
                 with progcache.launch(
                     "kmeans.stream_accum", step_key, timings, phase,
@@ -323,7 +339,7 @@ def streamed_accumulate(
                 ):
                     sums, counts, cost = _kmeans_chunk_accum(
                         sums, counts, cost, cj, wj, centers, precision,
-                        need_cost,
+                        need_cost, policy,
                     )
     stats.finalize(timings, phase, time.perf_counter() - t0)
     return _psum_host([sums, counts, cost], guard=guard)
@@ -340,7 +356,7 @@ def _center_update(centers, sums, counts):
 def lloyd_run_streamed(
     source: ChunkSource, init_centers: np.ndarray, max_iter: int, tol: float,
     dtype, precision: str = "highest", weights=None, validated: bool = False,
-    timings=None,
+    timings=None, policy: str = "f32",
 ):
     """Streamed Lloyd loop; same return contract as kmeans_ops.lloyd_run:
     (centers, n_iter, cost, counts).  Convergence semantics match
@@ -363,7 +379,7 @@ def lloyd_run_streamed(
     for _ in range(max_iter):
         sums, counts, _ = streamed_accumulate(
             source, centers, dtype, precision, need_cost=False,
-            weights=weights, timings=timings,
+            weights=weights, timings=timings, policy=policy,
         )
         centers, max_moved = _center_update(centers, sums, counts)
         n_iter += 1
@@ -373,9 +389,16 @@ def lloyd_run_streamed(
         check_finite(centers, f"K-Means centroids (streamed pass {n_iter})")
         if float(max_moved) <= tol_sq:
             break
+    # final cost/counts pass: full precision INPUTS too (policy="f32" —
+    # one extra f32-staged pass).  The cost identity |x|^2 + |c|^2 - 2x.c
+    # cancels catastrophically for tight clusters under bf16-rounded
+    # inputs (measured ~2x cost inflation where centroids matched to
+    # 1e-4): the user-facing objective must not carry the fast policy's
+    # rounding — the same contract as the in-memory _lloyd_run_jit,
+    # which recomputes against its f32 table
     _, counts, cost = streamed_accumulate(
         source, centers, dtype, "highest", need_cost=True, weights=weights,
-        timings=timings,
+        timings=timings, policy="f32",
     )
     return centers, n_iter, cost, counts
 
@@ -484,6 +507,7 @@ def _pad_cands(cands: np.ndarray, cap: int, d: int) -> np.ndarray:
 def init_kmeans_parallel_streamed(
     source: ChunkSource, k: int, seed: int, init_steps: int, dtype,
     weights=None, validated: bool = False, timings=None,
+    policy: str = "f32",
 ) -> np.ndarray:
     """Streamed k-means|| (Bahmani), host-orchestrated.
 
@@ -513,6 +537,13 @@ def init_kmeans_parallel_streamed(
     d = source.n_features
     l = 2.0 * k
     cap = 4 * k  # per-round candidate block (2x expected picks)
+    # bf16 policy: chunks stage at bfloat16 for the distance folds (the
+    # candidate ROWS are picked from the untouched host chunks, so the
+    # candidates themselves keep full precision; only the sampling
+    # probabilities and ownership weights carry bf16 rounding — Bahmani
+    # oversampling is robust to far larger perturbations, and parity
+    # compares converged cost, survey §7.3)
+    stage_dtype = psn.staging_dtype(policy, dtype)
     # per-process stream for sampling OWN rows; shared stream for the
     # final reduction (must be identical on every process)
     samp_rng = np.random.default_rng(seed + 31 * jax.process_index())
@@ -540,7 +571,9 @@ def init_kmeans_parallel_streamed(
         stats = PrefetchStats()
         t0 = time.perf_counter()
         guard = _PassGuard()
-        with guard, _staged_chunks(source, weights, dtype, stats) as pf:
+        with guard, _staged_chunks(
+            source, weights, dtype, stats, stage_dtype
+        ) as pf:
             for ci, (chunk, n_valid, wv, cj, _) in enumerate(pf):
                 if cands_dev is not None:
                     prev = (
@@ -610,7 +643,9 @@ def init_kmeans_parallel_streamed(
     stats = PrefetchStats()
     t0 = time.perf_counter()
     guard = _PassGuard()
-    with guard, _staged_chunks(source, weights, dtype, stats) as pf:
+    with guard, _staged_chunks(
+        source, weights, dtype, stats, stage_dtype
+    ) as pf:
         for _, _, _, cj, wj in pf:
             progcache.note(
                 "kmeans.stream_pll_own",
@@ -630,17 +665,51 @@ def init_kmeans_parallel_streamed(
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _colsum_chunk(total, chunk, w):
-    return total + jnp.sum(chunk * w[:, None], axis=0)
+    return total + jnp.sum(psn.upcast(chunk) * w[:, None], axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("precision",), donate_argnums=(0,))
-def _gram_chunk(gram, chunk, w, mean, precision):
-    xc = (chunk - mean[None, :]) * w[:, None]
-    return gram + jnp.matmul(xc.T, xc, precision=_cov_prec(precision))
+@functools.partial(
+    jax.jit, static_argnames=("precision", "policy"), donate_argnums=(0,)
+)
+def _gram_chunk(gram, chunk, w, mean, precision, policy="f32"):
+    xc = (psn.upcast(chunk) - mean[None, :]) * w[:, None]
+    return gram + psn.pdot(xc.T, xc, policy, precision)
+
+
+# Kahan/Neumaier-compensated accumulators for the reduced-precision
+# policies: the per-chunk partials carry bf16 input rounding already, so
+# the CROSS-PASS f32 accumulation must not add O(n_chunks * eps)
+# cancellation on top — the compensation term recovers the bits each
+# f32 += loses, keeping the summation error bounded independent of the
+# chunk count (the "f32 accumulators with compensated summation across
+# passes" half of the policy contract).  Not used by the f32 policy:
+# its accumulation order must stay bit-identical to the pre-policy code.
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _colsum_chunk_comp(total, comp, chunk, w):
+    s = jnp.sum(psn.upcast(chunk) * w[:, None], axis=0)
+    y = s - comp
+    t = total + y
+    comp = (t - total) - y
+    return t, comp
+
+
+@functools.partial(
+    jax.jit, static_argnames=("precision", "policy"), donate_argnums=(0, 1)
+)
+def _gram_chunk_comp(gram, comp, chunk, w, mean, precision, policy):
+    xc = (psn.upcast(chunk) - mean[None, :]) * w[:, None]
+    g = psn.pdot(xc.T, xc, policy, precision)
+    y = g - comp
+    t = gram + y
+    comp = (t - gram) - y
+    return t, comp
 
 
 def covariance_streamed(
     source: ChunkSource, dtype, precision: str = "highest", timings=None,
+    policy: str = "f32",
 ):
     """Two-pass streamed covariance: (cov (d,d), mean (d,), n_rows), as
     host arrays identical on every process.
@@ -650,24 +719,37 @@ def covariance_streamed(
     multi-process shards reduce across processes after each pass.  Both
     passes pull through the prefetch pipeline; the split lands in
     ``timings`` under ``covariance_streamed/``.
+
+    ``policy`` (utils/precision.py): bf16 stages chunks at bfloat16
+    (half the transfer bytes), runs the per-chunk Gram matmuls on bf16
+    operands with f32 accumulation, and compensates the cross-chunk f32
+    accumulation (Kahan) so the pass count cannot amplify the rounding;
+    f32 keeps the exact pre-policy accumulators.
     """
     d = source.n_features
+    stage_dtype = psn.staging_dtype(policy, dtype)
+    compensated = policy == "bf16"
     total = jnp.zeros((d,), dtype)
+    comp = jnp.zeros((d,), dtype)
     n = 0
     stats = PrefetchStats()
     base_key = (
         progcache.backend_fingerprint(),
-        (source.chunk_rows, d), str(np.dtype(dtype)), precision,
+        (source.chunk_rows, d), str(np.dtype(dtype)), str(stage_dtype),
+        precision, policy,
     )
     t0 = time.perf_counter()
     guard = _PassGuard()
-    with guard, _staged_chunks(source, None, dtype, stats) as pf:
+    with guard, _staged_chunks(source, None, dtype, stats, stage_dtype) as pf:
         for _, n_valid, _, cj, wj in pf:
             with progcache.launch(
                 "pca.stream_colsum", base_key, timings,
                 "covariance_streamed", record_execute=False,
             ):
-                total = _colsum_chunk(total, cj, wj)
+                if compensated:
+                    total, comp = _colsum_chunk_comp(total, comp, cj, wj)
+                else:
+                    total = _colsum_chunk(total, cj, wj)
             n += n_valid
     stats.finalize(timings, "covariance_streamed", time.perf_counter() - t0)
     total, n_arr = _psum_host([total, np.asarray([n], np.int64)], guard=guard)
@@ -681,16 +763,22 @@ def covariance_streamed(
         raise ValueError("empty source")
     mean = jnp.asarray(total.astype(dtype) / n)
     gram = jnp.zeros((d, d), dtype)
+    gcomp = jnp.zeros((d, d), dtype)
     stats = PrefetchStats()
     t0 = time.perf_counter()
     guard = _PassGuard()
-    with guard, _staged_chunks(source, None, dtype, stats) as pf:
+    with guard, _staged_chunks(source, None, dtype, stats, stage_dtype) as pf:
         for _, _, _, cj, wj in pf:
             with progcache.launch(
                 "pca.stream_gram", base_key, timings,
                 "covariance_streamed", record_execute=False,
             ):
-                gram = _gram_chunk(gram, cj, wj, mean, precision)
+                if compensated:
+                    gram, gcomp = _gram_chunk_comp(
+                        gram, gcomp, cj, wj, mean, precision, policy
+                    )
+                else:
+                    gram = _gram_chunk(gram, cj, wj, mean, precision, policy)
     stats.finalize(timings, "covariance_streamed", time.perf_counter() - t0)
     (gram,) = _psum_host([gram], guard=guard)
     check_finite(gram, "PCA Gram accumulator (streamed Gram pass)")

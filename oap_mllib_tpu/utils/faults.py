@@ -33,11 +33,13 @@ comma-separated list of ``site:kind=count`` entries::
 
 Kinds: ``fail`` = transient (classified TRANSIENT — the retry tier),
 ``oom`` = device memory exhaustion (classified OOM — the halved-chunk
-rung), ``err`` = permanent (classified as no fault — propagates raw).
-``count`` is a positive int (the first N calls raise) or ``*``
-(persistent).  The registry is deterministic: same spec + same call
-sequence = same faults, so gates can assert exact retry counters
-(dev/fault_gate.py).
+rung), ``nan`` = non-finite iterate (classified NONFINITE — drives the
+precision-degradation rung and the ``nonfinite_policy`` tiers), ``err``
+= permanent (classified as no fault — propagates raw).  ``count`` is a
+positive int (the first N calls raise) or ``*`` (persistent).  The
+registry is deterministic: same spec + same call sequence = same
+faults, so gates can assert exact retry counters (dev/fault_gate.py,
+dev/precision_gate.py).
 """
 
 from __future__ import annotations
@@ -51,8 +53,9 @@ SITES = ("stream.read", "prefetch.stage", "bootstrap.connect", "fit.execute")
 
 KIND_FAIL = "fail"
 KIND_OOM = "oom"
+KIND_NONFINITE = "nan"
 KIND_ERR = "err"
-_KINDS = (KIND_FAIL, KIND_OOM, KIND_ERR)
+_KINDS = (KIND_FAIL, KIND_OOM, KIND_NONFINITE, KIND_ERR)
 
 
 class FaultInjected(Exception):
@@ -83,6 +86,16 @@ class InjectedPermanentError(FaultInjected, RuntimeError):
     kind = KIND_ERR
 
 
+class InjectedNonFiniteError(FaultInjected, FloatingPointError):
+    """Injected non-finite-iterate fault (classified NONFINITE, like a
+    real :class:`~oap_mllib_tpu.utils.resilience.NonFiniteError` from a
+    streamed guardrail) — drives the resilience ladder's
+    precision-degradation rung and the ``nonfinite_policy`` tiers in CI
+    without needing data that actually overflows."""
+
+    kind = KIND_NONFINITE
+
+
 def _make_fault(kind: str, site: str, nth: int) -> FaultInjected:
     if kind == KIND_OOM:
         return InjectedOOMError(
@@ -91,6 +104,10 @@ def _make_fault(kind: str, site: str, nth: int) -> FaultInjected:
     if kind == KIND_FAIL:
         return InjectedTransientError(
             f"injected transient fault at {site} (call {nth})"
+        )
+    if kind == KIND_NONFINITE:
+        return InjectedNonFiniteError(
+            f"injected non-finite iterate at {site} (call {nth})"
         )
     return InjectedPermanentError(
         f"injected permanent fault at {site} (call {nth})"

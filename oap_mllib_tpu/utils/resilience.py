@@ -19,8 +19,13 @@ The ladder, per accelerated fit (single-process; see below)::
       ├──> ONE degraded retry: halved chunks (streamed sources re-chunk
       │    at chunk_rows/2; in-memory K-Means doubles its Lloyd chunk
       │    count; streamed ALS halves its upload blocks)
-      │ still failing / retries exhausted / non-finite iterate under
-      │ nonfinite_policy="fallback"
+      │ non-finite iterate while a REDUCED compute-precision policy
+      │ (bf16/tf32, utils/precision.py) was active
+      ├──> the PRECISION rung: ONE retry with every policy pinned to f32
+      │    (precision.force_f32) — a rounding-induced overflow/NaN must
+      │    not fail a fit that is healthy at full precision
+      │ still failing / retries exhausted / non-finite iterate at f32
+      │ under nonfinite_policy="fallback"
       └──> the CPU/NumPy fallback path when Config.fallback is True;
            otherwise ResilienceError carrying the full fault history.
 
@@ -113,6 +118,7 @@ def classify_fault(exc: BaseException) -> Optional[str]:
         return {
             faults.KIND_FAIL: TRANSIENT,
             faults.KIND_OOM: OOM,
+            faults.KIND_NONFINITE: NONFINITE,
         }.get(exc.kind)
     if isinstance(exc, NonFiniteError):
         return NONFINITE
@@ -323,21 +329,33 @@ def resilient_fit(
 
     Fault routing: TRANSIENT retries under ``policy`` (count + deadline
     bounded); the first OOM steps to the degraded rung (transient
-    retries still available there); NONFINITE honors
+    retries still available there); a NONFINITE fault raised while the
+    attempt resolved a REDUCED compute-precision policy (bf16/tf32 —
+    utils/precision.reduced_active) first steps the PRECISION rung: one
+    retry with every policy pinned to f32, BEFORE the
+    ``nonfinite_policy`` decision, so a rounding-induced NaN degrades to
+    full precision instead of failing the fit; NONFINITE at f32 honors
     ``Config.nonfinite_policy`` (``raise`` propagates immediately,
     ``fallback`` escalates straight to the CPU rung); unclassified
     exceptions propagate unchanged.  Exhausted ladders raise
     :class:`ResilienceError` with the recorded history when fallback is
     unavailable.
     """
+    from oap_mllib_tpu.utils import precision as _precision
+
     stats = stats or ResilienceStats()
     if _world() > 1:
         return attempt(False)
     policy = policy or RetryPolicy.from_config()
     deadline = time.monotonic() + policy.deadline_s
     degraded = False
+    precision_degraded = False
     while True:
         try:
+            _precision.begin_attempt()
+            if precision_degraded:
+                with _precision.force_f32():
+                    return attempt(degraded)
             return attempt(degraded)
         except Exception as e:
             kind = classify_fault(e)
@@ -360,6 +378,23 @@ def resilient_fit(
                 stats.note_degradation()
                 log.warning(
                     "%s: device OOM (%s); retrying once with halved chunks",
+                    site, e,
+                )
+                continue
+            if (
+                kind == NONFINITE
+                and not precision_degraded
+                and _precision.reduced_active()
+            ):
+                # the precision rung: the fit ran bf16/tf32 — pin every
+                # policy to f32 for one retry before the nonfinite_policy
+                # decision (fits already at f32 skip straight past this,
+                # keeping the exact pre-policy fault semantics)
+                precision_degraded = True
+                stats.note_degradation()
+                log.warning(
+                    "%s: non-finite iterate under a reduced compute-"
+                    "precision policy (%s); retrying once at f32",
                     site, e,
                 )
                 continue

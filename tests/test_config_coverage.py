@@ -159,6 +159,31 @@ class TestConfigCoverage:
         with pytest.raises(ValueError, match="nonfinite_policy"):
             KMeans(k=2, init_mode="random", max_iter=1).fit(src)
 
+    def test_compute_precision_typo_raises_at_fit(self, rng):
+        """The kmeans_kernel/als_kernel contract for the precision
+        policy: a typo'd tier must raise at fit entry, not silently run
+        f32."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        set_config(compute_precision="bf8")
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        with pytest.raises(ValueError, match="compute_precision"):
+            KMeans(k=2, init_mode="random", max_iter=1).fit(x)
+
+    def test_per_algo_precision_overrides_inherit_and_validate(self):
+        from oap_mllib_tpu.utils import precision as psn
+
+        set_config(compute_precision="tf32")
+        # empty overrides inherit the global policy
+        assert psn.resolve("kmeans").name == "tf32"
+        assert psn.resolve("pca").name == "tf32"
+        set_config(pca_precision="f32")
+        assert psn.resolve("pca").name == "f32"
+        assert psn.resolve("als").name == "tf32"
+        set_config(kmeans_precision="nope")
+        with pytest.raises(ValueError, match="kmeans_precision"):
+            psn.resolve("kmeans")
+
     def test_retry_knobs_reach_policy(self):
         """retry_limit / retry_backoff / retry_deadline flow into
         RetryPolicy.from_config with float coercion intact."""

@@ -1,0 +1,415 @@
+"""Mixed-precision compute policy (utils/precision.py + the threading
+through every jitted fit entry): resolution/validation, bf16-vs-f32
+parity on fixed seeds, staging-time casts in the prefetch pipeline, the
+resilience ladder's f32-degradation rung, and summary/telemetry
+exposure of the chosen policy."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.utils import precision as psn
+
+
+def _blobs(rng, n=2048, d=16, k=4, spread=6.0, noise=0.2):
+    proto = rng.normal(size=(k, d)).astype(np.float32) * spread
+    x = (proto[rng.integers(k, size=n)]
+         + rng.normal(size=(n, d)).astype(np.float32) * noise)
+    return x
+
+
+class TestResolution:
+    def test_default_is_f32_with_configured_tier(self):
+        pol = psn.resolve("kmeans")
+        assert pol.name == "f32"
+        assert pol.requested == "f32"
+        assert pol.input_dtype == "float32"
+        assert pol.accum_dtype == "float32"
+        assert pol.dot_tier == "highest"  # matmul_precision default
+
+    def test_explicit_tiers_resolve(self):
+        for tier, in_dt in (("tf32", "float32"), ("bf16", "bfloat16")):
+            set_config(compute_precision=tier)
+            pol = psn.resolve("pca")
+            assert pol.name == tier
+            assert pol.input_dtype == in_dt
+            assert pol.accum_dtype == "float32"
+
+    def test_typo_raises(self):
+        set_config(compute_precision="bf8")
+        with pytest.raises(ValueError, match="compute_precision"):
+            psn.resolve("kmeans")
+
+    def test_per_algo_override_wins_and_validates(self):
+        set_config(compute_precision="bf16", als_precision="f32")
+        assert psn.resolve("als").name == "f32"
+        assert psn.resolve("kmeans").name == "bf16"
+        set_config(als_precision="bogus")
+        with pytest.raises(ValueError, match="als_precision"):
+            psn.resolve("als")
+
+    def test_unknown_algo_raises(self):
+        with pytest.raises(ValueError, match="unknown algorithm"):
+            psn.resolve("svm")
+
+    def test_typod_matmul_precision_raises_under_any_policy(self):
+        set_config(compute_precision="bf16", matmul_precision="hihgest")
+        with pytest.raises(ValueError, match="matmul_precision"):
+            psn.resolve("kmeans")
+
+    def test_auto_is_f32_without_fast_bf16_backend(self, monkeypatch):
+        # the suite runs on CPU — auto must not downgrade where bf16
+        # buys no throughput
+        set_config(compute_precision="auto")
+        assert psn.resolve("kmeans").name == "f32"
+        # with a fast-bf16 backend, auto picks bf16 for every algorithm
+        # with a registered parity bound (all three)
+        monkeypatch.setattr(psn, "_fast_bf16_backend", lambda: True)
+        for algo in psn.ALGOS:
+            pol = psn.resolve(algo)
+            assert pol.name == "bf16" and pol.requested == "auto"
+
+    def test_x64_pins_f32(self, monkeypatch):
+        monkeypatch.setattr(psn, "_fast_bf16_backend", lambda: True)
+        set_config(compute_precision="bf16", enable_x64=True)
+        pol = psn.resolve("pca")
+        assert pol.name == "f32"
+        assert pol.input_dtype == "float64"
+        set_config(compute_precision="auto")
+        assert psn.resolve("pca").name == "f32"
+
+    def test_force_f32_scope_overrides(self):
+        set_config(compute_precision="bf16")
+        with psn.force_f32():
+            assert psn.resolve("als").name == "f32"
+        assert psn.resolve("als").name == "bf16"
+
+    def test_reduced_active_tracks_attempt(self):
+        psn.begin_attempt()
+        assert not psn.reduced_active()
+        psn.resolve("kmeans")  # f32 default
+        assert not psn.reduced_active()
+        set_config(compute_precision="tf32")
+        psn.resolve("kmeans")
+        assert psn.reduced_active()
+        psn.begin_attempt()
+        assert not psn.reduced_active()
+
+    def test_kernel_tier_mapping(self):
+        assert psn.kernel_tier("f32", "highest") == "highest"
+        assert psn.kernel_tier("f32", "high") == "high"
+        assert psn.kernel_tier("tf32", "highest") == "high"
+        assert psn.kernel_tier("bf16", "highest") == "default"
+        with pytest.raises(ValueError):
+            psn.kernel_tier("fp8", "highest")
+
+    def test_staging_dtype(self):
+        import ml_dtypes
+
+        assert psn.staging_dtype("f32", np.float32) == np.float32
+        assert psn.staging_dtype("tf32", np.float32) == np.float32
+        assert psn.staging_dtype("bf16", np.float32) == np.dtype(
+            ml_dtypes.bfloat16
+        )
+        # the f64 lane never stages reduced
+        assert psn.staging_dtype("bf16", np.float64) == np.float64
+
+
+class TestPolicyDots:
+    def test_pdot_f32_bitwise_matches_legacy(self, rng):
+        a = jnp.asarray(rng.normal(size=(32, 16)).astype(np.float32))
+        b = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+        for tier in ("highest", "high", "default"):
+            want = jnp.matmul(a, b, precision=psn.legacy_precision(tier))
+            got = psn.pdot(a, b, "f32", tier)
+            assert np.array_equal(np.asarray(want), np.asarray(got))
+
+    def test_pdot_bf16_accumulates_f32(self, rng):
+        a = jnp.asarray(rng.normal(size=(64, 128)).astype(np.float32))
+        b = jnp.asarray(rng.normal(size=(128, 4)).astype(np.float32))
+        out = psn.pdot(a, b, "bf16")
+        assert out.dtype == jnp.float32
+        ref = np.asarray(jnp.matmul(a, b, precision="highest"))
+        # bf16 inputs: ~8-bit mantissa, f32 accumulation keeps the
+        # contraction from compounding it
+        rel = np.abs(np.asarray(out) - ref).max() / np.abs(ref).max()
+        assert 0 < rel < 5e-2
+
+    def test_pdot_accepts_bf16_staged_operands(self, rng):
+        a32 = rng.normal(size=(16, 8)).astype(np.float32)
+        a = jnp.asarray(a32).astype(jnp.bfloat16)
+        b = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+        out = psn.pdot(a, b, "bf16")
+        assert out.dtype == jnp.float32
+        # the f32 policy upcasts a stray bf16 operand rather than
+        # promoting the whole dot to bf16
+        out_f32 = psn.pdot(a, b, "f32", "highest")
+        assert out_f32.dtype == jnp.float32
+
+    def test_peinsum_f32_matches_legacy_highest(self, rng):
+        a = jnp.asarray(rng.normal(size=(3, 4, 5)).astype(np.float32))
+        b = jnp.asarray(rng.normal(size=(6, 4, 5)).astype(np.float32))
+        want = jnp.einsum("agp,bgp->gab", a, b, precision="highest")
+        got = psn.peinsum("agp,bgp->gab", a, b, "f32")
+        assert np.array_equal(np.asarray(want), np.asarray(got))
+
+    def test_upcast_noop_for_f32(self, rng):
+        a = jnp.asarray(rng.normal(size=(4,)).astype(np.float32))
+        assert psn.upcast(a) is a
+
+
+class TestParity:
+    """bf16 vs f32 on fixed seeds, within the registered bounds
+    (dev/precision_gate.py runs the same checks on larger shapes)."""
+
+    def test_kmeans_centroids_and_cost(self, rng):
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = _blobs(rng)
+        ref = KMeans(k=4, seed=7, max_iter=10).fit(x)
+        set_config(compute_precision="bf16")
+        bf = KMeans(k=4, seed=7, max_iter=10).fit(x)
+        scale = float(np.abs(x).max())
+        d2 = ((bf.cluster_centers_[:, None, :]
+               - ref.cluster_centers_[None, :, :]) ** 2).sum(-1)
+        cen = float(np.sqrt(d2.min(axis=1)).max()) / scale
+        cost = abs(bf.summary.training_cost - ref.summary.training_cost)
+        cost /= max(ref.summary.training_cost, 1e-30)
+        b = psn.PARITY_BOUNDS["kmeans"]
+        assert cen <= b["centroid_rel"], cen
+        assert cost <= b["cost_rel"], cost
+
+    def test_pca_subspace_and_ratios(self, rng):
+        from oap_mllib_tpu.models.pca import PCA
+
+        x = _blobs(rng)
+        ref = PCA(k=3).fit(x)
+        set_config(compute_precision="bf16")
+        bf = PCA(k=3).fit(x)
+        s = np.linalg.svd(ref.components_.T @ bf.components_,
+                          compute_uv=False)
+        angle = float(np.arccos(np.clip(s.min(), 0.0, 1.0)))
+        ratio = float(np.abs(
+            bf.explained_variance_ - ref.explained_variance_
+        ).max())
+        b = psn.PARITY_BOUNDS["pca"]
+        assert angle <= b["subspace_rad"], angle
+        assert ratio <= b["ratio_abs"], ratio
+
+    def test_als_factors_and_predictions(self, rng):
+        from oap_mllib_tpu.models.als import ALS
+
+        nu, ni, nnz = 300, 200, 8000
+        u = rng.integers(nu, size=nnz).astype(np.int64)
+        i = rng.integers(ni, size=nnz).astype(np.int64)
+        r = (rng.random(nnz) * 4 + 1).astype(np.float32)
+        ref = ALS(rank=6, max_iter=4, seed=3, implicit_prefs=True,
+                  alpha=10.0).fit(u, i, r)
+        set_config(compute_precision="bf16")
+        bf = ALS(rank=6, max_iter=4, seed=3, implicit_prefs=True,
+                 alpha=10.0).fit(u, i, r)
+        b = psn.PARITY_BOUNDS["als"]
+        f_dev = float(np.abs(bf.user_factors_ - ref.user_factors_).max())
+        f_dev /= max(float(np.abs(ref.user_factors_).max()), 1e-30)
+        pref = ref.predict(u[:1000], i[:1000])
+        pbf = bf.predict(u[:1000], i[:1000])
+        rmse = float(np.sqrt(np.mean((pbf - pref) ** 2)))
+        rmse /= max(float(np.sqrt(np.mean(pref ** 2))), 1e-30)
+        assert f_dev <= b["factor_rel"], f_dev
+        assert rmse <= b["rmse_rel"], rmse
+
+    def test_f32_policy_is_bit_compatible(self, rng):
+        """compute_precision='f32' must reproduce the default-argument
+        (pre-policy) kernels EXACTLY — at the op level, where a silent
+        numerics change would hide inside fit-level tolerance."""
+        from oap_mllib_tpu.ops import kmeans_ops, pca_ops
+
+        x = jnp.asarray(_blobs(rng, n=512))
+        w = jnp.ones((512,), jnp.float32)
+        c = jnp.asarray(np.asarray(x)[:4])
+        for tier in ("highest", "high"):
+            a = kmeans_ops._accumulate(x, w, c, tier, True)
+            bb = kmeans_ops._accumulate(x, w, c, tier, True, "f32")
+            for u, v in zip(a, bb):
+                assert np.array_equal(np.asarray(u), np.asarray(v))
+        cov_a, _ = pca_ops._covariance_jit(x, w, jnp.asarray(512.0), "highest")
+        cov_b, _ = pca_ops._covariance_jit(
+            x, w, jnp.asarray(512.0), "highest", "f32"
+        )
+        assert np.array_equal(np.asarray(cov_a), np.asarray(cov_b))
+
+    def test_streamed_f32_matches_in_memory_contract(self, rng):
+        """Streamed fits under the explicit f32 policy stay bit-identical
+        to the default-config streamed fit (stage dtype unchanged)."""
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = _blobs(rng, n=1024)
+        src = ChunkSource.from_array(x, chunk_rows=256)
+        ref = KMeans(k=4, seed=7, max_iter=5).fit(src)
+        set_config(compute_precision="f32")
+        f32 = KMeans(k=4, seed=7, max_iter=5).fit(src)
+        assert np.array_equal(ref.cluster_centers_, f32.cluster_centers_)
+        assert ref.summary.training_cost == f32.summary.training_cost
+
+
+class TestStagingCasts:
+    def test_streamed_chunks_stage_bf16(self, rng):
+        from oap_mllib_tpu.data.prefetch import PrefetchStats
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.ops import stream_ops
+
+        x = _blobs(rng, n=512)
+        src = ChunkSource.from_array(x, chunk_rows=256)
+        stats = PrefetchStats()
+        sd = psn.staging_dtype("bf16", np.float32)
+        with stream_ops._staged_chunks(src, None, np.float32, stats, sd) as pf:
+            for host_chunk, n_valid, host_w, cj, wj in pf:
+                assert cj.dtype == jnp.bfloat16
+                assert wj.dtype == jnp.float32  # weights stay accum dtype
+                # half the bytes of the f32 staging path per data chunk
+                assert cj.nbytes * 2 == host_chunk.astype(np.float32).nbytes
+
+    def test_streamed_chunks_stage_f32_by_default(self, rng):
+        from oap_mllib_tpu.data.prefetch import PrefetchStats
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.ops import stream_ops
+
+        x = _blobs(rng, n=512)
+        src = ChunkSource.from_array(x, chunk_rows=256)
+        stats = PrefetchStats()
+        with stream_ops._staged_chunks(src, None, np.float32, stats) as pf:
+            for _, _, _, cj, wj in pf:
+                assert cj.dtype == jnp.float32
+
+    def test_streamed_bf16_fit_within_bounds(self, rng):
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = _blobs(rng, n=1024)
+        src = ChunkSource.from_array(x, chunk_rows=256)
+        ref = KMeans(k=4, seed=7, max_iter=8).fit(src)
+        set_config(compute_precision="bf16")
+        bf = KMeans(k=4, seed=7, max_iter=8).fit(src)
+        assert bf.summary.precision == "bf16"
+        cost = abs(bf.summary.training_cost - ref.summary.training_cost)
+        cost /= max(ref.summary.training_cost, 1e-30)
+        # the final cost pass re-stages at f32 (the user-facing objective
+        # must not carry the cancellation of bf16-rounded inputs)
+        assert cost <= psn.PARITY_BOUNDS["kmeans"]["cost_rel"]
+
+
+class TestDegradationRung:
+    def test_rung_unit(self):
+        """resilient_fit: a NONFINITE fault under a reduced policy takes
+        ONE f32 retry (inside force_f32) before the nonfinite_policy
+        decision; at f32 the original raise semantics hold."""
+        from oap_mllib_tpu.utils import resilience
+
+        set_config(compute_precision="bf16", retry_backoff=0.001)
+        seen = []
+
+        def attempt(degraded):
+            pol = psn.resolve("kmeans")
+            seen.append(pol.name)
+            if pol.name != "f32":
+                raise resilience.NonFiniteError("bf16 overflow")
+            return "ok"
+
+        stats = resilience.ResilienceStats()
+        out = resilience.resilient_fit("KMeans", attempt, None, stats=stats)
+        assert out == "ok"
+        assert seen == ["bf16", "f32"]
+        assert stats.degradations == 1
+
+    def test_rung_skipped_at_f32(self):
+        """A fit already at f32 keeps the exact pre-policy semantics:
+        NONFINITE + nonfinite_policy='raise' propagates immediately."""
+        from oap_mllib_tpu.utils import resilience
+
+        calls = []
+
+        def attempt(degraded):
+            psn.resolve("kmeans")  # f32 default
+            calls.append(1)
+            raise resilience.NonFiniteError("genuine f32 nonfinite")
+
+        with pytest.raises(resilience.NonFiniteError):
+            resilience.resilient_fit("KMeans", attempt, None)
+        assert len(calls) == 1
+
+    def test_rung_end_to_end_with_injected_fault(self, rng):
+        """Injected 'nan' fault at the jitted-launch site under bf16:
+        the fit completes ACCELERATED at f32, one degradation booked."""
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.models.kmeans import KMeans
+        from oap_mllib_tpu.utils import faults
+
+        x = _blobs(rng, n=1024)
+        src = ChunkSource.from_array(x, chunk_rows=256)
+        set_config(compute_precision="bf16",
+                   fault_spec="fit.execute:nan=1", retry_backoff=0.001)
+        faults.reset()
+        m = KMeans(k=4, seed=7, max_iter=5).fit(src)
+        assert m.summary.accelerated
+        assert m.summary.precision == "f32"  # the rung's retry recorded
+        assert m.summary.resilience["degradations"] == 1
+
+    def test_nan_fault_kind_classifies_nonfinite(self):
+        from oap_mllib_tpu.utils import faults, resilience
+
+        exc = faults._make_fault(faults.KIND_NONFINITE, "fit.execute", 1)
+        assert resilience.classify_fault(exc) == resilience.NONFINITE
+
+
+class TestExposure:
+    def test_summaries_and_span_attrs(self, rng):
+        from oap_mllib_tpu.models.kmeans import KMeans
+        from oap_mllib_tpu.models.pca import PCA
+
+        x = _blobs(rng, n=512)
+        set_config(compute_precision="tf32")
+        m = KMeans(k=4, seed=7, max_iter=3).fit(x)
+        assert m.summary.precision == "tf32"
+        assert m.summary.timings.root.attrs["precision"] == "tf32"
+        p = PCA(k=2).fit(x)
+        assert p.summary["precision"] == "tf32"
+        assert p.summary["timings"].root.attrs["precision"] == "tf32"
+
+    def test_policy_rides_telemetry_export(self, rng, tmp_path):
+        """The span-tree root's precision attr reaches the JSONL sink."""
+        import json
+
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        log = tmp_path / "t.jsonl"
+        set_config(compute_precision="bf16", telemetry_log=str(log))
+        KMeans(k=4, seed=7, max_iter=3).fit(_blobs(rng, n=512))
+        roots = [
+            json.loads(line) for line in log.read_text().splitlines()
+            if json.loads(line).get("path") == "kmeans.fit"
+        ]
+        assert roots and all(
+            r["attrs"]["precision"] == "bf16" for r in roots
+        )
+
+    def test_als_summary_records_policy(self, rng):
+        from oap_mllib_tpu.models.als import ALS
+
+        u = rng.integers(50, size=1000).astype(np.int64)
+        i = rng.integers(40, size=1000).astype(np.int64)
+        r = (rng.random(1000) * 4 + 1).astype(np.float32)
+        set_config(compute_precision="bf16")
+        m = ALS(rank=4, max_iter=2, seed=3).fit(u, i, r)
+        assert m.summary["precision"] == "bf16"
+
+    def test_pallas_mode_aliases(self):
+        from oap_mllib_tpu.ops.pallas.kmeans_kernel import _check_mode
+
+        assert _check_mode("f32") == "highest"
+        assert _check_mode("tf32") == "high"
+        assert _check_mode("bf16") == "default"
+        assert _check_mode("highest") == "highest"
+        with pytest.raises(ValueError, match="mode"):
+            _check_mode("fp8")
