@@ -36,8 +36,9 @@ echo "   verbatim-minus-import examples below then run against real Spark) =="
 HAVE_PYSPARK=0
 if have_py pyspark; then HAVE_PYSPARK=1; fi
 
-echo "== lint (style gate — failures fail the build, like the reference's scalastyle) =="
-python dev/lint.py
+echo "== oaplint (style + architecture gate — the scalastyle analog, extended"
+echo "   to the PR 1-5 subsystem contracts; JSON artifact for the CI run) =="
+python dev/oaplint --json /tmp/oaplint_findings.json
 if have ruff; then
   ruff check .
 fi

@@ -1,132 +1,23 @@
 #!/usr/bin/env python
-"""Style gate (the scalastyle/clang-format analog — the reference FAILS the
-build on style violations, mllib-dal/pom.xml:303).
+"""Back-compat shim: the style gate moved into dev/oaplint (PR 6).
 
-This image ships no ruff/flake8/clang-format and installs are forbidden, so
-the always-on gate is this stdlib linter; dev/ci.sh additionally runs ruff
-and clang-format (configs live in pyproject.toml / native/.clang-format)
-whenever those binaries exist.
-
-Checks — Python (.py): syntax (ast parse), unused imports (skipped for
-__init__.py re-export manifests and names in __all__), tabs, trailing
-whitespace, missing final newline, lines > MAX_LEN.  C++ (.cpp/.h): tabs,
-trailing whitespace, missing final newline, lines > MAX_LEN.
-
-Exit code 1 on any finding; prints file:line: rule: detail.
+The stdlib style checks that lived here (syntax, unused imports, tabs,
+trailing whitespace, final newline, line length) are oaplint rules now,
+running alongside the subsystem-contract rules — one entry point, one
+output format, one CI gate (`python dev/oaplint`).  This shim keeps
+`python dev/lint.py` working for muscle memory and old scripts.
 """
 
 from __future__ import annotations
 
-import ast
+import os
 import sys
-from pathlib import Path
 
-MAX_LEN = 100
-ROOT = Path(__file__).resolve().parent.parent
-PY_DIRS = ["oap_mllib_tpu", "tests", "tests_tpu", "examples", "dev"]
-PY_FILES = ["bench.py", "__graft_entry__.py"]
-CPP_DIRS = ["oap_mllib_tpu/native/src"]
-SKIP_PARTS = {"build", "__pycache__", ".git"}
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-
-def _iter_files():
-    for d in PY_DIRS:
-        for p in sorted((ROOT / d).rglob("*.py")):
-            if not SKIP_PARTS & set(p.parts):
-                yield p, "py"
-    for f in PY_FILES:
-        yield ROOT / f, "py"
-    for d in CPP_DIRS:
-        base = ROOT / d
-        for pat in ("*.cpp", "*.h"):
-            for p in sorted(base.rglob(pat)):
-                if not SKIP_PARTS & set(p.parts):
-                    yield p, "cpp"
-
-
-def _names_used(tree: ast.AST) -> set:
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            # leftmost name of dotted access (np.zeros -> np)
-            n = node
-            while isinstance(n, ast.Attribute):
-                n = n.value
-            if isinstance(n, ast.Name):
-                used.add(n.id)
-    # __all__ entries and annotations-as-strings count as uses
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            used.add(node.value)
-    return used
-
-
-def _unused_imports(tree: ast.AST):
-    used = _names_used(tree)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                bound = a.asname or a.name.split(".")[0]
-                if bound not in used:
-                    yield node.lineno, a.name
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "__future__":  # future statement, not a binding
-                continue
-            for a in node.names:
-                if a.name == "*":
-                    continue
-                bound = a.asname or a.name
-                if bound not in used:
-                    yield node.lineno, f"{node.module}.{a.name}"
-
-
-def lint_file(path: Path, kind: str):
-    findings = []
-    try:
-        text = path.read_text()
-    except OSError as e:
-        return [(path, 0, "io", str(e))]
-    rel = path.relative_to(ROOT)
-    if text and not text.endswith("\n"):
-        findings.append((rel, len(text.splitlines()), "final-newline", "missing"))
-    for i, line in enumerate(text.splitlines(), 1):
-        if line.rstrip("\r\n") != line.rstrip():
-            findings.append((rel, i, "trailing-whitespace", line.rstrip()[-20:]))
-        if "\t" in line:
-            findings.append((rel, i, "tab", "use spaces"))
-        if len(line) > MAX_LEN:
-            findings.append((rel, i, "line-length", f"{len(line)} > {MAX_LEN}"))
-    if kind == "py":
-        try:
-            tree = ast.parse(text, filename=str(path))
-        except SyntaxError as e:
-            findings.append((rel, e.lineno or 0, "syntax", e.msg))
-            return findings
-        if path.name != "__init__.py":
-            for lineno, name in _unused_imports(tree):
-                # "# noqa" opt-out, matching the common-tool convention
-                src_line = text.splitlines()[lineno - 1]
-                if "noqa" not in src_line:
-                    findings.append((rel, lineno, "unused-import", name))
-    return findings
-
-
-def main() -> int:
-    all_findings = []
-    n_files = 0
-    for path, kind in _iter_files():
-        n_files += 1
-        all_findings.extend(lint_file(path, kind))
-    for rel, line, rule, detail in all_findings:
-        print(f"{rel}:{line}: {rule}: {detail}")
-    if all_findings:
-        print(f"lint: {len(all_findings)} finding(s) in {n_files} files")
-        return 1
-    print(f"lint: OK ({n_files} files)")
-    return 0
-
+from oaplint.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
+    print("dev/lint.py is now dev/oaplint (style + contract rules); "
+          "forwarding.", file=sys.stderr)
     sys.exit(main())

@@ -1,0 +1,265 @@
+"""oaplint: AST-based invariant checker for the subsystem contracts.
+
+PRs 1-5 built five cross-cutting subsystems (prefetch, progcache,
+resilience, telemetry, precision) whose correctness depends on every hot
+path routing through them: a raw ``jax.jit`` bypasses compile
+accounting, a raw ``jnp.dot`` bypasses the precision policy, a raw
+``lax.psum`` bypasses collective telemetry.  Those contracts were
+enforced only by convention; this package encodes them as static rules
+the build fails on — the scalastyle/clang-format analog (the reference
+fails its build on style violations, mllib-dal/pom.xml:303), extended
+from style to *architecture*.
+
+Layout:
+
+- this module: rule registry, per-line suppression handling, file
+  enumeration, the runner (``run``/``lint_text``);
+- ``style.py``: the dev/lint.py style checks absorbed as rules (R10);
+- ``contracts.py``: the per-file subsystem-contract rules (R1-R5,
+  R7-R9);
+- ``project.py``: the repo-wide Config documentation/coverage/env
+  contract (R6);
+- ``__main__.py``: the CLI (``python dev/oaplint``).
+
+Suppression syntax (reason REQUIRED — an unexplained opt-out is itself
+a finding)::
+
+    x = jax.jit(f)(a)  # oaplint: disable=jit-outside-progcache -- why
+
+or, as a standalone comment on the line above the finding::
+
+    # oaplint: disable=stream-host-sync -- end-of-fit barrier
+    jax.block_until_ready((x, y))
+
+Rule catalog with rationale: docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+ROOT = Path(__file__).resolve().parent.parent.parent
+PKG = "oap_mllib_tpu"
+PY_DIRS = ["oap_mllib_tpu", "tests", "tests_tpu", "examples", "dev"]
+PY_FILES = ["bench.py", "__graft_entry__.py"]
+CPP_DIRS = ["oap_mllib_tpu/native/src"]
+SKIP_PARTS = {"build", "__pycache__", ".git"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    detail: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.detail}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Context:
+    """Everything a file rule sees: the file's relative path (POSIX
+    style), raw text, split lines, parsed AST (None for non-Python
+    files), and the repo root (for rules that need sibling files, e.g.
+    the fault-site registry)."""
+
+    def __init__(self, rel: str, text: str, tree: Optional[ast.AST],
+                 root: Path):
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = tree
+        self.root = root
+        self._parents: Optional[Dict[int, ast.AST]] = None
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        """Parent AST node (lazily built map, shared across rules)."""
+        if self._parents is None:
+            self._parents = {}
+            for n in ast.walk(self.tree):
+                for c in ast.iter_child_nodes(n):
+                    self._parents[id(c)] = n
+        return self._parents.get(id(node))
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    scope: Optional[str]  # regex on rel path; None = every file
+    kind: str  # "py" | "any" | "project"
+    doc: str
+    check: Callable
+
+
+RULES: "Dict[str, Rule]" = {}
+
+
+def rule(name: str, *, scope: Optional[str] = None, kind: str = "py",
+         doc: str = ""):
+    """Register a rule.  ``check(ctx)`` yields ``(line, detail)`` pairs
+    (project rules get the repo root and yield ``(rel, line, detail)``)."""
+
+    def deco(fn):
+        RULES[name] = Rule(name, scope, kind, doc or fn.__doc__ or "", fn)
+        return fn
+
+    return deco
+
+
+# -- suppressions ------------------------------------------------------------
+
+_DIRECTIVE = re.compile(
+    r"#\s*oaplint:\s*disable=([A-Za-z0-9_\-, ]+?)\s*(?:--\s*(\S.*))?$"
+)
+
+
+def _suppressions(lines: List[str], known: Iterable[str]):
+    """Parse per-line suppression directives.
+
+    Returns (map line -> set of rule names suppressed there, list of
+    (line, detail) for malformed directives).  A directive on a
+    comment-only line applies to the NEXT line; inline directives apply
+    to their own line.  A missing/empty ``-- reason`` or an unknown rule
+    name makes the directive invalid (and a finding)."""
+    known = set(known)
+    by_line: Dict[int, set] = {}
+    bad: List[Tuple[int, str]] = []
+    for i, line in enumerate(lines, 1):
+        m = _DIRECTIVE.search(line)
+        if not m:
+            continue
+        names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+        reason = (m.group(2) or "").strip()
+        unknown = sorted(n for n in names if n not in known)
+        if not reason:
+            bad.append((i, f"suppression of {sorted(names)} carries no "
+                           "reason ('-- <reason>' is required)"))
+            continue
+        if unknown:
+            bad.append((i, f"suppression names unknown rule(s): {unknown}"))
+            continue
+        target = i + 1 if line.lstrip().startswith("#") else i
+        by_line.setdefault(target, set()).update(names)
+    return by_line, bad
+
+
+# -- runner ------------------------------------------------------------------
+
+
+def iter_files(root: Path):
+    for d in PY_DIRS:
+        for p in sorted((root / d).rglob("*.py")):
+            if not SKIP_PARTS & set(p.parts):
+                yield p, "py"
+    for f in PY_FILES:
+        p = root / f
+        if p.exists():
+            yield p, "py"
+    for d in CPP_DIRS:
+        base = root / d
+        for pat in ("*.cpp", "*.h"):
+            for p in sorted(base.rglob(pat)):
+                if not SKIP_PARTS & set(p.parts):
+                    yield p, "cpp"
+
+
+def _active_rules(names: Optional[Iterable[str]]):
+    if names is None:
+        return list(RULES.values())
+    return [RULES[n] for n in names]
+
+
+def lint_text(rel: str, text: str, *, root: Path = ROOT,
+              rules: Optional[Iterable[str]] = None,
+              kind: str = "py") -> List[Finding]:
+    """Lint one file's content under a (possibly pretend) relative path.
+
+    This is the test seam: fixtures lint snippets under paths like
+    ``oap_mllib_tpu/ops/foo_stream.py`` without touching the tree."""
+    findings: List[Finding] = []
+    tree = None
+    if kind == "py":
+        try:
+            tree = ast.parse(text, filename=rel)
+        except SyntaxError as e:
+            return [Finding(rel, e.lineno or 0, "syntax", e.msg or "")]
+    ctx = Context(rel, text, tree, root)
+    for r in _active_rules(rules):
+        if r.kind == "project":
+            continue
+        if r.kind == "py" and kind != "py":
+            continue
+        if r.scope is not None and not re.match(r.scope, rel):
+            continue
+        for line, detail in r.check(ctx):
+            findings.append(Finding(rel, line, r.name, detail))
+    sup, bad = _suppressions(ctx.lines, RULES)
+    findings = [
+        f for f in findings if f.rule not in sup.get(f.line, ())
+    ]
+    findings.extend(
+        Finding(rel, line, "bad-suppression", detail) for line, detail in bad
+    )
+    return findings
+
+
+def run(root: Path = ROOT, *, rules: Optional[Iterable[str]] = None,
+        paths: Optional[List[Path]] = None) -> Tuple[List[Finding], int]:
+    """Lint the tree (or explicit ``paths``); returns (findings, nfiles).
+
+    Project rules run once per invocation; file rules run per file."""
+    findings: List[Finding] = []
+    n_files = 0
+    root = root.resolve()
+    targets = (
+        [(p, "cpp" if p.suffix in (".cpp", ".h") else "py") for p in paths]
+        if paths is not None else list(iter_files(root))
+    )
+    for path, kind in targets:
+        n_files += 1
+        try:
+            text = path.read_text()
+        except OSError as e:
+            findings.append(Finding(str(path), 0, "io", str(e)))
+            continue
+        rel = path.resolve().relative_to(root).as_posix() \
+            if path.resolve().is_relative_to(root) else path.as_posix()
+        findings.extend(lint_text(rel, text, root=root, rules=rules,
+                                  kind=kind))
+    sup_cache: Dict[str, Dict[int, set]] = {}
+
+    def _suppressed(rel: str, line: int, name: str) -> bool:
+        if rel not in sup_cache:
+            try:
+                text = (root / rel).read_text()
+            except OSError:
+                text = ""
+            sup_cache[rel], _ = _suppressions(text.splitlines(), RULES)
+        return name in sup_cache[rel].get(line, ())
+
+    for r in _active_rules(rules):
+        if r.kind != "project":
+            continue
+        for rel, line, detail in r.check(root):
+            if not _suppressed(rel, line, r.name):
+                findings.append(Finding(rel, line, r.name, detail))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, n_files
+
+
+def to_json(findings: List[Finding]) -> str:
+    return json.dumps([f.as_dict() for f in findings], indent=2)
+
+
+# importing the rule modules registers their rules
+from . import style  # noqa: E402,F401  (registration side effect)
+from . import contracts  # noqa: E402,F401
+from . import project  # noqa: E402,F401

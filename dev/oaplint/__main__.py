@@ -1,0 +1,55 @@
+"""CLI: ``python dev/oaplint [paths...] [--json FILE] [--list-rules]``.
+
+Exit 1 on any finding; prints ``file:line: rule: detail`` per finding
+(the dev/lint.py output contract, so editors/CI parse it unchanged).
+``--json`` additionally writes the findings as a JSON artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import oaplint  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="oaplint")
+    ap.add_argument("paths", nargs="*", type=Path,
+                    help="files to lint (default: the whole tree)")
+    ap.add_argument("--json", metavar="FILE",
+                    help="also write findings as a JSON array to FILE "
+                         "('-' for stdout)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, r in sorted(oaplint.RULES.items()):
+            doc = " ".join(r.doc.split())
+            print(f"{name} [{r.kind}]: {doc}")
+        return 0
+
+    findings, n_files = oaplint.run(paths=args.paths or None)
+    for f in findings:
+        print(f.render())
+    if args.json:
+        payload = oaplint.to_json(findings)
+        if args.json == "-":
+            print(payload)
+        else:
+            Path(args.json).write_text(payload + "\n")
+    if findings:
+        print(f"oaplint: {len(findings)} finding(s) in {n_files} files "
+              f"({len(oaplint.RULES)} rules)")
+        return 1
+    print(f"oaplint: OK ({n_files} files, {len(oaplint.RULES)} rules)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

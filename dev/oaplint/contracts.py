@@ -1,0 +1,459 @@
+"""Subsystem-contract rules (R1-R5, R7-R9).
+
+Each rule encodes one invariant the PR 1-5 subsystems depend on.  They
+are heuristics over the AST — precise enough to lint the live package
+clean while catching every seeded violation in tests/test_oaplint.py's
+mutation fixtures.  Rationale per rule: docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from functools import lru_cache
+
+from . import PKG, rule
+
+OPS = rf"{PKG}/ops/"
+STREAM_FILES = rf"{PKG}/ops/[^/]*stream[^/]*\.py$"
+
+
+def _tail(func: ast.expr) -> str:
+    """Last attribute segment of a call target (a.b.c -> 'c', f -> 'f')."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _dotted(node: ast.expr) -> str:
+    """Dotted name of an attribute chain ('jax.numpy.dot'); '' if any
+    segment is not a plain name."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _subtree_ids(*roots) -> set:
+    out = set()
+    for r in roots:
+        if r is None:
+            continue
+        for n in ast.walk(r):
+            out.add(id(n))
+    return out
+
+
+# -- R1: jit dispatch must go through the program-cache registry -------------
+
+
+@rule("jit-outside-progcache", scope=rf"{PKG}/",
+      doc="jax.jit/jax.pmap only in utils/progcache.py, as decorators on "
+          "ops/ kernel entries (launch-tracked at dispatch), or inside a "
+          "builder passed to progcache.get_or_build — anything else "
+          "bypasses compile accounting and program reuse.")
+def _jit_outside_progcache(ctx):
+    if ctx.rel == f"{PKG}/utils/progcache.py":
+        return
+    tree = ctx.tree
+    # builders: functions/lambdas whose product is registered via
+    # progcache.get_or_build — jit inside them IS the registry path
+    fn_index = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_index.setdefault(n.name, []).append(n)
+    allowed = set()
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Call)
+                and _tail(n.func) == "get_or_build"):
+            continue
+        build = None
+        if len(n.args) >= 3:
+            build = n.args[2]
+        for kw in n.keywords:
+            if kw.arg == "build":
+                build = kw.value
+        if build is None:
+            continue
+        roots = []
+        if isinstance(build, ast.Lambda):
+            roots.append(build)
+            called = {_tail(c.func) for c in ast.walk(build)
+                      if isinstance(c, ast.Call)}
+        elif isinstance(build, ast.Name):
+            called = {build.id}
+        else:
+            called = set()
+        for name in called:
+            roots.extend(fn_index.get(name, []))
+        allowed |= _subtree_ids(*roots)
+    # decorators on ops/ kernel entries are the definition side of the
+    # contract; their launches are progcache.note/launch-tracked
+    if re.match(OPS, ctx.rel):
+        for n in ast.walk(tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                allowed |= _subtree_ids(*n.decorator_list)
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Attribute) and n.attr in ("jit", "pmap") \
+                and isinstance(n.value, ast.Name) and n.value.id == "jax" \
+                and id(n) not in allowed:
+            yield (n.lineno, f"raw jax.{n.attr} bypasses the program-cache "
+                   "registry; route dispatch through utils/progcache"
+                   ".get_or_build (builder) or launch/note")
+
+
+# -- R2: matmuls in ops/models must go through the precision policy ----------
+
+_MATMUL_FNS = {"dot", "matmul", "einsum", "tensordot", "vdot"}
+
+
+@rule("raw-matmul", scope=rf"{PKG}/(ops|models)/",
+      doc="No raw jnp.dot/matmul/einsum/@ in ops/ or models/ — use "
+          "precision.pdot/peinsum so the compute-precision policy "
+          "(Config.compute_precision) governs every hot-path contraction. "
+          "ops/pallas/ kernels are exempt (priced via "
+          "precision.kernel_tier).")
+def _raw_matmul(ctx):
+    if ctx.rel.startswith(f"{PKG}/ops/pallas/"):
+        return
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Call):
+            d = _dotted(n.func)
+            # host-side numpy (np.*) contractions are exempt: the policy
+            # governs device compute; the NumPy fallback plane is the
+            # f64/f32 reference the policy is measured against
+            if d.split(".")[-1] in _MATMUL_FNS and (
+                    d.startswith("jnp.") or d.startswith("jax.numpy.")):
+                yield (n.lineno, f"{d} bypasses the precision policy; use "
+                       "utils/precision.pdot or peinsum (f32 defaults are "
+                       "bit-compatible with Precision.HIGHEST)")
+        elif isinstance(n, ast.BinOp) and isinstance(n.op, ast.MatMult):
+            yield (n.lineno, "'@' matmul bypasses the precision policy; "
+                   "use utils/precision.pdot")
+
+
+# -- R3: collectives must go through the parallel/collective facade ----------
+
+_COLLECTIVES = {"psum", "pmean", "all_gather", "ppermute", "all_to_all",
+                "psum_scatter"}
+
+
+@rule("raw-collective", scope=rf"{PKG}/",
+      doc="No raw lax.psum/pmean/all_gather/ppermute/all_to_all outside "
+          "parallel/collective.py — the facade is the one seam that "
+          "books collective telemetry (and the DrJAX-style explicit "
+          "composition point).")
+def _raw_collective(ctx):
+    if ctx.rel == f"{PKG}/parallel/collective.py":
+        return
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Attribute) and n.attr in _COLLECTIVES:
+            d = _dotted(n)
+            if d.startswith("lax.") or d.startswith("jax.lax."):
+                yield (n.lineno, f"raw {d} bypasses collective "
+                       "accounting; use parallel/collective."
+                       f"{n.attr} (in-jit) or the eager facade")
+
+
+# -- R4: no host sync inside streamed per-chunk loops ------------------------
+
+_SYNC_ATTRS = {"block_until_ready", "item"}
+_PF_HINTS = ("Prefetcher", "staged_chunks", "prefetch")
+
+
+def _pf_names(fn: ast.AST) -> set:
+    """Names bound to a prefetch pipeline within a function: ``pf =
+    Prefetcher(...)`` or ``with _staged_chunks(...) as pf:``."""
+    names = set()
+
+    def _is_pf_call(v):
+        return isinstance(v, ast.Call) and any(
+            h in _tail(v.func) for h in _PF_HINTS)
+
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and _is_pf_call(n.value):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(n, ast.With):
+            for item in n.items:
+                if _is_pf_call(item.context_expr) and isinstance(
+                        item.optional_vars, ast.Name):
+                    names.add(item.optional_vars.id)
+    return names
+
+
+def _loop_targets(target: ast.expr) -> set:
+    return {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+
+
+@rule("stream-host-sync", scope=rf"{PKG}/(ops/[^/]*stream[^/]*\.py|models/)",
+      doc="No host-sync calls (jax.device_get, .block_until_ready, "
+          ".item(), np.asarray/float on chunk values) inside streamed "
+          "per-chunk prefetch loops — each sync stalls the pipeline and "
+          "destroys stage/compute overlap.  jax.block_until_ready "
+          "anywhere in a streamed kernel or model needs an audited "
+          "suppression (end-of-fit barriers).")
+def _stream_host_sync(ctx):
+    tree = ctx.tree
+    in_stream_ops = re.match(STREAM_FILES, ctx.rel) is not None
+    seen = set()
+
+    def emit(node, detail):
+        key = (node.lineno, detail)
+        if key not in seen:
+            seen.add(key)
+            yield node.lineno, detail
+
+    # barrier calls anywhere in scope need justification
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and _tail(n.func) == "block_until_ready":
+            yield from emit(n, "device barrier; if this is a deliberate "
+                            "end-of-fit sync, add a reasoned suppression")
+    if not in_stream_ops:
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        pf = _pf_names(fn)
+        if not pf:
+            continue
+        for loop in ast.walk(fn):
+            if not isinstance(loop, ast.For):
+                continue
+            it = loop.iter
+            if isinstance(it, ast.Call) and _tail(it.func) == "enumerate" \
+                    and it.args:
+                it = it.args[0]
+            if not (isinstance(it, ast.Name) and it.id in pf):
+                continue
+            targets = _loop_targets(loop.target)
+            for n in ast.walk(loop):
+                if n is loop or not isinstance(n, ast.Call):
+                    continue
+                d = _dotted(n.func)
+                t = _tail(n.func)
+                if d in ("jax.device_get",):
+                    yield from emit(n, f"{d} inside the per-chunk loop "
+                                    "stalls the prefetch pipeline")
+                elif t == "item" and isinstance(n.func, ast.Attribute):
+                    yield from emit(n, ".item() inside the per-chunk loop "
+                                    "syncs the device stream")
+                elif (t == "float" and isinstance(n.func, ast.Name)) or d in (
+                        "np.asarray", "np.array", "numpy.asarray",
+                        "numpy.array"):
+                    arg_names = set()
+                    for a in n.args:
+                        arg_names |= {x.id for x in ast.walk(a)
+                                      if isinstance(x, ast.Name)}
+                    if arg_names & targets or any(
+                            isinstance(a, ast.Call) and
+                            {x.id for x in ast.walk(a)
+                             if isinstance(x, ast.Name)} & targets
+                            for a in n.args):
+                        yield from emit(
+                            n, f"{d or t}() on a chunk value inside the "
+                            "per-chunk loop forces a host sync; accumulate "
+                            "on device (or defer the fetch past the loop)")
+
+
+# -- R5: no Python control flow on traced values in jitted bodies ------------
+
+_META_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "sharding",
+               "aval", "weak_type", "at"}
+
+
+def _jit_decorated(fn: ast.AST):
+    """If ``fn`` is decorated with jax.jit (bare or functools.partial),
+    return the set of its traced parameter names, else None."""
+    for dec in fn.decorator_list:
+        statics_names, statics_nums = set(), set()
+        hit = False
+        if _dotted(dec) in ("jax.jit", "jit"):
+            hit = True
+        elif isinstance(dec, ast.Call):
+            if _dotted(dec.func) in ("jax.jit", "jit"):
+                hit = True
+            elif _tail(dec.func) == "partial" and dec.args and _dotted(
+                    dec.args[0]) in ("jax.jit", "jit"):
+                hit = True
+            if hit:
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnames":
+                        for c in ast.walk(kw.value):
+                            if isinstance(c, ast.Constant) and isinstance(
+                                    c.value, str):
+                                statics_names.add(c.value)
+                    elif kw.arg == "static_argnums":
+                        for c in ast.walk(kw.value):
+                            if isinstance(c, ast.Constant) and isinstance(
+                                    c.value, int):
+                                statics_nums.add(c.value)
+        if not hit:
+            continue
+        pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        kw_only = [a.arg for a in fn.args.kwonlyargs]
+        traced = set(pos + kw_only) - statics_names
+        traced -= {p for i, p in enumerate(pos) if i in statics_nums}
+        traced -= {"self", "cls"}
+        return traced
+    return None
+
+
+def _traced_use(ctx, expr: ast.expr, traced: set):
+    """First traced-value use in ``expr`` that Python control flow would
+    concretize, or None.  Metadata access (x.shape/...), ``x is None``
+    trace-time checks, and static names are exempt."""
+    for n in ast.walk(expr):
+        if not (isinstance(n, ast.Name) and n.id in traced):
+            continue
+        parent = ctx.parent(n)
+        if isinstance(parent, ast.Attribute) and parent.attr in _META_ATTRS:
+            continue
+        if isinstance(parent, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops):
+            continue
+        return n
+    return None
+
+
+@rule("traced-python-branch", scope=rf"{PKG}/",
+      doc="No Python if/while/bool()/len() on traced values inside "
+          "jax.jit-decorated bodies — concretization errors at trace "
+          "time (or silent retraces).  static_argnames/argnums are "
+          "respected; x.shape metadata and 'x is None' are exempt.")
+def _traced_python_branch(ctx):
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        traced = _jit_decorated(fn)
+        if not traced:
+            continue
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.If, ast.While, ast.IfExp)):
+                bad = _traced_use(ctx, n.test, traced)
+                if bad is not None:
+                    kind = type(n).__name__.lower()
+                    yield (n.lineno, f"Python {kind} on traced value "
+                           f"'{bad.id}' in jitted '{fn.name}'; use lax."
+                           "cond/select or make the argument static")
+            elif isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id in ("bool", "len") and n.args:
+                bad = _traced_use(ctx, n.args[0], traced)
+                if bad is not None and isinstance(n.args[0], ast.Name):
+                    yield (n.lineno, f"{n.func.id}() on traced value "
+                           f"'{bad.id}' in jitted '{fn.name}'; use "
+                           ".shape metadata or lax primitives")
+
+
+# -- R7: fault-injection site strings must be registered ---------------------
+
+
+@lru_cache(maxsize=4)
+def _registered_sites(root) -> frozenset:
+    path = root / PKG / "utils" / "faults.py"
+    try:
+        tree = ast.parse(path.read_text())
+    except (OSError, SyntaxError):
+        return frozenset()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "SITES"
+                for t in n.targets):
+            return frozenset(
+                c.value for c in ast.walk(n.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str))
+    return frozenset()
+
+
+@rule("unregistered-fault-site", scope=rf"{PKG}/",
+      doc="maybe_fault(\"<site>\") literals must come from the SITES "
+          "registry in utils/faults.py — a typo'd site would silently "
+          "never fire, and Config.fault_spec validation would reject it.")
+def _unregistered_fault_site(ctx):
+    sites = _registered_sites(ctx.root)
+    if not sites:
+        return
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Call) and _tail(n.func) == "maybe_fault" \
+                and n.args and isinstance(n.args[0], ast.Constant) \
+                and isinstance(n.args[0].value, str):
+            site = n.args[0].value
+            if site not in sites:
+                yield (n.lineno, f"fault site {site!r} is not in utils/"
+                       f"faults.SITES {sorted(sites)}")
+
+
+# -- R8: no wall-clock / RNG nondeterminism in the compute plane -------------
+
+_LEGACY_NP_RANDOM = {"seed", "rand", "randn", "randint", "random", "choice",
+                     "shuffle", "permutation", "uniform", "normal", "zipf",
+                     "integers"}
+
+
+@rule("nondeterminism", scope=rf"{PKG}/(ops|models|data)/",
+      doc="No wall-clock reads (time.time/monotonic/perf_counter, "
+          "datetime.now) or global-state RNG (random module, legacy "
+          "np.random.*, unseeded default_rng) in ops/, models/, data/ — "
+          "results must be a pure function of inputs + seed; duration "
+          "clocks are confined to utils/timing.tick and telemetry/.")
+def _nondeterminism(ctx):
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Call):
+            d = _dotted(n.func)
+            if d in ("time.time", "time.monotonic", "time.perf_counter",
+                     "time.process_time"):
+                yield (n.lineno, f"{d}() in the compute plane; use utils/"
+                       "timing.tick() for duration accounting")
+            elif d in ("datetime.now", "datetime.utcnow",
+                       "datetime.datetime.now", "datetime.datetime.utcnow"):
+                yield n.lineno, f"wall-clock {d}() in the compute plane"
+            elif d.startswith("random."):
+                yield (n.lineno, f"global-state {d}() (stdlib random); "
+                       "use np.random.default_rng(seed)")
+            elif d.startswith("np.random.") or d.startswith("numpy.random."):
+                fn = d.split(".")[-1]
+                if fn == "default_rng":
+                    if not n.args and not n.keywords:
+                        yield (n.lineno, "unseeded np.random.default_rng()"
+                               "; pass an explicit seed")
+                elif fn in _LEGACY_NP_RANDOM:
+                    yield (n.lineno, f"legacy global-state {d}(); use "
+                           "np.random.default_rng(seed)")
+        elif isinstance(n, ast.Import):
+            for a in n.names:
+                if a.name == "random":
+                    yield (n.lineno, "stdlib random is process-global "
+                           "state; use np.random.default_rng(seed)")
+
+
+# -- R9: accelerated fits must finalize telemetry ----------------------------
+
+
+@rule("fit-missing-finalize", scope=rf"{PKG}/models/",
+      doc="Every accelerated fit wrapper (a models/ function that calls "
+          "resilience.resilient_fit) must pass its summary through "
+          "telemetry.finalize_fit before returning — otherwise the fit's "
+          "span tree and metrics snapshot never reach the exporters.")
+def _fit_missing_finalize(ctx):
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        n_fit = n_fin = 0
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                t = _tail(n.func)
+                if t == "resilient_fit":
+                    n_fit += 1
+                elif t == "finalize_fit":
+                    n_fin += 1
+        if n_fit and n_fin < n_fit:
+            yield (fn.lineno, f"'{fn.name}' runs {n_fit} resilient_fit "
+                   f"ladder(s) but calls telemetry.finalize_fit {n_fin} "
+                   "time(s); every accelerated return must finalize")

@@ -1,0 +1,85 @@
+"""Project-wide rule (R6): the Config field contract.
+
+Every ``Config`` dataclass field must be (a) documented in
+docs/configuration.md, (b) covered in tests/test_config_coverage.py, and
+(c) reachable via the automatic ``OAP_MLLIB_TPU_<UPPER>`` env override —
+so any hardcoded ``OAP_MLLIB_TPU_*`` string literal in the package must
+match a real field's env name.  This promotes dev/check_docs.py's
+runtime config-coverage check to a static pass (check_docs keeps the
+sample-execution and link checks, which need a runtime).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from . import PKG, rule
+
+ENV_PREFIX = "OAP_MLLIB_TPU_"
+
+
+def _config_fields(root):
+    """(name, lineno) per Config dataclass field, from the AST (no
+    import: the linter must run without jax/numpy present)."""
+    path = root / PKG / "config.py"
+    tree = ast.parse(path.read_text())
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ClassDef) and n.name == "Config":
+            return [
+                (s.target.id, s.lineno)
+                for s in n.body
+                if isinstance(s, ast.AnnAssign)
+                and isinstance(s.target, ast.Name)
+            ]
+    return []
+
+
+@rule("config-field-contract", kind="project",
+      doc="Every Config field must be documented in docs/configuration.md,"
+          " covered in tests/test_config_coverage.py, and any hardcoded "
+          "OAP_MLLIB_TPU_* env literal in the package must match a field's"
+          " derived env name (OAP_MLLIB_TPU_<FIELD_UPPER>).")
+def _config_field_contract(root):
+    fields = _config_fields(root)
+    names = [f for f, _ in fields]
+    cfg_rel = f"{PKG}/config.py"
+
+    docs = root / "docs" / "configuration.md"
+    doc_text = docs.read_text() if docs.exists() else ""
+    tests = root / "tests" / "test_config_coverage.py"
+    test_text = tests.read_text() if tests.exists() else ""
+    # the coverage test sweeps dataclasses.fields(Config) generically
+    # (read-somewhere, documented, env-override legs) — that sweep covers
+    # every field structurally; a field is uncovered only if BOTH the
+    # sweep and a by-name mention are absent
+    generic = "dataclasses.fields(Config)" in test_text
+
+    for name, lineno in fields:
+        if f"`{name}`" not in doc_text:
+            yield (cfg_rel, lineno,
+                   f"Config.{name} is not documented in "
+                   "docs/configuration.md")
+        if not generic and not re.search(rf"\b{re.escape(name)}\b",
+                                         test_text):
+            yield (cfg_rel, lineno,
+                   f"Config.{name} is not covered in "
+                   "tests/test_config_coverage.py")
+
+    valid_env = {ENV_PREFIX + f.upper() for f in names} | {ENV_PREFIX}
+    for path in sorted((root / PKG).rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue  # the syntax rule owns this
+        rel = path.relative_to(root).as_posix()
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                    and n.value.startswith(ENV_PREFIX) \
+                    and n.value not in valid_env:
+                yield (rel, n.lineno,
+                       f"env literal {n.value!r} does not match any "
+                       "Config field's derived override name "
+                       f"({ENV_PREFIX}<FIELD_UPPER>)")

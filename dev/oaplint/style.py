@@ -1,0 +1,95 @@
+"""Style rules (R10): the dev/lint.py checks absorbed as oaplint rules.
+
+One entry point now runs style AND contract checks — the reference runs
+scalastyle + clang-format as a single build gate (mllib-dal/pom.xml:303);
+this is the analog.  The ``# noqa`` opt-out for unused imports is kept
+(common-tool convention); every other opt-out uses the oaplint
+suppression syntax.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import rule
+
+MAX_LEN = 100
+
+
+@rule("syntax", kind="py",
+      doc="File must parse (enforced by the runner before any AST rule).")
+def _syntax(ctx):
+    return iter(())  # the runner reports SyntaxError under this name
+
+
+@rule("trailing-whitespace", kind="any",
+      doc="No trailing whitespace (style gate parity with dev/lint.py).")
+def _trailing(ctx):
+    for i, line in enumerate(ctx.lines, 1):
+        if line.rstrip("\r\n") != line.rstrip():
+            yield i, line.rstrip()[-20:] or "trailing whitespace"
+
+
+@rule("tab", kind="any", doc="Indent with spaces, never tabs.")
+def _tab(ctx):
+    for i, line in enumerate(ctx.lines, 1):
+        if "\t" in line:
+            yield i, "use spaces"
+
+
+@rule("line-length", kind="any",
+      doc=f"Lines must be <= {MAX_LEN} characters.")
+def _line_length(ctx):
+    for i, line in enumerate(ctx.lines, 1):
+        if len(line) > MAX_LEN:
+            yield i, f"{len(line)} > {MAX_LEN}"
+
+
+@rule("final-newline", kind="any", doc="File must end with a newline.")
+def _final_newline(ctx):
+    if ctx.text and not ctx.text.endswith("\n"):
+        yield len(ctx.lines), "missing"
+
+
+def _names_used(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            n = node  # leftmost name of dotted access (np.zeros -> np)
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+    # __all__ entries and annotations-as-strings count as uses
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used.add(node.value)
+    return used
+
+
+@rule("unused-import", kind="py",
+      doc="Imports must be used (skipped for __init__.py re-export "
+          "manifests; '# noqa' opts a line out, matching dev/lint.py).")
+def _unused_import(ctx):
+    if ctx.rel.endswith("__init__.py"):
+        return
+    used = _names_used(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            pairs = [(a.asname or a.name.split(".")[0], a.name)
+                     for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            pairs = [(a.asname or a.name, f"{node.module}.{a.name}")
+                     for a in node.names if a.name != "*"]
+        else:
+            continue
+        for bound, label in pairs:
+            if bound in used:
+                continue
+            src_line = ctx.lines[node.lineno - 1]
+            if "noqa" not in src_line:
+                yield node.lineno, label

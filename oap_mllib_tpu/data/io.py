@@ -25,6 +25,7 @@ def _force_py() -> bool:
     back to NumPy (tests, debugging).  ``OAP_MLLIB_TPU_PURE_PYTHON`` is
     the canonical name; ``..._IO`` is kept for back-compat.  Read per
     call so it works even when set after import."""
+    # oaplint: disable=config-field-contract -- deliberate non-Config env kill-switch
     for var in ("OAP_MLLIB_TPU_PURE_PYTHON", "OAP_MLLIB_TPU_PURE_PYTHON_IO"):
         if os.environ.get(var, "").strip().lower() in ("1", "true", "yes", "on"):
             return True

@@ -48,12 +48,12 @@ import contextlib
 import logging
 import queue
 import threading
-import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from oap_mllib_tpu.config import get_config
 from oap_mllib_tpu.telemetry import metrics as _tm
 from oap_mllib_tpu.utils.faults import maybe_fault
+from oap_mllib_tpu.utils.timing import tick
 
 log = logging.getLogger("oap_mllib_tpu")
 
@@ -113,11 +113,11 @@ class PrefetchStats:
 
     @contextlib.contextmanager
     def transfer(self):
-        t0 = time.perf_counter()
+        elapsed = tick()
         try:
             yield
         finally:
-            self.transfer_s += time.perf_counter() - t0
+            self.transfer_s += elapsed()
 
     def note_staged(self, item: Any) -> None:
         """Account one staged item's payload (producer side): sum the
@@ -222,10 +222,10 @@ class _Serial:
         if self._retire and self._prev is not None:
             _delete_jax_arrays(self._prev)
             self._prev = None
-        t0 = time.perf_counter()
+        elapsed = tick()
         item = next(self._items)  # StopIteration propagates
         out = item if self._stage is None else self._stage(item)
-        dt = time.perf_counter() - t0
+        dt = elapsed()
         # serial staging blocks the consumer: it is both stage and wait
         self._stats.stage_s += dt
         self._stats.wait_s += dt
@@ -289,9 +289,9 @@ class _Threaded:
                 except StopIteration:
                     self._q.put(_Sentinel(None))
                     return
-                t0 = time.perf_counter()
+                elapsed = tick()
                 out = item if self._stage is None else self._stage(item)
-                self._stats.stage_s += time.perf_counter() - t0
+                self._stats.stage_s += elapsed()
                 self._q.put(out)
         except BaseException as e:  # noqa: BLE001 — must cross the thread
             self._q.put(_Sentinel(e))
@@ -327,9 +327,9 @@ class _Threaded:
                 _delete_jax_arrays(self._prev)
             self._prev = None
             self._slots.release()
-        t0 = time.perf_counter()
+        elapsed = tick()
         out = self._q.get()
-        self._stats.wait_s += time.perf_counter() - t0
+        self._stats.wait_s += elapsed()
         if isinstance(out, _Sentinel):
             self._done = True
             self._join_producer("__next__ (end-of-stream drain)")

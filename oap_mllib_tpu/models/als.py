@@ -17,7 +17,6 @@ max+1 and may be passed explicitly.
 
 from __future__ import annotations
 
-import functools
 import json
 import os
 from typing import Optional
@@ -35,17 +34,26 @@ from oap_mllib_tpu.utils.dispatch import should_accelerate
 from oap_mllib_tpu.utils.timing import Timings, phase_timer
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
 def _top_k_pairs(q: jax.Array, targets: jax.Array, n: int):
-    """Top-n (scores, ids) for a block of query rows — module-level so
-    the compiled program caches across recommend_for_all_* calls (a
-    per-call jit lambda would recompile every time AND constant-fold the
-    whole factor matrix into the executable).  HIGHEST precision: the
-    returned scores are the model's predicted preferences and must match
+    """Top-n (scores, ids) for a block of query rows — dispatched through
+    the program registry so the compiled program caches across
+    recommend_for_all_* calls (a per-call jit lambda would recompile
+    every time AND constant-fold the whole factor matrix into the
+    executable).  pdot's f32 default (HIGHEST) on purpose: the returned
+    scores are the model's predicted preferences and must match
     predict() (TPU's default bf16 matmul drifts them ~1e-3 and can swap
     near-tie rankings — caught on hardware, round 5)."""
-    scores = jnp.matmul(q, targets.T, precision=jax.lax.Precision.HIGHEST)
-    return jax.lax.top_k(scores, n)
+
+    def kernel(q, targets, n):
+        scores = psn.pdot(q, targets.T)
+        return jax.lax.top_k(scores, n)
+
+    fn = progcache.get_or_build(
+        "als.top_k_pairs",
+        (progcache.backend_fingerprint(),),
+        lambda: jax.jit(kernel, static_argnames=("n",)),
+    )
+    return fn(q, targets, n)
 
 
 class ALSModel:
@@ -109,8 +117,12 @@ class ALSModel:
         xb, offsets, per = shard
         if not xb.is_fully_addressable:
             mesh = xb.sharding.mesh
-            xb = jax.jit(
-                lambda a: a, out_shardings=NamedSharding(mesh, P())
+            xb = progcache.get_or_build(
+                "als.gather_replicated",
+                (progcache.mesh_fingerprint(mesh),),
+                lambda: jax.jit(
+                    lambda a: a, out_shardings=NamedSharding(mesh, P())
+                ),
             )(xb)
         xb = np.asarray(xb)
         rank = xb.shape[1]
@@ -931,7 +943,8 @@ class ALS:
                 self.alpha, mesh, implicit=self.implicit_prefs,
                 timings=timings, policy=pol.name,
             )
-            jax.block_until_ready((x_blocks, y))
+            # oaplint: disable=stream-host-sync -- end-of-fit barrier so
+            jax.block_until_ready((x_blocks, y))  # phase_timer sees walls
         summary = {
             "timings": timings, "accelerated": True, "streamed": True,
             "block_parallel": True, "sharded_factors": True,
@@ -1064,7 +1077,8 @@ class ALS:
                     self.max_iter, self.reg_param, self.alpha, mesh,
                     implicit=self.implicit_prefs, policy=pol.name,
                 )
-            jax.block_until_ready((x_blocks, y))
+            # oaplint: disable=stream-host-sync -- end-of-fit barrier so
+            jax.block_until_ready((x_blocks, y))  # phase_timer sees walls
         # X stays block-sharded on device; the model gathers on demand
         # (offset bookkeeping ~ ALSResult cUserOffset/cItemOffset,
         # ALSDALImpl.cpp:529-575).  Y mirrors that when sharded; a

@@ -56,6 +56,8 @@ from oap_mllib_tpu.ops.als_ops import (
     normal_eq_partials_grouped,
     regularized_solve,
 )
+from oap_mllib_tpu.parallel import collective
+from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
@@ -118,19 +120,19 @@ def _block_body(user_partials, item_partials, reg, implicit, axis, eye):
         x_blk, y = carry
         a_u, b_u, n_u = user_partials(y)
         gram_y = (
-            jnp.matmul(y.T, y, precision=lax.Precision.HIGHEST)
+            psn.pdot(y.T, y)
             if implicit else None
         )
         x_blk = regularized_solve(a_u, b_u, n_u, reg, eye, gram_y).astype(
             y.dtype
         )
         a_i, b_i, n_i = item_partials(x_blk)
-        a_i = lax.psum(a_i, axis)
-        b_i = lax.psum(b_i, axis)
-        n_i = lax.psum(n_i, axis)
+        a_i = collective.psum(a_i, axis)
+        b_i = collective.psum(b_i, axis)
+        n_i = collective.psum(n_i, axis)
         gram_x = (
-            lax.psum(
-                jnp.matmul(x_blk.T, x_blk, precision=lax.Precision.HIGHEST),
+            collective.psum(
+                psn.pdot(x_blk.T, x_blk),
                 axis,
             )
             if implicit else None
@@ -159,11 +161,11 @@ def _block_body_2d(user_partials, item_partials, reg, implicit, axis, eye):
 
     def body(carry, _):
         x_blk, y_blk = carry
-        y_full = lax.all_gather(y_blk, axis, tiled=True)
+        y_full = collective.all_gather(y_blk, axis, tiled=True)
         a_u, b_u, n_u = user_partials(y_full)
         gram_y = (
-            lax.psum(
-                jnp.matmul(y_blk.T, y_blk, precision=lax.Precision.HIGHEST),
+            collective.psum(
+                psn.pdot(y_blk.T, y_blk),
                 axis,
             )
             if implicit else None
@@ -171,11 +173,11 @@ def _block_body_2d(user_partials, item_partials, reg, implicit, axis, eye):
         x_blk = regularized_solve(a_u, b_u, n_u, reg, eye, gram_y).astype(
             y_blk.dtype
         )
-        x_full = lax.all_gather(x_blk, axis, tiled=True)
+        x_full = collective.all_gather(x_blk, axis, tiled=True)
         a_i, b_i, n_i = item_partials(x_full)
         gram_x = (
-            lax.psum(
-                jnp.matmul(x_blk.T, x_blk, precision=lax.Precision.HIGHEST),
+            collective.psum(
+                psn.pdot(x_blk.T, x_blk),
                 axis,
             )
             if implicit else None

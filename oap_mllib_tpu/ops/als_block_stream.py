@@ -50,13 +50,11 @@ every layout (chunked segment-sums only reorder additions).
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from oap_mllib_tpu.config import get_config
@@ -75,7 +73,10 @@ from oap_mllib_tpu.ops.als_ops import (
     unpack_flat_moments,
 )
 from oap_mllib_tpu.ops.als_stream import groups_per_chunk
+from oap_mllib_tpu.parallel import collective
+from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
+from oap_mllib_tpu.utils.timing import tick
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
@@ -417,10 +418,7 @@ def _build_programs(mesh: Mesh, axis: str, implicit: bool,
         r = f_full.shape[1]
         a, b, n_reg = unpack_flat_moments(m, r)
         eye = jnp.eye(r, dtype=f_full.dtype)
-        gram = (
-            jnp.matmul(f_full.T, f_full, precision=lax.Precision.HIGHEST)
-            if implicit else None
-        )
+        gram = psn.pdot(f_full.T, f_full) if implicit else None
         return regularized_solve(a, b, n_reg, reg, eye, gram).astype(
             f_full.dtype
         )
@@ -437,11 +435,11 @@ def _build_programs(mesh: Mesh, axis: str, implicit: bool,
         # item-update allreduce; X Gram psums block Grams (exact: padded
         # rows are zero)
         r = x_blk.shape[1]
-        a, b, n_reg = unpack_flat_moments(lax.psum(m[0], axis), r)
+        a, b, n_reg = unpack_flat_moments(collective.psum(m[0], axis), r)
         eye = jnp.eye(r, dtype=x_blk.dtype)
         gram = (
-            lax.psum(
-                jnp.matmul(x_blk.T, x_blk, precision=lax.Precision.HIGHEST),
+            collective.psum(
+                psn.pdot(x_blk.T, x_blk),
                 axis,
             )
             if implicit else None
@@ -494,7 +492,7 @@ def als_block_run_streamed(
     width = (r + 1) * (r + 2)
     dtype = x0.dtype
     stats = PrefetchStats()
-    t_start = time.perf_counter()
+    elapsed = tick()
     place = _chunk_placer(mesh, axis, lay.owned)
     (accum_local_fn, accum_item_rep_fn, solve_local_fn,
      solve_item_rep_fn, replicate) = _make_programs(
@@ -581,6 +579,7 @@ def als_block_run_streamed(
                 zeros_i(), x_blk,
             )
             y = solve_item_rep_fn(m_i, x_blk, reg_j)
-    jax.block_until_ready((x_blk, y))
-    stats.finalize(timings, "als_iterations", time.perf_counter() - t_start)
+    # oaplint: disable=stream-host-sync -- end-of-fit barrier: fence async
+    jax.block_until_ready((x_blk, y))  # dispatches before timing finalize
+    stats.finalize(timings, "als_iterations", elapsed())
     return x_blk, y

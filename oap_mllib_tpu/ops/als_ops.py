@@ -510,10 +510,7 @@ def _als_run_grouped_jit(
             src_g, conf_g, valid_g, group_dst, factors, n_dst, alpha,
             implicit, policy,
         )
-        gram = (
-            jnp.matmul(factors.T, factors, precision=lax.Precision.HIGHEST)
-            if implicit else None
-        )
+        gram = psn.pdot(factors.T, factors) if implicit else None
         return regularized_solve(a, b, n_reg, reg, eye, gram).astype(
             factors.dtype
         )
@@ -582,7 +579,7 @@ def _half_update(
     r = src_factors.shape[1]
     # (r, r) <- MXU, psum over mesh — stays full f32 under every policy
     # (the Gram conditions the solve; its cost is O(n*r^2), not the hot path)
-    gram = jnp.matmul(src_factors.T, src_factors, precision=lax.Precision.HIGHEST)
+    gram = psn.pdot(src_factors.T, src_factors)
     a_part, b, n_reg = normal_eq_partials(
         dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha, True,
         policy,

@@ -32,13 +32,11 @@ tolerance (chunked segment-sums only reorder the additions).
 from __future__ import annotations
 
 import functools
-import time
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 from oap_mllib_tpu.data.prefetch import Prefetcher, PrefetchStats
 from oap_mllib_tpu.ops.als_ops import (
@@ -47,7 +45,9 @@ from oap_mllib_tpu.ops.als_ops import (
     regularized_solve,
     unpack_flat_moments,
 )
+from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
+from oap_mllib_tpu.utils.timing import tick
 
 
 def groups_per_chunk(P: int, r: int) -> int:
@@ -94,12 +94,7 @@ def _solve_side(
     r = src_factors.shape[1]
     a, b, n_reg = unpack_flat_moments(m_flat, r)
     eye = jnp.eye(r, dtype=src_factors.dtype)
-    gram = (
-        jnp.matmul(
-            src_factors.T, src_factors, precision=lax.Precision.HIGHEST
-        )
-        if implicit else None
-    )
+    gram = psn.pdot(src_factors.T, src_factors) if implicit else None
     return regularized_solve(a, b, n_reg, reg, eye, gram).astype(
         src_factors.dtype
     )
@@ -232,7 +227,7 @@ def als_run_streamed(
     x = jnp.asarray(np.asarray(x0, np.float32))
     y = jnp.asarray(np.asarray(y0, np.float32))
     stats = PrefetchStats()
-    t0 = time.perf_counter()
+    elapsed = tick()
     for it in range(max_iter):
         x = _half_update_streamed(
             by_user, y, n_users, gc_u, reg, alpha, implicit, stats=stats,
@@ -247,6 +242,7 @@ def als_run_streamed(
         # later half-iteration — detect at the iteration that produced it
         check_finite(x, f"ALS user factors (streamed iteration {it + 1})")
         check_finite(y, f"ALS item factors (streamed iteration {it + 1})")
-    jax.block_until_ready((x, y))
-    stats.finalize(timings, "als_iterations", time.perf_counter() - t0)
+    # oaplint: disable=stream-host-sync -- end-of-fit barrier: fence async
+    jax.block_until_ready((x, y))  # dispatches before timing finalize
+    stats.finalize(timings, "als_iterations", elapsed())
     return np.asarray(x), np.asarray(y)

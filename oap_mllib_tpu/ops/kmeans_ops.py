@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from oap_mllib_tpu.utils import precision as psn
+from oap_mllib_tpu.parallel import collective
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
@@ -416,24 +417,24 @@ def _build_lloyd_model_sharded(mesh, dax: str, max_: str, max_iter: int,
             xf = psn.upcast(x_blk)
             x_sq = jnp.sum(xf * xf, axis=1, keepdims=True)  # (n_loc, 1)
             # one psum carries all three feature-block partials at once
-            d2 = lax.psum(x_sq + c_sq[None, :] - 2.0 * cross, max_)
+            d2 = collective.psum(x_sq + c_sq[None, :] - 2.0 * cross, max_)
             d2 = jnp.maximum(d2, 0.0)
             assign = jnp.argmin(d2, axis=1)
             min_d2 = jnp.min(d2, axis=1)
         else:
             # loop-body mode: rank on the half-score (argmin-invariant to
             # |x|^2); still ONE psum over the model axis, no d2/min passes
-            score = lax.psum(0.5 * c_sq[None, :] - cross, max_)
+            score = collective.psum(0.5 * c_sq[None, :] - cross, max_)
             assign = jnp.argmin(score, axis=1)
         one_hot = (
             jax.nn.one_hot(assign, k, dtype=w_blk.dtype) * w_blk[:, None]
         )
-        sums_blk = lax.psum(
+        sums_blk = collective.psum(
             psn.pdot(one_hot.T, x_blk, pol, sprec), dax
         )  # (k, d_loc) — stays feature-local
-        counts = lax.psum(jnp.sum(one_hot, axis=0), dax)
+        counts = collective.psum(jnp.sum(one_hot, axis=0), dax)
         cost = (
-            lax.psum(jnp.sum(min_d2 * w_blk), dax)
+            collective.psum(jnp.sum(min_d2 * w_blk), dax)
             if need_cost else jnp.asarray(0.0, w_blk.dtype)
         )
         return sums_blk, counts, cost
@@ -454,7 +455,7 @@ def _build_lloyd_model_sharded(mesh, dax: str, max_: str, max_iter: int,
         # per-center move norms are partial over the local feature block —
         # complete them over the model axis before the convergence test
         return _lloyd_loop(
-            tile_accum, lambda m: lax.psum(m, max_), c0_blk, max_iter,
+            tile_accum, lambda m: collective.psum(m, max_), c0_blk, max_iter,
             tol_sq,
         )
 
@@ -536,9 +537,14 @@ def _to_host(a) -> np.ndarray:
     if isinstance(a, jax.Array) and not a.is_fully_addressable:
         from jax.sharding import NamedSharding, PartitionSpec
 
-        a = jax.jit(
-            lambda v: v,
-            out_shardings=NamedSharding(a.sharding.mesh, PartitionSpec()),
+        mesh = a.sharding.mesh
+        a = progcache.get_or_build(
+            "kmeans.fetch_replicated",
+            (progcache.mesh_fingerprint(mesh),),
+            lambda: jax.jit(
+                lambda v: v,
+                out_shardings=NamedSharding(mesh, PartitionSpec()),
+            ),
         )(a)
     return np.asarray(a)
 
@@ -555,9 +561,13 @@ def _gather_rows(x, idx: np.ndarray) -> np.ndarray:
         from jax.sharding import NamedSharding, PartitionSpec
 
         mesh = x.sharding.mesh
-        gathered = jax.jit(
-            lambda a, i: a[i],
-            out_shardings=NamedSharding(mesh, PartitionSpec()),
+        gathered = progcache.get_or_build(
+            "kmeans.gather_rows",
+            (progcache.mesh_fingerprint(mesh),),
+            lambda: jax.jit(
+                lambda a, i: a[i],
+                out_shardings=NamedSharding(mesh, PartitionSpec()),
+            ),
         )(x, jnp.asarray(idx))
         return np.asarray(gathered)
     return np.asarray(x[idx])

@@ -25,6 +25,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 from typing import Tuple
 from oap_mllib_tpu.utils import precision as psn
+from oap_mllib_tpu.parallel import collective
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
@@ -121,13 +122,13 @@ def _build_model_sharded_cov(mesh, dax: str, max_: str, precision: str,
     def tile_program(x_blk, mask_blk, n):
         xf = psn.upcast(x_blk)
         xm = xf * mask_blk[:, None]
-        col_sum = lax.psum(jnp.sum(xm, axis=0), dax)  # (d_loc,)
+        col_sum = collective.psum(jnp.sum(xm, axis=0), dax)  # (d_loc,)
         mean_loc = col_sum / n
         # centered Gram at every tier (see covariance: the raw-moment
         # form cancels catastrophically for large-mean data)
         xc = (xf - mean_loc[None, :]) * mask_blk[:, None]
-        xc_full = lax.all_gather(xc, max_, axis=1, tiled=True)  # (n_loc, d)
-        gram_rows = lax.psum(
+        xc_full = collective.all_gather(xc, max_, axis=1, tiled=True)  # (n_loc, d)
+        gram_rows = collective.psum(
             psn.pdot(xc.T, xc_full, policy, precision), dax
         )  # (d_loc, d)
         cov_rows = gram_rows / jnp.maximum(n - 1.0, 1.0)
@@ -257,19 +258,16 @@ def topk_eigh_randomized(
     q, _ = jnp.linalg.qr(probe)
 
     def body(q, _):
-        y = jnp.matmul(cov, q, precision=lax.Precision.HIGHEST)
+        y = psn.pdot(cov, q)
         q_next, _ = jnp.linalg.qr(y)  # re-orthonormalize every step
         return q_next, None
 
     q, _ = lax.scan(body, q, None, length=iters)
-    b = jnp.matmul(
-        q.T, jnp.matmul(cov, q, precision=lax.Precision.HIGHEST),
-        precision=lax.Precision.HIGHEST,
-    )
+    b = psn.pdot(q.T, psn.pdot(cov, q))
     w, v = jnp.linalg.eigh(0.5 * (b + b.T))  # ascending, (p, p)
     w = w[::-1][:k]
     v = v[:, ::-1][:, :k]
-    return w, jnp.matmul(q, v, precision=lax.Precision.HIGHEST)
+    return w, psn.pdot(q, v)
 
 
 @jax.jit
@@ -279,4 +277,4 @@ def project(x: jax.Array, components: jax.Array) -> jax.Array:
     NOTE Spark parity: PCAModel.transform does NOT mean-center before
     projecting (mllib.feature.PCAModel), so neither do we.
     """
-    return jnp.matmul(x, components, precision=lax.Precision.HIGHEST)
+    return psn.pdot(x, components)

@@ -24,7 +24,6 @@ Pass structure:
 from __future__ import annotations
 
 import functools
-import time
 from typing import List
 
 import jax
@@ -36,6 +35,7 @@ from oap_mllib_tpu.data.stream import ChunkSource
 from oap_mllib_tpu.ops import kmeans_ops
 from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
+from oap_mllib_tpu.utils.timing import tick
 
 
 def _chunk_weights(n_valid: int, chunk_rows: int, dtype) -> np.ndarray:
@@ -326,7 +326,7 @@ def streamed_accumulate(
         (source.chunk_rows, d, k), str(np.dtype(dtype)),
         str(stage_dtype), precision, need_cost, policy,
     )
-    t0 = time.perf_counter()
+    elapsed = tick()
     guard = _PassGuard()
     with guard:
         with _staged_chunks(
@@ -341,7 +341,7 @@ def streamed_accumulate(
                         sums, counts, cost, cj, wj, centers, precision,
                         need_cost, policy,
                     )
-    stats.finalize(timings, phase, time.perf_counter() - t0)
+    stats.finalize(timings, phase, elapsed())
     return _psum_host([sums, counts, cost], guard=guard)
 
 
@@ -427,7 +427,7 @@ def reservoir_sample(
     sample: List[np.ndarray] = []
     seen = 0
     stats = PrefetchStats()
-    t0 = time.perf_counter()
+    elapsed = tick()
     guard = _PassGuard()
     with guard, Prefetcher(source, stats=stats) as pf:
         for chunk, n_valid in pf:
@@ -443,7 +443,7 @@ def reservoir_sample(
                 for i in np.nonzero(j < k)[0]:  # sparse hits only
                     sample[j[i]] = chunk[start + i].copy()
             seen += n_valid
-    stats.finalize(timings, "init_centers", time.perf_counter() - t0)
+    stats.finalize(timings, "init_centers", elapsed())
     if guard.err is not None and _world() == 1:
         raise guard.err
     if _world() > 1:
@@ -569,7 +569,7 @@ def init_kmeans_parallel_streamed(
         picks: List[np.ndarray] = []
         new_phi = 0.0
         stats = PrefetchStats()
-        t0 = time.perf_counter()
+        elapsed = tick()
         guard = _PassGuard()
         with guard, _staged_chunks(
             source, weights, dtype, stats, stage_dtype
@@ -586,9 +586,11 @@ def init_kmeans_parallel_streamed(
                         (progcache.backend_fingerprint(),
                          progcache.array_key(cj, cands_dev)),
                     )
-                    h = np.array(  # writable host copy
-                        _chunk_min_d2(cj, prev, cands_dev)
-                    )
+                    # the d2 cache is host-resident by design (device
+                    # chunks retire); the fetch waits on this chunk only
+                    # while the producer stages the next one
+                    # oaplint: disable=stream-host-sync -- host d2 cache is the consume step
+                    h = np.array(_chunk_min_d2(cj, prev, cands_dev))
                     h[n_valid:] = 0.0  # padded rows carry no cost
                     if rnd > 0:
                         dmin_chunks[ci] = h
@@ -604,7 +606,7 @@ def init_kmeans_parallel_streamed(
                     hit[n_valid:] = False
                     for i in np.nonzero(hit)[0]:
                         picks.append(chunk[i].copy())
-        stats.finalize(timings, "init_centers", time.perf_counter() - t0)
+        stats.finalize(timings, "init_centers", elapsed())
         (phi_arr,) = _psum_host([np.asarray([new_phi])], guard=guard)
         phi = float(phi_arr[0])
         if _world() > 1:
@@ -641,7 +643,7 @@ def init_kmeans_parallel_streamed(
     cands_dev = jnp.asarray(cand_arr.astype(dtype))
     own = np.zeros((cand_arr.shape[0],), np.float64)
     stats = PrefetchStats()
-    t0 = time.perf_counter()
+    elapsed = tick()
     guard = _PassGuard()
     with guard, _staged_chunks(
         source, weights, dtype, stats, stage_dtype
@@ -652,8 +654,9 @@ def init_kmeans_parallel_streamed(
                 (progcache.backend_fingerprint(),
                  progcache.array_key(cj, cands_dev)),
             )
+            # oaplint: disable=stream-host-sync -- ownership sums accumulate on host by design
             own += np.asarray(_chunk_ownership(cj, wj, cands_dev))
-    stats.finalize(timings, "init_centers", time.perf_counter() - t0)
+    stats.finalize(timings, "init_centers", elapsed())
     (own,) = _psum_host([own], guard=guard)
     return kmeans_ops._weighted_kmeans_pp(cand_arr, own, k, final_rng)
 
@@ -738,7 +741,7 @@ def covariance_streamed(
         (source.chunk_rows, d), str(np.dtype(dtype)), str(stage_dtype),
         precision, policy,
     )
-    t0 = time.perf_counter()
+    elapsed = tick()
     guard = _PassGuard()
     with guard, _staged_chunks(source, None, dtype, stats, stage_dtype) as pf:
         for _, n_valid, _, cj, wj in pf:
@@ -751,7 +754,7 @@ def covariance_streamed(
                 else:
                     total = _colsum_chunk(total, cj, wj)
             n += n_valid
-    stats.finalize(timings, "covariance_streamed", time.perf_counter() - t0)
+    stats.finalize(timings, "covariance_streamed", elapsed())
     total, n_arr = _psum_host([total, np.asarray([n], np.int64)], guard=guard)
     from oap_mllib_tpu.utils.resilience import check_finite
 
@@ -765,7 +768,7 @@ def covariance_streamed(
     gram = jnp.zeros((d, d), dtype)
     gcomp = jnp.zeros((d, d), dtype)
     stats = PrefetchStats()
-    t0 = time.perf_counter()
+    elapsed = tick()
     guard = _PassGuard()
     with guard, _staged_chunks(source, None, dtype, stats, stage_dtype) as pf:
         for _, _, _, cj, wj in pf:
@@ -779,7 +782,7 @@ def covariance_streamed(
                     )
                 else:
                     gram = _gram_chunk(gram, cj, wj, mean, precision, policy)
-    stats.finalize(timings, "covariance_streamed", time.perf_counter() - t0)
+    stats.finalize(timings, "covariance_streamed", elapsed())
     (gram,) = _psum_host([gram], guard=guard)
     check_finite(gram, "PCA Gram accumulator (streamed Gram pass)")
     cov = gram.astype(np.float64 if dtype == np.float64 else np.float32)

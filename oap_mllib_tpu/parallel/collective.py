@@ -71,6 +71,59 @@ def _instrumented(op: str, x: jax.Array, dispatch):
     return out
 
 
+# -- in-jit collective seam --------------------------------------------------
+# Estimator kernels running under shard_map cannot call the eager facade
+# below (it would nest shard_map), so they route their named-axis
+# collectives through these thin wrappers instead — every collective in
+# the package is then emitted at one seam (oaplint rule R3,
+# raw-collective; the DrJAX argument that the map-reduce primitives are
+# THE explicit composition point, PAPERS.md arXiv:2403.07128).  The
+# counter increments at TRACE time — once per compiled program, not per
+# dispatch — so ``oap_collective_emitted_total`` is a census of
+# collectives emitted into programs, complementing the facade's
+# per-dispatch ``oap_collective_ops_total``.
+
+
+def _note_emitted(op: str) -> None:
+    _tm.counter(
+        "oap_collective_emitted_total", {"op": op},
+        help="Collective ops emitted into compiled programs "
+             "(trace-time census, not a dispatch count)",
+    ).inc()
+
+
+def psum(x, axis_name):
+    """``lax.psum`` at the collective seam (shard_map/jit bodies)."""
+    _note_emitted("psum")
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    """``lax.pmean`` at the collective seam."""
+    _note_emitted("pmean")
+    return lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name, **kwargs):
+    """``lax.all_gather`` at the collective seam (axis/tiled kwargs
+    pass through unchanged)."""
+    _note_emitted("all_gather")
+    return lax.all_gather(x, axis_name, **kwargs)
+
+
+def ppermute(x, axis_name, perm):
+    """``lax.ppermute`` at the collective seam."""
+    _note_emitted("ppermute")
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name, **kwargs):
+    """``lax.all_to_all`` at the collective seam (split/concat axis
+    kwargs pass through unchanged)."""
+    _note_emitted("all_to_all")
+    return lax.all_to_all(x, axis_name, **kwargs)
+
+
 def broadcast(x: jax.Array, mesh: Mesh, root: int = 0) -> jax.Array:
     """Replicate the root shard of a row-sharded array to all devices.
 

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from oap_mllib_tpu.config import get_config
 from oap_mllib_tpu.data.table import CSRTable
+from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
@@ -186,12 +187,18 @@ def exchange_ratings(
         order = jnp.argsort(1 - rows[:, 3], stable=True)
         return rows[order[:cap]]
 
-    compacted = jax.jit(
-        shard_map(
-            compact, mesh=mesh,
-            in_specs=P(axis, None), out_specs=P(axis, None),
-            check_vma=False,
-        )
+    # one compiled program per (mesh, axis, cap): the old per-call
+    # jit(shard_map) closure rebuilt (and re-traced) on every exchange
+    compacted = progcache.get_or_build(
+        "shuffle.compact",
+        (progcache.mesh_fingerprint(mesh), axis, cap),
+        lambda: jax.jit(
+            shard_map(
+                compact, mesh=mesh,
+                in_specs=P(axis, None), out_specs=P(axis, None),
+                check_vma=False,
+            )
+        ),
     )(exchanged)
 
     out_u = compacted[:, 0]

@@ -31,9 +31,15 @@ def _fetch_head(arr, n: int) -> np.ndarray:
                     f"with {type(arr.sharding).__name__}; pass a "
                     "NamedSharding array or a host array"
                 )
-            head = jax.jit(
-                lambda a: a[:n],
-                out_shardings=NamedSharding(mesh, PartitionSpec()),
+            from oap_mllib_tpu.utils import progcache
+
+            head = progcache.get_or_build(
+                "debug.fetch_head",
+                (progcache.mesh_fingerprint(mesh), n),
+                lambda: jax.jit(
+                    lambda a: a[:n],
+                    out_shardings=NamedSharding(mesh, PartitionSpec()),
+                ),
             )(arr)
             return np.asarray(head)
     except ImportError:

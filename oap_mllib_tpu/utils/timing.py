@@ -143,6 +143,17 @@ def phase_timer(timings: Timings, phase: str):
         yield
 
 
+def tick():
+    """Duration clock: returns a zero-arg callable yielding the seconds
+    elapsed since the ``tick()`` call.  The compute plane (ops/, models/,
+    data/) books stage/transfer/wait/compute walls through this instead
+    of reading ``time.*`` directly, so clock access stays confined to
+    this module and telemetry/ — the oaplint ``nondeterminism`` rule
+    (R8) enforces the confinement statically."""
+    t0 = time.perf_counter()
+    return lambda: time.perf_counter() - t0
+
+
 @contextlib.contextmanager
 def x64_scope(enable: bool):
     """Temporarily enable jax x64 for one fit; restores the prior value so

@@ -1,0 +1,452 @@
+"""oaplint unit tests: per-rule fixture snippets (positive + negative +
+suppression), the suppression grammar, and the meta-test that the SHIPPED
+tree lints clean.
+
+The positive fixtures double as the mutation check: each one is a seeded
+violation of exactly the invariant its rule encodes, linted under a
+pretend in-scope path through the ``lint_text`` seam — if a refactor
+weakens a rule, its seeded violation stops being caught and the
+parametrized test fails by name.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "dev"))
+
+import oaplint  # noqa: E402
+
+
+def lint(rel, text, rules=None, kind="py"):
+    return oaplint.lint_text(rel, text, rules=rules, kind=kind)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+OPS = "oap_mllib_tpu/ops/fake.py"
+MODELS = "oap_mllib_tpu/models/fake.py"
+STREAM = "oap_mllib_tpu/ops/fake_stream.py"
+
+
+# ---------------------------------------------------------------------------
+# seeded violations: one per rule (the mutation check)
+# ---------------------------------------------------------------------------
+
+SEEDED = {
+    "jit-outside-progcache": (MODELS, "import jax\nf = jax.jit(g)(x)\n"),
+    "raw-matmul": (OPS, "import jax.numpy as jnp\ny = jnp.dot(a, b)\n"),
+    "raw-collective": (OPS, "from jax import lax\ns = lax.psum(x, 'i')\n"),
+    "stream-host-sync": (
+        STREAM, "import jax\njax.block_until_ready(x)\n"),
+    "traced-python-branch": (
+        OPS,
+        "import jax\n\n\n@jax.jit\ndef f(x):\n"
+        "    if x > 0:\n        return x\n    return -x\n",
+    ),
+    "unregistered-fault-site": (
+        OPS,
+        "from oap_mllib_tpu.utils.faults import maybe_fault\n"
+        "maybe_fault('no.such.site')\n",
+    ),
+    "nondeterminism": (
+        OPS, "import time\nt0 = time.time()\nprint(t0)\n"),
+    "fit-missing-finalize": (
+        MODELS,
+        "def fit(self, x):\n    out = resilient_fit(run, cfg)\n"
+        "    return out\n",
+    ),
+    "trailing-whitespace": (OPS, "x = 1 \n"),
+    "tab": (OPS, "if True:\n\tx = 1\n"),
+    "line-length": (OPS, "x = '" + "a" * 120 + "'\n"),
+    "final-newline": (OPS, "x = 1"),
+    "unused-import": (OPS, "import os\nx = 1\n"),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(SEEDED))
+def test_seeded_violation_is_caught(rule):
+    rel, text = SEEDED[rule]
+    found = lint(rel, text, rules=[rule])
+    assert rules_of(found) == [rule], (
+        f"seeded {rule} violation was not caught: {found}")
+
+
+def test_findings_carry_position_and_render_contract():
+    rel, text = SEEDED["raw-matmul"]
+    (f,) = lint(rel, text, rules=["raw-matmul"])
+    assert (f.path, f.line) == (rel, 2)
+    assert f.render().startswith(f"{rel}:2: raw-matmul: ")
+    assert json.loads(oaplint.to_json([f]))[0]["rule"] == "raw-matmul"
+
+
+# ---------------------------------------------------------------------------
+# R1: jit routing
+# ---------------------------------------------------------------------------
+
+
+def test_jit_inside_get_or_build_lambda_is_allowed():
+    text = (
+        "import jax\nfrom oap_mllib_tpu.utils import progcache\n"
+        "fn = progcache.get_or_build('a', ('k',), lambda: jax.jit(g))\n"
+    )
+    assert lint(MODELS, text, rules=["jit-outside-progcache"]) == []
+
+
+def test_jit_inside_named_builder_fn_is_allowed():
+    text = (
+        "import jax\nfrom oap_mllib_tpu.utils import progcache\n\n\n"
+        "def _build():\n    return jax.jit(g)\n\n\n"
+        "fn = progcache.get_or_build('a', ('k',), _build)\n"
+    )
+    assert lint(OPS, text, rules=["jit-outside-progcache"]) == []
+
+
+def test_jit_decorator_allowed_in_ops_only():
+    text = "import jax\n\n\n@jax.jit\ndef f(x):\n    return x\n"
+    assert lint(OPS, text, rules=["jit-outside-progcache"]) == []
+    assert rules_of(lint(MODELS, text, rules=["jit-outside-progcache"])) \
+        == ["jit-outside-progcache"]
+
+
+def test_progcache_module_itself_is_exempt():
+    text = "import jax\nf = jax.jit(g)\n"
+    assert lint("oap_mllib_tpu/utils/progcache.py", text,
+                rules=["jit-outside-progcache"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R2: precision-policy matmuls
+# ---------------------------------------------------------------------------
+
+
+def test_pdot_and_host_numpy_matmuls_are_clean():
+    text = (
+        "import numpy as np\nfrom oap_mllib_tpu.utils import "
+        "precision as psn\ny = psn.pdot(a, b)\nz = np.dot(c, d)\n"
+    )
+    assert lint(OPS, text, rules=["raw-matmul"]) == []
+
+
+def test_at_matmul_and_einsum_flagged_pallas_exempt():
+    text = "import jax.numpy as jnp\ny = a @ b\nz = jnp.einsum('ij,jk', a, b)\n"
+    found = lint(MODELS, text, rules=["raw-matmul"])
+    assert [f.line for f in found] == [2, 3]
+    assert lint("oap_mllib_tpu/ops/pallas/fake.py", text,
+                rules=["raw-matmul"]) == []
+
+
+def test_matmul_outside_ops_models_is_out_of_scope():
+    text = "import jax.numpy as jnp\ny = jnp.dot(a, b)\n"
+    assert lint("oap_mllib_tpu/utils/fake.py", text,
+                rules=["raw-matmul"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R3: collective facade
+# ---------------------------------------------------------------------------
+
+
+def test_collective_facade_and_own_module_are_clean():
+    text = (
+        "from oap_mllib_tpu.parallel import collective\n"
+        "s = collective.psum(x, 'i')\n"
+    )
+    assert lint(OPS, text, rules=["raw-collective"]) == []
+    raw = "from jax import lax\ns = lax.psum(x, 'i')\n"
+    assert lint("oap_mllib_tpu/parallel/collective.py", raw,
+                rules=["raw-collective"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R4: streamed-loop host sync
+# ---------------------------------------------------------------------------
+
+_LOOP_TMPL = (
+    "import jax\nimport numpy as np\n"
+    "from oap_mllib_tpu.data.prefetch import Prefetcher\n\n\n"
+    "def run(items):\n"
+    "    pf = Prefetcher(items)\n"
+    "    for chunk in pf:\n"
+    "        {body}\n"
+)
+
+
+@pytest.mark.parametrize("body", [
+    "jax.device_get(chunk)",
+    "chunk.item()",
+    "h = np.asarray(chunk)",
+    "v = float(compute(chunk))",
+])
+def test_host_sync_in_prefetch_loop_flagged(body):
+    found = lint(STREAM, _LOOP_TMPL.format(body=body),
+                 rules=["stream-host-sync"])
+    assert rules_of(found) == ["stream-host-sync"]
+
+
+def test_host_fetch_outside_loop_or_of_host_values_is_clean():
+    text = _LOOP_TMPL.format(body="total = accumulate(chunk)") + (
+        "    h = np.asarray(total)\n"
+    )
+    assert lint(STREAM, text, rules=["stream-host-sync"]) == []
+    # np.asarray of a non-chunk name inside the loop: no sync on a
+    # device value, clean
+    text2 = _LOOP_TMPL.format(body="h = np.asarray(host_side)")
+    assert lint(STREAM, text2, rules=["stream-host-sync"]) == []
+
+
+def test_barrier_needs_reasoned_suppression():
+    text = (
+        "import jax\n"
+        "# oaplint: disable=stream-host-sync -- end-of-fit barrier\n"
+        "jax.block_until_ready(x)\n"
+    )
+    assert lint(STREAM, text, rules=["stream-host-sync"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R5: traced control flow
+# ---------------------------------------------------------------------------
+
+
+def test_static_args_metadata_and_is_none_are_exempt():
+    text = (
+        "from functools import partial\n\nimport jax\n\n\n"
+        "@partial(jax.jit, static_argnames=('n',))\n"
+        "def f(x, mask, n):\n"
+        "    if n > 2:\n        pass\n"
+        "    if x.shape[0] > 1:\n        pass\n"
+        "    if mask is None:\n        pass\n"
+        "    return x\n"
+    )
+    assert lint(OPS, text, rules=["traced-python-branch"]) == []
+
+
+def test_while_and_len_on_traced_values_flagged():
+    text = (
+        "import jax\n\n\n@jax.jit\ndef f(x):\n"
+        "    while x > 0:\n        x = x - 1\n"
+        "    n = len(x)\n"
+        "    return x + n\n"
+    )
+    found = lint(OPS, text, rules=["traced-python-branch"])
+    assert len(found) == 2
+
+
+def test_undecorated_function_is_out_of_scope():
+    text = "def f(x):\n    if x > 0:\n        return x\n    return -x\n"
+    assert lint(OPS, text, rules=["traced-python-branch"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R7: fault-site registry
+# ---------------------------------------------------------------------------
+
+
+def test_registered_site_is_clean():
+    text = (
+        "from oap_mllib_tpu.utils.faults import maybe_fault\n"
+        "maybe_fault('stream.read')\n"
+    )
+    assert lint(OPS, text, rules=["unregistered-fault-site"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R8: determinism
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_rng_and_tick_are_clean():
+    text = (
+        "import numpy as np\nfrom oap_mllib_tpu.utils.timing import tick\n"
+        "rng = np.random.default_rng(7)\nelapsed = tick()\n"
+    )
+    assert lint("oap_mllib_tpu/data/fake.py", text,
+                rules=["nondeterminism"]) == []
+
+
+def test_unseeded_rng_legacy_np_random_and_import_random_flagged():
+    text = (
+        "import random\nimport numpy as np\n"
+        "r1 = np.random.default_rng()\nr2 = np.random.rand(3)\n"
+    )
+    found = lint(OPS, text, rules=["nondeterminism"])
+    assert len(found) == 3
+
+
+def test_wall_clock_outside_compute_plane_is_out_of_scope():
+    text = "import time\nt0 = time.time()\nprint(t0)\n"
+    assert lint("oap_mllib_tpu/telemetry/fake.py", text,
+                rules=["nondeterminism"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R9: telemetry finalize
+# ---------------------------------------------------------------------------
+
+
+def test_fit_with_finalize_is_clean():
+    text = (
+        "def fit(self, x):\n    out = resilient_fit(run, cfg)\n"
+        "    return finalize_fit('als', out)\n"
+    )
+    assert lint(MODELS, text, rules=["fit-missing-finalize"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R10 style details
+# ---------------------------------------------------------------------------
+
+
+def test_noqa_and_init_reexports_opt_out_of_unused_import():
+    assert lint(OPS, "import os  # noqa: F401\nx = 1\n",
+                rules=["unused-import"]) == []
+    assert lint("oap_mllib_tpu/fake/__init__.py", "import os\n",
+                rules=["unused-import"]) == []
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    found = lint(OPS, "def f(:\n")
+    assert rules_of(found) == ["syntax"]
+
+
+# ---------------------------------------------------------------------------
+# suppression grammar
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression_with_reason():
+    text = ("import jax.numpy as jnp\n"
+            "y = jnp.dot(a, b)  "
+            "# oaplint: disable=raw-matmul -- parity probe\n")
+    assert lint(OPS, text, rules=["raw-matmul"]) == []
+
+
+def test_suppression_without_reason_is_rejected_and_does_not_apply():
+    text = ("import jax.numpy as jnp\n"
+            "y = jnp.dot(a, b)  # oaplint: disable=raw-matmul\n")
+    found = lint(OPS, text, rules=["raw-matmul"])
+    assert rules_of(found) == ["bad-suppression", "raw-matmul"]
+
+
+def test_suppression_of_unknown_rule_is_rejected():
+    # built by concatenation so the live-tree lint of THIS file does not
+    # parse the fixture as a real (and invalid) directive
+    text = "x = 1  # oaplint" ": disable=no-such-rule -- whatever\n"
+    found = lint(OPS, text, rules=["final-newline"])
+    assert rules_of(found) == ["bad-suppression"]
+
+
+def test_comment_line_suppression_applies_to_next_line_only():
+    text = (
+        "import jax.numpy as jnp\n"
+        "# oaplint: disable=raw-matmul -- audited\n"
+        "y = jnp.dot(a, b)\n"
+        "z = jnp.dot(a, b)\n"
+    )
+    found = lint(OPS, text, rules=["raw-matmul"])
+    assert [f.line for f in found] == [4]
+
+
+def test_multi_rule_suppression_comma_list():
+    text = (
+        "import jax.numpy as jnp\nfrom jax import lax\n"
+        "# oaplint: disable=raw-matmul, raw-collective -- audited pair\n"
+        "y = lax.psum(jnp.dot(a, b), 'i')\n"
+    )
+    assert lint(OPS, text, rules=["raw-matmul", "raw-collective"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R6: the project-wide Config contract (fixture tree)
+# ---------------------------------------------------------------------------
+
+_CONFIG_SRC = (
+    "import dataclasses\n\n\n"
+    "@dataclasses.dataclass\nclass Config:\n    alpha: float = 1.0\n"
+)
+
+
+def _project_tree(tmp_path, doc="`alpha`", cover=True, extra_env=None):
+    pkg = tmp_path / "oap_mllib_tpu"
+    pkg.mkdir()
+    (pkg / "config.py").write_text(_CONFIG_SRC)
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "configuration.md").write_text(f"| {doc} | doc |\n")
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "test_config_coverage.py").write_text(
+        "import dataclasses\nfor f in dataclasses.fields(Config):\n"
+        "    pass\n" if cover else "x = 1\n"
+    )
+    if extra_env:
+        (pkg / "io.py").write_text(f"VAR = {extra_env!r}\n")
+    return tmp_path
+
+
+def _project_findings(root):
+    findings, _ = oaplint.run(root, rules=["config-field-contract"],
+                              paths=[])
+    return findings
+
+
+def test_config_contract_clean_tree(tmp_path):
+    assert _project_findings(_project_tree(tmp_path)) == []
+
+
+def test_config_contract_flags_undocumented_field(tmp_path):
+    found = _project_findings(_project_tree(tmp_path, doc="`other`"))
+    assert len(found) == 1 and "not documented" in found[0].detail
+
+
+def test_config_contract_flags_uncovered_field(tmp_path):
+    found = _project_findings(_project_tree(tmp_path, cover=False))
+    assert len(found) == 1 and "not covered" in found[0].detail
+
+
+def test_config_contract_flags_mismatched_env_literal(tmp_path):
+    found = _project_findings(
+        _project_tree(tmp_path, extra_env="OAP_MLLIB_TPU_BOGUS"))
+    assert len(found) == 1 and "OAP_MLLIB_TPU_BOGUS" in found[0].detail
+
+
+def test_config_contract_matching_env_literal_is_clean(tmp_path):
+    assert _project_findings(
+        _project_tree(tmp_path, extra_env="OAP_MLLIB_TPU_ALPHA")) == []
+
+
+# ---------------------------------------------------------------------------
+# the gate: the live tree lints clean, with enough rules active
+# ---------------------------------------------------------------------------
+
+
+def test_live_tree_lints_clean():
+    findings, n_files = oaplint.run(ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert n_files > 80  # the whole tree was actually enumerated
+
+
+def test_rule_count_floor():
+    # ISSUE 6 acceptance: >= 9 active contract/style rules
+    assert len(oaplint.RULES) >= 9
+
+
+def test_every_suppression_in_tree_carries_reason():
+    # the runner rejects reasonless directives as findings; this asserts
+    # the stronger property directly on the shipped tree's directives
+    import re
+
+    pat = re.compile(r"oaplint:\s*disable=")
+    ok = re.compile(r"oaplint:\s*disable=[\w\-, ]+?--\s*\S")
+    for path, kind in oaplint.iter_files(ROOT):
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            if "test_oaplint" in path.name:
+                continue  # fixture strings exercise the bad grammar
+            if pat.search(line):
+                assert ok.search(line), f"{path}:{i}: reasonless directive"
