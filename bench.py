@@ -88,12 +88,25 @@ def _bound_extras(kind, achieved, bound):
     }
 
 
+def _sanitizers_state() -> str:
+    """The armed sanitizer set as a stable string ("off" when empty) —
+    recorded in every bench JSON line so runs are comparable: the
+    collective sanitizer adds a cross-check gather per host collective
+    and the retrace guard changes compile behavior, so numbers from
+    runs with different sanitizer sets must never be diffed silently."""
+    from oap_mllib_tpu.utils import sanitizers
+
+    names = sorted(sanitizers.enabled_set())
+    return ",".join(names) if names else "off"
+
+
 def _emit(metric, value, unit, vs_baseline, **extra):
     line = {
         "metric": metric,
         "value": round(value, 4),
         "unit": unit,
         "vs_baseline": round(vs_baseline, 2),
+        "sanitizers": _sanitizers_state(),
     }
     line.update(extra)
     print(json.dumps(line), flush=True)
@@ -1101,6 +1114,24 @@ def main():
                          "estimators under f32/tf32/bf16, reporting "
                          "throughput + parity vs f32 per policy")
     args = ap.parse_args()
+
+    if (args.precision_sweep or args.compile_sweep) \
+            and _sanitizers_state() != "off":
+        # the sweeps are compile-count/throughput COMPARISONS — within
+        # the run (bucketing off vs on, f32 vs bf16) and against the
+        # BENCH_r* baselines, all recorded sanitizers-off.  The
+        # collective sanitizer adds a gather per host collective and the
+        # retrace guard perturbs compile accounting, so a sweep under a
+        # different sanitizer set is not comparable: refuse instead of
+        # emitting silently skewed numbers.
+        ap.error(
+            f"--precision-sweep/--compile-sweep refuse to run with "
+            f"sanitizers armed (Config.sanitizers="
+            f"{_sanitizers_state()!r}): sanitizers perturb compile "
+            "counts and collective walls, so the sweep would not be "
+            "comparable to sanitizers-off baselines; unset "
+            "OAP_MLLIB_TPU_SANITIZERS for benching"
+        )
 
     if args.precision_sweep:
         bench_precision_sweep()

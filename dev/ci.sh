@@ -86,6 +86,13 @@ echo "   expected shape per estimator, collective op counters fire on the"
 echo "   pseudo-mesh ALS fit, resilience counters zero (dev/telemetry_gate.py) =="
 python dev/telemetry_gate.py
 
+echo "== sanitizer gate: dataflow analyzer required-clean (R16-R18 + unused-"
+echo "   suppression inventory), one sanitizer-on leg per sanitizer (single-"
+echo "   process + 2-process pseudo-cluster), seeded violations caught, and"
+echo "   sanitizers-off overhead unmeasurable on the 20-fit K-Means"
+echo "   microbench (dev/sanitizer_gate.py) =="
+python dev/sanitizer_gate.py
+
 echo "== compiled-mode TPU suite (skipped unless a TPU backend is present) =="
 if python -c "import jax, sys; sys.exit(0 if jax.default_backend() == 'tpu' else 1)" 2>/dev/null; then
   python -m pytest tests_tpu/ -q

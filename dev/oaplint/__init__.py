@@ -38,10 +38,12 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import json
 import re
+import tokenize
 from pathlib import Path
-from typing import Callable, Dict, Iterable, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 ROOT = Path(__file__).resolve().parent.parent.parent
 PKG = "oap_mllib_tpu"
@@ -94,7 +96,7 @@ class Context:
 class Rule:
     name: str
     scope: Optional[str]  # regex on rel path; None = every file
-    kind: str  # "py" | "any" | "project"
+    kind: str  # "py" | "any" | "project" | "dataflow"
     doc: str
     check: Callable
 
@@ -121,18 +123,48 @@ _DIRECTIVE = re.compile(
 )
 
 
-def _suppressions(lines: List[str], known: Iterable[str]):
-    """Parse per-line suppression directives.
+@dataclasses.dataclass
+class Directive:
+    """One parsed suppression directive: the line it sits on, the line
+    it applies to (comment-only lines apply to the NEXT line), the rule
+    names it disables, and its reason."""
 
-    Returns (map line -> set of rule names suppressed there, list of
-    (line, detail) for malformed directives).  A directive on a
-    comment-only line applies to the NEXT line; inline directives apply
-    to their own line.  A missing/empty ``-- reason`` or an unknown rule
-    name makes the directive invalid (and a finding)."""
+    line: int
+    target: int
+    names: Set[str]
+    reason: str
+
+
+def _comment_lines(text: str, lines: List[str]) -> List[Tuple[int, str]]:
+    """(lineno, line) pairs that carry a REAL comment token — directives
+    inside string literals (docstring examples, test fixtures) are not
+    directives.  Falls back to every line when tokenization fails (the
+    syntax rule owns broken files; non-Python files have no tokenizer)."""
+    try:
+        return sorted({
+            (tok.start[0], lines[tok.start[0] - 1])
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline)
+            if tok.type == tokenize.COMMENT
+        })
+    except (tokenize.TokenError, SyntaxError, IndentationError, IndexError):
+        return list(enumerate(lines, 1))
+
+
+def _suppressions(text: str, lines: List[str], known: Iterable[str],
+                  kind: str = "py"):
+    """Parse per-line suppression directives from real comments.
+
+    Returns (directives, bad) where ``bad`` is a list of (line, detail)
+    for malformed directives.  A missing/empty ``-- reason`` or an
+    unknown rule name makes the directive invalid (and a finding)."""
     known = set(known)
-    by_line: Dict[int, set] = {}
+    directives: List[Directive] = []
     bad: List[Tuple[int, str]] = []
-    for i, line in enumerate(lines, 1):
+    candidates = (
+        _comment_lines(text, lines) if kind == "py"
+        else list(enumerate(lines, 1))
+    )
+    for i, line in candidates:
         m = _DIRECTIVE.search(line)
         if not m:
             continue
@@ -147,8 +179,15 @@ def _suppressions(lines: List[str], known: Iterable[str]):
             bad.append((i, f"suppression names unknown rule(s): {unknown}"))
             continue
         target = i + 1 if line.lstrip().startswith("#") else i
-        by_line.setdefault(target, set()).update(names)
-    return by_line, bad
+        directives.append(Directive(i, target, names, reason))
+    return directives, bad
+
+
+def _by_target(directives: List[Directive]) -> Dict[int, set]:
+    by_line: Dict[int, set] = {}
+    for d in directives:
+        by_line.setdefault(d.target, set()).update(d.names)
+    return by_line
 
 
 # -- runner ------------------------------------------------------------------
@@ -177,45 +216,102 @@ def _active_rules(names: Optional[Iterable[str]]):
     return [RULES[n] for n in names]
 
 
-def lint_text(rel: str, text: str, *, root: Path = ROOT,
-              rules: Optional[Iterable[str]] = None,
-              kind: str = "py") -> List[Finding]:
-    """Lint one file's content under a (possibly pretend) relative path.
-
-    This is the test seam: fixtures lint snippets under paths like
-    ``oap_mllib_tpu/ops/foo_stream.py`` without touching the tree."""
+def _lint_one(rel: str, text: str, *, root: Path, rules, kind: str,
+              dataflow: bool):
+    """Shared per-file core: returns (kept findings + bad-suppression
+    findings, directives, used {(target_line, rule)} pairs).  ``dataflow``
+    controls whether dataflow-kind rules run here (the lint_text seam)
+    or are left to the package-wide pass (the runner)."""
     findings: List[Finding] = []
     tree = None
     if kind == "py":
         try:
             tree = ast.parse(text, filename=rel)
         except SyntaxError as e:
-            return [Finding(rel, e.lineno or 0, "syntax", e.msg or "")]
+            return [Finding(rel, e.lineno or 0, "syntax", e.msg or "")], [], set()
     ctx = Context(rel, text, tree, root)
     for r in _active_rules(rules):
         if r.kind == "project":
             continue
-        if r.kind == "py" and kind != "py":
+        if r.kind in ("py", "dataflow") and kind != "py":
             continue
         if r.scope is not None and not re.match(r.scope, rel):
             continue
-        for line, detail in r.check(ctx):
-            findings.append(Finding(rel, line, r.name, detail))
-    sup, bad = _suppressions(ctx.lines, RULES)
-    findings = [
-        f for f in findings if f.rule not in sup.get(f.line, ())
-    ]
-    findings.extend(
+        if r.kind == "dataflow":
+            if not dataflow:
+                continue
+            for frel, line, detail in r.check(root, extra=(rel, text)):
+                if frel == rel:
+                    findings.append(Finding(rel, line, r.name, detail))
+        else:
+            for line, detail in r.check(ctx):
+                findings.append(Finding(rel, line, r.name, detail))
+    directives, bad = _suppressions(text, ctx.lines, RULES, kind)
+    sup = _by_target(directives)
+    used: Set[Tuple[int, str]] = set()
+    kept: List[Finding] = []
+    for f in findings:
+        if f.rule in sup.get(f.line, ()):
+            used.add((f.line, f.rule))
+        else:
+            kept.append(f)
+    kept.extend(
         Finding(rel, line, "bad-suppression", detail) for line, detail in bad
     )
-    return findings
+    return kept, directives, used
+
+
+def _unused_findings(rel: str, directives: List[Directive],
+                     used: Set[Tuple[int, str]],
+                     skip_kinds=("project",)) -> List[Finding]:
+    """A directive whose rule produced no finding on its target line is
+    itself a finding: stale suppressions rot the audited-opt-out
+    inventory as code moves (ISSUE 7 satellite).  Project-rule names are
+    skipped where per-file usage is unknowable (the lint_text seam)."""
+    out = []
+    for d in directives:
+        for name in sorted(d.names):
+            r = RULES.get(name)
+            if r is not None and r.kind in skip_kinds:
+                continue
+            if (d.target, name) in used:
+                continue
+            out.append(Finding(
+                rel, d.line, "unused-suppression",
+                f"suppression of '{name}' matched no finding on line "
+                f"{d.target}; delete the stale directive (or fix the "
+                "drifted code it was auditing)",
+            ))
+    return out
+
+
+def lint_text(rel: str, text: str, *, root: Path = ROOT,
+              rules: Optional[Iterable[str]] = None,
+              kind: str = "py") -> List[Finding]:
+    """Lint one file's content under a (possibly pretend) relative path.
+
+    This is the test seam: fixtures lint snippets under paths like
+    ``oap_mllib_tpu/ops/foo_stream.py`` without touching the tree.
+    Dataflow rules analyze the snippet against the LIVE package index
+    (the snippet shadows any real file at ``rel``).  Unused-suppression
+    detection runs only when every rule is active — a subset run cannot
+    prove a directive dead."""
+    kept, directives, used = _lint_one(
+        rel, text, root=root, rules=rules, kind=kind, dataflow=True
+    )
+    if rules is None:
+        kept.extend(_unused_findings(rel, directives, used))
+    return kept
 
 
 def run(root: Path = ROOT, *, rules: Optional[Iterable[str]] = None,
         paths: Optional[List[Path]] = None) -> Tuple[List[Finding], int]:
     """Lint the tree (or explicit ``paths``); returns (findings, nfiles).
 
-    Project rules run once per invocation; file rules run per file."""
+    Project and dataflow rules run once per invocation (package-wide);
+    file rules run per file.  With every rule active, directives whose
+    rule matched nothing on their target line are reported as
+    ``unused-suppression`` findings."""
     findings: List[Finding] = []
     n_files = 0
     root = root.resolve()
@@ -223,6 +319,8 @@ def run(root: Path = ROOT, *, rules: Optional[Iterable[str]] = None,
         [(p, "cpp" if p.suffix in (".cpp", ".h") else "py") for p in paths]
         if paths is not None else list(iter_files(root))
     )
+    per_file: Dict[str, Tuple[List[Directive], Set[Tuple[int, str]]]] = {}
+    target_rels: List[str] = []
     for path, kind in targets:
         n_files += 1
         try:
@@ -232,27 +330,73 @@ def run(root: Path = ROOT, *, rules: Optional[Iterable[str]] = None,
             continue
         rel = path.resolve().relative_to(root).as_posix() \
             if path.resolve().is_relative_to(root) else path.as_posix()
-        findings.extend(lint_text(rel, text, root=root, rules=rules,
-                                  kind=kind))
-    sup_cache: Dict[str, Dict[int, set]] = {}
+        kept, directives, used = _lint_one(
+            rel, text, root=root, rules=rules, kind=kind, dataflow=False
+        )
+        findings.extend(kept)
+        per_file[rel] = (directives, used)
+        target_rels.append(rel)
 
-    def _suppressed(rel: str, line: int, name: str) -> bool:
-        if rel not in sup_cache:
+    def _file_state(rel: str):
+        if rel not in per_file:
             try:
                 text = (root / rel).read_text()
             except OSError:
                 text = ""
-            sup_cache[rel], _ = _suppressions(text.splitlines(), RULES)
-        return name in sup_cache[rel].get(line, ())
+            d, _ = _suppressions(text, text.splitlines(), RULES)
+            per_file[rel] = (d, set())
+        return per_file[rel]
 
     for r in _active_rules(rules):
-        if r.kind != "project":
+        if r.kind not in ("project", "dataflow"):
             continue
         for rel, line, detail in r.check(root):
-            if not _suppressed(rel, line, r.name):
+            directives, used = _file_state(rel)
+            if r.name in _by_target(directives).get(line, ()):
+                used.add((line, r.name))
+            else:
                 findings.append(Finding(rel, line, r.name, detail))
+    if rules is None:
+        for rel in target_rels:
+            directives, used = per_file[rel]
+            findings.extend(_unused_findings(rel, directives, used,
+                                             skip_kinds=()))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, n_files
+
+
+def suppression_inventory(root: Path = ROOT,
+                          findings: Optional[List[Finding]] = None) -> List[dict]:
+    """The audited-suppression inventory: one record per directive in
+    the tree ({path, line, target, rules, reason, used}), for the
+    ``--json`` artifact.  ``used`` is False iff the findings carry an
+    ``unused-suppression`` naming it (pass the same run's findings)."""
+    unused = set()
+    for f in findings or ():
+        if f.rule == "unused-suppression":
+            m = re.search(r"suppression of '([^']+)'", f.detail)
+            if m:
+                unused.add((f.path, f.line, m.group(1)))
+    out = []
+    for path, kind in iter_files(root):
+        try:
+            text = path.read_text()
+        except OSError:
+            continue
+        rel = path.resolve().relative_to(root).as_posix() \
+            if path.resolve().is_relative_to(root) else path.as_posix()
+        directives, _ = _suppressions(text, text.splitlines(), RULES, kind)
+        for d in directives:
+            names = sorted(d.names)
+            out.append({
+                "path": rel,
+                "line": d.line,
+                "target": d.target,
+                "rules": names,
+                "reason": d.reason,
+                "used": all((rel, d.line, n) not in unused for n in names),
+            })
+    return out
 
 
 def to_json(findings: List[Finding]) -> str:
@@ -263,3 +407,4 @@ def to_json(findings: List[Finding]) -> str:
 from . import style  # noqa: E402,F401  (registration side effect)
 from . import contracts  # noqa: E402,F401
 from . import project  # noqa: E402,F401
+from . import dataflow  # noqa: E402,F401

@@ -8,6 +8,7 @@ Exit 1 on any finding; prints ``file:line: rule: detail`` per finding
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from pathlib import Path
@@ -22,8 +23,9 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files to lint (default: the whole tree)")
     ap.add_argument("--json", metavar="FILE",
-                    help="also write findings as a JSON array to FILE "
-                         "('-' for stdout)")
+                    help="also write a JSON artifact to FILE ('-' for "
+                         "stdout): {findings: [...], suppressions: "
+                         "[the audited-directive inventory]}")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -38,7 +40,15 @@ def main(argv=None) -> int:
     for f in findings:
         print(f.render())
     if args.json:
-        payload = oaplint.to_json(findings)
+        # the artifact pairs the findings with the audited-suppression
+        # inventory (ISSUE 7 satellite): every directive in the tree,
+        # its rules/reason, and whether it still suppresses anything
+        payload = json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "suppressions": oaplint.suppression_inventory(
+                findings=findings
+            ),
+        }, indent=2)
         if args.json == "-":
             print(payload)
         else:

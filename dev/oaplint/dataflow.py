@@ -1,0 +1,951 @@
+"""Interprocedural SPMD dataflow rules (R16-R18).
+
+The per-file rules in contracts.py see one AST at a time; the failure
+modes that actually hang a pod are *flows*: a collective reachable only
+on rank 0, an axis name no mesh binds, a bf16 value summed at bf16.
+This module builds a package-wide index (module call graph + per-module
+binding environments) and runs an intraprocedural abstract
+interpretation over a small provenance lattice:
+
+- **rank provenance** — a value is RANK-DERIVED if it flows (through
+  assignments, comprehensions, loop targets, and calls to functions
+  whose returns are rank-derived) from ``jax.process_index()`` or a
+  ``.process_id``/``.process_index`` read; it is re-UNIFORMIZED by any
+  world-synchronizing call (``process_allgather``, the host reduction
+  helpers, the collective facade) — after a gather every rank holds the
+  same value.  ``jax.process_count()`` is uniform by definition.
+- **collective reachability** — a function REACHES-COLLECTIVE if its
+  body dispatches one (``parallel/collective`` facade ops, raw ``lax``
+  collectives, ``process_allgather``) or calls a function that does;
+  the evidence chain is kept for diagnostics.
+- **dtype tier** — a value is BF16-TIER once cast with
+  ``.astype(bfloat16)``; the tier survives until an explicit upcast.
+
+Fed rules (registered on import, like contracts.py):
+
+- **R16 collective-divergence**: a collective dispatch (or a call that
+  transitively reaches one) lexically under an ``if``/``while``/
+  ``for``/ternary whose condition/iterable is rank-derived — the
+  whole-world-hang shape.  The finding prints the full path: the
+  provenance chain of the condition and the call chain to the
+  collective.
+- **R17 unbound-collective-axis**: a collective's axis name must
+  resolve — through enclosing-scope assignments and helper-call
+  argument binding, package-wide — to a mesh-bound token: a
+  ``cfg.data_axis``/``cfg.model_axis`` read, a ``mesh.axis_names``
+  element, or a literal that some ``Mesh``/``PartitionSpec`` context in
+  the chain's modules actually binds.
+- **R18 precision-flow**: bf16-tier values must accumulate in f32 —
+  flags reductions (``jnp.sum``/``mean``/...) on bf16-tier operands
+  without an ``upcast``/f32 cast, f32→bf16→f32 round-trips whose bf16
+  value feeds no matmul (pure mantissa loss), and reduced-dtype
+  accumulator allocations.  ``ops/pallas/`` is exempt (the kernel's
+  hi/lo bf16 splitting is the deliberate exception, like R2) and
+  ``utils/precision.py`` is the one module allowed to own these casts.
+
+Known approximations (docs/static-analysis.md has the full table):
+call resolution is by function NAME across the package (shadowing
+merges conservatively); parameters are not rank-tainted from call sites
+(only explicit sources and returns taint); ``raise`` under a
+rank-dependent branch is NOT treated as divergence (fail-fast raises
+are the sanctioned per-rank exit — the ``_PassGuard`` contract carries
+them to the next reduction), while ``return``/``break``/``continue``
+are; dynamic axis strings built at runtime are opaque (not findings).
+The runtime sanitizer plane (``utils/sanitizers.py``,
+``Config.sanitizers``) witnesses the same invariants where the static
+pass cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import PKG, rule
+from .contracts import _dotted, _tail
+
+FACADE_REL = f"{PKG}/parallel/collective.py"
+
+_LAX_COLLECTIVES = {"psum", "pmean", "all_gather", "ppermute", "all_to_all",
+                    "psum_scatter"}
+_FACADE_OPS = {"psum", "pmean", "all_gather", "ppermute", "all_to_all",
+               "broadcast", "allgather_rows", "allreduce_sum",
+               "alltoall_rows"}
+# host-mediated world synchronizers: their results are identical on every
+# rank by construction, so they STOP rank-taint propagation
+_GATHER_TAILS = {"process_allgather"}
+
+
+# ---------------------------------------------------------------------------
+# package index
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    rel: str
+    qual: str  # rel::dotted.name
+    node: ast.AST
+    params: List[str]
+    enclosing: List["FuncInfo"]  # innermost last
+    own_calls: List[ast.Call] = dataclasses.field(default_factory=list)
+    own_returns: List[ast.AST] = dataclasses.field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+class ModuleInfo:
+    def __init__(self, rel: str, tree: ast.Module):
+        self.rel = rel
+        self.tree = tree
+        self.functions: List[FuncInfo] = []
+        self.bound_axis_literals: Set[str] = _bound_literals(tree)
+
+
+class PackageIndex:
+    """Cross-module context for the dataflow rules."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_name: Dict[str, List[FuncInfo]] = {}
+        self.owner: Dict[int, FuncInfo] = {}  # id(node) -> owning func
+        self.calls_by_tail: Dict[str, List[Tuple[FuncInfo, ast.Call]]] = {}
+        self.returns_rank: Set[str] = set()
+        self.returns_uniform: Set[str] = set()
+        self.reaches: Dict[str, Tuple[str, str, int]] = {}
+        # qual -> ("direct", op, line) | ("via", callee_qual, line)
+        self._taint_cache: Dict[Tuple[str, int, int], Dict] = {}
+
+    def resolve(self, call: ast.Call, rel: str) -> List[FuncInfo]:
+        """Candidate package functions a call may target (tail-name
+        resolution, same-module candidates preferred)."""
+        tail = _tail(call.func)
+        cands = self.by_name.get(tail, [])
+        same = [f for f in cands if f.rel == rel]
+        return same or cands
+
+    def chain(self, qual: str, limit: int = 6) -> str:
+        """Human-readable call chain from ``qual`` to its collective."""
+        parts = []
+        seen = set()
+        while qual in self.reaches and qual not in seen and limit:
+            seen.add(qual)
+            limit -= 1
+            kind, what, line = self.reaches[qual]
+            name = qual.split("::", 1)[1]
+            if kind == "direct":
+                parts.append(f"{name} -> {what} (line {line})")
+                break
+            parts.append(name)
+            qual = what
+        return " -> ".join(parts)
+
+
+def _bound_literals(tree: ast.Module) -> Set[str]:
+    """Axis-name literals a module's mesh contexts bind: strings inside
+    ``PartitionSpec``/``P(...)`` specs, ``Mesh``/``make_mesh`` axis
+    names, and ``shard_map`` axis kwargs."""
+    binders = {"P", "PartitionSpec", "Mesh", "make_mesh",
+               "AbstractMesh", "shard_map"}
+    out: Set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and _tail(n.func) in binders:
+            for c in ast.walk(n):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    out.add(c.value)
+    return out
+
+
+def _index_module(idx: PackageIndex, rel: str, tree: ast.Module) -> None:
+    mod = ModuleInfo(rel, tree)
+    idx.modules[rel] = mod
+
+    def visit(node, qual_prefix, enclosing):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{rel}::{qual_prefix}{child.name}"
+                a = child.args
+                params = [p.arg for p in
+                          a.posonlyargs + a.args + a.kwonlyargs]
+                if a.vararg:
+                    params.append(a.vararg.arg)
+                if a.kwarg:
+                    params.append(a.kwarg.arg)
+                fi = FuncInfo(rel, qual, child, params, list(enclosing))
+                mod.functions.append(fi)
+                idx.by_name.setdefault(child.name, []).append(fi)
+                for inner in ast.walk(child):
+                    idx.owner.setdefault(id(inner), fi)
+                visit(child, f"{qual_prefix}{child.name}.",
+                      enclosing + [fi])
+            else:
+                visit(child, qual_prefix, enclosing)
+
+    visit(tree, "", [])
+
+
+_INDEX_CACHE: Dict[str, PackageIndex] = {}
+
+
+def _finish_index(idx: PackageIndex, only=None) -> None:
+    """Precompute the per-function call/return lists (the hot inputs of
+    every fixpoint sweep) once the owner map is complete.  ``only``
+    restricts the precompute to freshly-indexed functions (the overlay
+    path, where every other module's lists are shared with the base)."""
+    funcs = only if only is not None else [
+        fi for mod in idx.modules.values() for fi in mod.functions
+    ]
+    for fi in funcs:
+        for n in ast.walk(fi.node):
+            if idx.owner.get(id(n)) is not fi:
+                continue
+            if isinstance(n, ast.Call):
+                fi.own_calls.append(n)
+                idx.calls_by_tail.setdefault(
+                    _tail(n.func), []).append((fi, n))
+            elif isinstance(n, ast.Return) and n.value is not None:
+                fi.own_returns.append(n.value)
+    _fixpoints(idx)
+
+
+def _base_index(root: Path) -> PackageIndex:
+    key = str(root.resolve())
+    idx = _INDEX_CACHE.get(key)
+    if idx is not None:
+        return idx
+    idx = PackageIndex()
+    for path in sorted((root / PKG).rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(root).as_posix()
+        try:
+            tree = ast.parse(path.read_text(), filename=rel)
+        except (OSError, SyntaxError):
+            continue  # io/syntax rules own these
+        _index_module(idx, rel, tree)
+    _finish_index(idx)
+    _INDEX_CACHE[key] = idx
+    return idx
+
+
+def build_index(root: Path, extra: Optional[Tuple[str, str]] = None
+                ) -> PackageIndex:
+    """The package index, optionally with one in-memory module shadowing
+    ``extra[0]`` (the lint_text mutation-test seam).  The overlay SHARES
+    the cached base index's parsed modules and per-function lists —
+    only the extra module is indexed fresh, and the fixpoints restart
+    from scratch over the shared structure (they only add facts, so
+    convergence is quick)."""
+    if extra is None:
+        return _base_index(root)
+    rel, text = extra
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError:
+        return _base_index(root)
+    base = _base_index(root)
+    idx = PackageIndex()
+    for mrel, mod in base.modules.items():
+        if mrel == rel:
+            continue
+        idx.modules[mrel] = mod
+        for fi in mod.functions:
+            idx.by_name.setdefault(fi.name, []).append(fi)
+    idx.owner = {
+        k: v for k, v in base.owner.items() if v.rel != rel
+    }
+    for tail, sites in base.calls_by_tail.items():
+        kept = [(fi, c) for fi, c in sites if fi.rel != rel]
+        if kept:
+            idx.calls_by_tail[tail] = kept
+    # seed the fixpoints with the base facts (minus anything owned by or
+    # derived via the shadowed module) — facts only grow, so re-running
+    # the fixpoints on top converges in a sweep or two
+    prefix = rel + "::"
+    idx.returns_rank = {
+        q for q in base.returns_rank if not q.startswith(prefix)
+    }
+    idx.returns_uniform = {
+        q for q in base.returns_uniform if not q.startswith(prefix)
+    }
+    idx.reaches = {
+        q: v for q, v in base.reaches.items()
+        if not q.startswith(prefix)
+        and (v[0] == "direct" or not v[1].startswith(prefix))
+    }
+    _index_module(idx, rel, tree)
+    _finish_index(idx, only=idx.modules[rel].functions)
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# provenance predicates
+# ---------------------------------------------------------------------------
+
+
+def _rank_source(expr: ast.AST) -> Optional[Tuple[int, str]]:
+    """(line, description) of the first explicit rank source in an
+    expression: ``jax.process_index()`` or a ``.process_id`` /
+    ``.process_index`` attribute read."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and _tail(n.func) == "process_index":
+            return n.lineno, f"{_dotted(n.func) or 'process_index'}()"
+        if isinstance(n, ast.Attribute) and n.attr in (
+                "process_id", "process_index"):
+            return n.lineno, f".{n.attr} read"
+    return None
+
+
+def _collective_dispatch(call: ast.Call) -> Optional[str]:
+    """The dispatched collective's name when ``call`` is a direct
+    collective: a facade op, a raw lax collective, or a host
+    process_allgather."""
+    d = _dotted(call.func)
+    tail = _tail(call.func)
+    if tail in _GATHER_TAILS:
+        return d or tail
+    if d.startswith(("lax.", "jax.lax.")) and tail in _LAX_COLLECTIVES:
+        return d
+    if d.startswith("collective.") and tail in _FACADE_OPS:
+        return d
+    return None
+
+
+def _call_names(expr: ast.AST) -> List[ast.Call]:
+    return [n for n in ast.walk(expr) if isinstance(n, ast.Call)]
+
+
+def _flat_names(t) -> List[str]:
+    """Names a store target actually REBINDS: plain names and
+    tuple/list destructuring.  A subscript/attribute store
+    (``summary["rank"] = r``) carries rank data without making the
+    container's NAME rank-derived for control-flow purposes — flagging
+    it would taint every summary dict a rank tag rides in."""
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in t.elts:
+            out.extend(_flat_names(e))
+        return out
+    if isinstance(t, ast.Starred):
+        return _flat_names(t.value)
+    return []
+
+
+def _assign_targets(node) -> List[str]:
+    if isinstance(node, ast.Assign):
+        tgts = node.targets
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign, ast.NamedExpr)):
+        tgts = [node.target]
+    elif isinstance(node, (ast.For, ast.AsyncFor)):
+        tgts = [node.target]
+    elif isinstance(node, ast.withitem):
+        tgts = [node.optional_vars] if node.optional_vars else []
+    else:
+        return []
+    out: List[str] = []
+    for t in tgts:
+        out.extend(_flat_names(t))
+    return out
+
+
+def _value_of(node):
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                         ast.NamedExpr)):
+        return node.value
+    if isinstance(node, (ast.For, ast.AsyncFor)):
+        return node.iter
+    if isinstance(node, ast.withitem):
+        return node.context_expr
+    return None
+
+
+def _uniformizing(idx: PackageIndex, expr: ast.AST, rel: str) -> bool:
+    """Does the expression pass through a world synchronizer (its value
+    is identical on every rank afterwards)?"""
+    for call in _call_names(expr):
+        if _collective_dispatch(call) is not None:
+            return True
+        for fi in idx.resolve(call, rel):
+            if fi.qual in idx.returns_uniform:
+                return True
+    return False
+
+
+def _fn_taints(idx: PackageIndex, fi: FuncInfo) -> Dict[str, Tuple[int, str]]:
+    """Rank-tainted local names of one function: name -> (line, chain
+    description).  Flow-insensitive fixpoint over the assignment-shaped
+    statements (assignments, loop targets, with-as, walrus).  Cached per
+    (function, fixpoint-state) — the sets only grow, so the state is the
+    pair of set sizes."""
+    key = (fi.qual, len(idx.returns_rank), len(idx.returns_uniform))
+    cached = idx._taint_cache.get(key)
+    if cached is not None:
+        return cached
+    tainted: Dict[str, Tuple[int, str]] = {}
+    nodes = [n for n in ast.walk(fi.node)
+             if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                               ast.NamedExpr, ast.For, ast.AsyncFor))]
+    for n in list(ast.walk(fi.node)):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            nodes.extend(n.items)
+    for _ in range(len(nodes) + 1):
+        changed = False
+        for n in nodes:
+            value = _value_of(n)
+            if value is None:
+                continue
+            targets = _assign_targets(n)
+            if not targets or all(t in tainted for t in targets):
+                continue
+            if _uniformizing(idx, value, fi.rel):
+                continue  # gathered values are world-uniform again
+            src = _rank_source(value)
+            if src is None:
+                for c in _call_names(value):
+                    for cand in idx.resolve(c, fi.rel):
+                        if cand.qual in idx.returns_rank:
+                            src = (c.lineno,
+                                   f"{cand.name}() returns a rank-derived "
+                                   "value")
+                            break
+                    if src:
+                        break
+            if src is None:
+                for name_node in ast.walk(value):
+                    if isinstance(name_node, ast.Name) \
+                            and name_node.id in tainted:
+                        line, desc = tainted[name_node.id]
+                        src = (name_node.lineno,
+                               f"'{name_node.id}' <- {desc}")
+                        break
+            if src is None:
+                continue
+            for t in targets:
+                if t not in tainted:
+                    tainted[t] = src
+                    changed = True
+        if not changed:
+            break
+    idx._taint_cache[key] = tainted
+    return tainted
+
+
+def _fixpoints(idx: PackageIndex) -> None:
+    """Package-wide fixpoints: which functions return rank-derived
+    values, which return world-uniform (gathered) values, and which
+    reach a collective."""
+    # reaches-collective
+    changed = True
+    while changed:
+        changed = False
+        for mod in idx.modules.values():
+            for fi in mod.functions:
+                if fi.qual in idx.reaches:
+                    continue
+                for call in fi.own_calls:
+                    op = _collective_dispatch(call)
+                    if op is not None:
+                        idx.reaches[fi.qual] = ("direct", op, call.lineno)
+                        changed = True
+                        break
+                    for cand in idx.resolve(call, fi.rel):
+                        if cand.qual in idx.reaches and cand is not fi:
+                            idx.reaches[fi.qual] = (
+                                "via", cand.qual, call.lineno)
+                            changed = True
+                            break
+                    if fi.qual in idx.reaches:
+                        break
+    # returns-uniform / returns-rank (interleaved: taint computation
+    # consults both sets, so iterate to a joint fixpoint)
+    for _ in range(8):
+        changed = False
+        for mod in idx.modules.values():
+            for fi in mod.functions:
+                rets = fi.own_returns
+                if not rets:
+                    continue
+                if fi.qual not in idx.returns_uniform:
+                    uniform_vars = set()
+                    for n in ast.walk(fi.node):
+                        value = _value_of(n)
+                        if value is not None and _uniformizing(
+                                idx, value, fi.rel):
+                            uniform_vars.update(_assign_targets(n))
+                    for r in rets:
+                        if _uniformizing(idx, r, fi.rel) or any(
+                                isinstance(x, ast.Name)
+                                and x.id in uniform_vars
+                                for x in ast.walk(r)):
+                            idx.returns_uniform.add(fi.qual)
+                            changed = True
+                            break
+                if fi.qual not in idx.returns_rank \
+                        and fi.qual not in idx.returns_uniform:
+                    tainted = _fn_taints(idx, fi)
+                    for r in rets:
+                        hit = _rank_source(r) is not None or any(
+                            isinstance(x, ast.Name) and x.id in tainted
+                            for x in ast.walk(r))
+                        if not hit:
+                            for c in _call_names(r):
+                                if any(cand.qual in idx.returns_rank
+                                       for cand in idx.resolve(c, fi.rel)):
+                                    hit = True
+                                    break
+                        if hit:
+                            idx.returns_rank.add(fi.qual)
+                            changed = True
+                            break
+        if not changed:
+            break
+
+
+# ---------------------------------------------------------------------------
+# R16: collective-divergence
+# ---------------------------------------------------------------------------
+
+
+def _cond_evidence(expr: ast.AST, tainted: Dict[str, Tuple[int, str]]
+                   ) -> Optional[str]:
+    """Why a condition/iterable is rank-derived, or None."""
+    src = _rank_source(expr)
+    if src is not None:
+        return f"{src[1]} at line {src[0]}"
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in tainted:
+            line, desc = tainted[n.id]
+            return f"'{n.id}' ({desc}, line {line})"
+    return None
+
+
+def _exits(block: Sequence[ast.stmt]) -> bool:
+    """Does a branch body unconditionally leave the enclosing block via
+    return/break/continue?  (``raise`` is deliberately excluded: the
+    fail-fast raise is the sanctioned per-rank exit — the _PassGuard
+    contract carries it to the next reduction.)"""
+    return bool(block) and isinstance(
+        block[-1], (ast.Return, ast.Break, ast.Continue))
+
+
+def _r16_function(idx: PackageIndex, fi: FuncInfo, emit) -> None:
+    tainted = _fn_taints(idx, fi)
+
+    def describe_call(call: ast.Call) -> Optional[str]:
+        op = _collective_dispatch(call)
+        if op is not None:
+            return f"collective {op}"
+        for cand in idx.resolve(call, fi.rel):
+            if cand.qual in idx.reaches:
+                return (f"call to '{cand.name}' which reaches a "
+                        f"collective ({idx.chain(cand.qual)})")
+        return None
+
+    def scan_calls(node: ast.AST, ctx: List[str]) -> None:
+        for call in _call_names(node):
+            what = describe_call(call)
+            if what is not None:
+                emit(call.lineno,
+                     f"{what} is reachable only under rank-divergent "
+                     "control flow: " + "; ".join(ctx) + " — every rank "
+                     "must issue the same collective sequence "
+                     "(static-world contract); hoist the collective out "
+                     "of the branch or make the condition world-uniform "
+                     "(gather/psum it first)")
+
+    def walk(stmts: Sequence[ast.stmt], ctx: List[str]) -> None:
+        diverged: Optional[str] = None
+        for st in stmts:
+            here = list(ctx)
+            if diverged is not None:
+                here.append(diverged)
+            if isinstance(st, (ast.If, ast.While)):
+                ev = _cond_evidence(st.test, tainted)
+                if ev is not None:
+                    kind = "if" if isinstance(st, ast.If) else "while"
+                    cond_ctx = here + [
+                        f"{kind} at line {st.lineno} branches on {ev}"]
+                    walk(st.body, cond_ctx)
+                    walk(st.orelse, cond_ctx)
+                    if diverged is None and (
+                            _exits(st.body) or _exits(st.orelse)):
+                        diverged = (
+                            f"code after line {st.lineno} (a rank-"
+                            f"dependent {kind} on {ev} exits early, so "
+                            "ranks diverge from here on)")
+                else:
+                    walk(st.body, here)
+                    walk(st.orelse, here)
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                ev = _cond_evidence(st.iter, tainted)
+                if ev is not None:
+                    loop_ctx = here + [
+                        f"for at line {st.lineno} iterates over "
+                        f"rank-derived {ev}"]
+                    walk(st.body, loop_ctx)
+                else:
+                    walk(st.body, here)
+                walk(st.orelse, here)
+                # the loop header itself may dispatch when divergent ctx
+                if here:
+                    scan_calls(st.iter, here)
+                continue
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # nested defs are scanned as their own functions
+            if isinstance(st, (ast.With, ast.AsyncWith, ast.Try)):
+                for item in getattr(st, "items", []):
+                    if here:
+                        scan_calls(item.context_expr, here)
+                for blk in (getattr(st, "body", []),
+                            getattr(st, "orelse", []),
+                            getattr(st, "finalbody", [])):
+                    walk(blk, here)
+                for h in getattr(st, "handlers", []):
+                    walk(h.body, here)
+                continue
+            # plain statement: ternaries inside count as branches
+            for n in ast.walk(st):
+                if isinstance(n, ast.IfExp):
+                    ev = _cond_evidence(n.test, tainted)
+                    if ev is not None:
+                        scan_calls(n.body, here + [
+                            f"ternary at line {n.lineno} branches on "
+                            f"{ev}"])
+                        scan_calls(n.orelse, here + [
+                            f"ternary at line {n.lineno} branches on "
+                            f"{ev}"])
+            if here:
+                scan_calls(st, here)
+
+    body = getattr(fi.node, "body", [])
+    walk(body, [])
+
+
+@rule("collective-divergence", scope=rf"{PKG}/", kind="dataflow",
+      doc="No collective (facade op, lax collective, process_allgather) "
+          "reachable under control flow derived from jax.process_index()"
+          " / Config.process_id — a rank-divergent collective does not "
+          "error, it hangs the whole world.  Interprocedural: calls that"
+          " transitively reach a collective count, and helper returns "
+          "propagate rank provenance; gathers re-uniformize.")
+def _r16(root, extra=None):
+    idx = build_index(Path(root), extra)
+    findings: List[Tuple[str, int, str]] = []
+    for rel, mod in idx.modules.items():
+        if rel == FACADE_REL:
+            continue
+        for fi in mod.functions:
+            _r16_function(
+                idx, fi,
+                lambda line, detail, _rel=rel: findings.append(
+                    (_rel, line, detail)),
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R17: unbound-collective-axis
+# ---------------------------------------------------------------------------
+
+_AXIS_ARG_OPS = {"psum": 1, "pmean": 1, "all_gather": 1, "ppermute": 1,
+                 "all_to_all": 1, "psum_scatter": 1}
+
+
+def _axis_expr(call: ast.Call) -> Optional[ast.AST]:
+    tail = _tail(call.func)
+    pos = _AXIS_ARG_OPS.get(tail)
+    if pos is None:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _local_binding(fi: FuncInfo, name: str) -> Optional[ast.AST]:
+    """The assignment value bound to ``name`` in ``fi`` or its enclosing
+    (closure) functions, innermost first; None if it is a parameter or
+    free."""
+    for scope in [fi] + list(reversed(fi.enclosing)):
+        own = [n for n in ast.walk(scope.node)
+               if isinstance(n, ast.Assign)]
+        for n in own:
+            if any(isinstance(t, ast.Name) and t.id == name
+                   for t in n.targets):
+                return n.value
+    return None
+
+
+def _param_scope(fi: FuncInfo, name: str) -> Optional[FuncInfo]:
+    for scope in [fi] + list(reversed(fi.enclosing)):
+        if name in scope.params:
+            return scope
+    return None
+
+
+def _resolve_axis(idx: PackageIndex, fi: FuncInfo, expr: ast.AST,
+                  depth: int, seen: Set[str]) -> List[Tuple[str, ...]]:
+    """Possible resolutions of an axis expression: ('config', field) |
+    ('mesh',) | ('literal', value, rel, line) | ('opaque',)."""
+    if depth <= 0:
+        return [("opaque",)]
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [("literal", expr.value, fi.rel, expr.lineno)]
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in ("data_axis", "model_axis"):
+            return [("config", expr.attr)]
+        return [("opaque",)]
+    if isinstance(expr, ast.Subscript):
+        # mesh.axis_names[i] — bound by the mesh that carries it
+        if isinstance(expr.value, ast.Attribute) \
+                and expr.value.attr == "axis_names":
+            return [("mesh",)]
+        return [("opaque",)]
+    if isinstance(expr, ast.Name):
+        bound = _local_binding(fi, expr.id)
+        if bound is not None:
+            return _resolve_axis(idx, fi, bound, depth - 1, seen)
+        pscope = _param_scope(fi, expr.id)
+        if pscope is not None:
+            return _resolve_param(idx, pscope, expr.id, depth - 1, seen)
+        return [("opaque",)]
+    return [("opaque",)]
+
+
+def _resolve_param(idx: PackageIndex, fi: FuncInfo, param: str,
+                   depth: int, seen: Set[str]) -> List[Tuple[str, ...]]:
+    """Resolve a parameter through every package call site of its
+    function (the 'resolved through helper calls' half of R17)."""
+    key = f"{fi.qual}#{param}"
+    if key in seen:
+        return [("opaque",)]
+    seen = seen | {key}
+    a = fi.node.args
+    pos_names = [p.arg for p in a.posonlyargs + a.args]
+    try:
+        pos_idx = pos_names.index(param)
+    except ValueError:
+        pos_idx = None
+    default = None
+    defaults = list(a.defaults)
+    if pos_idx is not None and defaults:
+        first_default = len(pos_names) - len(defaults)
+        if pos_idx >= first_default:
+            default = defaults[pos_idx - first_default]
+    out: List[Tuple[str, ...]] = []
+    for caller, call in idx.calls_by_tail.get(fi.name, []):
+        if fi not in idx.resolve(call, caller.rel):
+            continue
+        arg = None
+        for kw in call.keywords:
+            if kw.arg == param:
+                arg = kw.value
+        if arg is None and pos_idx is not None \
+                and len(call.args) > pos_idx:
+            arg = call.args[pos_idx]
+        if arg is None:
+            arg = default
+        if arg is None:
+            continue
+        out.extend(_resolve_axis(idx, caller, arg, depth, seen))
+    if not out and default is not None:
+        out.extend(_resolve_axis(idx, fi, default, depth, seen))
+    return out or [("opaque",)]
+
+
+@rule("unbound-collective-axis", scope=rf"{PKG}/", kind="dataflow",
+      doc="A collective's axis name must resolve — through enclosing "
+          "scopes and helper-call arguments, package-wide — to a mesh-"
+          "bound token: cfg.data_axis/model_axis, a mesh.axis_names "
+          "element, or a literal some Mesh/PartitionSpec context binds. "
+          "An unbound axis name fails only at trace time on the one "
+          "mesh shape that reaches it — or silently reduces over the "
+          "wrong axis.")
+def _r17(root, extra=None):
+    idx = build_index(Path(root), extra)
+    findings: List[Tuple[str, int, str]] = []
+    for rel, mod in idx.modules.items():
+        if rel == FACADE_REL:
+            continue
+        for fi in mod.functions:
+            for call in fi.own_calls:
+                d = _dotted(call.func)
+                if not (d.startswith(("collective.", "lax.", "jax.lax."))
+                        and _tail(call.func) in _AXIS_ARG_OPS):
+                    continue
+                axis = _axis_expr(call)
+                if axis is None:
+                    continue
+                for res in _resolve_axis(idx, fi, axis, 6, set()):
+                    if res[0] != "literal":
+                        continue
+                    value, src_rel, src_line = res[1], res[2], res[3]
+                    bound = set()
+                    for m in (idx.modules.get(src_rel),
+                              idx.modules.get(rel)):
+                        if m is not None:
+                            bound |= m.bound_axis_literals
+                    if value not in bound:
+                        findings.append((
+                            rel, call.lineno,
+                            f"collective axis name {value!r} (bound at "
+                            f"{src_rel}:{src_line}) is not bound by any "
+                            "enclosing shard_map/mesh context in the "
+                            "resolution chain's modules; use "
+                            "cfg.data_axis/cfg.model_axis (or a "
+                            "mesh.axis_names element) so the axis and "
+                            "the mesh cannot drift apart"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R18: precision-flow
+# ---------------------------------------------------------------------------
+
+_BF16_TOKENS = {"jnp.bfloat16", "jax.numpy.bfloat16", "np.bfloat16",
+                "ml_dtypes.bfloat16"}
+_F32_TOKENS = {"jnp.float32", "jax.numpy.float32", "np.float32",
+               "numpy.float32"}
+_REDUCTIONS = {"sum", "mean", "prod", "nansum", "nanmean", "cumsum",
+               "average", "var", "std"}
+_MATMULISH = {"pdot", "peinsum", "matmul", "dot", "einsum", "tensordot"}
+_ALLOC = {"zeros", "ones", "full", "empty"}
+
+
+def _dtype_token(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    return _dotted(expr)
+
+
+def _is_bf16_dtype(expr: ast.AST) -> bool:
+    return _dtype_token(expr) in _BF16_TOKENS | {"bfloat16"}
+
+
+def _is_f32_dtype(expr: ast.AST) -> bool:
+    return _dtype_token(expr) in _F32_TOKENS | {"float32"}
+
+
+def _astype_to(call: ast.Call, pred) -> bool:
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "astype" and call.args
+            and pred(call.args[0]))
+
+
+@rule("precision-flow", scope=rf"{PKG}/(ops|models|data)/",
+      doc="bf16-tier values must accumulate into f32: no reductions "
+          "(jnp.sum/mean/...) on bf16-cast values without an upcast, no "
+          "f32->bf16->f32 round-trips whose bf16 value feeds no matmul "
+          "(pure mantissa loss), no reduced-dtype accumulator "
+          "allocations.  utils/precision.pdot/peinsum own the bf16 "
+          "matmul path (f32 accumulation via preferred_element_type); "
+          "ops/pallas/ hi/lo-split kernels are exempt.")
+def _r18(ctx):
+    if ctx.rel.startswith(f"{PKG}/ops/pallas/"):
+        return
+    seen: Set[Tuple[int, str]] = set()
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Module)):
+            continue
+        for finding in _r18_scope(fn):
+            if finding not in seen:
+                seen.add(finding)
+                yield finding
+
+
+def _r18_scope(fn):
+        # bf16-tier names assigned in this scope
+        bf16: Set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and _astype_to(n.value, _is_bf16_dtype):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        bf16.add(t.id)
+        consumed_by_matmul: Set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call) and _tail(n.func) in _MATMULISH:
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    for x in ast.walk(a):
+                        if isinstance(x, ast.Name):
+                            consumed_by_matmul.add(x.id)
+            elif isinstance(n, ast.BinOp) and isinstance(n.op, ast.MatMult):
+                for x in ast.walk(n):
+                    if isinstance(x, ast.Name):
+                        consumed_by_matmul.add(x.id)
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            tail = _tail(n.func)
+            # (1) reduced-dtype accumulator allocation
+            if tail in _ALLOC:
+                dt = None
+                for kw in n.keywords:
+                    if kw.arg == "dtype":
+                        dt = kw.value
+                if dt is None and len(n.args) >= 2:
+                    dt = n.args[-1]
+                d = _dotted(n.func)
+                if dt is not None and _is_bf16_dtype(dt) and (
+                        d.startswith("jnp.") or d.startswith("jax.numpy.")):
+                    yield (n.lineno, f"{d} allocates a bfloat16 "
+                           "accumulator; accumulators must stay f32 "
+                           "(the precision-policy contract: bf16 "
+                           "operands, f32 accumulation)")
+                continue
+            # (2) f32->bf16->f32 round-trips
+            if _astype_to(n, _is_f32_dtype):
+                inner = n.func.value
+                if isinstance(inner, ast.Call) \
+                        and _astype_to(inner, _is_bf16_dtype):
+                    yield (n.lineno, "f32->bf16->f32 round-trip: the "
+                           "cast chain discards 16 mantissa bits and "
+                           "buys nothing (no matmul consumes the bf16 "
+                           "value); drop both casts or feed the bf16 "
+                           "value to precision.pdot/peinsum")
+                elif isinstance(inner, ast.Name) and inner.id in bf16 \
+                        and inner.id not in consumed_by_matmul:
+                    yield (n.lineno, f"'{inner.id}' is cast f32->bf16->"
+                           "f32 without feeding any matmul — a pure "
+                           "precision loss; remove the bf16 cast or "
+                           "route the contraction through "
+                           "precision.pdot/peinsum")
+            # (3) reductions on bf16-tier operands
+            d = _dotted(n.func)
+            if tail in _REDUCTIONS and (
+                    d.startswith("jnp.") or d.startswith("jax.numpy.")):
+                for a in n.args[:1]:
+                    sanitized = any(
+                        isinstance(c, ast.Call) and (
+                            _tail(c.func) == "upcast"
+                            or _astype_to(c, _is_f32_dtype))
+                        for c in ast.walk(a))
+                    if sanitized:
+                        continue
+                    hit = None
+                    for x in ast.walk(a):
+                        if isinstance(x, ast.Name) and x.id in bf16:
+                            hit = x.id
+                            break
+                        if isinstance(x, ast.Call) \
+                                and _astype_to(x, _is_bf16_dtype):
+                            hit = "<bf16 cast>"
+                            break
+                    if hit is not None:
+                        yield (n.lineno, f"{d} reduces bf16-tier value "
+                               f"{hit!r} at reduced dtype — summing at "
+                               "bf16 loses whole rows at realistic "
+                               "sizes; wrap the operand in precision."
+                               "upcast (f32 accumulation) like the "
+                               "streamed kernels do")

@@ -52,6 +52,7 @@ from typing import Any, Callable, Iterable, Iterator, Optional
 
 from oap_mllib_tpu.config import get_config
 from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.utils import sanitizers
 from oap_mllib_tpu.utils.faults import maybe_fault
 from oap_mllib_tpu.utils.timing import tick
 
@@ -401,7 +402,37 @@ class Prefetcher:
             self._impl = _Threaded(it, staged, self.depth, self.stats, retire)
 
     def __iter__(self):
-        return iter(self._impl)
+        it = iter(self._impl)
+        # sanitizer plane (utils/sanitizers.py, Config.sanitizers):
+        # "transfer" runs each CONSUMER body under a disallow transfer
+        # guard; "retrace" asserts zero new XLA compiles after the
+        # first chunk.  Off (the default) returns the raw iterator —
+        # two cached string checks per pass, nothing per chunk.
+        guard = sanitizers.enabled("transfer")
+        watch = (
+            sanitizers.RetraceWatch("prefetch")
+            if sanitizers.enabled("retrace") else None
+        )
+        if not guard and watch is None:
+            return it
+        return self._sanitized(it, guard, watch)
+
+    @staticmethod
+    def _sanitized(it, guard: bool, watch):
+        """Yield chunks with the armed sanitizers active in the consumer
+        body: the transfer guard covers exactly the code between yields
+        (the per-chunk step dispatch), and the retrace watch checks the
+        XLA compile count at every chunk boundary past the first."""
+        index = 0
+        for item in it:
+            if guard:
+                with sanitizers.transfer_scope():
+                    yield item
+            else:
+                yield item
+            if watch is not None:
+                watch.chunk_done(index)
+            index += 1
 
     def __enter__(self) -> "Prefetcher":
         return self

@@ -35,6 +35,7 @@ from oap_mllib_tpu.data.stream import ChunkSource
 from oap_mllib_tpu.ops import kmeans_ops
 from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
+from oap_mllib_tpu.utils import sanitizers
 from oap_mllib_tpu.utils.timing import tick
 
 
@@ -187,6 +188,17 @@ def _gather_with_guard(arrays, guard: "_PassGuard | None"):
     if guard is not None:
         flag = np.asarray([0 if guard.err is None else 1], np.int64)
         arrays = [flag] + arrays
+    # collective sanitizer seam: the host-mediated reductions are THE
+    # collectives of every streamed multi-process pass, so their
+    # signature (payload shapes + dtypes) is fingerprinted and
+    # cross-checked across ranks before the gather — a rank arriving
+    # here with a divergent payload raises on every rank instead of
+    # wedging process_allgather (utils/sanitizers.py)
+    sanitizers.note_collective(
+        "process_allgather", "host",
+        tuple(tuple(np.shape(a)) for a in arrays),
+        ",".join(str(getattr(a, "dtype", "?")) for a in arrays),
+    )
     with x64_scope(True):
         gathered = multihost_utils.process_allgather(arrays)
     if guard is not None:
@@ -576,21 +588,25 @@ def init_kmeans_parallel_streamed(
         ) as pf:
             for ci, (chunk, n_valid, wv, cj, _) in enumerate(pf):
                 if cands_dev is not None:
-                    prev = (
-                        jnp.asarray(dmin_chunks[ci])
-                        if rnd > 0
-                        else jnp.full((source.chunk_rows,), np.inf, dtype)
-                    )
                     progcache.note(
                         "kmeans.stream_pll_fold",
                         (progcache.backend_fingerprint(),
                          progcache.array_key(cj, cands_dev)),
                     )
                     # the d2 cache is host-resident by design (device
-                    # chunks retire); the fetch waits on this chunk only
-                    # while the producer stages the next one
-                    # oaplint: disable=stream-host-sync -- host d2 cache is the consume step
-                    h = np.array(_chunk_min_d2(cj, prev, cands_dev))
+                    # chunks retire); staging the previous round's dmin
+                    # up and fetching the fold back are ONE audited
+                    # consume step — allow_transfers is the runtime
+                    # analog of the lint suppression
+                    with sanitizers.allow_transfers():
+                        prev = (
+                            jnp.asarray(dmin_chunks[ci])
+                            if rnd > 0
+                            else jnp.full(
+                                (source.chunk_rows,), np.inf, dtype)
+                        )
+                        # oaplint: disable=stream-host-sync -- host d2 cache is the consume step
+                        h = np.array(_chunk_min_d2(cj, prev, cands_dev))
                     h[n_valid:] = 0.0  # padded rows carry no cost
                     if rnd > 0:
                         dmin_chunks[ci] = h
@@ -654,8 +670,9 @@ def init_kmeans_parallel_streamed(
                 (progcache.backend_fingerprint(),
                  progcache.array_key(cj, cands_dev)),
             )
-            # oaplint: disable=stream-host-sync -- ownership sums accumulate on host by design
-            own += np.asarray(_chunk_ownership(cj, wj, cands_dev))
+            with sanitizers.allow_transfers():  # audited host accumulation
+                # oaplint: disable=stream-host-sync -- ownership sums accumulate on host by design
+                own += np.asarray(_chunk_ownership(cj, wj, cands_dev))
     stats.finalize(timings, "init_centers", elapsed())
     (own,) = _psum_host([own], guard=guard)
     return kmeans_ops._weighted_kmeans_pp(cand_arr, own, k, final_rng)

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from oap_mllib_tpu.config import get_config
 from oap_mllib_tpu.telemetry import metrics as _tm
 from oap_mllib_tpu.telemetry.spans import current_span
+from oap_mllib_tpu.utils import sanitizers
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
@@ -47,12 +48,43 @@ def _shard_map(f, mesh, in_specs, out_specs):
     )
 
 
+def _payload_bytes(x) -> int:
+    """Per-PROCESS payload bytes of one facade operand: the fraction of
+    the global array whose shards live on this process's devices — the
+    bytes this rank actually contributes to the wire.  Booking the full
+    unsharded ``nbytes`` (the pre-ISSUE-7 behavior) over-counted
+    shard_map-inner traffic world-fold: every rank claimed the whole
+    array, so a 2-process world's byte counters summed to 2x the global
+    payload.  Host arrays (no sharding) and single-process worlds book
+    the full size, unchanged."""
+    nbytes = int(getattr(x, "nbytes", 0) or 0)
+    sharding = getattr(x, "sharding", None)
+    if sharding is None or nbytes == 0:
+        return nbytes
+    try:
+        devs = sharding.device_set
+        total = len(devs)
+        pidx = jax.process_index()
+        local = sum(1 for d in devs if d.process_index == pidx)
+        if total:
+            return (nbytes * local) // total
+    except Exception:
+        pass  # exotic shardings fall back to the global size
+    return nbytes
+
+
 def _instrumented(op: str, x: jax.Array, dispatch):
     """Run one facade dispatch with telemetry: invocation count, payload
-    bytes (the GLOBAL array — what crosses the fabric is layout-
-    dependent, so the operand size is the stable, comparable number),
-    and dispatch wall, booked to the registry and the active span."""
-    nbytes = int(getattr(x, "nbytes", 0) or 0)
+    bytes (this process's shard share — see :func:`_payload_bytes`),
+    and dispatch wall, booked to the registry and the active span; with
+    the ``collective`` sanitizer armed, the dispatch signature is also
+    fingerprinted and cross-checked across ranks first
+    (utils/sanitizers.note_collective)."""
+    nbytes = _payload_bytes(x)
+    sanitizers.note_collective(
+        op, get_config().data_axis, getattr(x, "shape", ()),
+        getattr(x, "dtype", ""),
+    )
     t0 = time.perf_counter()
     out = dispatch()
     dt = time.perf_counter() - t0

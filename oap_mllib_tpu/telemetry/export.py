@@ -144,6 +144,14 @@ def finalize_fit(summary) -> None:
     timings = _summary_get(summary, "timings")
     if timings is None or summary is None:
         return
+    # sanitizer fit-boundary hook (utils/sanitizers.py): attach the armed
+    # set + the fit's collective fingerprint, and cross-check the
+    # fingerprint across ranks — the backstop that converts a TAIL
+    # divergence (extra collectives after the last common op) into a
+    # diagnostic at the fit boundary.  One config-string check when off.
+    from oap_mllib_tpu.utils import sanitizers as _san
+
+    _san.finalize_fit_sanitizers(summary)
     root = timings.root
     if root.count == 0:
         root.duration_s = sum(c.duration_s for c in root.children)

@@ -259,6 +259,19 @@ def kernel_tier(name: str, matmul_tier: str) -> str:
     return {"f32": matmul_tier, "tf32": "high", "bf16": "default"}[name]
 
 
+def is_reduced_dtype(dtype) -> bool:
+    """Is ``dtype`` a reduced-precision tier under the policy (bf16/f16)?
+    Shared vocabulary for the collective sanitizer's payload fingerprints
+    (utils/sanitizers.py tags reduced payloads so a cross-rank POLICY
+    divergence — one rank staging bf16 while another stages f32 — shows
+    up in the fingerprint) and for oaplint R18's runtime counterpart."""
+    try:
+        name = str(np.dtype(dtype))
+    except TypeError:
+        name = str(dtype)
+    return name in ("bfloat16", "float16")
+
+
 # -- staging-time casts -------------------------------------------------------
 
 

@@ -1,0 +1,365 @@
+"""Runtime sanitizer plane: witness the SPMD invariants the static pass
+proves (dev/oaplint/dataflow.py), at the moment they would otherwise
+become a hang.
+
+The multi-rank failure mode this framework must never ship is the silent
+one: a collective issued under rank-divergent control flow does not
+error — every rank blocks inside a different (or missing) collective
+until the distributed timeout kills the world, with no diagnostic naming
+the op that diverged.  The static analyzer catches the *reachable*
+divergences (oaplint R16-R18); this module catches the rest at runtime,
+opt-in via ``Config.sanitizers`` (comma-set, default off — the
+sanitizers-off path is one cached string check per seam):
+
+- ``collective`` — every host-level collective dispatch (the eager
+  facade in parallel/collective.py and the host-mediated
+  ``process_allgather`` reductions in ops/stream_ops.py) records an
+  (op, axis, shape, dtype) fingerprint AND cross-checks it against every
+  other rank *before* dispatching.  A rank-divergent collective then
+  raises :class:`CollectiveDivergenceError` on every rank, naming this
+  rank's op and the first differing rank's op — instead of hanging.  The
+  per-fit fingerprint sequence is digested into the fit summary at
+  finalization (telemetry/export.finalize_fit) and cross-checked once
+  more there, so a tail divergence (one rank issuing extra ops after the
+  last common collective) is caught at the fit boundary.
+- ``transfer`` — streamed per-chunk consumer loop bodies run under
+  ``jax.transfer_guard("disallow")`` (data/prefetch.Prefetcher), so an
+  *implicit* device<->host transfer in the hot loop fails loudly — the
+  runtime ground truth behind oaplint R4 (stream-host-sync).  The two
+  audited host-accumulation sites in ops/stream_ops.py (which carry
+  reasoned lint suppressions) run under :func:`allow_transfers`, the
+  runtime analog of the suppression.  Backend caveat: the CPU backend's
+  device buffers alias host memory, so device->host reads never
+  trigger the guard there — CPU legs witness implicit host->device
+  transfers only; TPU witnesses both directions.
+- ``retrace`` — steady-state loops must compile nothing after warmup:
+  the prefetch pipeline asserts zero new XLA backend compiles
+  (utils/progcache.xla_compile_count — the same ground truth the
+  compile gate uses) from the second consumed chunk on, and
+  :func:`steady_state` offers the same assertion as a scope for
+  fit/score loops (dev/sanitizer_gate.py drives it).
+
+The cross-check protocol piggybacks on ``process_allgather`` with a
+FIXED-shape signature frame, so the check itself can never diverge in
+shape: ranks exchange their padded signature bytes, every rank compares
+the full set, and all ranks raise together on mismatch.  The portable
+-collective redistribution work and DrJAX's MapReduce primitives
+(PAPERS.md arXiv:2112.01075, arXiv:2403.07128) both assume exactly the
+invariant being witnessed here — every rank executes the same collective
+sequence over well-formed axes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import threading
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.telemetry import metrics as _tm
+
+VALID = ("collective", "transfer", "retrace")
+
+# fixed signature frame for the cross-check gather: every rank always
+# contributes exactly this many bytes, whatever its op — the check
+# itself is shape-uniform by construction
+_SIG_BYTES = 192
+
+
+class SanitizerError(RuntimeError):
+    """Base class for sanitizer-witnessed invariant violations."""
+
+
+class CollectiveDivergenceError(SanitizerError):
+    """Ranks disagreed on the next collective (op, axis, shape, dtype)."""
+
+
+class RetraceError(SanitizerError):
+    """A steady-state loop compiled a new XLA program after warmup."""
+
+
+# -- Config.sanitizers parsing ------------------------------------------------
+
+_parse_cache: Dict[str, FrozenSet[str]] = {}
+
+
+def enabled_set(cfg=None) -> FrozenSet[str]:
+    """The validated sanitizer set from ``Config.sanitizers`` (env
+    ``OAP_MLLIB_TPU_SANITIZERS``).  A typo'd name raises naming the
+    valid set — the kmeans_kernel/fault_spec contract: a sanitizer
+    config that silently arms nothing defeats the point."""
+    raw = (cfg or get_config()).sanitizers
+    hit = _parse_cache.get(raw)
+    if hit is not None:
+        return hit
+    names = frozenset(n.strip() for n in raw.split(",") if n.strip())
+    unknown = sorted(names - set(VALID))
+    if unknown:
+        raise ValueError(
+            f"Config.sanitizers names unknown sanitizer(s) {unknown}; "
+            f"valid names: {VALID} (comma-separated)"
+        )
+    _parse_cache[raw] = names
+    return names
+
+
+def enabled(name: str) -> bool:
+    """Is one sanitizer armed?  The off path is one config-string read
+    plus a dict hit — cheap enough for per-dispatch seams."""
+    raw = get_config().sanitizers
+    if not raw:
+        return False
+    return name in enabled_set()
+
+
+# -- collective fingerprinting + cross-check ----------------------------------
+
+_lock = threading.Lock()
+_SEQ: List[str] = []  # host-level dispatch signatures, process-lifetime
+_finalized_idx = 0  # start of the current fit's window into _SEQ
+
+
+def _world() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def _signature(op: str, axis: str, shape, dtype) -> str:
+    return f"{op}|{axis}|{tuple(shape)}|{dtype}"
+
+
+def _reduced_tag(dtype) -> str:
+    # tag reduced-precision payloads so a policy divergence (one rank
+    # staging bf16, another f32) shows up in the fingerprint too
+    from oap_mllib_tpu.utils import precision as psn
+
+    return "reduced" if psn.is_reduced_dtype(dtype) else "full"
+
+
+def _gather_frames(frame: bytes) -> List[bytes]:
+    """Exchange one fixed-size signature frame per rank; returns the
+    rank-ordered frames.  The payload shape is identical on every rank
+    whatever its op, so this gather pairs even when the ops diverge —
+    that pairing is what converts the hang into a diagnostic."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros((_SIG_BYTES,), np.uint8)
+    raw = frame[:_SIG_BYTES]
+    buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    return [bytes(gathered[r]).rstrip(b"\x00") for r in range(gathered.shape[0])]
+
+
+def _raise_divergence(kind: str, mine: str, frames: List[bytes]) -> None:
+    import jax
+
+    me = jax.process_index()
+    peers = []
+    first_bad = None
+    for r, f in enumerate(frames):
+        sig = f.decode("utf-8", "replace")
+        peers.append(f"  rank {r}: {sig}")
+        if first_bad is None and sig != mine:
+            first_bad = (r, sig)
+    _tm.counter(
+        "oap_sanitizer_violations_total", {"sanitizer": "collective"},
+        help="Sanitizer-witnessed invariant violations",
+    ).inc()
+    assert first_bad is not None
+    raise CollectiveDivergenceError(
+        f"collective sanitizer: rank-divergent {kind} — rank {me} is "
+        f"dispatching [{mine}] but rank {first_bad[0]} is dispatching "
+        f"[{first_bad[1]}]; every rank must issue the same collective "
+        "sequence (static-world contract, docs/distributed.md).  Full "
+        "world view:\n" + "\n".join(peers)
+    )
+
+
+def note_collective(op: str, axis: str, shape, dtype,
+                    crosscheck: bool = True) -> None:
+    """Record one host-level collective dispatch signature and — in a
+    multi-process world — cross-check it against every rank BEFORE the
+    dispatch.  Called from the eager facade (parallel/collective.py) and
+    the host-mediated reductions (ops/stream_ops.py); no-op unless the
+    ``collective`` sanitizer is armed."""
+    if not enabled("collective"):
+        return
+    sig = _signature(op, axis, shape, f"{dtype}:{_reduced_tag(dtype)}")
+    with _lock:
+        _SEQ.append(sig)
+    _tm.counter(
+        "oap_sanitizer_collective_ops_total",
+        help="Host-level collective dispatches fingerprinted by the "
+             "collective sanitizer",
+    ).inc()
+    if crosscheck and _world() > 1:
+        frames = _gather_frames(b"op:" + sig.encode())
+        mine = "op:" + sig
+        if any(f.decode("utf-8", "replace") != mine for f in frames):
+            _raise_divergence("collective", mine, frames)
+
+
+def fingerprint(since: Optional[int] = None) -> Tuple[int, str]:
+    """(op count, hex digest) of the recorded dispatch sequence from
+    ``since`` (default: the current fit window) to now."""
+    with _lock:
+        start = _finalized_idx if since is None else since
+        window = _SEQ[start:]
+    h = hashlib.sha256()
+    for sig in window:
+        h.update(sig.encode())
+        h.update(b"\x00")
+    return len(window), h.hexdigest()[:16]
+
+
+def finalize_fit_sanitizers(summary) -> None:
+    """Fit-boundary hook (telemetry/export.finalize_fit): attach the
+    armed sanitizer set and the fit's collective fingerprint to the
+    summary, and cross-check the fingerprint across ranks — the backstop
+    that catches a tail divergence (extra ops after the last common
+    collective, which no per-op check could pair).  Advances the fit
+    window so the next fit fingerprints only its own ops."""
+    global _finalized_idx
+    cfg = get_config()
+    if not cfg.sanitizers:
+        return
+    armed = enabled_set(cfg)
+    payload: Dict[str, object] = {"enabled": sorted(armed)}
+    if "collective" in armed:
+        count, digest = fingerprint()
+        with _lock:
+            _finalized_idx = len(_SEQ)
+        checked = False
+        if _world() > 1:
+            frame = f"fit:{count}:{digest}".encode()
+            frames = _gather_frames(frame)
+            mine = frame.decode()
+            if any(f.decode("utf-8", "replace") != mine for f in frames):
+                _raise_divergence(
+                    "fit collective fingerprint (op count:digest)",
+                    mine, frames,
+                )
+            checked = True
+        payload["collective"] = {
+            "ops": count, "fingerprint": digest, "world_checked": checked,
+        }
+    if summary is not None:
+        if isinstance(summary, dict):
+            summary["sanitizers"] = payload
+        else:
+            summary.sanitizers = payload
+
+
+# -- transfer sanitizer --------------------------------------------------------
+
+
+@contextlib.contextmanager
+def transfer_scope():
+    """``jax.transfer_guard("disallow")`` for a streamed consumer loop
+    body — an implicit device<->host transfer inside raises.  Caller
+    guards on :func:`enabled`; this scope always applies."""
+    import jax
+
+    _tm.counter(
+        "oap_sanitizer_transfer_scopes_total",
+        help="Per-chunk consumer bodies guarded by the transfer sanitizer",
+    ).inc()
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+@contextlib.contextmanager
+def allow_transfers():
+    """Audited opt-out inside a guarded loop — the runtime analog of a
+    reasoned ``stream-host-sync`` lint suppression: the two
+    host-accumulation sites in ops/stream_ops.py are *designed* host
+    syncs, so the transfer sanitizer must not convert the audit into a
+    false positive.  No-op when the sanitizer is off."""
+    if not enabled("transfer"):
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard("allow"):
+        yield
+
+
+# -- retrace sanitizer ---------------------------------------------------------
+
+
+def _compile_count() -> int:
+    from oap_mllib_tpu.utils import progcache
+
+    return progcache.xla_compile_count()
+
+
+class RetraceWatch:
+    """Zero-compiles-after-warmup assertion for a chunk loop: arm after
+    the first consumed chunk (its step legitimately pays trace + XLA
+    compile for the pass's program), then every later chunk boundary
+    must see the same XLA backend-compile count."""
+
+    __slots__ = ("label", "_base")
+
+    def __init__(self, label: str):
+        self.label = label
+        self._base: Optional[int] = None
+
+    def chunk_done(self, index: int) -> None:
+        """Called after the consumer finished chunk ``index`` (0-based)."""
+        if index == 0:
+            self._base = _compile_count()
+            return
+        if self._base is None:
+            return
+        now = _compile_count()
+        if now > self._base:
+            _tm.counter(
+                "oap_sanitizer_violations_total", {"sanitizer": "retrace"},
+                help="Sanitizer-witnessed invariant violations",
+            ).inc()
+            raise RetraceError(
+                f"retrace sanitizer: {now - self._base} new XLA backend "
+                f"compile(s) after warmup in steady-state loop "
+                f"'{self.label}' (chunk {index}); steady-state chunks must "
+                "reuse the pass's compiled program (utils/progcache; "
+                "compare dev/compile_gate.py's bucketing contract)"
+            )
+
+
+@contextlib.contextmanager
+def steady_state(label: str):
+    """Assert a scope compiles NOTHING — the serving/refit contract
+    after warmup (progcache + shape bucketing guarantee steady-state
+    fits compile zero XLA programs).  No-op unless the ``retrace``
+    sanitizer is armed; callers run their warmup fits outside the
+    scope."""
+    if not enabled("retrace"):
+        yield
+        return
+    base = _compile_count()
+    yield
+    delta = _compile_count() - base
+    if delta > 0:
+        _tm.counter(
+            "oap_sanitizer_violations_total", {"sanitizer": "retrace"},
+            help="Sanitizer-witnessed invariant violations",
+        ).inc()
+        raise RetraceError(
+            f"retrace sanitizer: {delta} new XLA backend compile(s) "
+            f"inside steady-state scope '{label}'; warm up the exact "
+            "shapes first, or widen shape bucketing "
+            "(Config.shape_bucketing)"
+        )
+
+
+def _reset_for_tests() -> None:
+    """Drop the recorded sequence + fit window (test isolation only)."""
+    global _finalized_idx
+    with _lock:
+        _SEQ.clear()
+    _finalized_idx = 0
+    _parse_cache.clear()

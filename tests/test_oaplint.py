@@ -68,6 +68,31 @@ SEEDED = {
     "line-length": (OPS, "x = '" + "a" * 120 + "'\n"),
     "final-newline": (OPS, "x = 1"),
     "unused-import": (OPS, "import os\nx = 1\n"),
+    # ISSUE 7 dataflow rules (dev/oaplint/dataflow.py): one seeded
+    # violating module per rule, analyzed against the LIVE package index
+    "collective-divergence": (
+        OPS,
+        "import jax\n"
+        "from oap_mllib_tpu.parallel import collective\n\n\n"
+        "def f(x, mesh):\n"
+        "    r = jax.process_index()\n"
+        "    if r == 0:\n"
+        "        x = collective.allreduce_sum(x, mesh)\n"
+        "    return x\n",
+    ),
+    "unbound-collective-axis": (
+        OPS,
+        "from oap_mllib_tpu.parallel import collective\n\n\n"
+        "def f(x):\n"
+        "    return collective.psum(x, 'rows')\n",
+    ),
+    "precision-flow": (
+        OPS,
+        "import jax.numpy as jnp\n\n\n"
+        "def f(x):\n"
+        "    y = x.astype(jnp.bfloat16)\n"
+        "    return jnp.sum(y)\n",
+    ),
 }
 
 
@@ -362,6 +387,207 @@ def test_multi_rule_suppression_comma_list():
         "y = lax.psum(jnp.dot(a, b), 'i')\n"
     )
     assert lint(OPS, text, rules=["raw-matmul", "raw-collective"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R16-R18: the interprocedural dataflow rules (dev/oaplint/dataflow.py)
+# ---------------------------------------------------------------------------
+
+
+def test_r16_interprocedural_reach_and_provenance_chain():
+    """A call that only TRANSITIVELY reaches a collective, under a
+    branch whose condition flows from process_index through a local,
+    is flagged — and the finding prints both chains."""
+    text = (
+        "import jax\n"
+        "from oap_mllib_tpu.ops import stream_ops\n\n\n"
+        "def f(arrays):\n"
+        "    me = jax.process_index()\n"
+        "    lead = me == 0\n"
+        "    if lead:\n"
+        "        return stream_ops._psum_host(arrays)\n"
+        "    return arrays\n"
+    )
+    (f,) = lint(OPS, text, rules=["collective-divergence"])
+    assert "_psum_host" in f.detail
+    assert "process_allgather" in f.detail  # the reach chain
+    assert "process_index" in f.detail  # the provenance chain
+    assert f.line == 9
+
+
+def test_r16_uniformized_condition_is_clean():
+    """A gather re-uniformizes: branching on a process_allgather'd
+    maximum is world-consistent, so a collective under it is fine (the
+    _gathered_triple_chunks shape in ops/als_block_stream.py)."""
+    text = (
+        "import numpy as np\n"
+        "from jax.experimental import multihost_utils\n"
+        "from oap_mllib_tpu.ops import stream_ops\n\n\n"
+        "def f(arrays, n_local):\n"
+        "    n_max = int(np.asarray(multihost_utils.process_allgather(\n"
+        "        np.asarray([n_local]))).max())\n"
+        "    if n_max > 0:\n"
+        "        return stream_ops._psum_host(arrays)\n"
+        "    return arrays\n"
+    )
+    assert lint(OPS, text, rules=["collective-divergence"]) == []
+
+
+def test_r16_rank_divergent_loop_flagged():
+    """Per-rank trip counts diverge too: a collective inside a loop
+    over rank-derived data is the same hang with more steps."""
+    text = (
+        "import jax\n"
+        "from oap_mllib_tpu.parallel import collective\n\n\n"
+        "def f(x, mesh, blocks):\n"
+        "    mine = [b for b in blocks if b % jax.process_count()\n"
+        "            == jax.process_index()]\n"
+        "    for b in mine:\n"
+        "        x = collective.allreduce_sum(x, mesh)\n"
+        "    return x\n"
+    )
+    found = lint(OPS, text, rules=["collective-divergence"])
+    assert [f.line for f in found] == [9]
+
+
+def test_r17_axis_resolved_through_helper_to_config_is_clean():
+    text = (
+        "from oap_mllib_tpu.config import get_config\n"
+        "from oap_mllib_tpu.parallel import collective\n\n\n"
+        "def helper(x, axis):\n"
+        "    return collective.psum(x, axis)\n\n\n"
+        "def entry(x):\n"
+        "    cfg = get_config()\n"
+        "    return helper(x, cfg.data_axis)\n"
+    )
+    assert lint(OPS, text, rules=["unbound-collective-axis"]) == []
+
+
+def test_r17_literal_bound_by_local_shard_map_spec_is_clean():
+    text = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "from oap_mllib_tpu.parallel import collective\n"
+        "from oap_mllib_tpu.utils.jax_compat import shard_map\n\n\n"
+        "def f(x, mesh):\n"
+        "    def inner(blk):\n"
+        "        return collective.psum(blk, 'data')\n\n"
+        "    return shard_map(inner, mesh=mesh, in_specs=(P('data'),),\n"
+        "                     out_specs=P())(x)\n"
+    )
+    assert lint(OPS, text, rules=["unbound-collective-axis"]) == []
+
+
+def test_r17_names_the_unbound_literal_and_its_origin():
+    text = (
+        "from oap_mllib_tpu.parallel import collective\n\n\n"
+        "def helper(x, axis):\n"
+        "    return collective.psum(x, axis)\n\n\n"
+        "def entry(x):\n"
+        "    return helper(x, 'rows')\n"
+    )
+    (f,) = lint(OPS, text, rules=["unbound-collective-axis"])
+    assert "'rows'" in f.detail and f.line == 5
+
+
+def test_r18_upcast_and_matmul_consumers_are_clean():
+    text = (
+        "import jax.numpy as jnp\n"
+        "from oap_mllib_tpu.utils import precision as psn\n\n\n"
+        "def f(x, c):\n"
+        "    y = x.astype(jnp.bfloat16)\n"
+        "    g = psn.pdot(y, c, 'bf16')\n"
+        "    s = jnp.sum(psn.upcast(y))\n"
+        "    return g, s\n"
+    )
+    assert lint(OPS, text, rules=["precision-flow"]) == []
+
+
+def test_r18_roundtrip_and_bf16_accumulator_flagged():
+    text = (
+        "import jax.numpy as jnp\n\n\n"
+        "def f(x):\n"
+        "    acc = jnp.zeros((4,), dtype=jnp.bfloat16)\n"
+        "    z = x.astype(jnp.bfloat16).astype(jnp.float32)\n"
+        "    return acc, z\n"
+    )
+    found = lint(OPS, text, rules=["precision-flow"])
+    assert [f.line for f in found] == [5, 6]
+
+
+def test_r18_pallas_kernels_are_exempt():
+    text = (
+        "import jax.numpy as jnp\n\n\n"
+        "def split(a):\n"
+        "    hi = a.astype(jnp.bfloat16)\n"
+        "    lo = (a - hi.astype(jnp.float32)).astype(jnp.bfloat16)\n"
+        "    return hi, lo\n"
+    )
+    assert lint("oap_mllib_tpu/ops/pallas/fake.py", text,
+                rules=["precision-flow"]) == []
+
+
+# ---------------------------------------------------------------------------
+# unused-suppression detection + the inventory (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_unused_suppression_is_flagged():
+    text = (
+        "import numpy as np\n"
+        "# oaplint: disable=raw-matmul -- stale: the dot moved away\n"
+        "y = np.copy(a)\n"
+    )
+    found = lint(OPS, text)  # all rules: unused detection active
+    assert "unused-suppression" in rules_of(found)
+    (f,) = [f for f in found if f.rule == "unused-suppression"]
+    assert f.line == 2 and "'raw-matmul'" in f.detail
+
+
+def test_used_suppression_is_not_flagged():
+    text = (
+        "import jax.numpy as jnp\n"
+        "y = jnp.dot(a, b)  # oaplint: disable=raw-matmul -- parity probe\n"
+    )
+    assert [f for f in lint(OPS, text)
+            if f.rule == "unused-suppression"] == []
+
+
+def test_subset_rule_runs_skip_unused_detection():
+    """With only some rules active a directive cannot be proven dead."""
+    text = (
+        "import numpy as np\n"
+        "# oaplint: disable=raw-matmul -- audited\n"
+        "y = np.copy(a)\n"
+    )
+    assert lint(OPS, text, rules=["raw-matmul"]) == []
+
+
+def test_directive_inside_string_literal_is_not_a_directive():
+    """Suppression syntax quoted in a docstring or fixture string must
+    neither suppress nor count as an (unused) directive — directives
+    are parsed from real comment tokens only."""
+    text = (
+        'DOC = """example:\n'
+        "    # oaplint: disable=raw-matmul -- why\n"
+        '"""\n'
+        "import jax.numpy as jnp\n"
+        "y = jnp.dot(a, b)\n"
+    )
+    found = lint(OPS, text, rules=["raw-matmul"])
+    assert rules_of(found) == ["raw-matmul"]  # the string did not suppress
+    assert [f for f in lint(OPS, text)
+            if f.rule == "unused-suppression"] == []
+
+
+def test_suppression_inventory_shape_and_usage():
+    findings, _ = oaplint.run(ROOT)
+    inv = oaplint.suppression_inventory(ROOT, findings)
+    assert inv, "the live tree carries audited suppressions"
+    for rec in inv:
+        assert set(rec) == {"path", "line", "target", "rules", "reason",
+                            "used"}
+        assert rec["reason"], f"reasonless directive in inventory: {rec}"
+        assert rec["used"] is True, f"stale directive shipped: {rec}"
 
 
 # ---------------------------------------------------------------------------

@@ -69,18 +69,22 @@ def _skip_if_environment_cannot_spawn(procs, outs):
             )
 
 
-def _launch_world(nproc=2, local_dev=2, timeout=300, worker=_WORKER):
+def _launch_world(nproc=2, local_dev=2, timeout=300, worker=_WORKER,
+                  env_extra=None):
     """Spawn an nproc world and collect (procs, outs, elapsed_sec) —
     the shared plumbing; callers interpret success/failure (the happy
     -path suites demand RESULT lines, the error-injection test demands
     prompt collective failure).  Worlds this environment cannot spawn
-    at all skip the calling test instead of erroring it."""
+    at all skip the calling test instead of erroring it.  ``env_extra``
+    rides into the workers' environment (worker mode switches)."""
     import time
 
     from oap_mllib_tpu.parallel.bootstrap import free_port
 
     coord = f"127.0.0.1:{free_port('127.0.0.1', 4000)}"
     env = _worker_env()
+    if env_extra:
+        env.update(env_extra)
     t0 = time.monotonic()
     procs = [
         subprocess.Popen(
@@ -106,8 +110,10 @@ def _launch_world(nproc=2, local_dev=2, timeout=300, worker=_WORKER):
     return procs, outs, time.monotonic() - t0
 
 
-def _run_world(nproc=2, local_dev=2, timeout=300, worker=_WORKER):
-    procs, outs, _ = _launch_world(nproc, local_dev, timeout, worker)
+def _run_world(nproc=2, local_dev=2, timeout=300, worker=_WORKER,
+               env_extra=None):
+    procs, outs, _ = _launch_world(nproc, local_dev, timeout, worker,
+                                   env_extra)
     results = {}
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}"
@@ -491,3 +497,57 @@ class TestPseudoCluster:
             world_results[0]["streamed_pca_var"]
             == world_results[1]["streamed_pca_var"]
         )
+
+
+_SANITIZER_WORKER = os.path.join(
+    os.path.dirname(__file__), "pseudo_cluster_worker_sanitizer.py"
+)
+
+
+class TestSanitizerPlane:
+    """The runtime sanitizer plane (utils/sanitizers.py) across a REAL
+    2-process world — the configuration it exists for."""
+
+    def test_collective_sanitizer_names_divergence_instead_of_hanging(self):
+        """ISSUE 7 acceptance: rank 0 dispatches allreduce_sum while
+        rank 1 dispatches allgather_rows — without the sanitizer this
+        wedges both ranks inside mismatched collectives until the
+        distributed timeout; with `collective` armed, BOTH ranks must
+        raise a CollectiveDivergenceError naming both ops, promptly
+        (the watchdog is the 120 s world timeout)."""
+        procs, outs, elapsed = _launch_world(
+            nproc=2, local_dev=1, timeout=120, worker=_SANITIZER_WORKER,
+            env_extra={"SANITIZER_WORKER_MODE": "diverge"},
+        )
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"divergence not caught:\n{out}"
+            assert "DIVERGENCE_CAUGHT" in out, out
+        assert elapsed < 100, f"world took {elapsed:.0f}s to diagnose"
+
+    @pytest.fixture(scope="class")
+    def probe_results(self):
+        return _run_world(
+            nproc=2, local_dev=2, worker=_SANITIZER_WORKER,
+            env_extra={"SANITIZER_WORKER_MODE": "probe"},
+        )
+
+    def test_facade_books_per_shard_bytes(self, probe_results):
+        """ISSUE 7 satellite: the facade must book each PROCESS's shard
+        bytes (half the global array here), not the unsharded abstract
+        shape — so the world's byte counters sum to the wire traffic
+        instead of world × payload."""
+        for rank in (0, 1):
+            r = probe_results[rank]
+            assert r["booked_bytes"] == r["global_bytes"] / 2, r
+
+    def test_sanitized_streamed_fit_clean_and_fingerprint_agrees(
+            self, probe_results):
+        """All three sanitizers armed over a streamed multi-process fit:
+        the fit must succeed (no false positives from the transfer/
+        retrace guards), the collective fingerprint must be world-checked
+        and identical across ranks, and the costs must agree exactly."""
+        r0, r1 = probe_results[0], probe_results[1]
+        assert r0["san_ops"] > 0
+        assert r0["san_world_checked"] and r1["san_world_checked"]
+        assert r0["san_fingerprint"] == r1["san_fingerprint"]
+        assert r0["streamed_cost"] == r1["streamed_cost"]

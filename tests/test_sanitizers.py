@@ -1,0 +1,318 @@
+"""Runtime sanitizer plane units (utils/sanitizers.py, ISSUE 7).
+
+The pseudo-cluster suite drives the sanitizers across a REAL 2-process
+world (tests/test_pseudo_cluster.py::TestSanitizerPlane); these units
+cover the single-process mechanics — parsing, the guards, the retrace
+watch, fingerprinting — plus the cross-rank divergence diagnostic with
+the gather stubbed (so the message contract is pinned even on hosts
+that cannot spawn multiprocess worlds)."""
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.utils import sanitizers as san
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sanitizer_state():
+    san._reset_for_tests()
+    yield
+    san._reset_for_tests()
+
+
+class TestConfigSurface:
+    def test_default_off(self):
+        assert san.enabled_set() == frozenset()
+        assert not san.enabled("collective")
+
+    def test_parse_comma_set(self):
+        set_config(sanitizers="collective, retrace")
+        assert san.enabled_set() == {"collective", "retrace"}
+        assert san.enabled("retrace") and not san.enabled("transfer")
+
+    def test_typo_raises_naming_valid_set(self):
+        """The fault_spec/kmeans_kernel contract: a sanitizer config
+        that silently arms nothing defeats the point."""
+        set_config(sanitizers="colective")
+        with pytest.raises(ValueError, match="transfer"):
+            san.enabled("collective")
+
+
+class TestCollectiveFingerprint:
+    def test_off_records_nothing(self):
+        san.note_collective("psum", "data", (4, 4), "float32")
+        assert san.fingerprint() == (0, san.fingerprint()[1])
+
+    def test_sequence_and_fingerprint(self):
+        set_config(sanitizers="collective")
+        san.note_collective("psum", "data", (4, 4), "float32")
+        san.note_collective("all_gather", "data", (8,), "float32")
+        n, digest = san.fingerprint()
+        assert n == 2
+        # deterministic: same sequence -> same digest
+        san._reset_for_tests()
+        san.note_collective("psum", "data", (4, 4), "float32")
+        san.note_collective("all_gather", "data", (8,), "float32")
+        assert san.fingerprint() == (n, digest)
+
+    def test_reduced_dtype_changes_fingerprint(self):
+        """A cross-rank PRECISION-POLICY divergence (one rank staging
+        bf16, another f32) must show in the fingerprint."""
+        set_config(sanitizers="collective")
+        san.note_collective("psum", "data", (4, 4), "float32")
+        _, f32 = san.fingerprint()
+        san._reset_for_tests()
+        san.note_collective("psum", "data", (4, 4), "bfloat16")
+        _, bf16 = san.fingerprint()
+        assert f32 != bf16
+
+    def test_divergence_diagnostic_names_both_ops(self, monkeypatch):
+        """The hang-to-diagnostic conversion: with a peer's frame
+        differing, note_collective must raise naming THIS rank's op and
+        the first differing rank's op (gather stubbed — the real-world
+        pairing is exercised by the pseudo-cluster suite)."""
+        set_config(sanitizers="collective")
+        monkeypatch.setattr(san, "_world", lambda: 2)
+        peer = b"op:allgather_rows|data|(4, 4)|float32:full"
+
+        def fake_gather(frame):
+            return [frame.rstrip(b"\x00"), peer]
+
+        monkeypatch.setattr(san, "_gather_frames", fake_gather)
+        with pytest.raises(san.CollectiveDivergenceError) as ei:
+            san.note_collective("allreduce_sum", "data", (4, 4), "float32")
+        msg = str(ei.value)
+        assert "allreduce_sum" in msg and "allgather_rows" in msg
+        assert "rank 1" in msg
+
+    def test_finalize_attaches_fingerprint_and_advances_window(self):
+        set_config(sanitizers="collective")
+        san.note_collective("psum", "data", (4, 4), "float32")
+        summary = {}
+        san.finalize_fit_sanitizers(summary)
+        assert summary["sanitizers"]["enabled"] == ["collective"]
+        assert summary["sanitizers"]["collective"]["ops"] == 1
+        assert not summary["sanitizers"]["collective"]["world_checked"]
+        # the next fit fingerprints only its own ops
+        summary2 = {}
+        san.finalize_fit_sanitizers(summary2)
+        assert summary2["sanitizers"]["collective"]["ops"] == 0
+
+    def test_finalize_tail_divergence_raises(self, monkeypatch):
+        """The fit-boundary backstop: rank-differing (count, digest)
+        frames at finalization raise instead of silently passing."""
+        set_config(sanitizers="collective")
+        monkeypatch.setattr(san, "_world", lambda: 2)
+        monkeypatch.setattr(
+            san, "_gather_frames",
+            lambda frame: [frame.rstrip(b"\x00"), b"fit:7:deadbeef"],
+        )
+        san.note_collective("psum", "data", (4, 4), "float32",
+                            crosscheck=False)
+        with pytest.raises(san.CollectiveDivergenceError, match="deadbeef"):
+            san.finalize_fit_sanitizers({})
+
+
+class TestTransferSanitizer:
+    def test_guarded_loop_catches_implicit_transfer(self):
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.data.prefetch import Prefetcher
+
+        set_config(sanitizers="transfer")
+        host = np.ones((4, 4), np.float32)
+        dev = [jnp.ones((4, 4)) for _ in range(3)]
+        with pytest.raises(Exception, match="[Dd]isallowed"):
+            with Prefetcher(dev) as pf:
+                for c in pf:
+                    _ = c + host  # implicit host->device of the operand
+
+    def test_off_by_default_loop_is_unguarded(self):
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.data.prefetch import Prefetcher
+
+        host = np.ones((4, 4), np.float32)
+        with Prefetcher([jnp.ones((4, 4))] * 2) as pf:
+            for c in pf:
+                _ = c + host  # fine: sanitizer off
+
+    def test_allow_transfers_escape_hatch(self):
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.data.prefetch import Prefetcher
+
+        set_config(sanitizers="transfer")
+        host = np.ones((4, 4), np.float32)
+        with Prefetcher([jnp.ones((4, 4))] * 2) as pf:
+            for c in pf:
+                with san.allow_transfers():  # the audited-site analog
+                    _ = c + host
+
+    def test_streamed_fit_runs_clean_under_guard(self, rng):
+        """The live streamed paths must be implicit-transfer-free: a
+        full streamed K-Means fit (k-means|| init included — its audited
+        host-sync sites run under allow_transfers) succeeds with the
+        guard armed, and matches the unguarded fit bit-for-bit."""
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = rng.normal(size=(800, 8)).astype(np.float32)
+        base = KMeans(k=4, seed=3, max_iter=4).fit(
+            ChunkSource.from_array(x, chunk_rows=256))
+        set_config(sanitizers="transfer")
+        guarded = KMeans(k=4, seed=3, max_iter=4).fit(
+            ChunkSource.from_array(x, chunk_rows=256))
+        assert guarded.summary.training_cost == base.summary.training_cost
+
+    def test_streamed_pca_and_als_clean_under_guard(self, rng):
+        """Every other streamed route is guard-clean too: the streamed
+        PCA moments and the streamed ALS edge uploads dispatch only
+        staged device buffers inside their chunk loops."""
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.models.als import ALS
+        from oap_mllib_tpu.models.pca import PCA
+
+        set_config(sanitizers="transfer")
+        x = rng.normal(size=(600, 8)).astype(np.float32)
+        PCA(k=3).fit(ChunkSource.from_array(x, chunk_rows=256))
+        u = rng.integers(40, size=900).astype(np.int64)
+        i = rng.integers(30, size=900).astype(np.int64)
+        r = (rng.random(900) * 4 + 1).astype(np.float32)
+        triples = np.stack(
+            [u.astype(np.float64), i.astype(np.float64),
+             r.astype(np.float64)], axis=1)
+        src = ChunkSource.from_array(triples, chunk_rows=256)
+        ALS(rank=3, max_iter=2, seed=3).fit(src)
+
+
+class TestRetraceSanitizer:
+    def test_steady_state_scope_passes_warm(self):
+        import jax
+        import jax.numpy as jnp
+
+        set_config(sanitizers="retrace")
+        f = jax.jit(lambda a: a * 2)
+        f(jnp.ones((3,)))  # warmup outside the scope
+        with san.steady_state("warm"):
+            f(jnp.ones((3,)))
+
+    def test_steady_state_scope_catches_compile(self):
+        import jax
+        import jax.numpy as jnp
+
+        set_config(sanitizers="retrace")
+        f = jax.jit(lambda a: a * 3)
+        f(jnp.ones((3,)))
+        with pytest.raises(san.RetraceError, match="steady-state scope"):
+            with san.steady_state("probe"):
+                f(jnp.ones((7,)))  # new shape -> backend compile
+
+    def test_prefetch_loop_catches_mid_pass_retrace(self):
+        """The per-chunk contract: chunk 0 may compile (warmup), any
+        later chunk that triggers a backend compile is a retrace — the
+        exact bug class PR 6 fixed in parallel/shuffle.py, now witnessed
+        at runtime."""
+        import jax
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.data.prefetch import Prefetcher
+
+        set_config(sanitizers="retrace")
+        f = jax.jit(lambda a: a + 1)
+        chunks = [jnp.ones((4,)), jnp.ones((4,)), jnp.ones((9,))]
+        with pytest.raises(san.RetraceError, match="after warmup"):
+            with Prefetcher(chunks) as pf:
+                for c in pf:
+                    f(c)  # chunk 2's new shape compiles mid-pass
+
+    def test_prefetch_loop_clean_on_stable_shapes(self):
+        import jax
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.data.prefetch import Prefetcher
+
+        set_config(sanitizers="retrace")
+        f = jax.jit(lambda a: a + 2)
+        with Prefetcher([jnp.ones((4,))] * 4) as pf:
+            for c in pf:
+                f(c)
+
+    def test_streamed_fit_is_retrace_free(self, rng):
+        """Steady-state streamed passes reuse one compiled program per
+        pass: the whole fit runs under the retrace sanitizer without a
+        finding."""
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        set_config(sanitizers="retrace")
+        x = rng.normal(size=(800, 8)).astype(np.float32)
+        KMeans(k=4, seed=3, max_iter=4).fit(
+            ChunkSource.from_array(x, chunk_rows=256))
+
+
+class TestPayloadBytes:
+    def test_per_shard_fraction(self):
+        """The facade must book this process's device fraction of the
+        operand (the 2-process half is regression-tested in the
+        pseudo-cluster suite; here the fraction is stubbed)."""
+        from oap_mllib_tpu.parallel.collective import _payload_bytes
+
+        class Dev:
+            def __init__(self, pidx):
+                self.process_index = pidx
+
+        class Sharding:
+            device_set = {Dev(0), Dev(0), Dev(1), Dev(1)}
+
+        class Arr:
+            nbytes = 1024
+            sharding = Sharding()
+
+        import jax
+
+        local = sum(1 for d in Sharding.device_set
+                    if d.process_index == jax.process_index())
+        assert local == 2  # this process "owns" 2 of the 4 stub devices
+        assert _payload_bytes(Arr()) == 1024 * local // 4
+
+    def test_host_array_books_full_size(self):
+        from oap_mllib_tpu.parallel.collective import _payload_bytes
+
+        assert _payload_bytes(np.ones((8, 8), np.float32)) == 256
+
+    def test_single_process_mesh_books_full_size(self):
+        """All 8 virtual devices are local to this one process, so the
+        booked bytes equal the global size — the single-process books
+        are unchanged by the per-shard fix."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from oap_mllib_tpu.parallel.collective import _payload_bytes
+        from oap_mllib_tpu.parallel.mesh import get_mesh
+
+        mesh = get_mesh()
+        x = jax.device_put(
+            jnp.ones((16, 4), jnp.float32),
+            NamedSharding(mesh, P("data", None)),
+        )
+        assert _payload_bytes(x) == x.nbytes
+
+
+class TestOverheadAndSummary:
+    def test_sanitizers_off_is_summary_free(self, rng):
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = rng.normal(size=(256, 6)).astype(np.float32)
+        m = KMeans(k=3, seed=1, init_mode="random", max_iter=2).fit(x)
+        assert not hasattr(m.summary, "sanitizers")
+
+    def test_enabled_set_lands_in_summary(self, rng):
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        set_config(sanitizers="retrace,transfer")
+        x = rng.normal(size=(256, 6)).astype(np.float32)
+        m = KMeans(k=3, seed=1, init_mode="random", max_iter=2).fit(x)
+        assert m.summary.sanitizers["enabled"] == ["retrace", "transfer"]
