@@ -86,6 +86,14 @@ echo "   expected shape per estimator, collective op counters fire on the"
 echo "   pseudo-mesh ALS fit, resilience counters zero (dev/telemetry_gate.py) =="
 python dev/telemetry_gate.py
 
+echo "== checkpoint gate: elastic worlds — interval writes land atomically,"
+echo "   a hard-killed fit resumes bit-identical to the uninterrupted run,"
+echo "   a resharded (8->2 block) restore holds 1e-5 parity, corrupt"
+echo "   manifests fall back (auto) / raise (require), ckpt.write faults"
+echo "   warn + count without killing the fit, and the checkpoint-off path"
+echo "   stays one string check per fit (dev/checkpoint_gate.py) =="
+python dev/checkpoint_gate.py
+
 echo "== sanitizer gate: dataflow analyzer required-clean (R16-R18 + unused-"
 echo "   suppression inventory), one sanitizer-on leg per sanitizer (single-"
 echo "   process + 2-process pseudo-cluster), seeded violations caught, and"

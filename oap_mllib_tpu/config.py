@@ -181,6 +181,31 @@ class Config:
     # seconds: connection attempts retry with backoff until this
     # deadline, then fail with an error naming coordinator/rank/elapsed.
     bootstrap_timeout: float = 60.0
+    # -- elastic worlds: sharded checkpoint/resume (utils/checkpoint.py) -----
+    # Checkpoint directory for iterate-state checkpoints.  Non-empty arms
+    # periodic per-rank sharded checkpoints on every fit path (K-Means
+    # centroids, ALS factor shards, PCA streamed moments, plus the
+    # pass/iteration index and world layout), written atomically
+    # (tmp+rename, manifest last) so a preempted worker can be relaunched
+    # and resume mid-fit — in a DIFFERENT world size if needed (factor
+    # shards are redistributed through a collective resharding pass at
+    # restore).  Multi-process worlds require this to be a filesystem
+    # shared by every rank.  Empty (default) = checkpointing off, zero
+    # overhead (one string check per fit).
+    checkpoint_dir: str = ""
+    # How often to checkpoint, in iterate-loop steps (streamed passes /
+    # ALS iterations; in-memory fits run their compiled loops in
+    # interval-sized segments and checkpoint between segments).  1
+    # (default) = every step.
+    checkpoint_interval: int = 1
+    # Restore policy when checkpoint_dir is armed: "auto" (default)
+    # resumes from a matching checkpoint when one exists and silently
+    # starts fresh otherwise (a corrupt or mismatched checkpoint also
+    # falls back to fresh, with a warning); "require" raises
+    # CheckpointError unless a valid checkpoint was restored (operators
+    # who must never silently recompute); "off" never restores but still
+    # writes (produce checkpoints without consuming them).
+    resume: str = "auto"
     # -- mixed-precision compute policy (utils/precision.py) -----------------
     # Process-wide input/accumulation precision for the matmul-dominated
     # hot paths (K-Means Lloyd distances + centroid sums, PCA

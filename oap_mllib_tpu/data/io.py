@@ -10,14 +10,109 @@ Formats (survey §2.8):
 A fast C++ parser backs these when the native library is built
 (oap_mllib_tpu/native); these NumPy versions are the always-available
 fallback and the correctness oracle.
+
+This module also owns the low-level durable-write/read primitives of the
+checkpoint subsystem (utils/checkpoint.py) and the hardened model
+persistence (models/*.save): atomic JSON manifests and npz shard files
+written tmp+``os.replace`` so a reader NEVER observes a torn file — a
+kill mid-write leaves either the old generation or a stray ``*.tmp``
+that validation ignores.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Optional, Tuple
+import tempfile
+from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+
+# -- atomic manifest/shard primitives (checkpoint + model persistence) --------
+
+
+def atomic_write_json(path: str, payload: dict) -> int:
+    """Durably write ``payload`` as JSON via tmp+``os.replace`` (atomic on
+    POSIX within one filesystem).  Returns bytes written."""
+    data = json.dumps(payload, sort_keys=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".",
+        prefix=os.path.basename(path) + ".", suffix=".tmp",
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return len(data)
+
+
+def read_json(path: str) -> dict:
+    """Read a JSON file written by :func:`atomic_write_json`."""
+    with open(path) as f:
+        return json.load(f)
+
+
+def atomic_save_npz(path: str, arrays: Dict[str, np.ndarray]) -> int:
+    """Durably write an uncompressed ``.npz`` of ``arrays`` via
+    tmp+``os.replace``.  Returns bytes written."""
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".",
+        prefix=os.path.basename(path) + ".", suffix=".tmp",
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        nbytes = os.path.getsize(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return nbytes
+
+
+def load_npz(path: str) -> Dict[str, np.ndarray]:
+    """Load every array of an ``.npz`` shard into host memory (the file
+    handle must not outlive the call — checkpoint GC unlinks old
+    generations while restored state is still in use)."""
+    with np.load(path) as z:
+        return {k: np.asarray(z[k]) for k in z.files}
+
+
+def atomic_save_npy(path: str, array: np.ndarray) -> int:
+    """Durably write one ``.npy`` array via tmp+``os.replace`` (the
+    hardened ``models/*.save`` write primitive)."""
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".",
+        prefix=os.path.basename(path) + ".", suffix=".tmp",
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.save(f, array)
+            f.flush()
+            os.fsync(f.fileno())
+        nbytes = os.path.getsize(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return nbytes
 
 def _force_py() -> bool:
     """Env kill-switch for the native host layer: forces the pure-Python

@@ -28,6 +28,7 @@ import numpy as np
 from oap_mllib_tpu import telemetry
 from oap_mllib_tpu.fallback import als_np
 from oap_mllib_tpu.ops import als_ops
+from oap_mllib_tpu.utils import checkpoint as ckpt_mod
 from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.dispatch import should_accelerate
@@ -272,11 +273,26 @@ class ALSModel:
         )
 
     def save(self, path: str) -> None:
+        """Atomic per-file writes, metadata last (data/io primitives) —
+        the KMeansModel.save torn-write contract.  Sharded fits gather
+        their factors first (a collective in multi-process worlds; the
+        user_factors_ contract above)."""
+        from oap_mllib_tpu.data import io as _io
+
         os.makedirs(path, exist_ok=True)
-        np.save(os.path.join(path, "user_factors.npy"), self.user_factors_)
-        np.save(os.path.join(path, "item_factors.npy"), self.item_factors_)
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump({"type": "ALSModel", "rank": int(self.rank), "version": 1}, f)
+        _io.atomic_save_npy(
+            os.path.join(path, "user_factors.npy"), self.user_factors_
+        )
+        _io.atomic_save_npy(
+            os.path.join(path, "item_factors.npy"), self.item_factors_
+        )
+        _io.atomic_write_json(
+            os.path.join(path, "metadata.json"),
+            {"type": "ALSModel", "rank": int(self.rank),
+             "user_shape": [int(v) for v in self.user_factors_.shape],
+             "item_shape": [int(v) for v in self.item_factors_.shape],
+             "version": 1},
+        )
 
     @classmethod
     def load(cls, path: str) -> "ALSModel":
@@ -284,10 +300,22 @@ class ALSModel:
             meta = json.load(f)
         if meta.get("type") != "ALSModel":
             raise ValueError(f"not an ALSModel directory: {path}")
-        return cls(
-            np.load(os.path.join(path, "user_factors.npy")),
-            np.load(os.path.join(path, "item_factors.npy")),
-        )
+        uf = np.load(os.path.join(path, "user_factors.npy"))
+        itf = np.load(os.path.join(path, "item_factors.npy"))
+        for name, arr in (("user_factors.npy", uf), ("item_factors.npy", itf)):
+            expect = meta.get(
+                name.replace("_factors.npy", "_shape"),
+                [None, meta["rank"]],
+            )
+            if arr.ndim != 2 or int(arr.shape[1]) != int(expect[1]) or (
+                    expect[0] is not None
+                    and int(arr.shape[0]) != int(expect[0])):
+                raise ValueError(
+                    f"{os.path.join(path, name)}: factors have shape "
+                    f"{tuple(arr.shape)}, metadata expects {tuple(expect)} "
+                    "— the model directory is torn or mixed from two saves"
+                )
+        return cls(uf, itf)
 
 
 def _grouped_ok_single(kernel: str, users, items, n_users: int,
@@ -522,6 +550,45 @@ class ALS:
              **self._block_summary(1)},
         )
 
+    def _ckpt_signature(self, n_users: int, n_items: int) -> dict:
+        """Checkpoint identity (utils/checkpoint.py): the solver params
+        and id-space shape.  World size, block layout, kernel choice,
+        chunking, and precision policy are deliberately absent — every
+        one of them may change across a preemption and the factor
+        iterates remain valid state."""
+        return {
+            "rank": self.rank, "implicit": bool(self.implicit_prefs),
+            "reg": float(self.reg_param), "alpha": float(self.alpha),
+            "seed": int(self.seed), "n_users": int(n_users),
+            "n_items": int(n_items),
+        }
+
+    def _run_segmented(self, ckpt, x0, y0, run_iters, n_users, n_items):
+        """Checkpoint-armed in-memory ALS: run the compiled scan in
+        ``checkpoint_interval``-sized segments with a full-factor
+        checkpoint between them.  The scan body is a pure function per
+        iteration, so segmentation is bit-identical to the single
+        compiled loop; ``run_iters(x, y, iters)`` runs one segment."""
+        resume = ckpt.restore()
+        done = 0
+        x, y = x0, y0
+        if resume.found:
+            # either storage form — a block world's sharded checkpoint
+            # restores onto this single-device fit too
+            x = ckpt_mod.factors_from_result(resume, "x", n_users)
+            y = ckpt_mod.factors_from_result(resume, "y", n_items)
+            done = min(int(resume.step), self.max_iter)
+            if "x" not in resume.arrays:
+                ckpt.mark_resharded()  # sharded state -> one device
+        while done < self.max_iter:
+            seg = min(ckpt.interval, self.max_iter - done)
+            x, y = run_iters(x, y, seg)
+            done += seg
+            ckpt.maybe_write(
+                done, {"x": np.asarray(x), "y": np.asarray(y)}, force=True,
+            )
+        return x, y
+
     def _fit_single_device(self, users, items, ratings, n_users, n_items,
                            x0, y0, degraded: bool = False) -> ALSModel:
         """The single-device accelerated fit (grouped or COO layouts).
@@ -581,6 +648,9 @@ class ALS:
                 valid = jnp.asarray(np.pad(np.ones(nnz, np.float32), (0, pad)))
         from oap_mllib_tpu.utils.profiling import maybe_trace
 
+        ckpt = ckpt_mod.maybe_open(
+            "als", self._ckpt_signature(n_users, n_items), timings=timings
+        )
         with phase_timer(timings, "als_iterations"), maybe_trace():
             if grouped_ok and degraded:
                 from oap_mllib_tpu.ops import als_stream
@@ -589,27 +659,51 @@ class ALS:
                     by_user, by_item, x0, y0, n_users, n_items,
                     self.max_iter, self.reg_param, self.alpha,
                     self.implicit_prefs, timings=timings, degraded=True,
-                    policy=pol.name,
+                    policy=pol.name, checkpoint=ckpt,
                 )
             elif grouped_ok:
-                x, y = als_ops.als_run_grouped(
-                    *dev, jnp.asarray(x0), jnp.asarray(y0),
-                    n_users, n_items, self.max_iter, self.reg_param,
-                    self.alpha, self.implicit_prefs, timings=timings,
-                    policy=pol.name,
-                )
+                def run_iters(xa, ya, iters):
+                    return als_ops.als_run_grouped(
+                        *dev, jnp.asarray(xa), jnp.asarray(ya),
+                        n_users, n_items, iters, self.reg_param,
+                        self.alpha, self.implicit_prefs, timings=timings,
+                        policy=pol.name,
+                    )
+
+                if ckpt is None:
+                    x, y = run_iters(x0, y0, self.max_iter)
+                else:
+                    x, y = self._run_segmented(
+                        ckpt, x0, y0, run_iters, n_users, n_items
+                    )
             elif self.implicit_prefs:
-                x, y = als_ops.als_implicit_run(
-                    u, i, c, valid, jnp.asarray(x0), jnp.asarray(y0),
-                    n_users, n_items, self.max_iter, self.reg_param,
-                    self.alpha, timings=timings, policy=pol.name,
-                )
+                def run_iters(xa, ya, iters):
+                    return als_ops.als_implicit_run(
+                        u, i, c, valid, jnp.asarray(xa), jnp.asarray(ya),
+                        n_users, n_items, iters, self.reg_param,
+                        self.alpha, timings=timings, policy=pol.name,
+                    )
+
+                if ckpt is None:
+                    x, y = run_iters(x0, y0, self.max_iter)
+                else:
+                    x, y = self._run_segmented(
+                        ckpt, x0, y0, run_iters, n_users, n_items
+                    )
             else:
-                x, y = als_ops.als_explicit_run(
-                    u, i, c, valid, jnp.asarray(x0), jnp.asarray(y0),
-                    n_users, n_items, self.max_iter, self.reg_param,
-                    timings=timings, policy=pol.name,
-                )
+                def run_iters(xa, ya, iters):
+                    return als_ops.als_explicit_run(
+                        u, i, c, valid, jnp.asarray(xa), jnp.asarray(ya),
+                        n_users, n_items, iters, self.reg_param,
+                        timings=timings, policy=pol.name,
+                    )
+
+                if ckpt is None:
+                    x, y = run_iters(x0, y0, self.max_iter)
+                else:
+                    x, y = self._run_segmented(
+                        ckpt, x0, y0, run_iters, n_users, n_items
+                    )
             x = np.asarray(x)
             y = np.asarray(y)
         summary = {
@@ -622,6 +716,8 @@ class ALS:
         if degraded and grouped_ok:
             summary["streamed"] = True  # the OOM rung ran the streamed kernels
         psn.record(summary, timings, pol)
+        if ckpt is not None:
+            ckpt.record(summary)
         return ALSModel(x, y, summary)
 
     @staticmethod
@@ -800,12 +896,16 @@ class ALS:
                 )
             from oap_mllib_tpu.utils.profiling import maybe_trace
 
+            ckpt = ckpt_mod.maybe_open(
+                "als", self._ckpt_signature(n_users, n_items),
+                timings=timings,
+            )
             with phase_timer(timings, "als_iterations"), maybe_trace():
                 x, y = als_stream.als_run_streamed(
                     by_user, by_item, x0, y0, n_users, n_items,
                     self.max_iter, self.reg_param, self.alpha,
                     self.implicit_prefs, timings=timings,
-                    degraded=degraded, policy=pol.name,
+                    degraded=degraded, policy=pol.name, checkpoint=ckpt,
                 )
             summary = {
                 "timings": timings, "accelerated": True, "streamed": True,
@@ -814,6 +914,8 @@ class ALS:
                 **self._block_summary(1),
             }
             psn.record(summary, timings, pol)
+            if ckpt is not None:
+                ckpt.record(summary)
             return ALSModel(x, y, summary)
 
         model = resilience.resilient_fit(
@@ -937,11 +1039,14 @@ class ALS:
                 )
         from oap_mllib_tpu.utils.profiling import maybe_trace
 
+        ckpt = ckpt_mod.maybe_open(
+            "als", self._ckpt_signature(n_users, n_items), timings=timings
+        )
         with phase_timer(timings, "als_iterations"), maybe_trace():
             x_blocks, y = als_block_stream.als_block_run_streamed(
                 lay, x0_dev, y0_dev, self.max_iter, self.reg_param,
                 self.alpha, mesh, implicit=self.implicit_prefs,
-                timings=timings, policy=pol.name,
+                timings=timings, policy=pol.name, checkpoint=ckpt,
             )
             # oaplint: disable=stream-host-sync -- end-of-fit barrier so
             jax.block_until_ready((x_blocks, y))  # phase_timer sees walls
@@ -954,6 +1059,8 @@ class ALS:
             **self._block_summary(world),
         }
         psn.record(summary, timings, pol)
+        if ckpt is not None:
+            ckpt.record(summary)
         if item_sharded:
             return ALSModel(
                 None, None, summary,
@@ -964,6 +1071,68 @@ class ALS:
             None, np.asarray(y), summary,
             sharded_user=(x_blocks, np.asarray(lay.offsets_u), lay.upb),
         )
+
+    def _run_block_segmented(self, ckpt, run_iters, x0_dev, y0_dev, mesh,
+                             offsets, upb, ioffsets, ipb, item_sharded):
+        """Checkpoint-armed block-parallel ALS (in-memory runners): the
+        compiled runners execute in ``checkpoint_interval``-sized
+        segments; between segments every rank writes ITS blocks' valid
+        factor rows (global ids + values), and restore re-buckets
+        whatever shards the relaunched world read onto the LIVE block
+        layout through the collective resharding pass
+        (parallel/shuffle.reshard_factor_rows) — the full table never
+        materializes on one host.  ``run_iters(x, y, iters)`` runs one
+        segment on device arrays in the runner's block forms."""
+        from oap_mllib_tpu.parallel.shuffle import reshard_factor_rows
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        layout = {
+            "offsets_u": [int(v) for v in offsets],
+            "upb": int(upb),
+            "item_sharded": bool(item_sharded),
+        }
+        if item_sharded:
+            layout["offsets_i"] = [int(v) for v in ioffsets]
+            layout["ipb"] = int(ipb)
+        resume = ckpt.restore()
+        done = 0
+        x, y = x0_dev, y0_dev
+        if resume.found:
+            done = min(int(resume.step), self.max_iter)
+            nproc, rank = jax.process_count(), jax.process_index()
+            ids_u, vals_u = ckpt_mod.sharded_rows_from_result(
+                resume, "x", nproc, rank
+            )
+            x = reshard_factor_rows(ids_u, vals_u, mesh, offsets, upb)
+            if item_sharded:
+                ids_i, vals_i = ckpt_mod.sharded_rows_from_result(
+                    resume, "y", nproc, rank
+                )
+                y = reshard_factor_rows(ids_i, vals_i, mesh, ioffsets, ipb)
+            else:
+                y_host = ckpt_mod.replicated_from_result(
+                    resume, "y", int(y0_dev.shape[0]),
+                )
+                y = jax.make_array_from_callback(
+                    y_host.shape, NamedSharding(mesh, P()),
+                    lambda idx: y_host[idx],
+                )
+            if resume.layout != layout:
+                ckpt.mark_resharded()
+        while done < self.max_iter:
+            seg = min(ckpt.interval, self.max_iter - done)
+            x, y = run_iters(x, y, seg)
+            done += seg
+            sharded = {"x": ckpt_mod.local_factor_rows(x, offsets, upb)}
+            arrays = {}
+            if item_sharded:
+                sharded["y"] = ckpt_mod.local_factor_rows(y, ioffsets, ipb)
+            else:
+                arrays["y"] = np.asarray(y)
+            ckpt.maybe_write(
+                done, arrays, sharded=sharded, layout=layout, force=True,
+            )
+        return x, y
 
     def _block_summary(self, effective_user_blocks: int) -> dict:
         """Requested vs effective block layout for the fit summary."""
@@ -1050,32 +1219,50 @@ class ALS:
                 )
         from oap_mllib_tpu.utils.profiling import maybe_trace
 
+        ckpt = ckpt_mod.maybe_open(
+            "als", self._ckpt_signature(n_users, n_items), timings=timings
+        )
         with phase_timer(timings, "als_iterations"), maybe_trace():
             if item_sharded:
                 if grouped is not None:
-                    x_blocks, y = als_block.als_block_run_grouped_2d(
-                        grouped, x0_dev, y0_dev,
-                        self.max_iter, self.reg_param, self.alpha, mesh,
-                        implicit=self.implicit_prefs, policy=pol.name,
-                    )
+                    def run_iters(xa, ya, iters):
+                        return als_block.als_block_run_grouped_2d(
+                            grouped, xa, ya,
+                            iters, self.reg_param, self.alpha, mesh,
+                            implicit=self.implicit_prefs, policy=pol.name,
+                        )
                 else:
-                    x_blocks, y = als_block.als_block_run_2d(
-                        u_loc, i_glob, conf, valid, *item_shuffle,
-                        x0_dev, y0_dev,
-                        self.max_iter, self.reg_param, self.alpha, mesh,
+                    def run_iters(xa, ya, iters):
+                        return als_block.als_block_run_2d(
+                            u_loc, i_glob, conf, valid, *item_shuffle,
+                            xa, ya,
+                            iters, self.reg_param, self.alpha, mesh,
+                            implicit=self.implicit_prefs, policy=pol.name,
+                        )
+            elif grouped is not None:
+                def run_iters(xa, ya, iters):
+                    return als_block.als_block_run_grouped(
+                        grouped, xa, ya,
+                        iters, self.reg_param, self.alpha, mesh,
                         implicit=self.implicit_prefs, policy=pol.name,
                     )
-            elif grouped is not None:
-                x_blocks, y = als_block.als_block_run_grouped(
-                    grouped, x0_dev, y0_dev,
-                    self.max_iter, self.reg_param, self.alpha, mesh,
-                    implicit=self.implicit_prefs, policy=pol.name,
-                )
             else:
-                x_blocks, y = als_block.als_block_run(
-                    u_loc, i_glob, conf, valid, x0_dev, y0_dev,
-                    self.max_iter, self.reg_param, self.alpha, mesh,
-                    implicit=self.implicit_prefs, policy=pol.name,
+                def run_iters(xa, ya, iters):
+                    return als_block.als_block_run(
+                        u_loc, i_glob, conf, valid, xa, ya,
+                        iters, self.reg_param, self.alpha, mesh,
+                        implicit=self.implicit_prefs, policy=pol.name,
+                    )
+
+            if ckpt is None:
+                x_blocks, y = run_iters(x0_dev, y0_dev, self.max_iter)
+            else:
+                x_blocks, y = self._run_block_segmented(
+                    ckpt, run_iters, x0_dev, y0_dev, mesh,
+                    offsets, upb,
+                    ioffsets if item_sharded else None,
+                    ipb if item_sharded else 0,
+                    item_sharded,
                 )
             # oaplint: disable=stream-host-sync -- end-of-fit barrier so
             jax.block_until_ready((x_blocks, y))  # phase_timer sees walls
@@ -1091,6 +1278,8 @@ class ALS:
             **self._block_summary(world),
         }
         psn.record(summary, timings, pol)
+        if ckpt is not None:
+            ckpt.record(summary)
         if item_sharded:
             return ALSModel(
                 None, None, summary,

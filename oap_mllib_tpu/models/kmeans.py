@@ -29,6 +29,7 @@ from oap_mllib_tpu.data.table import DenseTable
 from oap_mllib_tpu.fallback.kmeans_np import lloyd_np, predict_np
 from oap_mllib_tpu.ops import kmeans_ops
 from oap_mllib_tpu.parallel.mesh import get_mesh
+from oap_mllib_tpu.utils import checkpoint as ckpt_mod
 from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.dispatch import should_accelerate
@@ -179,12 +180,24 @@ class KMeansModel:
 
     # -- persistence (~ Spark ML read/write, tested in IntelKMeansSuite) -----
     def save(self, path: str) -> None:
+        """Atomic write (tmp+``os.replace`` per file, metadata last —
+        data/io primitives): a kill mid-save leaves either the previous
+        model or arrays the metadata does not reference yet, never a
+        torn file the next load would misread."""
+        from oap_mllib_tpu.data import io as _io
+
         os.makedirs(path, exist_ok=True)
-        np.save(os.path.join(path, "centers.npy"), self.cluster_centers_)
-        meta = {"type": "KMeansModel", "distance_measure": self.distance_measure,
-                "k": int(self.k), "version": 1}
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(meta, f)
+        _io.atomic_save_npy(
+            os.path.join(path, "centers.npy"), self.cluster_centers_
+        )
+        _io.atomic_write_json(
+            os.path.join(path, "metadata.json"),
+            {"type": "KMeansModel",
+             "distance_measure": self.distance_measure,
+             "k": int(self.k),
+             "shape": [int(v) for v in self.cluster_centers_.shape],
+             "version": 1},
+        )
 
     @classmethod
     def load(cls, path: str) -> "KMeansModel":
@@ -192,7 +205,17 @@ class KMeansModel:
             meta = json.load(f)
         if meta.get("type") != "KMeansModel":
             raise ValueError(f"not a KMeansModel directory: {path}")
-        centers = np.load(os.path.join(path, "centers.npy"))
+        cpath = os.path.join(path, "centers.npy")
+        centers = np.load(cpath)
+        expect = meta.get("shape", [meta["k"], None])
+        if centers.ndim != 2 or int(centers.shape[0]) != int(expect[0]) or (
+                expect[1] is not None
+                and int(centers.shape[1]) != int(expect[1])):
+            raise ValueError(
+                f"{cpath}: centers have shape {tuple(centers.shape)}, "
+                f"metadata expects {tuple(expect)} — the model directory "
+                "is torn or mixed from two saves"
+            )
         return cls(centers, meta["distance_measure"])
 
 
@@ -362,6 +385,19 @@ class KMeans:
         telemetry.finalize_fit(model.summary)
         return model
 
+    def _ckpt_signature(self, d: int, cfg) -> dict:
+        """Checkpoint identity (utils/checkpoint.py): the parameters that
+        define WHICH optimization the iterate state belongs to.  World
+        size, chunk geometry, and the precision policy are deliberately
+        absent — all three may legitimately change across a preemption
+        (that is the elastic-worlds point)."""
+        return {
+            "k": self.k, "d": int(d), "init_mode": self.init_mode,
+            "init_steps": self.init_steps, "seed": int(self.seed),
+            "tol": float(self.tol), "distance": self.distance_measure,
+            "x64": bool(cfg.enable_x64),
+        }
+
     def _fit_stream_inner(self, source, sample_weight, dtype, cfg) -> KMeansModel:
         from oap_mllib_tpu.ops import stream_ops
 
@@ -378,8 +414,18 @@ class KMeans:
         )
         timings = Timings("kmeans.fit")
         cache_before = progcache.stats()
+        ckpt = ckpt_mod.maybe_open(
+            "kmeans", self._ckpt_signature(source.n_features, cfg),
+            timings=timings,
+        )
+        resume = ckpt.restore() if ckpt is not None else None
         with phase_timer(timings, "init_centers"):
-            if self.init_mode == INIT_RANDOM:
+            if resume is not None and resume.found:
+                # the restored centroids ARE the iterate: the init passes
+                # (reservoir / k-means||) are part of the work a resumed
+                # fit does not redo
+                centers0 = np.asarray(resume.arrays["centers"], dtype)
+            elif self.init_mode == INIT_RANDOM:
                 centers0 = stream_ops.reservoir_sample(
                     source, self.k, self.seed, timings=timings
                 )
@@ -393,7 +439,8 @@ class KMeans:
             centers, n_iter, cost, counts = stream_ops.lloyd_run_streamed(
                 source, centers0, self.max_iter, self.tol, dtype,
                 tier, weights=sample_weight, validated=True,
-                timings=timings, policy=pol.name,
+                timings=timings, policy=pol.name, checkpoint=ckpt,
+                resume=resume,
             )
         summary = KMeansSummary(
             float(cost), int(n_iter), timings, accelerated=True,
@@ -402,6 +449,8 @@ class KMeans:
         summary.streamed = True
         summary.progcache = progcache.delta(cache_before)
         psn.record(summary, timings, pol)
+        if ckpt is not None:
+            ckpt.record(summary)
         return KMeansModel(np.asarray(centers), self.distance_measure, summary)
 
     # -- accelerated path (~ KMeansDALImpl.train, KMeansDALImpl.scala:35) ----
@@ -446,8 +495,20 @@ class KMeans:
                 # collective path: multi-host shards pad per process, so the
                 # weights must be stitched with the mask's exact layout
                 weights = table.align_weights(sample_weight, mesh)
+        ckpt = ckpt_mod.maybe_open(
+            "kmeans", self._ckpt_signature(d_orig, cfg), timings=timings
+        )
+        resume = ckpt.restore() if ckpt is not None else None
         with phase_timer(timings, "init_centers"):
-            if self.init_mode == INIT_RANDOM:
+            if resume is not None and resume.found:
+                # restored centroids are stored at d_orig; re-pad the
+                # feature axis to whatever the CURRENT mesh needs (the
+                # model-parallel degree may have changed with the world)
+                c = np.asarray(resume.arrays["centers"], dtype)
+                centers0 = np.pad(
+                    c, ((0, 0), (0, x.shape[1] - d_orig))
+                )
+            elif self.init_mode == INIT_RANDOM:
                 centers0 = kmeans_ops.init_random(
                     table.data, table.n_rows, self.k, self.seed,
                     index_map=table.valid_to_padded,
@@ -460,7 +521,8 @@ class KMeans:
         with phase_timer(timings, "lloyd_loop"):
             centers, n_iter, cost, counts = self._run_lloyd(
                 table, weights, centers0, dtype, cfg, mesh, timings,
-                degraded=degraded, pol=pol,
+                degraded=degraded, pol=pol, ckpt=ckpt, resume=resume,
+                d_orig=d_orig,
             )
             centers = np.asarray(centers)[:, :d_orig]
             n_iter = int(n_iter)
@@ -471,10 +533,13 @@ class KMeans:
         )
         summary.progcache = progcache.delta(cache_before)
         psn.record(summary, timings, pol)
+        if ckpt is not None:
+            ckpt.record(summary)
         return KMeansModel(centers, self.distance_measure, summary)
 
     def _run_lloyd(self, table, weights, centers0, dtype, cfg, mesh,
-                   timings=None, degraded=False, pol=None):
+                   timings=None, degraded=False, pol=None, ckpt=None,
+                   resume=None, d_orig=None):
         """Dispatch the hot loop to the configured kernel.
 
         ``auto`` picks the fastest measured path for the shape/tier
@@ -511,19 +576,32 @@ class KMeans:
             # what OOMed) onto the chunked XLA Lloyd at doubled chunk
             # count — half the live distance buffer per step
             use_pallas = False
+        if ckpt is not None:
+            # checkpointing segments the loop between compiled calls; the
+            # fused whole-fit Pallas kernel has no segment boundary to
+            # checkpoint at, so route onto the chunked XLA Lloyd
+            # (docs/distributed.md "Elastic worlds")
+            use_pallas = False
         if mesh.shape[cfg.model_axis] > 1 and cfg.kmeans_kernel != "xla":
-            return kmeans_ops.lloyd_run_model_sharded(
-                table.data,
-                weights,
-                centers0,
-                self.max_iter,
-                jnp.asarray(self.tol, dtype),
-                mesh,
-                cfg.data_axis,
-                cfg.model_axis,
-                precision=tier,
-                timings=timings,
-                policy=pol.name,
+            def run_iters(c0, iters):
+                return kmeans_ops.lloyd_run_model_sharded(
+                    table.data,
+                    weights,
+                    c0,
+                    iters,
+                    jnp.asarray(self.tol, dtype),
+                    mesh,
+                    cfg.data_axis,
+                    cfg.model_axis,
+                    precision=tier,
+                    timings=timings,
+                    policy=pol.name,
+                )
+
+            if ckpt is None:
+                return run_iters(centers0, self.max_iter)
+            return self._run_lloyd_segmented(
+                run_iters, centers0, ckpt, resume, d_orig
             )
         single_device = len(jax.devices()) == 1 and jax.process_count() == 1
         if use_pallas:
@@ -554,17 +632,60 @@ class KMeans:
             # auto_row_chunks returns a chunk COUNT — doubling it halves
             # the rows (and the live (chunk, k) buffer) per scan step
             row_chunks = min(row_chunks * 2, max(table.n_padded, 1))
-        return kmeans_ops.lloyd_run(
-            table.data,
-            weights,
-            jnp.asarray(centers0),
-            self.max_iter,
-            jnp.asarray(self.tol, dtype),
-            row_chunks=row_chunks,
-            precision=tier,
-            timings=timings,
-            policy=pol.name,
+
+        def run_iters(c0, iters):
+            return kmeans_ops.lloyd_run(
+                table.data,
+                weights,
+                jnp.asarray(c0),
+                iters,
+                jnp.asarray(self.tol, dtype),
+                row_chunks=row_chunks,
+                precision=tier,
+                timings=timings,
+                policy=pol.name,
+            )
+
+        if ckpt is None:
+            return run_iters(centers0, self.max_iter)
+        return self._run_lloyd_segmented(
+            run_iters, centers0, ckpt, resume, d_orig
         )
+
+    def _run_lloyd_segmented(self, run_iters, centers0, ckpt, resume,
+                             d_orig):
+        """Checkpoint-armed in-memory Lloyd: run the compiled loop in
+        ``checkpoint_interval``-sized segments and checkpoint the
+        centroids + completed-iteration count between them.  The centroid
+        SEQUENCE is identical to the unsegmented loop (each iteration is
+        a pure function of the previous centers); the one observable
+        divergence is a fit that converges exactly on a segment boundary
+        running one extra (sub-tol) iteration — a resumed fit replays
+        the same segment schedule, so kill-and-resume stays bit-identical
+        against an uninterrupted checkpoint-armed run."""
+        done = 0
+        converged = False
+        if resume is not None and resume.found:
+            done = min(int(resume.step), self.max_iter)
+            converged = bool(resume.extra.get("converged", False))
+        centers = centers0
+        ran_segment = False
+        while done < self.max_iter and not converged:
+            seg = min(ckpt.interval, self.max_iter - done)
+            centers, n_it, cost, counts = run_iters(centers, seg)
+            ran_segment = True
+            done += int(n_it)
+            converged = int(n_it) < seg
+            ckpt.maybe_write(
+                done,
+                {"centers": ckpt_mod.fetch_replicated(centers)[:, :d_orig]},
+                extra={"converged": converged}, force=True,
+            )
+        if not ran_segment:
+            # fully restored (converged or out of budget): one
+            # zero-iteration call computes cost/counts for the summary
+            centers, _, cost, counts = run_iters(centers, 0)
+        return centers, done, cost, counts
 
     # -- fallback path (~ trainWithML, KMeans.scala:355) ---------------------
     def _fit_fallback(self, x: np.ndarray, sample_weight: Optional[np.ndarray]) -> KMeansModel:

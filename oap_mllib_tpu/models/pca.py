@@ -27,6 +27,7 @@ from oap_mllib_tpu.data.table import DenseTable
 from oap_mllib_tpu.fallback.pca_np import pca_np
 from oap_mllib_tpu.ops import pca_ops
 from oap_mllib_tpu.parallel.mesh import get_mesh
+from oap_mllib_tpu.utils import checkpoint as ckpt_mod
 from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.dispatch import MAX_PCA_FEATURES, should_accelerate
@@ -60,11 +61,24 @@ class PCAModel:
         return np.asarray(pca_ops.project(jnp.asarray(x), jnp.asarray(self.components_)))
 
     def save(self, path: str) -> None:
+        """Atomic per-file writes, metadata last (data/io primitives) —
+        the KMeansModel.save torn-write contract."""
+        from oap_mllib_tpu.data import io as _io
+
         os.makedirs(path, exist_ok=True)
-        np.save(os.path.join(path, "components.npy"), self.components_)
-        np.save(os.path.join(path, "explained_variance.npy"), self.explained_variance_)
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump({"type": "PCAModel", "k": int(self.k), "version": 1}, f)
+        _io.atomic_save_npy(
+            os.path.join(path, "components.npy"), self.components_
+        )
+        _io.atomic_save_npy(
+            os.path.join(path, "explained_variance.npy"),
+            self.explained_variance_,
+        )
+        _io.atomic_write_json(
+            os.path.join(path, "metadata.json"),
+            {"type": "PCAModel", "k": int(self.k),
+             "shape": [int(v) for v in self.components_.shape],
+             "version": 1},
+        )
 
     @classmethod
     def load(cls, path: str) -> "PCAModel":
@@ -72,10 +86,26 @@ class PCAModel:
             meta = json.load(f)
         if meta.get("type") != "PCAModel":
             raise ValueError(f"not a PCAModel directory: {path}")
-        return cls(
-            np.load(os.path.join(path, "components.npy")),
-            np.load(os.path.join(path, "explained_variance.npy")),
-        )
+        cpath = os.path.join(path, "components.npy")
+        comps = np.load(cpath)
+        var = np.load(os.path.join(path, "explained_variance.npy"))
+        expect = meta.get("shape", [None, meta["k"]])
+        if comps.ndim != 2 or int(comps.shape[1]) != int(expect[1]) or (
+                expect[0] is not None
+                and int(comps.shape[0]) != int(expect[0])):
+            raise ValueError(
+                f"{cpath}: components have shape {tuple(comps.shape)}, "
+                f"metadata expects {tuple(expect)} — the model directory "
+                "is torn or mixed from two saves"
+            )
+        if var.shape[0] != comps.shape[1]:
+            raise ValueError(
+                f"{os.path.join(path, 'explained_variance.npy')}: "
+                f"{var.shape[0]} variance ratios for {comps.shape[1]} "
+                "components — the model directory is torn or mixed "
+                "from two saves"
+            )
+        return cls(comps, var)
 
 
 def _pca_solver_cfg() -> str:
@@ -236,6 +266,15 @@ class PCA:
         telemetry.finalize_fit(model.summary)
         return model
 
+    def _ckpt_signature(self, d: int, cfg, moments: str) -> dict:
+        """Checkpoint identity (utils/checkpoint.py).  ``moments`` names
+        the checkpointed accumulator layout — ``"colsum"`` (streamed
+        pass-1 state) vs ``"cov"`` (in-memory covariance) — so the two
+        paths can never consume each other's intermediate state.  ``k``
+        is deliberately absent: the moments do not depend on it."""
+        return {"d": int(d), "moments": moments,
+                "x64": bool(cfg.enable_x64)}
+
     def _fit_stream_inner(self, source, dtype, cfg) -> PCAModel:
         from oap_mllib_tpu.ops import stream_ops
 
@@ -245,13 +284,17 @@ class PCA:
         timings = Timings("pca.fit")
         cache_before = progcache.stats()
         d = source.n_features
+        ckpt = ckpt_mod.maybe_open(
+            "pca", self._ckpt_signature(d, cfg, "colsum"), timings=timings
+        )
         with phase_timer(timings, "covariance_streamed"):
             tier = (
                 "highest" if cfg.enable_x64
                 else psn.kernel_tier(pol.name, cfg.matmul_precision)
             )
             cov, _, n = stream_ops.covariance_streamed(
-                source, dtype, tier, timings=timings, policy=pol.name
+                source, dtype, tier, timings=timings, policy=pol.name,
+                checkpoint=ckpt,
             )
         # cov is exactly (d, d) here — no model-sharding feature pad
         vals, vecs, total, solver = self._solve_spectrum(cov, d, timings)
@@ -265,6 +308,8 @@ class PCA:
             "progcache": progcache.delta(cache_before),
         }
         psn.record(summary, timings, pol)
+        if ckpt is not None:
+            ckpt.record(summary)
         return PCAModel(vecs, ratio, summary)
 
     # -- accelerated path (~ PCADALImpl.train, PCADALImpl.scala:35) ----------
@@ -286,36 +331,57 @@ class PCA:
         mesh = get_mesh()
         mp = mesh.shape[cfg.model_axis]
         d = x.shape[1]
+        ckpt = ckpt_mod.maybe_open(
+            "pca", self._ckpt_signature(d, cfg, "cov"), timings=timings
+        )
+        resume = ckpt.restore() if ckpt is not None else None
+        restored = (
+            resume is not None and resume.found
+            and resume.extra.get("stage") == "cov"
+        )
         if mp > 1:
             # model-sharded Gram needs d % model == 0; zero-pad feature
             # columns (they yield zero eigenvalues, which sort last) and
             # slice the component rows back after eigh
             x = np.pad(x, ((0, 0), (0, (-d) % mp)))
-        with phase_timer(timings, "table_convert"):
-            make = (
-                DenseTable.from_process_local
-                if jax.process_count() > 1
-                else DenseTable.from_numpy
-            )
-            table = make(x.astype(dtype), mesh)
-        with phase_timer(timings, "covariance"):
-            n_rows = jnp.asarray(float(table.n_rows), dtype)
-            # x64 lane pins the Gram to HIGHEST regardless of tier
-            # (f64 has no bf16 fast path to buy anything with); the
-            # compute-precision policy maps onto the tier otherwise
-            tier = (
-                "highest" if cfg.enable_x64
-                else psn.kernel_tier(pol.name, cfg.matmul_precision)
-            )
-            if mp > 1:
-                cov, _ = pca_ops.covariance_model_sharded(
-                    table.data, table.mask, n_rows, mesh, tier,
-                    timings=timings, policy=pol.name,
+        if restored:
+            # the in-memory iterate state is the covariance itself
+            # (stored unpadded, so it restores onto any model-parallel
+            # degree): skip the table conversion AND the Gram pass, go
+            # straight to the eigensolver
+            cov = jnp.asarray(np.asarray(resume.arrays["cov"], dtype))
+        else:
+            with phase_timer(timings, "table_convert"):
+                make = (
+                    DenseTable.from_process_local
+                    if jax.process_count() > 1
+                    else DenseTable.from_numpy
                 )
-            else:
-                cov, _ = pca_ops.covariance(
-                    table.data, table.mask, n_rows, tier, timings=timings,
-                    policy=pol.name,
+                table = make(x.astype(dtype), mesh)
+            with phase_timer(timings, "covariance"):
+                n_rows = jnp.asarray(float(table.n_rows), dtype)
+                # x64 lane pins the Gram to HIGHEST regardless of tier
+                # (f64 has no bf16 fast path to buy anything with); the
+                # compute-precision policy maps onto the tier otherwise
+                tier = (
+                    "highest" if cfg.enable_x64
+                    else psn.kernel_tier(pol.name, cfg.matmul_precision)
+                )
+                if mp > 1:
+                    cov, _ = pca_ops.covariance_model_sharded(
+                        table.data, table.mask, n_rows, mesh, tier,
+                        timings=timings, policy=pol.name,
+                    )
+                else:
+                    cov, _ = pca_ops.covariance(
+                        table.data, table.mask, n_rows, tier,
+                        timings=timings, policy=pol.name,
+                    )
+            if ckpt is not None:
+                ckpt.maybe_write(
+                    1,
+                    {"cov": ckpt_mod.fetch_replicated(cov)[:d, :d]},
+                    extra={"stage": "cov"}, force=True,
                 )
         vals, vecs, total, solver = self._solve_spectrum(cov, d, timings)
         ratio = vals / total if total > 0 else np.zeros(self.k)
@@ -327,6 +393,8 @@ class PCA:
             "progcache": progcache.delta(cache_before),
         }
         psn.record(summary, timings, pol)
+        if ckpt is not None:
+            ckpt.record(summary)
         return PCAModel(vecs, ratio, summary)
 
     # -- fallback path (~ vanilla mllib.feature.PCA, PCA.scala:110-116) ------

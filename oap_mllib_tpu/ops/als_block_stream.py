@@ -475,6 +475,7 @@ def als_block_run_streamed(
     implicit: bool,
     timings=None,
     policy: str = "f32",
+    checkpoint=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Streamed block-parallel ALS over the mesh (both feedback modes,
     both item layouts).  Returns (X blocks, Y) in the same forms as the
@@ -484,7 +485,16 @@ def als_block_run_streamed(
     executes (staging is rank-local, so lookahead cannot desynchronize
     the collective launch order — every rank still issues the same
     accum/solve sequence).  The stage/transfer/compute split lands in
-    ``timings`` under ``als_iterations/``."""
+    ``timings`` under ``als_iterations/``.
+
+    ``checkpoint`` (utils/checkpoint.py) is the elastic-worlds channel
+    for the production topology: every rank writes ITS blocks' valid
+    factor rows (global row ids + values) per interval, and restore
+    re-buckets whatever shards the relaunched world read onto the LIVE
+    block layout through one collective resharding pass
+    (parallel/shuffle.reshard_factor_rows) — the full table never
+    materializes on one host, whether the world shrank, grew, or merely
+    re-blocked."""
     cfg = get_config()
     axis = cfg.data_axis
     world = mesh.shape[axis]
@@ -556,7 +566,66 @@ def als_block_run_streamed(
         return m
 
     x_blk, y = x0, y0
-    for _ in range(max_iter):
+    start_it = 0
+    ckpt_layout = None
+    if checkpoint is not None:
+        from oap_mllib_tpu.parallel.shuffle import reshard_factor_rows
+        from oap_mllib_tpu.utils import checkpoint as ckpt_mod
+
+        ckpt_layout = {
+            "offsets_u": [int(v) for v in lay.offsets_u],
+            "upb": int(lay.upb),
+            "item_sharded": bool(lay.item_sharded),
+        }
+        if lay.item_sharded:
+            ckpt_layout["offsets_i"] = [int(v) for v in lay.offsets_i]
+            ckpt_layout["ipb"] = int(lay.ipb)
+        resume = checkpoint.restore()
+        if resume.found:
+            start_it = min(int(resume.step), max_iter)
+            # the collective resharding pass runs on EVERY restore (same
+            # code path for same-world and resized worlds; values travel
+            # as exact bit patterns, so a same-layout round trip is
+            # bit-identical)
+            nproc, rank = jax.process_count(), jax.process_index()
+            ids_u, vals_u = ckpt_mod.sharded_rows_from_result(
+                resume, "x", nproc, rank
+            )
+            x_blk = reshard_factor_rows(
+                ids_u, vals_u, mesh, lay.offsets_u, lay.upb
+            )
+            if lay.item_sharded:
+                ids_i, vals_i = ckpt_mod.sharded_rows_from_result(
+                    resume, "y", nproc, rank
+                )
+                y = reshard_factor_rows(
+                    ids_i, vals_i, mesh, lay.offsets_i, lay.ipb
+                )
+            else:
+                y = jnp.asarray(
+                    ckpt_mod.replicated_from_result(resume, "y", lay.n_items)
+                )
+            if resume.layout != ckpt_layout:
+                checkpoint.mark_resharded()
+
+        def _write_state(step: int) -> None:
+            sharded = {
+                "x": ckpt_mod.local_factor_rows(
+                    x_blk, lay.offsets_u, lay.upb
+                )
+            }
+            arrays = {}
+            if lay.item_sharded:
+                sharded["y"] = ckpt_mod.local_factor_rows(
+                    y, lay.offsets_i, lay.ipb
+                )
+            else:
+                arrays["y"] = np.asarray(y)
+            checkpoint.maybe_write(
+                step, arrays, sharded=sharded, layout=ckpt_layout,
+            )
+
+    for it in range(start_it, max_iter):
         # -- user update: stream by-user chunks against the (gathered)
         # item table, solve locally
         y_full = replicate(y) if lay.item_sharded else y
@@ -579,6 +648,10 @@ def als_block_run_streamed(
                 zeros_i(), x_blk,
             )
             y = solve_item_rep_fn(m_i, x_blk, reg_j)
+        if checkpoint is not None and checkpoint.due(it + 1):
+            # the shard pull is a host sync, so gate it on the interval
+            # BEFORE materializing the local rows
+            _write_state(it + 1)
     # oaplint: disable=stream-host-sync -- end-of-fit barrier: fence async
     jax.block_until_ready((x_blk, y))  # dispatches before timing finalize
     stats.finalize(timings, "als_iterations", elapsed())

@@ -198,6 +198,7 @@ def als_run_streamed(
     timings=None,
     degraded: bool = False,
     policy: str = "f32",
+    checkpoint=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Full streamed ALS loop (both feedback modes), host-driven.
 
@@ -213,7 +214,12 @@ def als_run_streamed(
     -chunk rung (utils/resilience.py): upload blocks shrink to half the
     budgeted group count, halving the per-step live HBM after a device
     OOM — the math is chunk-size-invariant (segment-sums only reorder
-    additions)."""
+    additions).  ``checkpoint`` (utils/checkpoint.py) restores both
+    factor tables + the iteration index at entry and writes them every
+    ``Config.checkpoint_interval`` iterations — the iterates are exact
+    state, so continuation is bit-identical (and survives a degraded
+    re-chunk: chunk geometry is deliberately outside the checkpoint
+    signature)."""
     from oap_mllib_tpu.utils.resilience import check_finite
 
     r = np.asarray(x0).shape[1]
@@ -224,11 +230,25 @@ def als_run_streamed(
         gc_i = max(1, gc_i // 2)
     by_user = _pad_group_rows(by_user, gc_u, n_users)
     by_item = _pad_group_rows(by_item, gc_i, n_items)
+    start_it = 0
+    if checkpoint is not None:
+        from oap_mllib_tpu.utils import checkpoint as ckpt_mod
+
+        resume = checkpoint.restore()
+        if resume.found:
+            # either storage form: a block-parallel world's sharded
+            # factor checkpoint restores here too (this process reads
+            # every old shard — a world of one)
+            x0 = ckpt_mod.factors_from_result(resume, "x", n_users)
+            y0 = ckpt_mod.factors_from_result(resume, "y", n_items)
+            start_it = min(int(resume.step), max_iter)
+            if "x" not in resume.arrays:
+                checkpoint.mark_resharded()  # sharded state -> one device
     x = jnp.asarray(np.asarray(x0, np.float32))
     y = jnp.asarray(np.asarray(y0, np.float32))
     stats = PrefetchStats()
     elapsed = tick()
-    for it in range(max_iter):
+    for it in range(start_it, max_iter):
         x = _half_update_streamed(
             by_user, y, n_users, gc_u, reg, alpha, implicit, stats=stats,
             timings=timings, policy=policy,
@@ -242,6 +262,10 @@ def als_run_streamed(
         # later half-iteration — detect at the iteration that produced it
         check_finite(x, f"ALS user factors (streamed iteration {it + 1})")
         check_finite(y, f"ALS item factors (streamed iteration {it + 1})")
+        if checkpoint is not None:
+            checkpoint.maybe_write(
+                it + 1, {"x": np.asarray(x), "y": np.asarray(y)},
+            )
     # oaplint: disable=stream-host-sync -- end-of-fit barrier: fence async
     jax.block_until_ready((x, y))  # dispatches before timing finalize
     stats.finalize(timings, "als_iterations", elapsed())

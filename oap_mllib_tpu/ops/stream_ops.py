@@ -368,7 +368,7 @@ def _center_update(centers, sums, counts):
 def lloyd_run_streamed(
     source: ChunkSource, init_centers: np.ndarray, max_iter: int, tol: float,
     dtype, precision: str = "highest", weights=None, validated: bool = False,
-    timings=None, policy: str = "f32",
+    timings=None, policy: str = "f32", checkpoint=None, resume=None,
 ):
     """Streamed Lloyd loop; same return contract as kmeans_ops.lloyd_run:
     (centers, n_iter, cost, counts).  Convergence semantics match
@@ -380,7 +380,17 @@ def lloyd_run_streamed(
     (KMeans._fit_source) already ran it — the sync is one collective per
     call and must not triple up inside a single fit.  ``timings``
     accumulates the per-pass stage/transfer/compute split under
-    ``lloyd_loop/``."""
+    ``lloyd_loop/``.
+
+    ``checkpoint``/``resume`` (utils/checkpoint.py): the elastic-worlds
+    channel.  ``resume`` is a restored :class:`RestoreResult` whose
+    centroids the CALLER already used as ``init_centers`` (skipping the
+    init passes); here it re-enters the loop at the recorded pass.
+    ``checkpoint`` writes the post-pass centroids + pass index + the
+    converged flag every ``Config.checkpoint_interval`` passes.  The
+    pass math is untouched, so continuation is bit-identical in an
+    unchanged world; a changed world only reorders the cross-rank
+    reduction sums (<= fp tolerance)."""
     if weights is not None and not validated:
         _checked_entry(lambda: _check_weight_source(source, weights))
     from oap_mllib_tpu.utils.resilience import check_finite
@@ -388,7 +398,11 @@ def lloyd_run_streamed(
     centers = jnp.asarray(np.asarray(init_centers, dtype))
     tol_sq = float(tol) ** 2
     n_iter = 0
-    for _ in range(max_iter):
+    converged = False
+    if resume is not None and resume.found:
+        n_iter = min(int(resume.step), max_iter)
+        converged = bool(resume.extra.get("converged", False))
+    while n_iter < max_iter and not converged:
         sums, counts, _ = streamed_accumulate(
             source, centers, dtype, precision, need_cost=False,
             weights=weights, timings=timings, policy=policy,
@@ -399,8 +413,12 @@ def lloyd_run_streamed(
         # centroid poisons every later pass silently — catch it at the
         # iteration that produced it, while the cause is still nearby
         check_finite(centers, f"K-Means centroids (streamed pass {n_iter})")
-        if float(max_moved) <= tol_sq:
-            break
+        converged = float(max_moved) <= tol_sq
+        if checkpoint is not None:
+            checkpoint.maybe_write(
+                n_iter, {"centers": np.asarray(centers)},
+                extra={"converged": converged}, force=converged,
+            )
     # final cost/counts pass: full precision INPUTS too (policy="f32" —
     # one extra f32-staged pass).  The cost identity |x|^2 + |c|^2 - 2x.c
     # cancels catastrophically for tight clusters under bf16-rounded
@@ -729,7 +747,7 @@ def _gram_chunk_comp(gram, comp, chunk, w, mean, precision, policy):
 
 def covariance_streamed(
     source: ChunkSource, dtype, precision: str = "highest", timings=None,
-    policy: str = "f32",
+    policy: str = "f32", checkpoint=None,
 ):
     """Two-pass streamed covariance: (cov (d,d), mean (d,), n_rows), as
     host arrays identical on every process.
@@ -745,40 +763,63 @@ def covariance_streamed(
     operands with f32 accumulation, and compensates the cross-chunk f32
     accumulation (Kahan) so the pass count cannot amplify the rounding;
     f32 keeps the exact pre-policy accumulators.
+
+    ``checkpoint`` (utils/checkpoint.py): PCA's iterate state is its
+    pass structure — after the colsum pass the reduced column sums + row
+    count checkpoint (the streamed accumulators of the tentpole), so a
+    preempted fit resumes straight into the Gram pass.  The reduced
+    moments are identical on every rank, so restore is world-size-
+    independent by construction.
     """
     d = source.n_features
     stage_dtype = psn.staging_dtype(policy, dtype)
     compensated = policy == "bf16"
-    total = jnp.zeros((d,), dtype)
-    comp = jnp.zeros((d,), dtype)
-    n = 0
-    stats = PrefetchStats()
+    from oap_mllib_tpu.utils.resilience import check_finite
+
+    resume = checkpoint.restore() if checkpoint is not None else None
     base_key = (
         progcache.backend_fingerprint(),
         (source.chunk_rows, d), str(np.dtype(dtype)), str(stage_dtype),
         precision, policy,
     )
-    elapsed = tick()
-    guard = _PassGuard()
-    with guard, _staged_chunks(source, None, dtype, stats, stage_dtype) as pf:
-        for _, n_valid, _, cj, wj in pf:
-            with progcache.launch(
-                "pca.stream_colsum", base_key, timings,
-                "covariance_streamed", record_execute=False,
-            ):
-                if compensated:
-                    total, comp = _colsum_chunk_comp(total, comp, cj, wj)
-                else:
-                    total = _colsum_chunk(total, cj, wj)
-            n += n_valid
-    stats.finalize(timings, "covariance_streamed", elapsed())
-    total, n_arr = _psum_host([total, np.asarray([n], np.int64)], guard=guard)
-    from oap_mllib_tpu.utils.resilience import check_finite
-
-    # per-pass guardrails (Config.nonfinite_policy): an overflowed f32
-    # column sum or Gram silently yields Inf/NaN eigenvectors passes later
-    check_finite(total, "PCA column sums (streamed mean pass)")
-    n = int(n_arr[0])
+    if resume is not None and resume.found and (
+            resume.extra.get("stage") == "colsum"):
+        total = resume.arrays["colsum"]
+        n = int(resume.extra["n_rows"])
+    else:
+        total = jnp.zeros((d,), dtype)
+        comp = jnp.zeros((d,), dtype)
+        n = 0
+        stats = PrefetchStats()
+        elapsed = tick()
+        guard = _PassGuard()
+        with guard, _staged_chunks(
+            source, None, dtype, stats, stage_dtype
+        ) as pf:
+            for _, n_valid, _, cj, wj in pf:
+                with progcache.launch(
+                    "pca.stream_colsum", base_key, timings,
+                    "covariance_streamed", record_execute=False,
+                ):
+                    if compensated:
+                        total, comp = _colsum_chunk_comp(total, comp, cj, wj)
+                    else:
+                        total = _colsum_chunk(total, cj, wj)
+                n += n_valid
+        stats.finalize(timings, "covariance_streamed", elapsed())
+        total, n_arr = _psum_host(
+            [total, np.asarray([n], np.int64)], guard=guard
+        )
+        # per-pass guardrails (Config.nonfinite_policy): an overflowed
+        # f32 column sum or Gram silently yields Inf/NaN eigenvectors
+        # passes later
+        check_finite(total, "PCA column sums (streamed mean pass)")
+        n = int(n_arr[0])
+        if checkpoint is not None:
+            checkpoint.maybe_write(
+                1, {"colsum": np.asarray(total)},
+                extra={"stage": "colsum", "n_rows": n}, force=True,
+            )
     if n < 1:
         raise ValueError("empty source")
     mean = jnp.asarray(total.astype(dtype) / n)

@@ -82,6 +82,23 @@ def default_coordinator(start_port: int = 3000) -> str:
     return f"{ip}:{free_port(ip, start_port)}"
 
 
+def world_layout() -> dict:
+    """The live world's shape, as recorded in checkpoint manifests
+    (utils/checkpoint.py): process count/rank plus the global device
+    count.  A restore compares this against the manifest's copy to
+    decide between bit-identical continuation (same world) and the
+    collective resharding pass (world changed) — the elastic-worlds
+    analog of the reference re-creating its communicator per job
+    (OneCCL.cpp:60-99) with the world size Spark handed it."""
+    import jax
+
+    return {
+        "processes": int(jax.process_count()),
+        "rank": int(jax.process_index()),
+        "devices": int(len(jax.devices())),
+    }
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

@@ -208,6 +208,110 @@ def exchange_ratings(
     return out_u, out_i, out_r, out_valid, offsets
 
 
+def reshard_factor_rows(
+    ids: np.ndarray,
+    vals: np.ndarray,
+    mesh: Mesh,
+    offsets: np.ndarray,
+    per: int,
+) -> jax.Array:
+    """Collective redistribution of factor-table rows onto the mesh's
+    block layout — the elastic-worlds restore path (utils/checkpoint.py).
+
+    Each process contributes the host rows it read from an arbitrary
+    subset of checkpoint shards (``ids`` (m,) global row ids, ``vals``
+    (m, r) float32), every global row appearing on exactly one process.
+    Rows are bucketed by destination block under the NEW ``offsets``,
+    exchanged through ONE compiled ``all_to_all`` of max-bucket-padded
+    int32 records (the exchange_ratings machinery with factor payloads
+    instead of rating triples — the portable-collective redistribution
+    of arXiv:2112.01075), and scattered into a ``(world * per, r)``
+    block-sharded array by a registry-cached jit(shard_map) program.  No
+    host ever materializes the full table; factor values travel as exact
+    f32 bit patterns (int32 bitcast).  Rows absent from every process's
+    input land as zeros (a block's padding rows beyond its boundary).
+    """
+    ids = np.asarray(ids, np.int64)
+    vals = np.asarray(vals, np.float32)
+    if ids.size and int(ids.max()) >= 2**31:
+        raise ValueError(
+            f"factor row ids must fit int32; got max id {int(ids.max())}"
+        )
+    cfg = get_config()
+    axis = cfg.data_axis
+    world = mesh.shape[axis]
+    nproc = jax.process_count()
+    local_sources = max(1, world // nproc)
+    r = vals.shape[1]
+
+    dst = np.clip(
+        np.searchsorted(np.asarray(offsets), ids, side="right") - 1,
+        0, world - 1,
+    )
+    buckets = [[None] * world for _ in range(local_sources)]
+    counts_local = np.zeros((local_sources, world), np.int64)
+    for b in range(world):
+        sel = np.nonzero(dst == b)[0]
+        for s in range(local_sources):
+            part = sel[s::local_sources]  # round-robin balance (as above)
+            buckets[s][b] = part
+            counts_local[s, b] = len(part)
+
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+
+        counts = np.asarray(
+            multihost_utils.process_allgather(counts_local)
+        ).reshape(world, world)
+    else:
+        counts = counts_local
+    max_bucket = max(1, int(counts.max()))
+
+    def _pack(part: np.ndarray) -> np.ndarray:
+        rec = np.zeros((max_bucket, r + 2), np.int32)
+        c = len(part)
+        rec[:c, 0] = ids[part].astype(np.int32)
+        rec[:c, 1 : r + 1] = vals[part].view(np.int32)
+        rec[:c, r + 1] = 1
+        return rec
+
+    local_rec = np.concatenate(
+        [_pack(buckets[s][b]) for s in range(local_sources) for b in range(world)],
+        axis=0,
+    )
+    sharding = NamedSharding(mesh, P(axis, None))
+    if nproc > 1:
+        sharded = jax.make_array_from_process_local_data(sharding, local_rec)
+    else:
+        sharded = jax.device_put(jnp.asarray(local_rec), sharding)
+
+    from oap_mllib_tpu.parallel.collective import alltoall_rows
+
+    exchanged = alltoall_rows(sharded, mesh)  # rank b holds its block's rows
+
+    def scatter(rows, offs):  # per-rank (world * max_bucket, r + 2) int32
+        b = jax.lax.axis_index(axis)
+        lo = offs[b]
+        valid = rows[:, r + 1] > 0
+        # invalid/foreign rows index past the block -> mode="drop"
+        idx = jnp.where(valid, rows[:, 0] - lo, per)
+        v = jax.lax.bitcast_convert_type(rows[:, 1 : r + 1], jnp.float32)
+        return jnp.zeros((per, r), jnp.float32).at[idx].set(v, mode="drop")
+
+    scatter_fn = progcache.get_or_build(
+        "shuffle.reshard_scatter",
+        (progcache.mesh_fingerprint(mesh), axis, world * max_bucket, per, r),
+        lambda: jax.jit(
+            shard_map(
+                scatter, mesh=mesh,
+                in_specs=(P(axis, None), P()), out_specs=P(axis, None),
+                check_vma=False,
+            )
+        ),
+    )
+    return scatter_fn(exchanged, jnp.asarray(np.asarray(offsets), jnp.int32))
+
+
 def shuffle_to_blocks(
     users: np.ndarray,
     items: np.ndarray,

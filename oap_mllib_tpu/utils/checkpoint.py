@@ -1,0 +1,627 @@
+"""Elastic worlds: sharded iterate-state checkpoint/resume.
+
+The resilience ladder (utils/resilience.py) is deliberately bypassed in
+multi-process worlds under the static-world contract, so the
+configuration production actually runs — pod slices on preemptible
+capacity — had zero fault tolerance: one preempted host killed the whole
+fit and every pass of work with it.  This module is the missing half:
+**periodic sharded checkpoints of iterate state** (K-Means centroids,
+ALS user/item factor shards, PCA streamed colsum/Gram moments, plus the
+pass/iteration index and world layout), written per-rank with atomic
+tmp+rename and a manifest, and **resume-from-checkpoint onto any world
+size** — factor shards are redistributed through a collective resharding
+pass (parallel/shuffle.reshard_factor_rows) when the world changed, so a
+fleet that lost or gained hosts re-enters the iterate loop where it left
+off instead of starting over.
+
+On-disk layout (one directory per fit identity)::
+
+    <checkpoint_dir>/<algo>-<sig12>/
+        manifest.json                  # step, world, layout, signature
+        step00000003.rank0.npz         # rank 0's shard at step 3
+        step00000003.rank1.npz         # ...
+
+Write protocol (the torn-write contract):
+
+1. every rank writes its ``step<N>.rank<r>.npz`` shard via
+   tmp+``os.replace`` (data/io.atomic_save_npz);
+2. ranks agree the write landed everywhere (one tiny allgather in
+   multi-process worlds — rank-uniform, fingerprinted by the collective
+   sanitizer like every host collective);
+3. rank 0 atomically replaces ``manifest.json``, which NAMES the step —
+   a kill anywhere in 1–3 leaves the previous generation fully valid;
+4. each rank garbage-collects its own shards older than the previous
+   generation (two generations are kept so a failed manifest flip never
+   strands the step it still points at).
+
+Restore validates manifest version/signature and every needed shard's
+embedded step; any failure is a *corrupt checkpoint*: a fresh fit (with
+a warning) under ``Config.resume="auto"``, :class:`CheckpointError`
+under ``resume="require"``.  Replicated state (centroids, moments,
+replicated Y) restores onto any world directly; block-sharded factor
+tables are re-read round-robin from the old rank shards and redistributed
+collectively — no host ever materializes the full table.
+
+Both ``ckpt.write`` and ``ckpt.restore`` are fault-injection sites
+(utils/faults.py): a failed periodic write warns + counts and the fit
+continues; an injected restore fault exercises the corrupt-checkpoint
+tiers deterministically in CI (dev/checkpoint_gate.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.data import io as _io
+from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.utils import faults
+from oap_mllib_tpu.utils.timing import phase_timer, tick, x64_scope
+
+log = logging.getLogger("oap_mllib_tpu")
+
+MANIFEST = "manifest.json"
+_VERSION = 1
+_KEEP_GENERATIONS = 2
+
+DECISION_FOUND = "found"
+DECISION_FRESH = "fresh"
+DECISION_RESHARDED = "resharded"
+
+
+class CheckpointError(RuntimeError):
+    """A restore that ``Config.resume="require"`` cannot satisfy (no
+    checkpoint, a corrupt manifest/shard, or a signature mismatch)."""
+
+
+def resume_cfg(cfg=None) -> str:
+    """Validated ``Config.resume`` — a typo must raise, not silently
+    behave like any valid value (the als_kernel contract)."""
+    cfg = cfg or get_config()
+    policy = cfg.resume
+    if policy not in ("auto", "require", "off"):
+        raise ValueError(
+            f"resume must be auto|require|off, got {policy!r}"
+        )
+    return policy
+
+
+def _world() -> Tuple[int, int]:
+    import jax
+
+    return jax.process_count(), jax.process_index()
+
+
+def _sig_hash(signature: Dict[str, Any]) -> str:
+    blob = json.dumps(signature, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def fetch_replicated(arr) -> np.ndarray:
+    """Host copy of a (logically) replicated device value.  Multi-process
+    arrays that are not fully addressable (e.g. model-axis-sharded
+    centers) first gather through a registry-cached replication program —
+    the ALSModel._gather_blocks pattern; a COLLECTIVE, so every rank must
+    checkpoint together (they do: writes fire at config-uniform steps)."""
+    import jax
+
+    if not hasattr(arr, "sharding") or getattr(
+            arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from oap_mllib_tpu.utils import progcache
+
+    mesh = arr.sharding.mesh
+    fn = progcache.get_or_build(
+        "ckpt.gather_replicated",
+        (progcache.mesh_fingerprint(mesh),),
+        lambda: jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P())),
+    )
+    return np.asarray(fn(arr))
+
+
+def local_factor_rows(arr, offsets, per: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(ids, vals) for THIS process's valid rows of a block-sharded
+    ``(world * per, r)`` factor table: each addressable block shard
+    contributes its rows below the block boundary (padding dropped),
+    with their GLOBAL row ids — exactly the shard payload a different
+    world size can re-bucket at restore.  Model-axis replicas dedupe by
+    block start."""
+    offsets = np.asarray(offsets)
+    ids: List[np.ndarray] = []
+    vals: List[np.ndarray] = []
+    seen = set()
+    for s in sorted(arr.addressable_shards,
+                    key=lambda sh: sh.index[0].start or 0):
+        start = s.index[0].start or 0
+        if start in seen:
+            continue
+        seen.add(start)
+        b = start // per
+        lo, hi = int(offsets[b]), int(offsets[b + 1])
+        data = np.asarray(s.data)
+        ids.append(np.arange(lo, hi, dtype=np.int64))
+        vals.append(data[: hi - lo])
+    r = arr.shape[-1]
+    if not ids:
+        return np.zeros((0,), np.int64), np.zeros((0, r), np.float32)
+    return np.concatenate(ids), np.concatenate(vals).astype(np.float32)
+
+
+def factors_from_result(res: "RestoreResult", name: str,
+                        n_rows: int) -> np.ndarray:
+    """Full ``(n_rows, r)`` host factor table from either storage form —
+    replicated (``arrays``) or block-sharded (``sharded``).  Single-
+    device restores of a checkpoint written by a block-parallel world
+    land here: the reading process holds every old shard (round-robin
+    over a world of one), so assembly is exact; rows no shard carried
+    stay zero (a shrunken id space's tail)."""
+    if name in res.arrays:
+        return np.asarray(res.arrays[name], np.float32)
+    ids, vals = res.sharded[name]
+    r = vals.shape[1] if vals.ndim == 2 else 1
+    out = np.zeros((n_rows, r), np.float32)
+    keep = ids < n_rows
+    out[ids[keep]] = vals[keep]
+    return out
+
+
+def replicated_from_result(res: "RestoreResult", name: str,
+                           n_rows: int) -> np.ndarray:
+    """Full replicated host table from either storage form, correct in
+    multi-process worlds: a block-sharded checkpoint restored into a
+    replicated layout gathers every rank's loaded rows first (each rank
+    only read its round-robin subset of old shards).  The gathers are
+    rank-uniform and ride the collective-sanitizer fingerprint plane
+    like every host collective."""
+    import jax
+
+    if name in res.arrays or jax.process_count() == 1:
+        return factors_from_result(res, name, n_rows)
+    from jax.experimental import multihost_utils
+
+    from oap_mllib_tpu.utils import sanitizers
+
+    ids, vals = res.sharded[name]
+    r = vals.shape[1] if vals.ndim == 2 else 1
+    n_local = np.asarray([len(ids)], np.int64)
+    sanitizers.note_collective("process_allgather", "host", ((1,),), "int64")
+    with x64_scope(True):
+        counts = np.asarray(multihost_utils.process_allgather(n_local))
+    n_max = max(1, int(counts.max()))
+    pid = np.full((n_max,), -1, np.int64)
+    pid[: len(ids)] = ids
+    pval = np.zeros((n_max, r), np.float32)
+    pval[: len(ids)] = vals
+    sanitizers.note_collective(
+        "process_allgather", "host", ((n_max,), (n_max, r)),
+        "int64,float32",
+    )
+    with x64_scope(True):
+        gid, gval = multihost_utils.process_allgather([pid, pval])
+    gid = np.asarray(gid).reshape(-1)
+    gval = np.asarray(gval).reshape(-1, r)
+    out = np.zeros((n_rows, r), np.float32)
+    keep = (gid >= 0) & (gid < n_rows)
+    out[gid[keep]] = gval[keep]
+    return out
+
+
+def sharded_rows_from_result(res: "RestoreResult", name: str,
+                             world: int, rank: int):
+    """(ids, vals) feed for the collective resharding pass from either
+    storage form.  A replicated checkpoint (written by a single-device
+    or replicated-Y fit) is strided ``rank::world`` so the live world's
+    ranks contribute disjoint row sets — reshard_factor_rows requires
+    every global row on exactly one process."""
+    if name in res.sharded:
+        return res.sharded[name]
+    arr = np.asarray(res.arrays[name], np.float32)
+    ids = np.arange(rank, arr.shape[0], world, dtype=np.int64)
+    return ids, arr[ids]
+
+
+@dataclasses.dataclass
+class RestoreResult:
+    """Outcome of one restore attempt; ``decision`` lands in the fit
+    summary and the ``checkpoint`` span so operators can see whether a
+    fit continued, started fresh (and why), or was resharded."""
+
+    decision: str = DECISION_FRESH
+    step: int = 0
+    reason: str = ""
+    old_world: int = 0
+    new_world: int = 0
+    arrays: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    sharded: Dict[str, Tuple[np.ndarray, np.ndarray]] = dataclasses.field(
+        default_factory=dict
+    )
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    layout: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def found(self) -> bool:
+        return self.decision != DECISION_FRESH
+
+
+class Checkpointer:
+    """One fit's checkpoint channel: periodic sharded writes + restore.
+
+    Built by :func:`maybe_open` (None when ``Config.checkpoint_dir`` is
+    empty — the zero-overhead off path).  ``signature`` is the fit
+    identity (algo, shapes, seed, solver params, dtype — NOT the world
+    size, chunk geometry, or precision policy, which are all allowed to
+    change across a preemption); it keys the directory name and is
+    embedded in the manifest, so a restore can never consume state from
+    a different problem.
+    """
+
+    def __init__(self, algo: str, signature: Dict[str, Any], *,
+                 cfg=None, timings=None):
+        cfg = cfg or get_config()
+        self.algo = algo
+        self.signature = dict(signature)
+        self.signature["algo"] = algo
+        self.resume = resume_cfg(cfg)
+        self.interval = max(int(cfg.checkpoint_interval), 1)
+        self.dir = os.path.join(
+            cfg.checkpoint_dir, f"{algo}-{_sig_hash(self.signature)}"
+        )
+        self.timings = timings
+        self.world, self.rank = _world()
+        self.writes = 0
+        self.bytes_written = 0
+        self.last_step = -1
+        self._result: Optional[RestoreResult] = None
+
+    # -- write side ----------------------------------------------------------
+
+    def due(self, step: int) -> bool:
+        """True when ``step`` is a checkpoint boundary — callers whose
+        state EXTRACTION is itself expensive (a sharded-factor host
+        pull) gate on this before materializing anything."""
+        return step % self.interval == 0
+
+    def maybe_write(self, step: int, arrays: Dict[str, np.ndarray],
+                    extra: Optional[Dict[str, Any]] = None,
+                    sharded: Optional[Dict[str, tuple]] = None,
+                    layout: Optional[Dict[str, Any]] = None,
+                    force: bool = False) -> bool:
+        """Checkpoint iterate state at ``step`` when the interval says so
+        (or ``force``).  ``arrays`` is replicated state (identical on
+        every rank — each writes its copy for redundancy); ``sharded``
+        maps name -> (ids, vals) of THIS rank's factor rows (see
+        :func:`local_factor_rows`); ``extra``/``layout`` are
+        JSON-serializable world-uniform metadata (pass index, converged
+        flag, block offsets).  Never raises: a failed write warns +
+        counts — a checkpoint is insurance, not a liveness dependency."""
+        if not force and step % self.interval:
+            return False
+        with self._phase():
+            return self._write_guarded(step, arrays, extra or {},
+                                       sharded or {}, layout or {})
+
+    def _phase(self):
+        """The ``checkpoint`` child span under the fit root (a no-op
+        context when the caller attached no Timings)."""
+        if self.timings is None:
+            return contextlib.nullcontext()
+        return phase_timer(self.timings, "checkpoint")
+
+    def _write_guarded(self, step, arrays, extra, sharded, layout) -> bool:
+        elapsed = tick()
+        ok, err, nbytes = True, None, 0
+        try:
+            faults.maybe_fault("ckpt.write")
+            nbytes = self._write_shard(step, arrays, sharded)
+        except Exception as e:  # noqa: BLE001 — insurance must not kill
+            ok, err = False, e
+        # rank-uniform agreement BEFORE the manifest flip: the manifest
+        # must never name a step some rank failed to persist.  Reached on
+        # the failure path too, so a one-rank fault cannot desync the
+        # world's collective schedule.
+        all_ok = self._sync_ok(ok)
+        if not all_ok:
+            _tm.counter(
+                "oap_checkpoint_write_failures_total", {"algo": self.algo},
+                help="Checkpoint writes that failed (warned, fit continued)",
+            ).inc()
+            log.warning(
+                "%s: checkpoint write at step %d failed (%s); fit "
+                "continues without this checkpoint",
+                self.algo, step,
+                err if err is not None else "failure on a peer rank",
+            )
+            return False
+        if self.rank == 0:
+            try:
+                self._write_manifest(step, list(arrays), extra,
+                                     list(sharded), layout)
+            except Exception as e:  # noqa: BLE001
+                log.warning(
+                    "%s: checkpoint manifest flip at step %d failed (%s); "
+                    "the previous generation stays live",
+                    self.algo, step, e,
+                )
+                return False
+        self._gc()
+        self.writes += 1
+        self.bytes_written += nbytes
+        self.last_step = step
+        _tm.counter(
+            "oap_checkpoint_writes_total", {"algo": self.algo},
+            help="Checkpoint shard writes that landed durably",
+        ).inc()
+        _tm.counter(
+            "oap_checkpoint_bytes_written_total",
+            help="Bytes written to checkpoint shards",
+        ).inc(nbytes)
+        _tm.counter(
+            "oap_checkpoint_shards_total",
+            help="Checkpoint shard files written",
+        ).inc()
+        _tm.counter(
+            "oap_checkpoint_write_seconds_total",
+            help="Wall spent writing checkpoints",
+        ).inc(elapsed())
+        self._note_span()
+        return True
+
+    def _shard_name(self, step: int, rank: int) -> str:
+        return f"step{step:08d}.rank{rank}.npz"
+
+    def _write_shard(self, step, arrays, sharded) -> int:
+        os.makedirs(self.dir, exist_ok=True)
+        payload = {"__step__": np.asarray(step, np.int64)}
+        for name, a in arrays.items():
+            payload[f"a.{name}"] = np.asarray(a)
+        for name, (ids, vals) in sharded.items():
+            payload[f"s.{name}.ids"] = np.asarray(ids, np.int64)
+            payload[f"s.{name}.vals"] = np.asarray(vals, np.float32)
+        path = os.path.join(self.dir, self._shard_name(step, self.rank))
+        return _io.atomic_save_npz(path, payload)
+
+    def _write_manifest(self, step, array_names, extra, sharded_names,
+                        layout) -> None:
+        from oap_mllib_tpu.parallel.bootstrap import world_layout
+
+        wl = world_layout()
+        manifest = {
+            "version": _VERSION,
+            "algo": self.algo,
+            "step": int(step),
+            "world": self.world,
+            "devices": wl["devices"],
+            "arrays": sorted(array_names),
+            "sharded": sorted(sharded_names),
+            "extra": extra,
+            "layout": layout,
+            "signature": self.signature,
+            "interval": self.interval,
+        }
+        _io.atomic_write_json(os.path.join(self.dir, MANIFEST), manifest)
+
+    def _sync_ok(self, ok: bool) -> bool:
+        if self.world == 1:
+            return ok
+        from jax.experimental import multihost_utils
+
+        from oap_mllib_tpu.utils import sanitizers
+
+        flag = np.asarray([0 if ok else 1], np.int64)
+        sanitizers.note_collective(
+            "process_allgather", "host", ((1,),), "int64"
+        )
+        with x64_scope(True):
+            gathered = multihost_utils.process_allgather(flag)
+        return int(np.asarray(gathered).sum()) == 0
+
+    def _gc(self) -> None:
+        """Drop THIS rank's shards beyond the newest _KEEP_GENERATIONS
+        (best-effort; a racing reader already holds its data in memory —
+        data/io.load_npz materializes eagerly)."""
+        try:
+            mine = sorted(
+                f for f in os.listdir(self.dir)
+                if f.endswith(f".rank{self.rank}.npz")
+            )
+            for f in mine[:-_KEEP_GENERATIONS]:
+                os.unlink(os.path.join(self.dir, f))
+        except OSError:
+            pass
+
+    # -- restore side --------------------------------------------------------
+
+    def restore(self) -> RestoreResult:
+        """One restore attempt; the decision (found / fresh / resharded,
+        old->new world) is remembered for :meth:`record`.  Corrupt or
+        mismatched checkpoints follow ``Config.resume``: "auto" falls
+        back to a fresh fit with a warning, "require" raises
+        :class:`CheckpointError`, "off" never reads at all."""
+        elapsed = tick()
+        with self._phase():
+            res = self._restore_guarded()
+        self._result = res
+        _tm.counter(
+            "oap_checkpoint_restores_total",
+            {"algo": self.algo, "decision": res.decision},
+            help="Checkpoint restore attempts by outcome",
+        ).inc()
+        _tm.counter(
+            "oap_checkpoint_restore_seconds_total",
+            help="Wall spent in checkpoint restore attempts",
+        ).inc(elapsed())
+        self._note_span()
+        return res
+
+    def _restore_guarded(self) -> RestoreResult:
+        if self.resume == "off":
+            return RestoreResult(reason="resume=off", new_world=self.world)
+        err: Optional[Exception] = None
+        res = RestoreResult(new_world=self.world)
+        try:
+            faults.maybe_fault("ckpt.restore")
+            res = self._load()
+        except Exception as e:  # noqa: BLE001 — classified below
+            err = e
+        # rank-uniform outcome: one rank with a torn shard must not start
+        # fresh while its peers resume mid-fit (divergent collective
+        # schedules hang the world)
+        if not self._sync_ok(err is None):
+            err = err or CheckpointError(
+                f"{self.algo}: checkpoint restore failed on a peer rank"
+            )
+            res = RestoreResult(new_world=self.world)
+        if err is not None:
+            if self.resume == "require":
+                raise CheckpointError(
+                    f"{self.algo}: resume='require' but no usable "
+                    f"checkpoint under {self.dir}: {err}"
+                ) from err
+            if isinstance(err, FileNotFoundError):
+                res.reason = "no checkpoint found"
+            else:
+                res.reason = f"corrupt checkpoint: {err}"
+                log.warning(
+                    "%s: falling back to a fresh fit (%s)",
+                    self.algo, res.reason,
+                )
+        return res
+
+    def _load(self) -> RestoreResult:
+        mpath = os.path.join(self.dir, MANIFEST)
+        if not os.path.exists(mpath):
+            raise FileNotFoundError(f"no checkpoint manifest at {mpath}")
+        manifest = _io.read_json(mpath)
+        if manifest.get("version") != _VERSION:
+            raise CheckpointError(
+                f"manifest version {manifest.get('version')!r} != {_VERSION}"
+            )
+        if manifest.get("signature") != self.signature:
+            raise CheckpointError(
+                "checkpoint signature mismatch (different problem): "
+                f"manifest {manifest.get('signature')!r} vs fit "
+                f"{self.signature!r}"
+            )
+        step = int(manifest["step"])
+        old_world = int(manifest["world"])
+        decision = (
+            DECISION_FOUND if old_world == self.world else DECISION_RESHARDED
+        )
+        # replicated arrays: read the old rank aligned with THIS rank
+        # (any old shard carries them; aligned keeps same-world restores
+        # reading each rank's own file)
+        rep_shard = self._load_shard(step, self.rank % old_world)
+        arrays = {
+            name: rep_shard[f"a.{name}"] for name in manifest["arrays"]
+        }
+        # sharded state: partition the old shard files round-robin over
+        # the NEW world so every old row is read exactly once, then the
+        # caller reshards collectively (shuffle.reshard_factor_rows)
+        sharded: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        if manifest["sharded"]:
+            per_name: Dict[str, Tuple[list, list]] = {
+                n: ([], []) for n in manifest["sharded"]
+            }
+            for old_rank in range(old_world):
+                if old_rank % self.world != self.rank:
+                    continue
+                shard = (
+                    rep_shard if old_rank == self.rank % old_world
+                    else self._load_shard(step, old_rank)
+                )
+                for name in manifest["sharded"]:
+                    per_name[name][0].append(shard[f"s.{name}.ids"])
+                    per_name[name][1].append(shard[f"s.{name}.vals"])
+            for name, (ids, vals) in per_name.items():
+                sharded[name] = (
+                    np.concatenate(ids) if ids else np.zeros((0,), np.int64),
+                    np.concatenate(vals) if vals else np.zeros(
+                        (0, 1), np.float32),
+                )
+        self.last_step = step
+        return RestoreResult(
+            decision=decision, step=step, old_world=old_world,
+            new_world=self.world, arrays=arrays, sharded=sharded,
+            extra=dict(manifest.get("extra", {})),
+            layout=dict(manifest.get("layout", {})),
+        )
+
+    def _load_shard(self, step: int, rank: int) -> Dict[str, np.ndarray]:
+        path = os.path.join(self.dir, self._shard_name(step, rank))
+        shard = _io.load_npz(path)
+        got = int(shard.get("__step__", np.asarray(-1)))
+        if got != step:
+            raise CheckpointError(
+                f"shard {path} records step {got}, manifest says {step}"
+            )
+        return shard
+
+    def mark_resharded(self) -> None:
+        """Upgrade a same-world restore to ``resharded`` when the caller
+        redistributed state anyway (e.g. the block layout changed with
+        the process count unchanged — a num_user_blocks re-cap)."""
+        if self._result is not None and self._result.found:
+            self._result.decision = DECISION_RESHARDED
+            self._note_span()
+
+    # -- summary / telemetry -------------------------------------------------
+
+    def as_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "dir": self.dir,
+            "interval": self.interval,
+            "writes": self.writes,
+            "bytes_written": self.bytes_written,
+            "last_step": self.last_step,
+        }
+        res = self._result
+        if res is not None:
+            out["decision"] = res.decision
+            out["restored_step"] = res.step
+            if res.found:
+                out["old_world"] = res.old_world
+                out["new_world"] = res.new_world
+            elif res.reason:
+                out["reason"] = res.reason
+        return out
+
+    def record(self, summary) -> None:
+        """Attach the fit's checkpoint accounting + restore decision to
+        its summary (dict key / object attribute — the merge_stats
+        convention) so operators can see which fits resumed and from
+        where."""
+        if summary is None:
+            return
+        if isinstance(summary, dict):
+            summary["checkpoint"] = self.as_dict()
+        else:
+            summary.checkpoint = self.as_dict()
+        self._note_span()
+
+    def _note_span(self) -> None:
+        if self.timings is None:
+            return
+        self.timings.root.node("checkpoint").attrs.update(self.as_dict())
+
+
+def maybe_open(algo: str, signature: Dict[str, Any], *,
+               timings=None) -> Optional[Checkpointer]:
+    """The one checkpointing entry estimators call: None when
+    ``Config.checkpoint_dir`` is empty (one string check — the
+    checkpoint-off ~0% overhead contract, asserted by
+    dev/checkpoint_gate.py), else a :class:`Checkpointer` rooted at the
+    fit's signature directory."""
+    cfg = get_config()
+    if not cfg.checkpoint_dir:
+        return None
+    return Checkpointer(algo, signature, cfg=cfg, timings=timings)

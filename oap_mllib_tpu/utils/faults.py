@@ -21,6 +21,14 @@ site                  fires at
 ``fit.execute``       every jitted-program launch that goes through
                       ``progcache.launch`` (utils/progcache.py) — the
                       jitted-fit chokepoint, where a device OOM surfaces
+``ckpt.write``        every periodic checkpoint write
+                      (utils/checkpoint.Checkpointer.write) — a failed
+                      write must warn + count, never kill a healthy fit
+``ckpt.restore``      every checkpoint restore attempt
+                      (utils/checkpoint.Checkpointer.restore) — a fault
+                      here is a corrupt/unreadable checkpoint: fresh fit
+                      under ``Config.resume="auto"``, CheckpointError
+                      under ``resume="require"``
 ====================  =====================================================
 
 Arming: ``Config.fault_spec`` / env ``OAP_MLLIB_TPU_FAULT_SPEC``, a
@@ -49,7 +57,10 @@ from typing import Dict, Optional
 
 from oap_mllib_tpu.config import get_config
 
-SITES = ("stream.read", "prefetch.stage", "bootstrap.connect", "fit.execute")
+SITES = (
+    "stream.read", "prefetch.stage", "bootstrap.connect", "fit.execute",
+    "ckpt.write", "ckpt.restore",
+)
 
 KIND_FAIL = "fail"
 KIND_OOM = "oom"

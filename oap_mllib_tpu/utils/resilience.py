@@ -175,7 +175,8 @@ class ResilienceStats:
     """Per-fit fault accounting, merged into fit summaries next to the
     ``progcache`` delta (see :func:`merge_stats`)."""
 
-    __slots__ = ("retries", "degradations", "faults", "backoff_s", "history")
+    __slots__ = ("retries", "degradations", "faults", "backoff_s", "history",
+                 "ladder")
 
     def __init__(self) -> None:
         self.retries = 0  # transient retries taken
@@ -183,6 +184,13 @@ class ResilienceStats:
         self.faults = 0  # faults observed (classified exceptions)
         self.backoff_s = 0.0  # total wall slept in backoff
         self.history: List[str] = []  # "<site>[<kind>]: <message>" entries
+        # which protections were live for this fit: "active" (the full
+        # single-process ladder) vs "bypassed(static-world)" (multi-
+        # process worlds keep fail-fast-together semantics; recovery
+        # there is restart-level — utils/checkpoint.py resume).  Stamped
+        # by resilient_fit so operators can read a fit summary and know
+        # WHY no rung fired, not just that none did.
+        self.ladder = "active"
 
     def record(self, site: str, kind: Optional[str], exc: BaseException) -> None:
         self.faults += 1
@@ -222,6 +230,7 @@ class ResilienceStats:
             "faults": self.faults,
             "backoff_s": self.backoff_s,
             "history": list(self.history),
+            "ladder": self.ladder,
         }
 
 
@@ -345,7 +354,9 @@ def resilient_fit(
 
     stats = stats or ResilienceStats()
     if _world() > 1:
+        stats.ladder = "bypassed(static-world)"
         return attempt(False)
+    stats.ladder = "active"
     policy = policy or RetryPolicy.from_config()
     deadline = time.monotonic() + policy.deadline_s
     degraded = False

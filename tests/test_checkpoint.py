@@ -1,0 +1,522 @@
+"""Elastic-worlds checkpoint/resume (utils/checkpoint.py, ISSUE 8).
+
+Covers the subsystem bottom-up: the atomic write primitives, the
+manifest/shard protocol and its corruption tiers, same-world
+bit-identical continuation on every fit path of all three estimators,
+cross-world-size resharded restores (block-layout changes through the
+collective resharding pass), the ``ckpt.*`` fault sites, and a genuine
+kill-and-resume subprocess leg (a fit hard-killed mid-pass by its own
+source, relaunched, and required to match the uninterrupted run
+bit-for-bit).  The 2-process pseudo-cluster leg lives in
+tests/test_pseudo_cluster.py; the CI gate is dev/checkpoint_gate.py.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu.config import get_config, set_config
+from oap_mllib_tpu.data import io as data_io
+from oap_mllib_tpu.data.stream import ChunkSource
+from oap_mllib_tpu.models.als import ALS
+from oap_mllib_tpu.models.kmeans import KMeans
+from oap_mllib_tpu.models.pca import PCA
+from oap_mllib_tpu.utils import checkpoint as ckpt_mod
+from oap_mllib_tpu.utils import faults
+from oap_mllib_tpu.utils.checkpoint import CheckpointError
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def blobs(rng):
+    proto = rng.normal(size=(4, 10)).astype(np.float32) * 3.0
+    return (proto[rng.integers(4, size=1600)]
+            + rng.normal(size=(1600, 10)).astype(np.float32) * 0.2)
+
+
+@pytest.fixture
+def noise(rng):
+    # structureless data: Lloyd never hits an exact fixpoint, so pass
+    # counts equal max_iter — the iterate-count assertions stay exact
+    return rng.normal(size=(1600, 10)).astype(np.float32)
+
+
+@pytest.fixture
+def ratings(rng):
+    nu, ni = 50, 30
+    u = rng.integers(nu, size=900).astype(np.int64)
+    i = rng.integers(ni, size=900).astype(np.int64)
+    v = (rng.random(900).astype(np.float32) * 4 + 1)
+    u[0], i[0] = nu - 1, ni - 1
+    return u, i, v
+
+
+class TestAtomicIO:
+    def test_json_roundtrip_and_no_tmp_left(self, tmp_path):
+        p = str(tmp_path / "m.json")
+        n = data_io.atomic_write_json(p, {"a": 1, "b": [2, 3]})
+        assert n > 0
+        assert data_io.read_json(p) == {"a": 1, "b": [2, 3]}
+        assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+    def test_npz_roundtrip(self, tmp_path):
+        p = str(tmp_path / "s.npz")
+        arrays = {"x": np.arange(6).reshape(2, 3).astype(np.float32)}
+        assert data_io.atomic_save_npz(p, arrays) == os.path.getsize(p)
+        out = data_io.load_npz(p)
+        np.testing.assert_array_equal(out["x"], arrays["x"])
+
+    def test_replace_is_atomic_generation_flip(self, tmp_path):
+        p = str(tmp_path / "m.json")
+        data_io.atomic_write_json(p, {"gen": 1})
+        data_io.atomic_write_json(p, {"gen": 2})
+        assert data_io.read_json(p) == {"gen": 2}
+
+
+class TestCheckpointerCore:
+    def test_off_by_default_zero_objects(self):
+        assert ckpt_mod.maybe_open("kmeans", {"k": 2}) is None
+
+    def test_resume_typo_raises(self, tmp_path):
+        set_config(checkpoint_dir=str(tmp_path), resume="sometimes")
+        with pytest.raises(ValueError, match="resume must be"):
+            ckpt_mod.maybe_open("kmeans", {"k": 2})
+
+    def test_interval_gates_writes(self, tmp_path, noise):
+        set_config(checkpoint_dir=str(tmp_path), checkpoint_interval=2)
+        m = KMeans(k=3, seed=1, max_iter=5, tol=0.0).fit(
+            ChunkSource.from_array(noise, chunk_rows=512)
+        )
+        # passes 2 and 4 land; pass 5 is not a boundary and not converged
+        assert m.summary.checkpoint["writes"] == 2
+        assert m.summary.checkpoint["last_step"] == 4
+
+    def test_signature_mismatch_is_fresh(self, tmp_path, blobs):
+        set_config(checkpoint_dir=str(tmp_path))
+        src = ChunkSource.from_array(blobs, chunk_rows=512)
+        KMeans(k=3, seed=1, max_iter=3).fit(src)
+        m = KMeans(k=4, seed=1, max_iter=3).fit(src)  # different k
+        assert m.summary.checkpoint["decision"] == "fresh"
+
+    def test_manifest_names_world_and_signature(self, tmp_path, blobs):
+        set_config(checkpoint_dir=str(tmp_path))
+        m = KMeans(k=3, seed=1, max_iter=3).fit(
+            ChunkSource.from_array(blobs, chunk_rows=512)
+        )
+        mdir = m.summary.checkpoint["dir"]
+        man = data_io.read_json(os.path.join(mdir, "manifest.json"))
+        assert man["world"] == 1 and man["algo"] == "kmeans"
+        assert man["signature"]["k"] == 3
+        assert man["step"] == m.summary.num_iter
+
+    def test_gc_keeps_two_generations(self, tmp_path, noise):
+        set_config(checkpoint_dir=str(tmp_path))
+        m = KMeans(k=3, seed=1, max_iter=6, tol=0.0).fit(
+            ChunkSource.from_array(noise, chunk_rows=512)
+        )
+        mdir = m.summary.checkpoint["dir"]
+        shards = [f for f in os.listdir(mdir) if f.endswith(".npz")]
+        assert len(shards) == 2  # newest two of six writes
+
+
+class TestSameWorldContinuation:
+    """Kill-free continuation units: a fit stopped at step N (via
+    max_iter) and re-run to completion must equal the uninterrupted
+    checkpoint-armed run bit-for-bit, on every wired path."""
+
+    def _continue_equals_full(self, tmp_a, tmp_b, fit_fn, get_state):
+        set_config(checkpoint_dir=str(tmp_a))
+        full = fit_fn(max_iter=6)
+        set_config(checkpoint_dir=str(tmp_b))
+        fit_fn(max_iter=3)
+        resumed = fit_fn(max_iter=6)
+        ck = (resumed.summary.checkpoint
+              if not isinstance(resumed.summary, dict)
+              else resumed.summary["checkpoint"])
+        assert ck["decision"] == "found"
+        assert ck["restored_step"] == 3
+        for a, b in zip(get_state(full), get_state(resumed)):
+            np.testing.assert_array_equal(a, b)
+        return full, resumed
+
+    def test_streamed_kmeans(self, tmp_path, noise):
+        def fit(max_iter):
+            return KMeans(k=3, seed=2, max_iter=max_iter, tol=0.0).fit(
+                ChunkSource.from_array(noise, chunk_rows=512)
+            )
+
+        full, resumed = self._continue_equals_full(
+            tmp_path / "a", tmp_path / "b", fit,
+            lambda m: [m.cluster_centers_],
+        )
+        assert full.summary.training_cost == resumed.summary.training_cost
+
+    def test_in_memory_kmeans_segmented(self, tmp_path, noise):
+        def fit(max_iter):
+            return KMeans(k=3, seed=2, max_iter=max_iter, tol=0.0).fit(noise)
+
+        self._continue_equals_full(
+            tmp_path / "a", tmp_path / "b", fit,
+            lambda m: [m.cluster_centers_],
+        )
+
+    def test_in_memory_kmeans_checkpointed_matches_unarmed(self, blobs,
+                                                          tmp_path):
+        """Segmentation must not change the iterate sequence: a
+        checkpoint-armed in-memory fit equals the checkpoint-off fit
+        (tol=0 keeps convergence off segment boundaries)."""
+        base = KMeans(k=3, seed=2, max_iter=5, tol=0.0).fit(blobs)
+        set_config(checkpoint_dir=str(tmp_path))
+        armed = KMeans(k=3, seed=2, max_iter=5, tol=0.0).fit(blobs)
+        np.testing.assert_array_equal(
+            base.cluster_centers_, armed.cluster_centers_
+        )
+
+    def test_streamed_pca_resumes_past_colsum(self, tmp_path, blobs):
+        set_config(checkpoint_dir=str(tmp_path))
+        src = ChunkSource.from_array(blobs, chunk_rows=512)
+        full = PCA(k=3).fit(src)
+        resumed = PCA(k=3).fit(src)
+        assert resumed.summary["checkpoint"]["decision"] == "found"
+        np.testing.assert_array_equal(full.components_, resumed.components_)
+
+    def test_in_memory_pca_resumes_past_covariance(self, tmp_path, blobs):
+        set_config(checkpoint_dir=str(tmp_path))
+        full = PCA(k=3).fit(blobs)
+        resumed = PCA(k=3).fit(blobs)
+        assert resumed.summary["checkpoint"]["decision"] == "found"
+        np.testing.assert_array_equal(full.components_, resumed.components_)
+
+    @pytest.mark.parametrize("implicit", [True, False])
+    def test_block_als(self, tmp_path, ratings, implicit):
+        u, i, v = ratings
+
+        def fit(max_iter):
+            return ALS(rank=3, max_iter=max_iter, reg_param=0.1, alpha=0.8,
+                       implicit_prefs=implicit, seed=3).fit(u, i, v)
+
+        self._continue_equals_full(
+            tmp_path / "a", tmp_path / "b", fit,
+            lambda m: [m.user_factors_, m.item_factors_],
+        )
+
+    def test_single_device_als(self, tmp_path, ratings):
+        u, i, v = ratings
+
+        def fit(max_iter):
+            return ALS(rank=3, max_iter=max_iter, reg_param=0.1, seed=3,
+                       num_user_blocks=1).fit(u, i, v)
+
+        self._continue_equals_full(
+            tmp_path / "a", tmp_path / "b", fit,
+            lambda m: [m.user_factors_, m.item_factors_],
+        )
+
+    def test_streamed_block_als_sharded_items(self, tmp_path, ratings):
+        u, i, v = ratings
+        set_config(als_kernel="grouped", als_item_layout="sharded")
+        trip = np.stack([u.astype(np.float64), i.astype(np.float64),
+                         v.astype(np.float64)], axis=1)
+
+        def fit(max_iter):
+            return ALS(rank=3, max_iter=max_iter, reg_param=0.1, alpha=0.8,
+                       implicit_prefs=True, seed=3).fit(
+                ChunkSource.from_array(trip, chunk_rows=256)
+            )
+
+        self._continue_equals_full(
+            tmp_path / "a", tmp_path / "b", fit,
+            lambda m: [m.user_factors_, m.item_factors_],
+        )
+
+
+class TestReshardedRestore:
+    """Cross-world restores: the collective resharding pass must land the
+    resumed fit within fp tolerance of the uninterrupted oracle."""
+
+    def test_block_layout_shrink_and_grow(self, tmp_path, ratings):
+        u, i, v = ratings
+        base = ALS(rank=3, max_iter=4, reg_param=0.1, seed=3).fit(u, i, v)
+        # 8 blocks -> 2 blocks
+        set_config(checkpoint_dir=str(tmp_path / "s"))
+        ALS(rank=3, max_iter=2, reg_param=0.1, seed=3).fit(u, i, v)
+        m2 = ALS(rank=3, max_iter=4, reg_param=0.1, seed=3,
+                 num_user_blocks=2).fit(u, i, v)
+        assert m2.summary["checkpoint"]["decision"] == "resharded"
+        np.testing.assert_allclose(
+            m2.user_factors_, base.user_factors_, atol=1e-5, rtol=1e-5
+        )
+        # 2 blocks -> 8 blocks
+        set_config(checkpoint_dir=str(tmp_path / "g"))
+        ALS(rank=3, max_iter=2, reg_param=0.1, seed=3,
+            num_user_blocks=2).fit(u, i, v)
+        m8 = ALS(rank=3, max_iter=4, reg_param=0.1, seed=3).fit(u, i, v)
+        assert m8.summary["checkpoint"]["decision"] == "resharded"
+        np.testing.assert_allclose(
+            m8.user_factors_, base.user_factors_, atol=1e-5, rtol=1e-5
+        )
+
+    def test_single_device_to_blocks_and_back(self, tmp_path, ratings):
+        u, i, v = ratings
+        base = ALS(rank=3, max_iter=4, reg_param=0.1, seed=3).fit(u, i, v)
+        set_config(checkpoint_dir=str(tmp_path / "up"))
+        ALS(rank=3, max_iter=2, reg_param=0.1, seed=3,
+            num_user_blocks=1).fit(u, i, v)
+        up = ALS(rank=3, max_iter=4, reg_param=0.1, seed=3).fit(u, i, v)
+        assert up.summary["checkpoint"]["decision"] == "resharded"
+        np.testing.assert_allclose(
+            up.user_factors_, base.user_factors_, atol=1e-5, rtol=1e-5
+        )
+        set_config(checkpoint_dir=str(tmp_path / "down"))
+        ALS(rank=3, max_iter=2, reg_param=0.1, seed=3).fit(u, i, v)
+        down = ALS(rank=3, max_iter=4, reg_param=0.1, seed=3,
+                   num_user_blocks=1).fit(u, i, v)
+        assert down.summary["checkpoint"]["decision"] == "resharded"
+        np.testing.assert_allclose(
+            down.user_factors_, base.user_factors_, atol=1e-5, rtol=1e-5
+        )
+
+    def test_fabricated_two_rank_checkpoint_restores_single_process(
+            self, tmp_path, blobs):
+        """A manifest recording world=2 (per-rank shards fabricated as a
+        2-rank world would write them) must restore in THIS 1-process
+        world with decision 'resharded' and exact centroids (replicated
+        state)."""
+        set_config(checkpoint_dir=str(tmp_path))
+        sig = KMeans(k=3, seed=2, max_iter=5)._ckpt_signature(
+            blobs.shape[1], get_config()
+        )
+        centers = np.asarray(blobs[:3], np.float32)
+        ck = ckpt_mod.Checkpointer("kmeans", sig)
+        ck.world = 2  # fabricate the 2-rank world's write
+        for rank in (0, 1):
+            ck.rank = rank
+            ck._write_shard(2, {"centers": centers}, {})
+        ck.rank = 0
+        ck._write_manifest(2, ["centers"], {"converged": False}, [], {})
+        m = KMeans(k=3, seed=2, max_iter=2).fit(
+            ChunkSource.from_array(blobs, chunk_rows=512)
+        )
+        assert m.summary.checkpoint["decision"] == "resharded"
+        assert m.summary.checkpoint["old_world"] == 2
+        assert m.summary.checkpoint["new_world"] == 1
+
+
+class TestCorruptionTiers:
+    def _arm(self, tmp_path, blobs):
+        set_config(checkpoint_dir=str(tmp_path))
+        src = ChunkSource.from_array(blobs, chunk_rows=512)
+        m = KMeans(k=3, seed=1, max_iter=3).fit(src)
+        return src, m.summary.checkpoint["dir"]
+
+    def test_corrupt_manifest_auto_is_fresh(self, tmp_path, blobs):
+        src, mdir = self._arm(tmp_path, blobs)
+        with open(os.path.join(mdir, "manifest.json"), "w") as f:
+            f.write("{torn")
+        m = KMeans(k=3, seed=1, max_iter=3).fit(src)
+        assert m.summary.checkpoint["decision"] == "fresh"
+        assert "corrupt" in m.summary.checkpoint["reason"]
+
+    def test_corrupt_manifest_require_raises(self, tmp_path, blobs):
+        src, mdir = self._arm(tmp_path, blobs)
+        with open(os.path.join(mdir, "manifest.json"), "w") as f:
+            f.write("{torn")
+        set_config(resume="require")
+        with pytest.raises(CheckpointError, match="require"):
+            KMeans(k=3, seed=1, max_iter=3).fit(src)
+
+    def test_stale_shard_step_is_corrupt(self, tmp_path, blobs):
+        """Manifest pointing at a step whose shard carries another step
+        (the torn multi-rank write the barrier defends against) must be
+        treated as corrupt, not silently restored."""
+        src, mdir = self._arm(tmp_path, blobs)
+        man = data_io.read_json(os.path.join(mdir, "manifest.json"))
+        shard = [f for f in os.listdir(mdir) if f.endswith(".npz")][-1]
+        man["step"] = 99
+        os.rename(
+            os.path.join(mdir, shard),
+            os.path.join(mdir, f"step{99:08d}.rank0.npz"),
+        )
+        data_io.atomic_write_json(os.path.join(mdir, "manifest.json"), man)
+        m = KMeans(k=3, seed=1, max_iter=3).fit(src)
+        assert m.summary.checkpoint["decision"] == "fresh"
+
+    def test_require_without_any_checkpoint_raises(self, tmp_path, blobs):
+        set_config(checkpoint_dir=str(tmp_path), resume="require")
+        with pytest.raises(CheckpointError, match="no usable checkpoint"):
+            KMeans(k=3, seed=1, max_iter=3).fit(
+                ChunkSource.from_array(blobs, chunk_rows=512)
+            )
+
+    def test_resume_off_writes_but_never_reads(self, tmp_path, noise):
+        set_config(checkpoint_dir=str(tmp_path), resume="off")
+        src = ChunkSource.from_array(noise, chunk_rows=512)
+        KMeans(k=3, seed=1, max_iter=3).fit(src)
+        m = KMeans(k=3, seed=1, max_iter=3).fit(src)
+        assert m.summary.checkpoint["decision"] == "fresh"
+        assert m.summary.checkpoint["reason"] == "resume=off"
+        assert m.summary.checkpoint["writes"] == 3
+
+
+class TestFaultSites:
+    def test_write_fault_warns_and_fit_survives(self, tmp_path, noise):
+        from oap_mllib_tpu.telemetry import metrics as tm
+
+        set_config(
+            checkpoint_dir=str(tmp_path), fault_spec="ckpt.write:fail=2"
+        )
+        faults.reset()
+        before = tm.snapshot().get(
+            "oap_checkpoint_write_failures_total", {}
+        ).get("algo=kmeans", 0.0)
+        m = KMeans(k=3, seed=1, max_iter=4, tol=0.0).fit(
+            ChunkSource.from_array(noise, chunk_rows=512)
+        )
+        assert m.summary.accelerated
+        assert m.summary.checkpoint["writes"] == 2  # 2 of 4 failed
+        after = tm.snapshot()[
+            "oap_checkpoint_write_failures_total"]["algo=kmeans"]
+        assert after - before == 2
+
+    def test_restore_fault_auto_fresh_require_raises(self, tmp_path, blobs):
+        src = ChunkSource.from_array(blobs, chunk_rows=512)
+        set_config(checkpoint_dir=str(tmp_path))
+        KMeans(k=3, seed=1, max_iter=3).fit(src)
+        set_config(fault_spec="ckpt.restore:err=1")
+        faults.reset()
+        m = KMeans(k=3, seed=1, max_iter=3).fit(src)
+        assert m.summary.checkpoint["decision"] == "fresh"
+        set_config(resume="require")
+        faults.reset()
+        with pytest.raises(CheckpointError):
+            KMeans(k=3, seed=1, max_iter=3).fit(src)
+
+    def test_ckpt_sites_registered(self):
+        assert "ckpt.write" in faults.SITES
+        assert "ckpt.restore" in faults.SITES
+        parsed = faults.parse_spec("ckpt.write:fail=1,ckpt.restore:err=*")
+        assert parsed["ckpt.write"].kind == faults.KIND_FAIL
+        assert parsed["ckpt.restore"].limit == -1
+
+
+class TestHardenedModelPersistence:
+    def test_kmeans_save_atomic_and_validated(self, tmp_path, blobs):
+        from oap_mllib_tpu.models.kmeans import KMeansModel
+
+        m = KMeans(k=3, seed=1, max_iter=2).fit(blobs)
+        p = str(tmp_path / "km")
+        m.save(p)
+        assert [f for f in os.listdir(p) if f.endswith(".tmp")] == []
+        meta = data_io.read_json(os.path.join(p, "metadata.json"))
+        assert meta["shape"] == [3, blobs.shape[1]]
+        # torn directory: centers from a different save
+        np.save(os.path.join(p, "centers.npy"), np.zeros((7, 2), np.float32))
+        with pytest.raises(ValueError) as e:
+            KMeansModel.load(p)
+        assert "centers.npy" in str(e.value) and "(3," in str(e.value)
+
+    def test_pca_save_validated(self, tmp_path, blobs):
+        from oap_mllib_tpu.models.pca import PCAModel
+
+        m = PCA(k=3).fit(blobs)
+        p = str(tmp_path / "pc")
+        m.save(p)
+        np.save(os.path.join(p, "components.npy"),
+                np.zeros((blobs.shape[1], 9), np.float32))
+        with pytest.raises(ValueError, match="components.npy"):
+            PCAModel.load(p)
+
+    def test_als_save_validated(self, tmp_path, ratings):
+        from oap_mllib_tpu.models.als import ALSModel
+
+        u, i, v = ratings
+        m = ALS(rank=3, max_iter=2, seed=3).fit(u, i, v)
+        p = str(tmp_path / "als")
+        m.save(p)
+        np.save(os.path.join(p, "user_factors.npy"),
+                np.zeros((5, 9), np.float32))
+        with pytest.raises(ValueError, match="user_factors.npy"):
+            ALSModel.load(p)
+
+
+class TestLadderVisibility:
+    def test_single_process_fit_reports_active_ladder(self, blobs):
+        m = KMeans(k=3, seed=1, max_iter=2).fit(blobs)
+        assert m.summary.resilience["ladder"] == "active"
+
+
+_KILL_SCRIPT = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.data.stream import ChunkSource
+from oap_mllib_tpu.models.kmeans import KMeans
+
+mode, ckdir = sys.argv[1], sys.argv[2]
+rng = np.random.default_rng(11)
+proto = rng.normal(size=(4, 8)).astype(np.float32) * 3.0
+x = (proto[rng.integers(4, size=1500)]
+     + rng.normal(size=(1500, 8)).astype(np.float32) * 0.2)
+
+passes = {"n": 0}
+
+def gen():
+    passes["n"] += 1
+    # source walk 1 = the random-init reservoir pass; Lloyd passes are
+    # walks 2+.  The victim dies mid-read of Lloyd pass 3 (walk 4),
+    # with passes 1 and 2 checkpointed durably.
+    if mode == "victim" and passes["n"] == 4:
+        os._exit(9)  # hard kill: no cleanup, no atexit — a preemption
+    for lo in range(0, x.shape[0], 500):
+        yield x[lo:lo + 500]
+
+src = ChunkSource(gen, x.shape[1], 500, n_rows=x.shape[0])
+set_config(checkpoint_dir=ckdir)
+m = KMeans(k=4, seed=7, init_mode="random", max_iter=8, tol=0.0).fit(src)
+ck = m.summary.checkpoint
+print("RESULT", repr((float(m.summary.training_cost),
+                      m.cluster_centers_.tobytes().hex(),
+                      ck["decision"], ck["restored_step"])))
+"""
+
+
+class TestKillAndResume:
+    def test_hard_killed_fit_resumes_bit_identical(self, tmp_path):
+        """The acceptance leg, single-process form: a fit hard-killed
+        (os._exit inside its own source, no cleanup) at pass 3 is
+        relaunched with the same config and must produce the
+        uninterrupted run's model bit-for-bit."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+        def run(mode, ckdir):
+            return subprocess.run(
+                [sys.executable, "-c", _KILL_SCRIPT, mode, ckdir],
+                capture_output=True, text=True, env=env, cwd=_REPO,
+                timeout=240,
+            )
+
+        full = run("full", str(tmp_path / "full"))
+        assert full.returncode == 0, full.stdout + full.stderr
+        victim = run("victim", str(tmp_path / "kill"))
+        assert victim.returncode == 9  # genuinely killed mid-pass
+        resumed = run("resume", str(tmp_path / "kill"))
+        assert resumed.returncode == 0, resumed.stdout + resumed.stderr
+
+        def parse(out):
+            line = [ln for ln in out.splitlines() if ln.startswith("RESULT")]
+            return eval(line[-1][len("RESULT "):])  # noqa: S307 — own output
+
+        cost_f, centers_f, dec_f, _ = parse(full.stdout)
+        cost_r, centers_r, dec_r, step_r = parse(resumed.stdout)
+        assert dec_f == "fresh" and dec_r == "found"
+        assert step_r == 2  # killed mid-pass-3 -> pass 2 is durable
+        assert centers_r == centers_f  # bit-identical continuation
+        assert cost_r == cost_f

@@ -502,6 +502,112 @@ class TestPseudoCluster:
 _SANITIZER_WORKER = os.path.join(
     os.path.dirname(__file__), "pseudo_cluster_worker_sanitizer.py"
 )
+_CKPT_WORKER = os.path.join(
+    os.path.dirname(__file__), "pseudo_cluster_worker_ckpt.py"
+)
+
+
+class TestElasticWorlds:
+    """ISSUE 8 acceptance: kill-and-resume across a REAL 2-process world
+    (utils/checkpoint.py), plus the 2->1 resharded restore."""
+
+    def _launch_kill_world(self, ckdir, timeout=240):
+        """Victim world: rank 1 hard-kills itself mid-pass; rank 0 is
+        left in the pass collective and reaped by this watchdog — the
+        preemption the elastic-worlds subsystem exists for."""
+        import time
+
+        from oap_mllib_tpu.parallel.bootstrap import free_port
+
+        coord = f"127.0.0.1:{free_port('127.0.0.1', 4000)}"
+        env = _worker_env()
+        env.update({
+            "CKPT_WORKER_MODE": "victim", "CKPT_CHECKPOINT_DIR": ckdir,
+        })
+        procs = [
+            subprocess.Popen(
+                [sys.executable, _CKPT_WORKER, str(r), "2", coord, "1"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=_REPO,
+            )
+            for r in range(2)
+        ]
+        deadline = time.monotonic() + timeout
+        while procs[1].poll() is None and time.monotonic() < deadline:
+            time.sleep(0.5)
+        # rank 0 has lost its peer; give it a moment, then reap it
+        grace = time.monotonic() + 20
+        while procs[0].poll() is None and time.monotonic() < grace:
+            time.sleep(0.5)
+        outs = []
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            out, _ = p.communicate(timeout=60)
+            outs.append(out)
+        _skip_if_environment_cannot_spawn(procs, outs)
+        return procs, outs
+
+    def test_kill_and_resume_matches_uninterrupted(self, tmp_path):
+        full_dir = str(tmp_path / "full")
+        kill_dir = str(tmp_path / "kill")
+        # leg 1: the uninterrupted checkpoint-armed world (the oracle)
+        full = _run_world(
+            nproc=2, local_dev=1, worker=_CKPT_WORKER,
+            env_extra={"CKPT_WORKER_MODE": "full",
+                       "CKPT_CHECKPOINT_DIR": full_dir},
+        )
+        assert full[0]["decision"] == "fresh"
+        assert full[0]["ladder"] == "bypassed(static-world)"
+        assert full[0]["centers_hex"] == full[1]["centers_hex"]
+
+        # leg 2: the same fit, rank 1 preempted mid-pass-3
+        procs, outs = self._launch_kill_world(kill_dir)
+        assert procs[1].returncode == 9, outs[1]  # genuinely killed
+        # passes 1-2 are durable: both rank shards + the manifest
+        mdirs = os.listdir(kill_dir)
+        assert len(mdirs) == 1
+        manifest = json.load(
+            open(os.path.join(kill_dir, mdirs[0], "manifest.json"))
+        )
+        assert manifest["step"] == 2 and manifest["world"] == 2
+
+        # leg 3: a RELAUNCHED 2-process world resumes and must match the
+        # uninterrupted run bit-for-bit
+        resumed = _run_world(
+            nproc=2, local_dev=1, worker=_CKPT_WORKER,
+            env_extra={"CKPT_WORKER_MODE": "resume",
+                       "CKPT_CHECKPOINT_DIR": kill_dir},
+        )
+        for rank in (0, 1):
+            assert resumed[rank]["decision"] == "found"
+            assert resumed[rank]["restored_step"] == 2
+            assert resumed[rank]["centers_hex"] == full[rank]["centers_hex"]
+            assert resumed[rank]["cost"] == full[rank]["cost"]
+
+        # leg 4: 2 -> 1 resharded restore — THIS process (a 1-process
+        # world) consumes the 2-rank checkpoint and must land within fp
+        # tolerance of the 2-process run (reduction order changes)
+        import numpy as _np
+
+        from oap_mllib_tpu.config import set_config
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        rng = _np.random.default_rng(321)  # must match the worker
+        x = rng.normal(size=(3000, 8)).astype(_np.float32)
+        set_config(checkpoint_dir=kill_dir)
+        try:
+            m1 = KMeans(
+                k=4, seed=7, init_mode="random", max_iter=6, tol=0.0
+            ).fit(ChunkSource.from_array(x, chunk_rows=500))
+        finally:
+            set_config(checkpoint_dir="")
+        assert m1.summary.checkpoint["decision"] == "resharded"
+        assert m1.summary.checkpoint["old_world"] == 2
+        _np.testing.assert_allclose(
+            m1.summary.training_cost, full[0]["cost"], rtol=1e-5
+        )
 
 
 class TestSanitizerPlane:

@@ -188,6 +188,77 @@ class TestFaultRegistry:
         faults.maybe_fault("stream.read")  # disarmed by config change
 
 
+class TestCheckpointFaultSites:
+    """The ``ckpt.*`` fault sites (ISSUE 8 satellite): registry-level
+    behavior here; the fit-level tiers (warn-never-kill writes, the
+    corrupt-restore `resume` decision) are tests/test_checkpoint.py."""
+
+    def test_sites_registered_and_grammar_accepts(self):
+        assert "ckpt.write" in faults.SITES
+        assert "ckpt.restore" in faults.SITES
+        parsed = faults.parse_spec(
+            "ckpt.write:fail=2,ckpt.restore:err=*"
+        )
+        assert parsed["ckpt.write"].limit == 2
+        assert parsed["ckpt.restore"].limit == -1
+
+    def test_all_kinds_fire_deterministically(self):
+        for kind, exc in (
+            ("fail", faults.InjectedTransientError),
+            ("oom", faults.InjectedOOMError),
+            ("err", faults.InjectedPermanentError),
+            ("nan", faults.InjectedNonFiniteError),
+        ):
+            set_config(fault_spec=f"ckpt.write:{kind}=1")
+            faults.reset()
+            with pytest.raises(exc):
+                faults.maybe_fault("ckpt.write")
+            faults.maybe_fault("ckpt.write")  # budget spent: silent
+            assert faults.stats()["ckpt.write"]["fired"] == 1
+
+    def test_write_site_fault_never_escalates_the_ladder(self, rng):
+        """A persistent ckpt.write fault must not consume ladder rungs:
+        the fit completes accelerated with zero retries/degradations
+        (checkpoint writes are insurance, outside the fault ladder)."""
+        import tempfile
+
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        set_config(
+            checkpoint_dir=tempfile.mkdtemp(),
+            fault_spec="ckpt.write:fail=*",
+        )
+        faults.reset()
+        x = rng.normal(size=(600, 6)).astype(np.float32)
+        m = KMeans(k=3, seed=1, max_iter=3).fit(
+            ChunkSource.from_array(x, chunk_rows=256)
+        )
+        assert m.summary.accelerated
+        assert m.summary.resilience["retries"] == 0
+        assert m.summary.resilience["degradations"] == 0
+        assert m.summary.checkpoint["writes"] == 0
+        set_config(checkpoint_dir="")
+
+
+class TestLadderVisibility:
+    def test_stats_default_and_bypass_label(self):
+        stats = ResilienceStats()
+        assert stats.as_dict()["ladder"] == "active"
+        out = resilience.resilient_fit(
+            "t", lambda degraded: "ok", None, stats=stats
+        )
+        assert out == "ok"
+        assert stats.ladder == "active"  # single-process world
+
+    def test_bypass_label_when_world_large(self, monkeypatch):
+        monkeypatch.setattr(resilience, "_world", lambda: 2)
+        stats = ResilienceStats()
+        resilience.resilient_fit(
+            "t", lambda degraded: "ok", None, stats=stats
+        )
+        assert stats.ladder == "bypassed(static-world)"
+
+
 class TestLadderRungs:
     """Each rung driven end to end through a real streamed K-Means fit."""
 
