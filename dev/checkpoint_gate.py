@@ -35,6 +35,15 @@ import tempfile
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the resharded leg needs the suite's 8-device virtual mesh: on a single
+# device the 8-block checkpoint and the 2-block relaunch collapse to the
+# same layout and no resharding happens (the sanitizer/telemetry gates'
+# setup)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 

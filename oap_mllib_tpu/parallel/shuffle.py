@@ -241,7 +241,16 @@ def reshard_factor_rows(
     axis = cfg.data_axis
     world = mesh.shape[axis]
     nproc = jax.process_count()
-    local_sources = max(1, world // nproc)
+    if world % nproc:
+        # the bucket round-robin and the (world, world) counts reshape
+        # below assume every process addresses world // nproc mesh slots
+        # (the exchange_ratings machinery's contract); an uneven split
+        # would silently misassign rows, so refuse it loudly
+        raise ValueError(
+            f"reshard_factor_rows requires the {axis!r} axis size "
+            f"({world}) to be a multiple of process_count ({nproc})"
+        )
+    local_sources = world // nproc
     r = vals.shape[1]
 
     dst = np.clip(
@@ -260,9 +269,22 @@ def reshard_factor_rows(
     if nproc > 1:
         from jax.experimental import multihost_utils
 
-        counts = np.asarray(
-            multihost_utils.process_allgather(counts_local)
-        ).reshape(world, world)
+        # r rides the counts allgather: every process derives the padded
+        # record width (r + 2) from ITS vals, and a rank-divergent width
+        # would crash or hang the all_to_all with mismatched shapes —
+        # diagnose it here instead (shard-less restore ranks get their r
+        # from the checkpoint manifest, utils/checkpoint._load)
+        payload = np.concatenate(
+            [np.asarray([r], np.int64), counts_local.reshape(-1)]
+        )
+        gathered = np.asarray(multihost_utils.process_allgather(payload))
+        peer_r = gathered[:, 0]
+        if not (peer_r == r).all():
+            raise ValueError(
+                "reshard_factor_rows: factor width r diverges across "
+                f"ranks: {sorted(set(int(x) for x in peer_r))}"
+            )
+        counts = gathered[:, 1:].reshape(world, world)
     else:
         counts = counts_local
     max_bucket = max(1, int(counts.max()))

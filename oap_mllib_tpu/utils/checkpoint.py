@@ -56,6 +56,7 @@ import hashlib
 import json
 import logging
 import os
+import re
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -71,6 +72,7 @@ log = logging.getLogger("oap_mllib_tpu")
 MANIFEST = "manifest.json"
 _VERSION = 1
 _KEEP_GENERATIONS = 2
+_SHARD_RE = re.compile(r"step(\d{8})\.rank(\d+)\.npz$")
 
 DECISION_FOUND = "found"
 DECISION_FRESH = "fresh"
@@ -342,17 +344,35 @@ class Checkpointer:
                 err if err is not None else "failure on a peer rank",
             )
             return False
+        flip_ok = True
         if self.rank == 0:
             try:
                 self._write_manifest(step, list(arrays), extra,
-                                     list(sharded), layout)
+                                     sharded, layout)
             except Exception as e:  # noqa: BLE001
+                flip_ok = False
                 log.warning(
                     "%s: checkpoint manifest flip at step %d failed (%s); "
                     "the previous generation stays live",
                     self.algo, step, e,
                 )
-                return False
+        # second rank-uniform agreement: the manifest flip is the commit
+        # point, so a failed flip must look failed on EVERY rank — peers
+        # must not count writes/last_step (and report a durable
+        # checkpoint in metrics and the fit summary) while the manifest
+        # still names the previous generation.
+        if not self._sync_ok(flip_ok):
+            _tm.counter(
+                "oap_checkpoint_write_failures_total", {"algo": self.algo},
+                help="Checkpoint writes that failed (warned, fit continued)",
+            ).inc()
+            if self.rank != 0:
+                log.warning(
+                    "%s: checkpoint manifest flip at step %d failed on "
+                    "rank 0; the previous generation stays live",
+                    self.algo, step,
+                )
+            return False
         self._gc()
         self.writes += 1
         self.bytes_written += nbytes
@@ -390,10 +410,22 @@ class Checkpointer:
         path = os.path.join(self.dir, self._shard_name(step, self.rank))
         return _io.atomic_save_npz(path, payload)
 
-    def _write_manifest(self, step, array_names, extra, sharded_names,
+    def _write_manifest(self, step, array_names, extra, sharded,
                         layout) -> None:
         from oap_mllib_tpu.parallel.bootstrap import world_layout
 
+        # per-name value width of the sharded state (``sharded`` is the
+        # name -> (ids, vals) dict; a bare name list is accepted for
+        # fabricated-manifest tests).  Recorded so a restoring rank that
+        # was assigned NO old shards (the world grew) can still build its
+        # empty (0, r) placeholder with the TRUE width — widths derived
+        # per-rank from local data would be rank-divergent there, and
+        # rank-divergent buffer shapes hang the restore collectives.
+        widths = {}
+        if isinstance(sharded, dict):
+            for name, (_ids, vals) in sharded.items():
+                v = np.asarray(vals)
+                widths[name] = int(v.shape[1]) if v.ndim == 2 else 1
         wl = world_layout()
         manifest = {
             "version": _VERSION,
@@ -402,7 +434,8 @@ class Checkpointer:
             "world": self.world,
             "devices": wl["devices"],
             "arrays": sorted(array_names),
-            "sharded": sorted(sharded_names),
+            "sharded": sorted(sharded),
+            "widths": widths,
             "extra": extra,
             "layout": layout,
             "signature": self.signature,
@@ -428,14 +461,27 @@ class Checkpointer:
     def _gc(self) -> None:
         """Drop THIS rank's shards beyond the newest _KEEP_GENERATIONS
         (best-effort; a racing reader already holds its data in memory —
-        data/io.load_npz materializes eagerly)."""
+        data/io.load_npz materializes eagerly).  Rank 0 additionally
+        drops VANISHED ranks' shards — ranks >= the current world, left
+        behind by a restore onto a smaller world — once their generation
+        ages out of the kept set, so elastic cycles in a long-lived
+        checkpoint_dir cannot accumulate orphans no live rank owns."""
         try:
-            mine = sorted(
-                f for f in os.listdir(self.dir)
-                if f.endswith(f".rank{self.rank}.npz")
-            )
+            entries = []
+            for f in os.listdir(self.dir):
+                m = _SHARD_RE.match(f)
+                if m:
+                    entries.append((int(m.group(1)), int(m.group(2)), f))
+            mine = sorted(f for step, rank, f in entries
+                          if rank == self.rank)
             for f in mine[:-_KEEP_GENERATIONS]:
                 os.unlink(os.path.join(self.dir, f))
+            if self.rank == 0:
+                kept = set(sorted({step for step, _, _ in entries})
+                           [-_KEEP_GENERATIONS:])
+                for step, rank, f in entries:
+                    if rank >= self.world and step not in kept:
+                        os.unlink(os.path.join(self.dir, f))
         except OSError:
             pass
 
@@ -529,6 +575,10 @@ class Checkpointer:
         # caller reshards collectively (shuffle.reshard_factor_rows)
         sharded: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
         if manifest["sharded"]:
+            widths = {
+                n: int(w)
+                for n, w in dict(manifest.get("widths", {})).items()
+            }
             per_name: Dict[str, Tuple[list, list]] = {
                 n: ([], []) for n in manifest["sharded"]
             }
@@ -543,10 +593,16 @@ class Checkpointer:
                     per_name[name][0].append(shard[f"s.{name}.ids"])
                     per_name[name][1].append(shard[f"s.{name}.vals"])
             for name, (ids, vals) in per_name.items():
+                # a rank assigned no old shards (the world GREW past
+                # old_world) still participates in the restore gathers
+                # and the resharding all_to_all, whose record widths
+                # every rank derives from vals.shape[1] — so the empty
+                # placeholder must carry the manifest-recorded value
+                # width, never a guessed one
                 sharded[name] = (
                     np.concatenate(ids) if ids else np.zeros((0,), np.int64),
                     np.concatenate(vals) if vals else np.zeros(
-                        (0, 1), np.float32),
+                        (0, widths.get(name, 1)), np.float32),
                 )
         self.last_step = step
         return RestoreResult(

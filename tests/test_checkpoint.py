@@ -306,6 +306,125 @@ class TestReshardedRestore:
         assert m.summary.checkpoint["new_world"] == 1
 
 
+class TestElasticWorldEdges:
+    """Hardening around the grow path and the commit protocol: the
+    empty-shard placeholder's width, the manifest-flip agreement,
+    vanished-rank garbage collection, and reshard preconditions."""
+
+    def test_grown_world_shardless_rank_gets_true_width(self, tmp_path):
+        """A rank assigned no old shards (the world GREW past old_world)
+        must build its empty placeholder with the manifest-recorded
+        value width, not a guessed width 1: every restore collective
+        derives record widths from vals.shape[1] per-process, and
+        rank-divergent widths crash or hang the world."""
+        set_config(checkpoint_dir=str(tmp_path))
+        ids = np.arange(4, dtype=np.int64)
+        vals = np.arange(12, dtype=np.float32).reshape(4, 3)
+        ck = ckpt_mod.Checkpointer("als", {"rank": 3})
+        ck.world = 2
+        for rank in (0, 1):
+            ck.rank = rank
+            ck._write_shard(5, {}, {"x": (ids + 4 * rank, vals + rank)})
+        ck.rank = 0
+        ck._write_manifest(5, [], {}, {"x": (ids, vals)}, {})
+        man = data_io.read_json(os.path.join(ck.dir, "manifest.json"))
+        assert man["widths"] == {"x": 3}
+
+        grown = ckpt_mod.Checkpointer("als", {"rank": 3})
+        grown.world, grown.rank = 3, 2  # no old rank maps to rank 2
+        res = grown._load()
+        gids, gvals = res.sharded["x"]
+        assert gids.shape == (0,)
+        assert gvals.shape == (0, 3) and gvals.dtype == np.float32
+        assert res.decision == "resharded" and res.old_world == 2
+        # a data-bearing rank of the same grown world agrees on width
+        bearing = ckpt_mod.Checkpointer("als", {"rank": 3})
+        bearing.world, bearing.rank = 3, 0
+        _, bvals = bearing._load().sharded["x"]
+        assert bvals.shape[1] == gvals.shape[1]
+
+    def test_manifest_flip_failure_is_rank_uniform(self, tmp_path,
+                                                   monkeypatch):
+        """A peer rank must not count a write as durable when rank 0's
+        manifest flip failed — the second agreement carries the flip
+        outcome to every rank before writes/last_step advance."""
+        set_config(checkpoint_dir=str(tmp_path))
+        ck = ckpt_mod.Checkpointer("kmeans", {"k": 2})
+        ck.world, ck.rank = 2, 1
+        outcomes = []
+        monkeypatch.setattr(
+            ck, "_sync_ok",
+            lambda ok: outcomes.append(ok) or len(outcomes) == 1,
+        )
+        ok = ck.maybe_write(
+            1, {"c": np.zeros((2, 2), np.float32)}, force=True
+        )
+        assert ok is False
+        assert outcomes == [True, True]  # shard landed; flip agreement ran
+        assert ck.writes == 0 and ck.last_step == -1
+
+    def test_rank0_flip_failure_counts_failed_write(self, tmp_path,
+                                                    monkeypatch):
+        from oap_mllib_tpu.telemetry import metrics as tm
+
+        set_config(checkpoint_dir=str(tmp_path))
+        ck = ckpt_mod.Checkpointer("kmeans", {"k": 2})
+
+        def boom(*a, **k):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(ck, "_write_manifest", boom)
+        before = tm.snapshot().get(
+            "oap_checkpoint_write_failures_total", {}
+        ).get("algo=kmeans", 0.0)
+        ok = ck.maybe_write(
+            1, {"c": np.zeros((2, 2), np.float32)}, force=True
+        )
+        assert ok is False and ck.writes == 0
+        after = tm.snapshot()[
+            "oap_checkpoint_write_failures_total"]["algo=kmeans"]
+        assert after - before == 1
+
+    def test_gc_reaps_vanished_ranks_stale_shards(self, tmp_path):
+        """After a restore onto a smaller world, the vanished ranks'
+        shards must not accumulate forever: rank 0 reaps ranks >= the
+        current world once their generation ages out of the kept set."""
+        set_config(checkpoint_dir=str(tmp_path))
+        ck = ckpt_mod.Checkpointer("kmeans", {"k": 2})
+        z = {"c": np.zeros((2, 2), np.float32)}
+        ck.rank = 1  # the old 2-rank world's history
+        for step in (1, 2, 4):
+            ck._write_shard(step, z, {})
+        ck.rank = 0
+        for step in (1, 2, 3, 4):
+            ck._write_shard(step, z, {})
+        ck.world = 1
+        ck._gc()
+        assert sorted(os.listdir(ck.dir)) == [
+            "step00000003.rank0.npz",
+            "step00000004.rank0.npz",
+            "step00000004.rank1.npz",  # kept generation: not stale yet
+        ]
+
+    def test_reshard_rejects_indivisible_world(self, monkeypatch):
+        """A data axis not divisible by the process count would silently
+        misassign rows through the bucket round-robin and the counts
+        reshape — reshard_factor_rows must refuse it at entry."""
+        import jax
+
+        from oap_mllib_tpu.parallel.mesh import get_mesh
+        from oap_mllib_tpu.parallel.shuffle import reshard_factor_rows
+
+        mesh = get_mesh()  # 8-way data axis on the suite mesh
+        monkeypatch.setattr(jax, "process_count", lambda: 3)
+        with pytest.raises(ValueError, match="multiple of process_count"):
+            reshard_factor_rows(
+                np.arange(4, dtype=np.int64),
+                np.zeros((4, 3), np.float32),
+                mesh, np.array([0, 4, 8]), 4,
+            )
+
+
 class TestCorruptionTiers:
     def _arm(self, tmp_path, blobs):
         set_config(checkpoint_dir=str(tmp_path))
