@@ -283,6 +283,7 @@ def bench_pca(n=1 << 20, d=128):
     import jax.numpy as jnp
     from jax import lax
 
+    from oap_mllib_tpu.config import get_config
     from oap_mllib_tpu.ops import pca_ops
 
     rng = np.random.default_rng(1)
@@ -353,6 +354,15 @@ def bench_pca(n=1 << 20, d=128):
         dispatch_sec=round(max(dt - cov_sec - eigh_sec, 0.0), 4),
         cov_tflops=round(cov_tflops, 1),
         cov_mfu=round(cov_tflops * 1e12 / _peak_flops(), 3),
+        # which Gram kernel the dispatch rule picked for this shape —
+        # the ISSUE 9 fused Pallas moments kernel on TPU, XLA elsewhere
+        kernel=(
+            "pallas"
+            if pca_ops.use_pallas_gram(
+                get_config().pca_kernel, d, "highest", np.float32
+            )
+            else "xla"
+        ),
         # eigh's share of the end-to-end wall: a growing share at fixed
         # d means the O(d^3) finalize (not the Gram) regressed
         eigh_wall_share=round(eigh_sec / dt, 4),
@@ -365,6 +375,27 @@ def bench_pca(n=1 << 20, d=128):
 # ---------------------------------------------------------------------------
 # ALS
 # ---------------------------------------------------------------------------
+
+
+def _als_solve_extras(n_users, n_items, rank, sec_per_iter):
+    """MFU-style annotation for the ALS normal-equation SOLVE kernel
+    (ISSUE 9): analytic solve+assembly FLOPs per iteration — both
+    halves Cholesky-factor (2/3·r³) and doubly-substitute (4·r²) one
+    system per user/item row — over the iteration wall, next to the
+    gather bound.  A lower bound on solve intensity (the wall includes
+    the moment build), but a regression in the fused Pallas solve
+    surfaces as a falling solve_mfu at fixed shape."""
+    from oap_mllib_tpu.ops.als_ops import resolve_solve_kernel
+
+    flops = (n_users + n_items) * (
+        (2.0 / 3.0) * rank ** 3 + 4.0 * rank ** 2
+    )
+    solve_tflops = flops / sec_per_iter / 1e12
+    return {
+        "solve_tflops": round(solve_tflops, 4),
+        "solve_mfu": round(solve_tflops * 1e12 / _peak_flops(), 6),
+        "solve_kernel": resolve_solve_kernel(rank, np.float32),
+    }
 
 
 def bench_als():
@@ -426,6 +457,7 @@ def bench_als():
         t_cpu_iter / sec_per_iter,
         **_bound_extras("gather_indices_per_sec",
                         gathered / sec_per_iter, _ALS_GATHER_CEILING),
+        **_als_solve_extras(n_users, n_items, rank, sec_per_iter),
     )
     return sec_per_iter
 
@@ -487,6 +519,7 @@ def bench_als_large():
         t_cpu_iter / sec_per_iter,
         **_bound_extras("gather_indices_per_sec",
                         gathered / sec_per_iter, _ALS_GATHER_CEILING),
+        **_als_solve_extras(n_users, n_items, rank, sec_per_iter),
     )
     return sec_per_iter
 

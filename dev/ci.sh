@@ -101,6 +101,13 @@ echo "   sanitizers-off overhead unmeasurable on the 20-fit K-Means"
 echo "   microbench (dev/sanitizer_gate.py) =="
 python dev/sanitizer_gate.py
 
+echo "== kernel gate: interpret-mode parity across the Pallas kernel plane"
+echo "   (K-Means accumulate, PCA moments, ALS solve, factor Gram),"
+echo "   bf16-on-Pallas routing asserted, and 8-device virtual-mesh ring"
+echo "   -reduction parity vs psum at 1e-5 with zero standalone centroid"
+echo "   allreduces in the ring-fused Lloyd build (dev/kernel_gate.py) =="
+python dev/kernel_gate.py
+
 echo "== compiled-mode TPU suite (skipped unless a TPU backend is present) =="
 if python -c "import jax, sys; sys.exit(0 if jax.default_backend() == 'tpu' else 1)" 2>/dev/null; then
   python -m pytest tests_tpu/ -q

@@ -142,16 +142,34 @@ def _raw_matmul(ctx):
 
 _COLLECTIVES = {"psum", "pmean", "all_gather", "ppermute", "all_to_all",
                 "psum_scatter"}
+# Pallas device-level communication primitives (inter-chip DMA +
+# semaphore signaling).  EXEMPT inside ops/pallas/ kernel bodies: a
+# remote-DMA ring kernel IS the collective — it books its census through
+# ops/pallas/_tiers.note_emitted (oap_kernel_emitted_total) and its
+# wrapper's kernel_launch telemetry, the kernel-plane analog of the
+# facade seam.  Outside ops/pallas/ they are findings like any raw
+# collective: ad-hoc remote DMAs would bypass every accounting seam the
+# package has.  NB the exemption is primitive-scoped, not blanket — a
+# raw lax.psum inside a kernel body still fires (seeded-mutation test
+# in tests/test_oaplint.py).
+_PALLAS_COMMS = {"make_async_remote_copy", "semaphore_signal",
+                 "semaphore_wait", "get_barrier_semaphore"}
 
 
 @rule("raw-collective", scope=rf"{PKG}/",
       doc="No raw lax.psum/pmean/all_gather/ppermute/all_to_all outside "
           "parallel/collective.py — the facade is the one seam that "
           "books collective telemetry (and the DrJAX-style explicit "
-          "composition point).")
+          "composition point).  pltpu remote-DMA/semaphore primitives "
+          "(make_async_remote_copy, semaphore_signal/wait, "
+          "get_barrier_semaphore) are additionally findings outside "
+          "ops/pallas/ and exempt inside it — kernel bodies ARE the "
+          "collective there and book the oap_kernel_* census instead; "
+          "raw lax.* collectives inside kernels still fire.")
 def _raw_collective(ctx):
     if ctx.rel == f"{PKG}/parallel/collective.py":
         return
+    in_pallas = ctx.rel.startswith(f"{PKG}/ops/pallas/")
     for n in ast.walk(ctx.tree):
         if isinstance(n, ast.Attribute) and n.attr in _COLLECTIVES:
             d = _dotted(n)
@@ -159,6 +177,17 @@ def _raw_collective(ctx):
                 yield (n.lineno, f"raw {d} bypasses collective "
                        "accounting; use parallel/collective."
                        f"{n.attr} (in-jit) or the eager facade")
+        elif (
+            not in_pallas
+            and isinstance(n, ast.Attribute)
+            and n.attr in _PALLAS_COMMS
+        ):
+            d = _dotted(n)
+            if d.startswith("pltpu.") or ".pallas.tpu" in d:
+                yield (n.lineno, f"{d} outside ops/pallas/ bypasses the "
+                       "kernel-plane communication seam; device DMA "
+                       "collectives live in ops/pallas/ kernels (which "
+                       "book the oap_kernel_* census)")
 
 
 # -- R4: no host sync inside streamed per-chunk loops ------------------------

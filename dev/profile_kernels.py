@@ -233,6 +233,137 @@ def markdown_als(rows) -> str:
     return "\n".join(out)
 
 
+PCA_SHAPES = [
+    # (n, d) — streamed-chunk scale + the large-d wall
+    (1 << 18, 256),
+    (1 << 16, 1024),
+]
+SOLVE_SHAPES = [
+    # (n_dst, rank) — ML-1M user side + a wide batch
+    (6040, 10),
+    (200_000, 10),
+]
+
+
+def profile_fused():
+    """Fused-vs-unfused shoot-out for the ISSUE 9 kernels: the PCA
+    covariance pass (XLA two-pass vs the fused Pallas moments kernel)
+    and the ALS batched normal-equation solve (XLA unrolled batch solve
+    vs the fused Pallas assembly+solve).  Off-TPU the Pallas legs run in
+    interpret mode — parity-only, timings meaningless — so regenerate on
+    hardware like the K-Means table."""
+    import jax
+    import jax.numpy as jnp
+
+    from oap_mllib_tpu.ops import als_ops
+    from oap_mllib_tpu.ops.pallas.als_kernel import solve_normal_eq_pallas
+    from oap_mllib_tpu.ops.pallas.pca_kernel import covariance_pallas
+    from oap_mllib_tpu.ops.pca_ops import _covariance_jit
+
+    interp = jax.default_backend() != "tpu"
+    pca_shapes, solve_shapes = PCA_SHAPES, SOLVE_SHAPES
+    if interp:
+        print("# non-TPU backend: pallas legs run interpret mode on "
+              "reduced shapes (parity only — timings not comparable)",
+              flush=True)
+        pca_shapes, solve_shapes = [(4096, 128)], [(6040, 10)]
+    rows = []
+    rng = np.random.default_rng(0)
+    for n, d in pca_shapes:
+        x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        m = jnp.ones((n,), jnp.float32)
+        nv = jnp.asarray(float(n))
+        for kernel, run in (
+            ("xla", lambda: np.asarray(_covariance_jit(x, m, nv)[0])),
+            ("pallas", lambda: np.asarray(
+                covariance_pallas(x, m, nv, interpret=interp)[0])),
+        ):
+            dt = _time_run(run)
+            flops = 2 * n * d * d  # centered Gram
+            rows.append({
+                "op": "pca_covariance", "shape": f"{n}x{d}",
+                "kernel": kernel, "ms": round(dt * 1e3, 2),
+                "tflops": round(flops / dt / 1e12, 2),
+            })
+            print(json.dumps(rows[-1]), flush=True)
+    for nd, r in solve_shapes:
+        mm = rng.normal(size=(nd, r, r)).astype(np.float32)
+        a = jnp.asarray(np.einsum("nij,nkj->nik", mm, mm) + 0.5 * np.eye(r))
+        b = jnp.asarray(rng.normal(size=(nd, r)).astype(np.float32))
+        n_reg = jnp.asarray(np.ones((nd,), np.float32))
+        gram = jnp.asarray(np.eye(r, dtype=np.float32))
+        eye = jnp.eye(r, dtype=jnp.float32)
+        solve = jax.jit(
+            lambda a_, b_, n_: als_ops.regularized_solve(
+                a_, b_, n_, 0.1, eye, gram
+            )
+        )
+        for kernel, run in (
+            ("xla", lambda: np.asarray(solve(a, b, n_reg))),
+            ("pallas", lambda: np.asarray(solve_normal_eq_pallas(
+                a, b, n_reg, 0.1, gram, interpret=interp))),
+        ):
+            dt = _time_run(run)
+            rows.append({
+                "op": "als_solve", "shape": f"{nd}xr{r}",
+                "kernel": kernel, "ms": round(dt * 1e3, 2),
+            })
+            print(json.dumps(rows[-1]), flush=True)
+    return rows
+
+
+def profile_overlap():
+    """Ring-overlap on/off sweep: per-iteration slope of the
+    model-sharded Lloyd with the ring-fused moments reduction vs the
+    psum path, on whatever mesh the backend offers (the 8-device virtual
+    CPU mesh exercises the schedule; ICI overlap numbers need TPU)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from oap_mllib_tpu.config import set_config
+    from oap_mllib_tpu.ops import kmeans_ops
+    from oap_mllib_tpu.parallel.mesh import get_mesh
+
+    if len(jax.devices()) < 2:
+        print("# <2 devices: ring == psum fallback, nothing to sweep",
+              flush=True)
+        return []
+    set_config(model_parallel=1)
+    mesh = get_mesh()
+    rng = np.random.default_rng(0)
+    n, d, k = 1 << 17, 128, 128
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    xs = jax.device_put(
+        jnp.asarray(x), NamedSharding(mesh, P("data", "model"))
+    )
+    ws = jax.device_put(
+        jnp.ones((n,), jnp.float32), NamedSharding(mesh, P("data"))
+    )
+    tol = jnp.asarray(0.0, jnp.float32)
+    rows = []
+    for mode in ("auto", "off"):
+        set_config(ring_reduction=mode)
+        ts = {}
+        for iters in (4, 16):
+            fn = lambda it=iters: np.asarray(
+                kmeans_ops.lloyd_run_model_sharded(
+                    xs, ws, jnp.asarray(x[:k]), it, tol, mesh,
+                    "data", "model",
+                )[0]
+            )
+            ts[iters] = _time_run(fn)
+        slope = (ts[16] - ts[4]) / 12
+        rows.append({
+            "op": "lloyd_model_sharded", "ring": mode,
+            "shape": f"{n}x{d} k={k}",
+            "ms_per_iter": round(max(slope, 0.0) * 1e3, 2),
+        })
+        print(json.dumps(rows[-1]), flush=True)
+    set_config(ring_reduction="auto")
+    return rows
+
+
 def _print_progcache_stats() -> None:
     """Program-cache hit/miss report for the profiled run: the ops
     entries register every launch with utils/progcache, so after a
@@ -261,6 +392,10 @@ if __name__ == "__main__":
         rows = profile_als()
         print()
         print(markdown_als(rows))
+    elif "--fused" in sys.argv:
+        profile_fused()
+    elif "--overlap" in sys.argv:
+        profile_overlap()
     else:
         rows = profile()
         print()

@@ -96,6 +96,39 @@ class Config:
     # layout.  Applies to both the single-device and the block-parallel
     # paths.
     als_kernel: str = "auto"
+    # PCA covariance kernel: "auto" runs the fused Pallas moments kernel
+    # (ops/pallas/pca_kernel: center + mask + Gram + colsum per row tile
+    # in VMEM, no HBM centered temp) when its preconditions hold — TPU,
+    # single device, f32, and the (d, d) Gram block fits the kernel's
+    # VMEM budget (d <= ~2048) — at every precision tier (the kernel
+    # ships the same hand-rolled bf16 hi/lo-split tiers as the K-Means
+    # kernel, so the bf16 policy prices ON Pallas).  "xla"/"pallas"
+    # force a path; "pallas" still requires the preconditions and falls
+    # back otherwise.  Applies to the in-memory AND streamed covariance
+    # passes (the model-sharded Gram stays on the shard_map XLA path).
+    pca_kernel: str = "auto"
+    # ALS normal-equation solve kernel: "auto" runs the batched Pallas
+    # assembly+solve kernel (ops/pallas/als_kernel: per-user Gram
+    # assembly — moments + ALS-WR regularization + implicit Gram term —
+    # and the unrolled rank-r Cholesky in one fused program, batch on
+    # the 128-lane axis) when on TPU with f32 factors and rank <= 32;
+    # "xla" keeps the batch-wide unrolled XLA solve
+    # (ops/als_ops._chol_solve_unrolled); "pallas" forces the kernel
+    # (same preconditions, falls back otherwise).  Applies wherever
+    # moments meet regularized_solve: single-device grouped/COO and the
+    # block-parallel runners.
+    als_solve_kernel: str = "auto"
+    # Cross-device reduction of per-pass moments (K-Means centroid
+    # sums/counts/cost over the data axis, and the streamed multi-host
+    # per-pass reductions): "auto"/"on" replace the post-pass psums with
+    # the ring reduction (ops/pallas/ring_reduce: reduce-scatter +
+    # all-gather rotating fixed segments around the mesh ring —
+    # pltpu.make_async_remote_copy DMA on TPU, the identical-schedule
+    # ppermute program elsewhere), falling back to the psum path when
+    # the mesh has fewer than 2 devices on the reduce axis; "off" keeps
+    # the psum path everywhere.  "on" and "auto" are synonyms today
+    # (the auto rule may grow shape bounds as TPU measurements land).
+    ring_reduction: str = "auto"
     # ALS item-factor layout on the block-parallel path.  "replicated"
     # keeps Y on every device and psums full (n_items, r, r+1) item
     # partials each iteration — one collective, best at small n_items.

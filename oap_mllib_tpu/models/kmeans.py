@@ -406,12 +406,15 @@ class KMeans:
         # effect on a retry; the legacy kernel tier maps off it
         pol = psn.resolve("kmeans")
         tier = psn.kernel_tier(pol.name, cfg.matmul_precision)
-        # kmeans_kernel validation must run on EVERY accelerated fit (the
-        # _run_lloyd invariant): a typo'd value raises here too, even
-        # though the streamed path always runs the chunked XLA programs
+        # kmeans_kernel/ring_reduction validation must run on EVERY
+        # accelerated fit (the _run_lloyd invariant): a typo'd value
+        # raises here too, even though the streamed path always runs the
+        # chunked XLA programs (the ring engages in its multi-process
+        # per-pass reductions — stream_ops._ring_mesh)
         kmeans_ops.use_pallas_path(
             cfg.kmeans_kernel, source.n_features, self.k, tier, dtype,
         )
+        kmeans_ops.ring_mode_cfg(cfg)
         timings = Timings("kmeans.fit")
         cache_before = progcache.stats()
         ckpt = ckpt_mod.maybe_open(
@@ -560,16 +563,19 @@ class KMeans:
         # (utils/precision.kernel_tier: f32 keeps matmul_precision, tf32
         # the bf16_3x "high" tier, bf16 the single-pass "default" tier) so
         # the kernel-dispatch rules price it like the tier it runs at —
-        # notably the bf16 policy routes off Pallas onto the chunked XLA
-        # Lloyd, where the all-bf16 single-pass pipeline measured fastest
+        # the bf16 policy now prices ON Pallas (kmeans_ops
+        # .pallas_preferred accepts "default"; ISSUE 9 retired the
+        # routes-off-Pallas workaround)
         pol = pol or psn.resolve("kmeans")
         tier = psn.kernel_tier(pol.name, cfg.matmul_precision)
         # use_pallas_path is the single kmeans_kernel validation point and
         # must run on EVERY accelerated fit — a typo'd value raises even
-        # when the model-sharded route below makes its answer moot
+        # when the model-sharded route below makes its answer moot; the
+        # ring_reduction knob validates under the same contract
         use_pallas = kmeans_ops.use_pallas_path(
             cfg.kmeans_kernel, table.data.shape[1], self.k, tier, dtype,
         )
+        kmeans_ops.ring_mode_cfg(cfg)
         if degraded:
             # the halved-chunk rung after a device OOM: route off the
             # fused Pallas kernel (whole-table VMEM residency is exactly

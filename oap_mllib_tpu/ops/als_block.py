@@ -52,12 +52,13 @@ from oap_mllib_tpu.config import get_config
 # partials and solve, so the two paths cannot diverge in the weighting
 from oap_mllib_tpu.ops.als_ops import (
     GROUPED_MAX_BLOWUP,
+    _factor_gram,
     normal_eq_partials,
     normal_eq_partials_grouped,
     regularized_solve,
+    resolve_solve_kernel,
 )
 from oap_mllib_tpu.parallel import collective
-from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
@@ -108,44 +109,47 @@ def item_layout_sharded(
     )
 
 
-def _block_body(user_partials, item_partials, reg, implicit, axis, eye):
+def _block_body(user_partials, item_partials, reg, implicit, axis, eye,
+                solve_kernel="xla"):
     """One alternating iteration of the block layout, shared by the COO and
     grouped-edge programs: user update fully local, item update partials +
     ONE psum (replacing the reference's gather/step2Master/bcast/all2all
     chain, ALSDALImpl.cpp:336-431).  ``user_partials(y)`` /
     ``item_partials(x_blk)`` return (A, b, n_reg) from whichever edge
-    layout the caller closed over."""
+    layout the caller closed over.  ``solve_kernel`` picks the
+    regularized_solve consumer (als_ops.resolve_solve_kernel)."""
 
     def body(carry, _):
         x_blk, y = carry
         a_u, b_u, n_u = user_partials(y)
         gram_y = (
-            psn.pdot(y.T, y)
+            _factor_gram(y, solve_kernel)
             if implicit else None
         )
-        x_blk = regularized_solve(a_u, b_u, n_u, reg, eye, gram_y).astype(
-            y.dtype
-        )
+        x_blk = regularized_solve(
+            a_u, b_u, n_u, reg, eye, gram_y, solve_kernel
+        ).astype(y.dtype)
         a_i, b_i, n_i = item_partials(x_blk)
         a_i = collective.psum(a_i, axis)
         b_i = collective.psum(b_i, axis)
         n_i = collective.psum(n_i, axis)
         gram_x = (
             collective.psum(
-                psn.pdot(x_blk.T, x_blk),
+                _factor_gram(x_blk, solve_kernel),
                 axis,
             )
             if implicit else None
         )
-        y = regularized_solve(a_i, b_i, n_i, reg, eye, gram_x).astype(
-            x_blk.dtype
-        )
+        y = regularized_solve(
+            a_i, b_i, n_i, reg, eye, gram_x, solve_kernel
+        ).astype(x_blk.dtype)
         return (x_blk, y), None
 
     return body
 
 
-def _block_body_2d(user_partials, item_partials, reg, implicit, axis, eye):
+def _block_body_2d(user_partials, item_partials, reg, implicit, axis, eye,
+                   solve_kernel="xla"):
     """One alternating iteration of the fully-sharded 2-D layout: BOTH
     factor matrices block-sharded.  Each half-iteration all_gathers the
     other side's factors (tiled, so the gathered array IS the padded
@@ -165,26 +169,26 @@ def _block_body_2d(user_partials, item_partials, reg, implicit, axis, eye):
         a_u, b_u, n_u = user_partials(y_full)
         gram_y = (
             collective.psum(
-                psn.pdot(y_blk.T, y_blk),
+                _factor_gram(y_blk, solve_kernel),
                 axis,
             )
             if implicit else None
         )
-        x_blk = regularized_solve(a_u, b_u, n_u, reg, eye, gram_y).astype(
-            y_blk.dtype
-        )
+        x_blk = regularized_solve(
+            a_u, b_u, n_u, reg, eye, gram_y, solve_kernel
+        ).astype(y_blk.dtype)
         x_full = collective.all_gather(x_blk, axis, tiled=True)
         a_i, b_i, n_i = item_partials(x_full)
         gram_x = (
             collective.psum(
-                psn.pdot(x_blk.T, x_blk),
+                _factor_gram(x_blk, solve_kernel),
                 axis,
             )
             if implicit else None
         )
-        y_blk = regularized_solve(a_i, b_i, n_i, reg, eye, gram_x).astype(
-            y_blk.dtype
-        )
+        y_blk = regularized_solve(
+            a_i, b_i, n_i, reg, eye, gram_x, solve_kernel
+        ).astype(y_blk.dtype)
         return (x_blk, y_blk), None
 
     return body
@@ -220,6 +224,7 @@ def als_block_run(
     world = mesh.shape[axis]
     upb = x0.shape[0] // world  # users per block (padded)
     n_items, r = y0.shape
+    solve_kernel = resolve_solve_kernel(r, y0.dtype, cfg)
 
     # the jitted shard_map program is registry-cached (utils/progcache):
     # rebuilding the closure per fit — the pattern every runner in this
@@ -241,7 +246,7 @@ def als_block_run(
                     i_glob, u_loc, cf, vl, x_, n_items, alpha, implicit,
                     policy,
                 ),
-                reg, implicit, axis, eye,
+                reg, implicit, axis, eye, solve_kernel,
             )
             (x_blk, y), _ = lax.scan(body, (x_blk, y), None, length=max_iter)
             return x_blk, y
@@ -261,6 +266,7 @@ def als_block_run(
     key = (
         progcache.mesh_fingerprint(mesh), axis, upb, n_items, r,
         max_iter, reg, alpha, implicit, str(y0.dtype), policy,
+        solve_kernel,
     )
     fn = progcache.get_or_build("als_block.coo", key, build)
     launch_key = key + (progcache.array_key(u_local, x0),)
@@ -523,6 +529,7 @@ def als_block_run_grouped(
     world = mesh.shape[axis]
     upb = x0.shape[0] // world
     n_items, r = y0.shape
+    solve_kernel = resolve_solve_kernel(r, y0.dtype, cfg)
 
     def build():
         eye = jnp.eye(r, dtype=y0.dtype)
@@ -535,7 +542,7 @@ def als_block_run_grouped(
                 lambda x_: normal_eq_partials_grouped(
                     si, ci, vi, gi, x_, n_items, alpha, implicit, policy
                 ),
-                reg, implicit, axis, eye,
+                reg, implicit, axis, eye, solve_kernel,
             )
             (x_blk, y), _ = lax.scan(body, (x_blk, y), None, length=max_iter)
             return x_blk, y
@@ -556,6 +563,7 @@ def als_block_run_grouped(
     key = (
         progcache.mesh_fingerprint(mesh), axis, upb, n_items, r,
         max_iter, reg, alpha, implicit, str(y0.dtype), policy,
+        solve_kernel,
     )
     fn = progcache.get_or_build("als_block.grouped", key, build)
     launch_key = key + (progcache.array_key(gb.u_src, gb.i_src, x0),)
@@ -598,6 +606,7 @@ def als_block_run_2d(
     upb = x0.shape[0] // world
     ipb = y0.shape[0] // world
     r = y0.shape[1]
+    solve_kernel = resolve_solve_kernel(r, y0.dtype, cfg)
 
     def build():
         eye = jnp.eye(r, dtype=y0.dtype)
@@ -610,7 +619,7 @@ def als_block_run_2d(
                 lambda x_full: normal_eq_partials(
                     il, ur, ci, vi, x_full, ipb, alpha, implicit, policy
                 ),
-                reg, implicit, axis, eye,
+                reg, implicit, axis, eye, solve_kernel,
             )
             (x_blk, y_blk), _ = lax.scan(
                 body, (x_blk, y_blk), None, length=max_iter
@@ -632,6 +641,7 @@ def als_block_run_2d(
     key = (
         progcache.mesh_fingerprint(mesh), axis, upb, ipb, r,
         max_iter, reg, alpha, implicit, str(y0.dtype), policy,
+        solve_kernel,
     )
     fn = progcache.get_or_build("als_block.coo_2d", key, build)
     launch_key = key + (progcache.array_key(u_local, i_local, x0),)
@@ -665,6 +675,7 @@ def als_block_run_grouped_2d(
     upb = x0.shape[0] // world
     ipb = y0.shape[0] // world
     r = y0.shape[1]
+    solve_kernel = resolve_solve_kernel(r, y0.dtype, cfg)
 
     def build():
         eye = jnp.eye(r, dtype=y0.dtype)
@@ -677,7 +688,7 @@ def als_block_run_grouped_2d(
                 lambda x_full: normal_eq_partials_grouped(
                     si, ci, vi, gi, x_full, ipb, alpha, implicit, policy
                 ),
-                reg, implicit, axis, eye,
+                reg, implicit, axis, eye, solve_kernel,
             )
             (x_blk, y_blk), _ = lax.scan(
                 body, (x_blk, y_blk), None, length=max_iter
@@ -699,6 +710,7 @@ def als_block_run_grouped_2d(
     key = (
         progcache.mesh_fingerprint(mesh), axis, upb, ipb, r,
         max_iter, reg, alpha, implicit, str(y0.dtype), policy,
+        solve_kernel,
     )
     fn = progcache.get_or_build("als_block.grouped_2d", key, build)
     launch_key = key + (progcache.array_key(gb.u_src, gb.i_src, x0),)

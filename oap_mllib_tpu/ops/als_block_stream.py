@@ -67,14 +67,15 @@ from oap_mllib_tpu.ops.als_block import (
     _pad_groups,
 )
 from oap_mllib_tpu.ops.als_ops import (
+    _factor_gram,
     build_grouped_edges,
     grouped_block_moments,
     regularized_solve,
+    resolve_solve_kernel,
     unpack_flat_moments,
 )
 from oap_mllib_tpu.ops.als_stream import groups_per_chunk
 from oap_mllib_tpu.parallel import collective
-from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.timing import tick
 from oap_mllib_tpu.utils.jax_compat import shard_map
@@ -350,21 +351,25 @@ def _chunk_placer(mesh: Mesh, axis: str, owned: List[int]):
 
 
 def _make_programs(mesh: Mesh, axis: str, implicit: bool,
-                   policy: str = "f32"):
+                   policy: str = "f32", solve_kernel: str = "xla"):
     """The four compiled building blocks, registry-cached per (mesh
-    fingerprint, axis, implicit, precision policy) — utils/progcache —
-    so repeat fits on one mesh reuse the jitted closures instead of
-    rebuilding (and re-tracing) them every call; within a fit they
-    already cached compilations across chunks and iterations."""
-    key = (progcache.mesh_fingerprint(mesh), axis, implicit, policy)
+    fingerprint, axis, implicit, precision policy, solve kernel) —
+    utils/progcache — so repeat fits on one mesh reuse the jitted
+    closures instead of rebuilding (and re-tracing) them every call;
+    within a fit they already cached compilations across chunks and
+    iterations."""
+    key = (
+        progcache.mesh_fingerprint(mesh), axis, implicit, policy,
+        solve_kernel,
+    )
     return progcache.get_or_build(
         "als_block_stream.programs", key,
-        lambda: _build_programs(mesh, axis, implicit, policy),
+        lambda: _build_programs(mesh, axis, implicit, policy, solve_kernel),
     )
 
 
 def _build_programs(mesh: Mesh, axis: str, implicit: bool,
-                    policy: str = "f32"):
+                    policy: str = "f32", solve_kernel: str = "xla"):
     """Build the four jitted building blocks (cached above)."""
     sh2 = P(axis, None)
     sh1 = P(axis)
@@ -418,10 +423,10 @@ def _build_programs(mesh: Mesh, axis: str, implicit: bool,
         r = f_full.shape[1]
         a, b, n_reg = unpack_flat_moments(m, r)
         eye = jnp.eye(r, dtype=f_full.dtype)
-        gram = psn.pdot(f_full.T, f_full) if implicit else None
-        return regularized_solve(a, b, n_reg, reg, eye, gram).astype(
-            f_full.dtype
-        )
+        gram = _factor_gram(f_full, solve_kernel) if implicit else None
+        return regularized_solve(
+            a, b, n_reg, reg, eye, gram, solve_kernel
+        ).astype(f_full.dtype)
 
     solve_local_fn = jax.jit(
         shard_map(
@@ -439,14 +444,14 @@ def _build_programs(mesh: Mesh, axis: str, implicit: bool,
         eye = jnp.eye(r, dtype=x_blk.dtype)
         gram = (
             collective.psum(
-                psn.pdot(x_blk.T, x_blk),
+                _factor_gram(x_blk, solve_kernel),
                 axis,
             )
             if implicit else None
         )
-        return regularized_solve(a, b, n_reg, reg, eye, gram).astype(
-            x_blk.dtype
-        )
+        return regularized_solve(
+            a, b, n_reg, reg, eye, gram, solve_kernel
+        ).astype(x_blk.dtype)
 
     solve_item_rep_fn = jax.jit(
         shard_map(
@@ -506,7 +511,7 @@ def als_block_run_streamed(
     place = _chunk_placer(mesh, axis, lay.owned)
     (accum_local_fn, accum_item_rep_fn, solve_local_fn,
      solve_item_rep_fn, replicate) = _make_programs(
-        mesh, axis, implicit, policy
+        mesh, axis, implicit, policy, resolve_solve_kernel(r, dtype, cfg)
     )
     alpha_j = jnp.asarray(alpha, dtype)
     reg_j = jnp.asarray(reg, dtype)

@@ -233,17 +233,78 @@ def unpack_flat_moments(m_flat: jax.Array, r: int):
     return m[:, :r, :r], m[:, :r, r], m[:, r, r + 1]
 
 
-def regularized_solve(a, b, n_reg, reg, eye, gram=None) -> jax.Array:
+def regularized_solve(a, b, n_reg, reg, eye, gram=None,
+                      kernel: str = "xla") -> jax.Array:
     """THE half-update solve every ALS path consumes moments through
     (single-device grouped/COO, streamed, block-parallel, streamed
     block): ALS-WR lambda scaling (reg x per-row rating count — Spark
     parity, reference ALS.scala:1794-1795), optional implicit-feedback
     Gram term, masked Cholesky.  One definition so the paths cannot
-    diverge in the regularization convention."""
+    diverge in the regularization convention.
+
+    ``kernel`` selects the consumer: "xla" (default) keeps the
+    batch-wide unrolled solve below; "pallas" routes through the fused
+    assembly+solve kernel (ops/pallas/als_kernel.solve_traced — same
+    elimination sequence, one HBM read of the moments, resolved by
+    :func:`resolve_solve_kernel`); "pallas_interpret" is the CPU
+    interpret-mode leg tier-1 exercises the full runners through."""
+    if kernel.startswith("pallas"):
+        from oap_mllib_tpu.ops.pallas.als_kernel import solve_traced
+
+        return solve_traced(
+            a, b, n_reg, reg, gram, interpret=kernel == "pallas_interpret"
+        )
     a = a + reg * n_reg[:, None, None] * eye[None]
     if gram is not None:
         a = gram[None] + a
     return masked_solve(a, b, n_reg)
+
+
+def _factor_gram(factors, kernel: str = "xla"):
+    """The implicit-feedback Gram ``F^T F`` feeding regularized_solve —
+    psn.pdot on the XLA route, the streamed Pallas factor-Gram kernel on
+    the pallas routes.  Pinned mode="highest" either way: Grams condition
+    the solve and never run reduced (utils/precision.py contract)."""
+    if kernel.startswith("pallas"):
+        from oap_mllib_tpu.ops.pallas.als_kernel import factor_gram_traced
+
+        return factor_gram_traced(
+            factors, "highest", interpret=kernel == "pallas_interpret"
+        )
+    return psn.pdot(factors.T, factors)
+
+
+def resolve_solve_kernel(r: int, dtype=None, cfg=None) -> str:
+    """Resolve Config.als_solve_kernel to the concrete consumer for this
+    fit — the single decision point every ALS runner (single-device,
+    block-parallel, streamed) resolves through, so two paths cannot
+    route the same fit to different solve kernels.  "auto" takes the
+    fused Pallas kernel on TPU with f32 factors in the unrolled-rank
+    regime (r <= 32); anything else — CPU tier-1 included — keeps the
+    XLA path.  A typo'd value raises on EVERY accelerated fit."""
+    import numpy as np
+
+    from oap_mllib_tpu.config import get_config
+
+    cfg = cfg or get_config()
+    choice = cfg.als_solve_kernel
+    if choice not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"als_solve_kernel must be auto|xla|pallas, got {choice!r}"
+        )
+    from oap_mllib_tpu.ops.pallas.als_kernel import pallas_solve_preferred
+
+    want = choice == "pallas" or (
+        choice == "auto" and pallas_solve_preferred(r)
+    )
+    if (
+        want
+        and jax.default_backend() == "tpu"
+        and r <= 32
+        and (dtype is None or np.dtype(dtype) == np.float32)
+    ):
+        return "pallas"
+    return "xla"
 
 
 GROUPED_MAX_BLOWUP = 6.0
@@ -487,7 +548,10 @@ def normal_eq_partials_grouped(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_users", "n_items", "max_iter", "implicit", "policy"),
+    static_argnames=(
+        "n_users", "n_items", "max_iter", "implicit", "policy",
+        "solve_kernel",
+    ),
 )
 def _als_run_grouped_jit(
     u_src_g, u_conf_g, u_valid_g, u_group_dst,  # item ids grouped by user
@@ -501,6 +565,7 @@ def _als_run_grouped_jit(
     alpha: float,
     implicit: bool,
     policy: str = "f32",
+    solve_kernel: str = "xla",
 ) -> Tuple[jax.Array, jax.Array]:
     r = x0.shape[1]
     eye = jnp.eye(r, dtype=x0.dtype)
@@ -510,10 +575,10 @@ def _als_run_grouped_jit(
             src_g, conf_g, valid_g, group_dst, factors, n_dst, alpha,
             implicit, policy,
         )
-        gram = psn.pdot(factors.T, factors) if implicit else None
-        return regularized_solve(a, b, n_reg, reg, eye, gram).astype(
-            factors.dtype
-        )
+        gram = _factor_gram(factors, solve_kernel) if implicit else None
+        return regularized_solve(
+            a, b, n_reg, reg, eye, gram, solve_kernel
+        ).astype(factors.dtype)
 
     def body(carry, _):
         x, y = carry
@@ -539,6 +604,7 @@ def als_run_grouped(
     timings=None,
     phase: str = "als_iterations",
     policy: str = "f32",
+    solve_kernel: str = "",
 ) -> Tuple[jax.Array, jax.Array]:
     """Full ALS loop on the grouped-edge layout (both feedback modes).
 
@@ -547,20 +613,25 @@ def als_run_grouped(
     the program-cache registry (utils/progcache); ``timings`` receives
     the ``<phase>/compile`` / ``<phase>/execute`` wall split.  ``policy``
     is the compute-precision policy (utils/precision.py) for the moment
-    matmuls — the Gram and every solve stay f32 under all policies."""
+    matmuls — the Gram and every solve stay f32 under all policies.
+    ``solve_kernel``: "" resolves Config.als_solve_kernel
+    (:func:`resolve_solve_kernel`); explicit values are the test seam."""
+    solve_kernel = solve_kernel or resolve_solve_kernel(
+        x0.shape[1], x0.dtype
+    )
     # reg/alpha are traced scalars, not statics — they do not key a new
     # program and so stay out of the cache key
     key = (
         progcache.backend_fingerprint(),
         progcache.array_key(u_src_g, i_src_g, x0, y0),
-        n_users, n_items, max_iter, implicit, policy,
+        n_users, n_items, max_iter, implicit, policy, solve_kernel,
     )
     with progcache.launch("als.run_grouped", key, timings, phase):
         return _als_run_grouped_jit(
             u_src_g, u_conf_g, u_valid_g, u_group_dst,
             i_src_g, i_conf_g, i_valid_g, i_group_dst,
             x0, y0, n_users, n_items, max_iter, reg, alpha, implicit,
-            policy,
+            policy, solve_kernel,
         )
 
 
@@ -574,24 +645,28 @@ def _half_update(
     reg: float,
     alpha: float,
     policy: str = "f32",
+    solve_kernel: str = "xla",
 ) -> jax.Array:
     """Solve one side's factors given the other side's. Returns (n_dst, r)."""
     r = src_factors.shape[1]
     # (r, r) <- MXU, psum over mesh — stays full f32 under every policy
     # (the Gram conditions the solve; its cost is O(n*r^2), not the hot path)
-    gram = psn.pdot(src_factors.T, src_factors)
+    gram = _factor_gram(src_factors, solve_kernel)
     a_part, b, n_reg = normal_eq_partials(
         dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha, True,
         policy,
     )
     eye = jnp.eye(r, dtype=src_factors.dtype)
-    return regularized_solve(a_part, b, n_reg, reg, eye, gram).astype(
-        src_factors.dtype
-    )
+    return regularized_solve(
+        a_part, b, n_reg, reg, eye, gram, solve_kernel
+    ).astype(src_factors.dtype)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_users", "n_items", "max_iter", "policy")
+    jax.jit,
+    static_argnames=(
+        "n_users", "n_items", "max_iter", "policy", "solve_kernel"
+    ),
 )
 def _als_implicit_run_jit(
     u_idx: jax.Array,
@@ -606,15 +681,18 @@ def _als_implicit_run_jit(
     reg: float,
     alpha: float,
     policy: str = "f32",
+    solve_kernel: str = "xla",
 ) -> Tuple[jax.Array, jax.Array]:
 
     def body(carry, _):
         x, y = carry
         x = _half_update(
-            u_idx, i_idx, conf, valid, y, n_users, reg, alpha, policy
+            u_idx, i_idx, conf, valid, y, n_users, reg, alpha, policy,
+            solve_kernel,
         )
         y = _half_update(
-            i_idx, u_idx, conf, valid, x, n_items, reg, alpha, policy
+            i_idx, u_idx, conf, valid, x, n_items, reg, alpha, policy,
+            solve_kernel,
         )
         return (x, y), None
 
@@ -626,24 +704,31 @@ def als_implicit_run(
     u_idx, i_idx, conf, valid, x0, y0,
     n_users: int, n_items: int, max_iter: int, reg: float, alpha: float,
     timings=None, phase: str = "als_iterations", policy: str = "f32",
+    solve_kernel: str = "",
 ) -> Tuple[jax.Array, jax.Array]:
     """Full training loop: alternating user/item updates under lax.scan
     (the reference's trainModel loop, ALSDALImpl.cpp:318-438).
     Registry-tracked (utils/progcache), like :func:`als_run_grouped`."""
+    solve_kernel = solve_kernel or resolve_solve_kernel(
+        x0.shape[1], x0.dtype
+    )
     key = (
         progcache.backend_fingerprint(),
         progcache.array_key(u_idx, x0, y0),
-        n_users, n_items, max_iter, policy,
+        n_users, n_items, max_iter, policy, solve_kernel,
     )
     with progcache.launch("als.implicit_coo", key, timings, phase):
         return _als_implicit_run_jit(
             u_idx, i_idx, conf, valid, x0, y0,
-            n_users, n_items, max_iter, reg, alpha, policy,
+            n_users, n_items, max_iter, reg, alpha, policy, solve_kernel,
         )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_users", "n_items", "max_iter", "policy")
+    jax.jit,
+    static_argnames=(
+        "n_users", "n_items", "max_iter", "policy", "solve_kernel"
+    ),
 )
 def _als_explicit_run_jit(
     u_idx: jax.Array,
@@ -657,6 +742,7 @@ def _als_explicit_run_jit(
     max_iter: int,
     reg: float,
     policy: str = "f32",
+    solve_kernel: str = "xla",
 ) -> Tuple[jax.Array, jax.Array]:
 
     def half(dst_idx, src_idx, src_factors, n_dst):
@@ -666,9 +752,9 @@ def _als_explicit_run_jit(
             False, policy,
         )
         eye = jnp.eye(r, dtype=src_factors.dtype)
-        return regularized_solve(a_part, b, n_reg, reg, eye).astype(
-            src_factors.dtype
-        )
+        return regularized_solve(
+            a_part, b, n_reg, reg, eye, None, solve_kernel
+        ).astype(src_factors.dtype)
 
     def body(carry, _):
         x, y = carry
@@ -684,19 +770,23 @@ def als_explicit_run(
     u_idx, i_idx, rating, valid, x0, y0,
     n_users: int, n_items: int, max_iter: int, reg: float,
     timings=None, phase: str = "als_iterations", policy: str = "f32",
+    solve_kernel: str = "",
 ) -> Tuple[jax.Array, jax.Array]:
     """Explicit-feedback ALS (beyond the reference's accelerated surface —
     it falls back to Spark for explicit; we accelerate both).
     Registry-tracked (utils/progcache), like :func:`als_run_grouped`."""
+    solve_kernel = solve_kernel or resolve_solve_kernel(
+        x0.shape[1], x0.dtype
+    )
     key = (
         progcache.backend_fingerprint(),
         progcache.array_key(u_idx, x0, y0),
-        n_users, n_items, max_iter, policy,
+        n_users, n_items, max_iter, policy, solve_kernel,
     )
     with progcache.launch("als.explicit_coo", key, timings, phase):
         return _als_explicit_run_jit(
             u_idx, i_idx, rating, valid, x0, y0,
-            n_users, n_items, max_iter, reg, policy,
+            n_users, n_items, max_iter, reg, policy, solve_kernel,
         )
 
 

@@ -41,11 +41,12 @@ import numpy as np
 from oap_mllib_tpu.data.prefetch import Prefetcher, PrefetchStats
 from oap_mllib_tpu.ops.als_ops import (
     _GROUPED_BUDGET_ELEMS,
+    _factor_gram,
     grouped_block_moments,
     regularized_solve,
+    resolve_solve_kernel,
     unpack_flat_moments,
 )
-from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
 from oap_mllib_tpu.utils.timing import tick
 
@@ -85,17 +86,20 @@ def _accum_moments(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("implicit",))
+@functools.partial(
+    jax.jit, static_argnames=("implicit", "solve_kernel")
+)
 def _solve_side(
-    m_flat: jax.Array, src_factors: jax.Array, reg: jax.Array, implicit: bool
+    m_flat: jax.Array, src_factors: jax.Array, reg: jax.Array,
+    implicit: bool, solve_kernel: str = "xla",
 ) -> jax.Array:
     """Factors from the summed flat moments — identical consumption to
     als_ops.als_run_grouped's half step (the shared regularized_solve)."""
     r = src_factors.shape[1]
     a, b, n_reg = unpack_flat_moments(m_flat, r)
     eye = jnp.eye(r, dtype=src_factors.dtype)
-    gram = psn.pdot(src_factors.T, src_factors) if implicit else None
-    return regularized_solve(a, b, n_reg, reg, eye, gram).astype(
+    gram = _factor_gram(src_factors, solve_kernel) if implicit else None
+    return regularized_solve(a, b, n_reg, reg, eye, gram, solve_kernel).astype(
         src_factors.dtype
     )
 
@@ -140,6 +144,7 @@ def _half_update_streamed(
     grouped_host, factors_dev: jax.Array, n_dst: int, gc: int, reg, alpha,
     implicit: bool, stats: Optional[PrefetchStats] = None, timings=None,
     phase: str = "als_iterations", policy: str = "f32",
+    solve_kernel: str = "xla",
 ) -> jax.Array:
     """One side's update: walk the host-resident grouped layout (already
     padded to a multiple of ``gc`` group rows) through the device in
@@ -158,7 +163,7 @@ def _half_update_streamed(
     step_key = (
         progcache.backend_fingerprint(),
         (gc, src_g.shape[1], n_dst, r), str(factors_dev.dtype), implicit,
-        policy,
+        policy, solve_kernel,
     )
     pf = Prefetcher(
         range(0, src_g.shape[0], gc),
@@ -181,7 +186,8 @@ def _half_update_streamed(
         record_execute=False,
     ):
         return _solve_side(
-            m, factors_dev, jnp.asarray(reg, factors_dev.dtype), implicit
+            m, factors_dev, jnp.asarray(reg, factors_dev.dtype), implicit,
+            solve_kernel,
         )
 
 
@@ -223,6 +229,7 @@ def als_run_streamed(
     from oap_mllib_tpu.utils.resilience import check_finite
 
     r = np.asarray(x0).shape[1]
+    solve_kernel = resolve_solve_kernel(r, np.float32)
     gc_u = groups_per_chunk(by_user[0].shape[1], r)
     gc_i = groups_per_chunk(by_item[0].shape[1], r)
     if degraded:
@@ -251,11 +258,11 @@ def als_run_streamed(
     for it in range(start_it, max_iter):
         x = _half_update_streamed(
             by_user, y, n_users, gc_u, reg, alpha, implicit, stats=stats,
-            timings=timings, policy=policy,
+            timings=timings, policy=policy, solve_kernel=solve_kernel,
         )
         y = _half_update_streamed(
             by_item, x, n_items, gc_i, reg, alpha, implicit, stats=stats,
-            timings=timings, policy=policy,
+            timings=timings, policy=policy, solve_kernel=solve_kernel,
         )
         # iterate-level guardrail (Config.nonfinite_policy): a singular
         # normal-equation solve yields NaN factors that contaminate every

@@ -70,8 +70,17 @@ def pallas_preferred(d: int, k: int, precision: str) -> bool:
     -split sums pay 1+2 bf16 passes where XLA "high" pays 3+3, "highest"
     6+6) with one known exception — small n*k at "high" (64k x 64, k=64:
     XLA 0.08 vs Pallas 0.19 ms/iter), accepted as a ~0.1 ms/iter auto-rule
-    miss in BASELINE.md rather than special-cased here; at "default" XLA's
-    all-bf16 single-pass pipeline wins instead.
+    miss in BASELINE.md rather than special-cased here.
+
+    "default" (= the bf16 compute policy via precision.kernel_tier) now
+    prices ON Pallas too — the ISSUE 9 workaround retirement: the old
+    rule routed it to XLA's all-bf16 single-pass pipeline, measured
+    faster when the kernel's counts still ran as two f32 VPU passes over
+    (bn, k); with the counts-as-bf16-matmul rework (see
+    kmeans_kernel._make_kernel) the fused kernel's halved HBM traffic
+    carries the tier, and dev/profile_kernels.py's fused-vs-unfused
+    sweep regenerates the evidence per backend.
+
     Large k is excluded: the kernel holds the full (k, d) centers AND sums
     blocks in VMEM, so past ~4M padded elements apiece (2 x 16 MB f32)
     Mosaic would fail to place them — those fits stay on the chunked XLA
@@ -80,7 +89,7 @@ def pallas_preferred(d: int, k: int, precision: str) -> bool:
     d_pad = -(-d // 128) * 128
     if k_pad * d_pad > (1 << 22):  # 16 MB per f32 VMEM block
         return False
-    return precision in ("highest", "high")
+    return precision in ("highest", "high", "default")
 
 
 def use_pallas_path(kernel_cfg: str, d: int, k: int, precision: str, dtype) -> bool:
@@ -103,6 +112,30 @@ def use_pallas_path(kernel_cfg: str, d: int, k: int, precision: str, dtype) -> b
         and jax.process_count() == 1
         and np.dtype(dtype) == np.float32
     )
+
+
+def ring_mode_cfg(cfg=None) -> str:
+    """Validated Config.ring_reduction.  Called on EVERY accelerated
+    K-Means dispatch — single-device included, where the knob has no
+    routing effect — so a typo raises everywhere (the als_item_layout
+    contract: it must not surface only once deployed to a mesh)."""
+    from oap_mllib_tpu.config import get_config
+
+    cfg = cfg or get_config()
+    mode = cfg.ring_reduction
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(
+            f"ring_reduction must be auto|on|off, got {mode!r}"
+        )
+    return mode
+
+
+def ring_enabled(mesh, data_axis: str, cfg=None) -> bool:
+    """Resolve Config.ring_reduction for a mesh: the ring-fused moments
+    reduction (ops/pallas/ring_reduce) runs by default ("auto"/"on")
+    whenever the reduce axis actually has >= 2 devices, and falls back
+    cleanly to the psum path below that — the acceptance contract."""
+    return ring_mode_cfg(cfg) != "off" and mesh.shape[data_axis] >= 2
 
 
 def _assign_prec(precision: str) -> str:
@@ -375,7 +408,8 @@ def lloyd_run(
 
 
 def _lloyd_model_sharded_fn(mesh, dax: str, max_: str, max_iter: int,
-                            precision: str, policy: str = "f32"):
+                            precision: str, policy: str = "f32",
+                            ring: bool = False):
     """Compiled model-sharded Lloyd program, cached in the process-wide
     program registry (utils/progcache — this function's old private
     functools.lru_cache is the pattern the registry generalizes) per
@@ -383,17 +417,18 @@ def _lloyd_model_sharded_fn(mesh, dax: str, max_: str, max_iter: int,
     closure per fit would recompile."""
     key = (
         progcache.mesh_fingerprint(mesh), dax, max_, max_iter, precision,
-        policy,
+        policy, ring,
     )
     return progcache.get_or_build(
         "kmeans.lloyd_model_sharded", key,
         lambda: _build_lloyd_model_sharded(mesh, dax, max_, max_iter,
-                                           precision, policy),
+                                           precision, policy, ring),
     )
 
 
 def _build_lloyd_model_sharded(mesh, dax: str, max_: str, max_iter: int,
-                               precision: str, policy: str = "f32"):
+                               precision: str, policy: str = "f32",
+                               ring: bool = False):
     """Build the jitted model-sharded Lloyd program (cached above).
 
     Mesh-sharded linalg (survey §5): on a (data, model) mesh each device
@@ -407,7 +442,16 @@ def _build_lloyd_model_sharded(mesh, dax: str, max_: str, max_iter: int,
     shard updates its own slice) with a psum over data only.  The reference
     cannot shard this dimension at all (oneDAL centroids are single-node,
     KMeansDALImpl.cpp:101-131).
+
+    ``ring=True`` replaces the three standalone data-axis psums of the
+    accumulate (centroid sums, counts, cost) with ONE ring reduction of
+    the packed (k, d_loc + 2) moments buffer
+    (ops/pallas/ring_reduce.ring_allreduce — remote-DMA kernel on TPU,
+    the identical-schedule ppermute program elsewhere); the model-axis
+    assignment psum and the convergence-move psum are untouched.
     """
+    world = mesh.shape[dax]
+
     def accum(x_blk, w_blk, c_blk, aprec, sprec, pol, need_cost):
         k = c_blk.shape[0]
         cf = psn.upcast(c_blk)
@@ -429,14 +473,39 @@ def _build_lloyd_model_sharded(mesh, dax: str, max_: str, max_iter: int,
         one_hot = (
             jax.nn.one_hot(assign, k, dtype=w_blk.dtype) * w_blk[:, None]
         )
-        sums_blk = collective.psum(
-            psn.pdot(one_hot.T, x_blk, pol, sprec), dax
-        )  # (k, d_loc) — stays feature-local
-        counts = collective.psum(jnp.sum(one_hot, axis=0), dax)
-        cost = (
-            collective.psum(jnp.sum(min_d2 * w_blk), dax)
+        sums_part = psn.pdot(one_hot.T, x_blk, pol, sprec)  # (k, d_loc)
+        counts_part = jnp.sum(one_hot, axis=0)  # (k,)
+        cost_part = (
+            jnp.sum(min_d2 * w_blk)
             if need_cost else jnp.asarray(0.0, w_blk.dtype)
         )
+        if ring:
+            # ONE packed ring reduction instead of three psums: columns
+            # [0:d_loc] sums, d_loc counts, d_loc+1 the cost scalar (row
+            # 0; zero elsewhere so the sum is exact)
+            extra = jnp.zeros((k, 2), sums_part.dtype)
+            extra = extra.at[:, 0].set(counts_part)
+            if need_cost:
+                extra = extra.at[0, 1].set(cost_part)
+            from oap_mllib_tpu.ops.pallas.ring_reduce import ring_allreduce
+
+            d_loc = sums_part.shape[1]
+            red = ring_allreduce(
+                jnp.concatenate([sums_part, extra], axis=1), dax, world
+            )
+            sums_blk = red[:, :d_loc]
+            counts = red[:, d_loc]
+            cost = (
+                red[0, d_loc + 1]
+                if need_cost else jnp.asarray(0.0, w_blk.dtype)
+            )
+        else:
+            sums_blk = collective.psum(sums_part, dax)  # feature-local
+            counts = collective.psum(counts_part, dax)
+            cost = (
+                collective.psum(cost_part, dax)
+                if need_cost else jnp.asarray(0.0, w_blk.dtype)
+            )
         return sums_blk, counts, cost
 
     def rank_program(x_blk, w_blk, c0_blk, tol_sq):
@@ -492,13 +561,19 @@ def lloyd_run_model_sharded(
     a multiple of the model-axis size (the estimator zero-pads feature
     columns; zero columns contribute nothing to distances or moves, and
     their centroid entries stay exactly zero).
+
+    The per-pass centroid moments reduce with the ring-fused path by
+    default (:func:`ring_enabled`: Config.ring_reduction, >= 2 devices
+    on the data axis, f32 — the ring packs/reduces in f32, so the x64
+    parity lane keeps the psum path).
     """
+    ring = ring_enabled(mesh, data_axis) and np.dtype(x.dtype) == np.float32
     fn = _lloyd_model_sharded_fn(mesh, data_axis, model_axis, max_iter,
-                                 precision, policy)
+                                 precision, policy, ring)
     key = (
         progcache.mesh_fingerprint(mesh),
         progcache.array_key(x, weights),
-        np.asarray(init_centers).shape, max_iter, precision, policy,
+        np.asarray(init_centers).shape, max_iter, precision, policy, ring,
     )
     with progcache.launch("kmeans.lloyd_model_sharded.run", key, timings,
                           phase):

@@ -1,0 +1,289 @@
+"""Batched ALS normal-equation kernels: per-user Gram assembly + rank-r
+Cholesky solve, and the streamed factor Gram.
+
+The XLA half-update (ops/als_ops.regularized_solve) assembles the batched
+(n_dst, r, r) systems — ALS-WR regularization + the implicit-feedback
+Gram term — as separate HBM-materialized intermediates before the
+unrolled batch-wide solve (``_chol_solve_unrolled``), paying ~3 extra
+reads/writes of the (n_dst, r, r) tensor.  ``solve_normal_eq_pallas``
+fuses the whole consumer: each grid step loads one batch tile of flat
+moments into VMEM, assembles A = moments + reg*n_reg*I (+ Gram) in
+registers, runs the unrolled rank-r Cholesky + both substitutions, masks
+empty rows, and writes only the (r, batch) factor tile back — one HBM
+read of the moments, one write of the factors.
+
+Layout: batch on the 128-LANE axis throughout (the grouped-path lesson,
+als_ops module notes: a (B, r, r) layout pads every r-minor buffer ~13x
+to the vreg tile at r=10).  Inputs arrive as one flat (r*r + r + 1, B)
+moment sheet — A row-major, then b, then n_reg — so every unrolled
+Cholesky step is a (1, B) lane-wide VPU op.
+
+Numerics: the solve is pinned f32 at EVERY tier, matching the package
+contract that Grams and solves never run reduced (utils/precision.py —
+the solve's conditioning is what the policy protects); ``mode`` is
+validated through the shared tier vocabulary so policy aliases pass
+through uniformly, and governs only :func:`factor_gram_pallas` (the
+(r, r) Gram streamed over the factor table with the hand-rolled hi/lo
+split tiers).  The elimination sequence replicates
+``_chol_solve_unrolled`` operation-for-operation (lower triangle only —
+the reference's masked upper-triangle work feeds only zeroed columns),
+so results are bit-identical to the XLA path on the same backend.
+
+Caller contract: rank r <= 32 (the unrolled-solve bound shared with
+masked_solve), batch pads to the 256-column tile with n_reg = 0 rows
+(masked to zero factors, sliced off by the wrapper).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from oap_mllib_tpu.ops.pallas._tiers import (
+    LANE,
+    check_mode,
+    kernel_launch,
+    note_emitted,
+    pad_to,
+    tiered_dot,
+)
+from oap_mllib_tpu.utils import progcache
+
+_BATCH = 256  # solve batch tile (lane axis)
+_GRAM_BLOCK_ROWS = 512
+MAX_RANK = 32  # the unrolled-solve bound (als_ops.masked_solve contract)
+
+
+def _make_solve_kernel(r: int, use_gram: bool):
+    w_a = r * r  # flat-sheet row offsets: A row-major, then b, then n_reg
+
+    def _kernel(m_ref, gram_ref, reg_ref, out_ref):
+        reg = reg_ref[0, 0]
+        gram = gram_ref[:]  # (r, r) — zeros row space never read if unused
+        nr = m_ref[w_a + r : w_a + r + 1, :]  # n_reg (1, B)
+
+        # assemble the lower triangle of A: moments + ALS-WR reg
+        # (reg * n_reg on the diagonal) + the implicit Gram term, in the
+        # exact addition order of als_ops.regularized_solve
+        # (a + reg*n*I first, gram added second) so bits match
+        at = {}
+        for i in range(r):
+            for j in range(i + 1):
+                a_ij = m_ref[i * r + j : i * r + j + 1, :]
+                if i == j:
+                    a_ij = a_ij + reg * nr
+                if use_gram:
+                    a_ij = gram[i, j] + a_ij
+                at[(i, j)] = a_ij
+
+        # unrolled batch-wide Cholesky via rank-1 Schur downdates —
+        # operation-for-operation the sequence of
+        # als_ops._chol_solve_unrolled, lower triangle only (the
+        # reference's masked upper-triangle entries feed only zeroed
+        # columns and never change a result bit)
+        cols = {}
+        for j in range(r):
+            d = jnp.sqrt(at[(j, j)])
+            for i in range(j, r):
+                cols[(i, j)] = at[(i, j)] / d
+            for i1 in range(j + 1, r):
+                for i2 in range(j + 1, i1 + 1):
+                    at[(i1, i2)] = at[(i1, i2)] - cols[(i1, j)] * cols[(i2, j)]
+
+        rhs = [m_ref[w_a + j : w_a + j + 1, :] for j in range(r)]
+        z = [None] * r
+        for j in range(r):  # forward: L z = b
+            z[j] = rhs[j] / cols[(j, j)]
+            for i in range(j + 1, r):
+                rhs[i] = rhs[i] - cols[(i, j)] * z[j]
+        w = [None] * r
+        for j in reversed(range(r)):  # back: L^T w = z
+            acc = z[j]
+            for k in range(j + 1, r):
+                acc = acc - cols[(k, j)] * w[k]
+            w[j] = acc / cols[(j, j)]
+
+        for j in range(r):  # empty rows (n_reg == 0) get zero factors
+            out_ref[j : j + 1, :] = jnp.where(
+                nr > 0, jnp.nan_to_num(w[j]), 0.0
+            )
+
+    return _kernel
+
+
+def _pallas_solve(m_t, gram, reg, r, use_gram, interpret):
+    """Raw pallas_call on the pre-packed (W, B) moment sheet (traced
+    inside the jitted wrappers — no jit of its own)."""
+    w_rows, n = m_t.shape
+    grid = (n // _BATCH,)
+    out = pl.pallas_call(
+        _make_solve_kernel(r, use_gram),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (w_rows, _BATCH), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((r, r), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (r, _BATCH), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((r, n), jnp.float32),
+        interpret=interpret,
+    )(m_t, gram, reg)
+    return out
+
+
+def solve_traced(a, b, n_reg, reg, gram=None, interpret=False):
+    """Traced pack + kernel + slice (no jit of its own) — the seam the
+    ALS runners' jitted bodies call through (als_ops.regularized_solve
+    with kernel="pallas").  Returns (n_dst, r) factors, f32."""
+    note_emitted("als.solve")
+    n, r = b.shape
+    if r > MAX_RANK:
+        raise ValueError(
+            f"pallas ALS solve supports rank <= {MAX_RANK}, got {r} "
+            "(the unrolled-solve bound; larger ranks use the XLA path)"
+        )
+    n_pad = pad_to(max(n, _BATCH), _BATCH)
+    # flat moment sheet: A row-major | b | n_reg, batch on lanes —
+    # padding columns carry n_reg 0 so they solve to masked zeros
+    m = jnp.concatenate(
+        [
+            a.astype(jnp.float32).reshape(n, r * r),
+            b.astype(jnp.float32),
+            n_reg.astype(jnp.float32)[:, None],
+        ],
+        axis=1,
+    )
+    m_t = jnp.zeros((r * r + r + 1, n_pad), jnp.float32).at[:, :n].set(m.T)
+    use_gram = gram is not None
+    g = (
+        gram.astype(jnp.float32)
+        if use_gram
+        else jnp.zeros((r, r), jnp.float32)
+    )
+    reg_arr = jnp.full((1, 1), reg, jnp.float32)
+    out = _pallas_solve(m_t, g, reg_arr, r, use_gram, interpret)
+    return out[:, :n].T
+
+
+@functools.partial(jax.jit, static_argnames=("use_gram", "interpret"))
+def _solve_jit(a, b, n_reg, reg, gram, use_gram, interpret):
+    return solve_traced(
+        a, b, n_reg, reg, gram if use_gram else None, interpret
+    )
+
+
+def solve_normal_eq_pallas(
+    a: jax.Array,
+    b: jax.Array,
+    n_reg: jax.Array,
+    reg,
+    gram: jax.Array = None,
+    mode: str = "highest",
+    interpret: bool = False,
+) -> jax.Array:
+    """Standalone entry over :func:`solve_traced`: one registry-tracked
+    jitted program (pack + kernel + slice).  ``mode`` is validated for
+    API uniformity with the other kernels but the solve always runs f32
+    (module docstring: the package pins solves full-precision under
+    every policy)."""
+    check_mode(mode)
+    use_gram = gram is not None
+    progcache.note(
+        "als.pallas_solve",
+        (progcache.backend_fingerprint(),
+         progcache.array_key(a, b), use_gram, interpret),
+    )
+    with kernel_launch("als.solve"):
+        return _solve_jit(
+            a, b, n_reg, jnp.asarray(reg, jnp.float32),
+            gram if use_gram else jnp.zeros((b.shape[1],) * 2, jnp.float32),
+            use_gram, interpret,
+        )
+
+
+# -- streamed factor Gram ----------------------------------------------------
+
+
+def _make_gram_kernel(mode):
+    def _kernel(f_ref, gram_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            gram_ref[:] = jnp.zeros_like(gram_ref)
+
+        f = f_ref[:]  # (bn, r_pad)
+        gram_ref[:] += tiered_dot(f, f, (((0,), (0,)), ((), ())), mode)
+
+    return _kernel
+
+
+def _pallas_factor_gram(f_p, mode, interpret):
+    n, r_pad = f_p.shape
+    grid = (n // _GRAM_BLOCK_ROWS,)
+    return pl.pallas_call(
+        _make_gram_kernel(mode),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (_GRAM_BLOCK_ROWS, r_pad), lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (r_pad, r_pad), lambda i: (0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((r_pad, r_pad), jnp.float32),
+        interpret=interpret,
+    )(f_p)
+
+
+def factor_gram_traced(factors, mode="highest", interpret=False):
+    """Traced pad + kernel + slice: the (r, r) factor Gram ``F^T F``
+    streamed over the factor table in row tiles — the implicit-feedback
+    Gram term of the ALS half-update, with the shared hi/lo split tiers.
+    Production call sites pin mode="highest" (solves and the Grams that
+    condition them never run reduced — utils/precision.py contract); the
+    split tiers exist for parity tests and shapes where a caller
+    explicitly prices them."""
+    note_emitted("als.factor_gram")
+    n, r = factors.shape
+    n_pad = pad_to(max(n, _GRAM_BLOCK_ROWS), _GRAM_BLOCK_ROWS)
+    r_pad = pad_to(r, LANE)
+    f_p = jnp.zeros((n_pad, r_pad), jnp.float32).at[:n, :r].set(
+        factors.astype(jnp.float32)
+    )
+    gram = _pallas_factor_gram(f_p, mode, interpret)
+    return gram[:r, :r]
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "interpret"))
+def _factor_gram_jit(factors, mode, interpret):
+    return factor_gram_traced(factors, mode, interpret)
+
+
+def factor_gram_pallas(
+    factors: jax.Array, mode: str = "highest", interpret: bool = False
+) -> jax.Array:
+    """Standalone registry-tracked entry over :func:`factor_gram_traced`."""
+    mode = check_mode(mode)
+    progcache.note(
+        "als.pallas_factor_gram",
+        (progcache.backend_fingerprint(),
+         progcache.array_key(factors), mode, interpret),
+    )
+    with kernel_launch("als.factor_gram"):
+        return _factor_gram_jit(factors, mode, interpret)
+
+
+def pallas_solve_preferred(r: int) -> bool:
+    """Shape rule for als_solve_kernel="auto": the fused assembly+solve
+    covers the unrolled-rank regime (r <= 32, Spark's default is 10);
+    larger ranks keep the library Cholesky path."""
+    return r <= MAX_RANK

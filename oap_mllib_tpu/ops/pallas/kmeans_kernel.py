@@ -10,8 +10,9 @@ sequential execution for safe read-modify-write accumulation (the pallas
 accumulate pattern).  HBM traffic per iteration drops from
 O(n*d + 2*n*k) to O(n*d + k*d).
 
-Precision tiers (``mode``) — Mosaic only lowers Precision.HIGHEST/DEFAULT,
-so split tiers are implemented by hand with bf16 hi/lo splits:
+Precision tiers (``mode``) — shared vocabulary in ops/pallas/_tiers.py
+(Mosaic only lowers Precision.HIGHEST/DEFAULT, so split tiers are
+implemented by hand with bf16 hi/lo splits):
 
 - ``highest``: both matmuls f32 Precision.HIGHEST.  Parity default.
 - ``high``: distance cross-term single-pass bf16 (the tier contract —
@@ -25,7 +26,10 @@ so split tiers are implemented by hand with bf16 hi/lo splits:
 
 Caller contract (see ``lloyd_accumulate_pallas``): rows padded to the block
 size with weight 0; k and d padded to lane multiples (128) by the wrapper —
-dummy centers get +inf-like coordinates so no row ever selects them.
+dummy centers get +inf-like coordinates so no row ever selects them.  The
+single-shot path pads INSIDE one jitted program (pad + kernel + slice),
+so progcache sees one program per input signature instead of a spray of
+eager padding dispatches per call (ISSUE 9 satellite).
 """
 
 from __future__ import annotations
@@ -38,36 +42,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from oap_mllib_tpu.ops.pallas._tiers import (
+    LANE,
+    check_mode,
+    dot_bf16,
+    dot_f32,
+    kernel_launch,
+    pad_to,
+    split_bf16,
+)
+from oap_mllib_tpu.utils import progcache
+
 _BLOCK_ROWS = 512
-_LANE = 128
-_MODES = ("highest", "high", "default")
-# compute-precision policy names (utils/precision.py) accepted as mode
-# aliases: the kernel's tiers already ARE the policy's hand-rolled bf16
-# splits — "tf32" is the bf16_3x "high" tier, "bf16" the single-pass
-# bf16 "default" tier, "f32" the full-f32 "highest" tier — so callers
-# resolving a policy can pass its name straight through.
-_MODE_ALIASES = {"f32": "highest", "tf32": "high", "bf16": "default"}
-
-
-def _split_bf16(a):
-    """f32 -> (hi, lo) bf16 pair with a ~= hi + lo."""
-    hi = a.astype(jnp.bfloat16)
-    lo = (a - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    return hi, lo
-
-
-def _dot_f32(a, b, dn):
-    return jax.lax.dot_general(
-        a, b, dimension_numbers=dn,
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
-
-
-def _dot_bf16(a, b, dn):
-    return jax.lax.dot_general(
-        a, b, dimension_numbers=dn, preferred_element_type=jnp.float32
-    )
 
 
 def _cross_term(x, c, mode):
@@ -80,9 +66,9 @@ def _cross_term(x, c, mode):
     sums exceed in both modes)."""
     dn = (((1,), (1,)), ((), ()))
     if mode == "highest":
-        return _dot_f32(x, c, dn)
+        return dot_f32(x, c, dn)
     # high/default: single-pass bf16 — argmin only flips on near-ties
-    return _dot_bf16(x.astype(jnp.bfloat16), c.astype(jnp.bfloat16), dn)
+    return dot_bf16(x.astype(jnp.bfloat16), c.astype(jnp.bfloat16), dn)
 
 
 def _cluster_sums(one_hot01, wx, mode):
@@ -92,12 +78,12 @@ def _cluster_sums(one_hot01, wx, mode):
     same error envelope as the XLA default tier (~1e-3)."""
     dn = (((0,), (0,)), ((), ()))
     if mode == "highest":
-        return _dot_f32(one_hot01, wx, dn)
+        return dot_f32(one_hot01, wx, dn)
     oh = one_hot01.astype(jnp.bfloat16)  # exact
     if mode == "default":
-        return _dot_bf16(oh, wx.astype(jnp.bfloat16), dn)
-    wx_hi, wx_lo = _split_bf16(wx)
-    return _dot_bf16(oh, wx_hi, dn) + _dot_bf16(oh, wx_lo, dn)
+        return dot_bf16(oh, wx.astype(jnp.bfloat16), dn)
+    wx_hi, wx_lo = split_bf16(wx)
+    return dot_bf16(oh, wx_hi, dn) + dot_bf16(oh, wx_lo, dn)
 
 
 def _make_kernel(mode, need_cost=True):
@@ -154,21 +140,19 @@ def _make_kernel(mode, need_cost=True):
             # this shape compiles where the f32-HIGHEST variant blew
             # Mosaic's scoped vmem (see the assignment note above).
             oh = one_hot.astype(jnp.bfloat16)
-            w_hi, w_lo = _split_bf16(w)
+            w_hi, w_lo = split_bf16(w)
             dn = (((1,), (0,)), ((), ()))
-            counts_ref[:] += _dot_bf16(w_hi.T, oh, dn) + _dot_bf16(w_lo.T, oh, dn)
+            counts_ref[:] += dot_bf16(w_hi.T, oh, dn) + dot_bf16(w_lo.T, oh, dn)
         if need_cost:
             cost_ref[0, 0] += jnp.sum(min_d2 * w)
 
     return _kernel
 
 
-def _pad_to(x: int, m: int) -> int:
-    return ((x + m - 1) // m) * m
-
-
-@functools.partial(jax.jit, static_argnames=("mode", "interpret", "need_cost"))
-def _call(x, w, centers, mode="highest", interpret=False, need_cost=True):
+def _pallas_accumulate(x, w, centers, mode="highest", interpret=False,
+                       need_cost=True):
+    """Raw pallas_call on pre-padded operands (traced inside the jitted
+    wrappers below — no jit of its own)."""
     n, d = x.shape
     k = centers.shape[0]
     grid = (n // _BLOCK_ROWS,)
@@ -195,17 +179,54 @@ def _call(x, w, centers, mode="highest", interpret=False, need_cost=True):
     return sums, counts, cost
 
 
-def _check_mode(mode: str) -> str:
-    """Canonicalize a mode: legacy tier names pass through, policy names
-    map via _MODE_ALIASES, anything else raises (typos must not silently
-    run a different tier)."""
-    mode = _MODE_ALIASES.get(mode, mode)
-    if mode not in _MODES:
-        raise ValueError(
-            f"mode must be one of {_MODES} (or a policy alias "
-            f"{tuple(_MODE_ALIASES)}), got {mode!r}"
-        )
-    return mode
+@functools.partial(jax.jit, static_argnames=("mode", "interpret", "need_cost"))
+def _call(x, w, centers, mode="highest", interpret=False, need_cost=True):
+    return _pallas_accumulate(x, w, centers, mode, interpret, need_cost)
+
+
+def _pad_operands_traced(x, weights, centers):
+    """Padding math shared by the jitted wrappers (traced, never eager):
+    rows to the 512-row block, k and d to lane multiples.  Dummy centers
+    sit at 1e15 so no real row selects them; dummy feature columns of
+    real centers are 0 (matching padded x columns)."""
+    n, d = x.shape
+    k = centers.shape[0]
+    n_pad = pad_to(max(n, _BLOCK_ROWS), _BLOCK_ROWS)
+    d_pad = pad_to(d, LANE)
+    k_pad = pad_to(k, LANE)
+    x_p = jnp.zeros((n_pad, d_pad), jnp.float32).at[:n, :d].set(x.astype(jnp.float32))
+    w_p = jnp.zeros((n_pad, 1), jnp.float32).at[:n, 0].set(weights.astype(jnp.float32))
+    c_p = jnp.full((k_pad, d_pad), 1e15, jnp.float32).at[:k, :d].set(
+        centers.astype(jnp.float32)
+    )
+    c_p = c_p.at[:k, d:].set(0.0)
+    return x_p, w_p, c_p
+
+
+def _pad_operands(x, weights, centers):
+    """One compiled program per shape signature for the loop entry's pad
+    step — previously ~6 eager dispatches per call.  Built through the
+    program-cache registry (R1: jit lives in a get_or_build builder)."""
+    fn = progcache.get_or_build(
+        "kmeans.pallas_pad", (),
+        lambda: jax.jit(_pad_operands_traced),
+    )
+    return fn(x, weights, centers)
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "interpret", "need_cost"))
+def _accumulate_jit(x, weights, centers, mode, interpret, need_cost):
+    """Single-shot fused accumulate: pad + kernel + slice in ONE jitted
+    program.  The old path ran ``_pad_operands`` eagerly before a jitted
+    kernel call — roughly six XLA dispatches of padding scatter/concat per
+    invocation that the program cache could not see (``lloyd_run_pallas``
+    pads once outside its loop and never had the problem)."""
+    k, d = centers.shape[0], x.shape[1]
+    x_p, w_p, c_p = _pad_operands_traced(x, weights, centers)
+    sums, counts, cost = _pallas_accumulate(
+        x_p, w_p, c_p, mode, interpret, need_cost
+    )
+    return sums[:k, :d], counts[0, :k], cost[0, 0]
 
 
 def lloyd_accumulate_pallas(
@@ -217,32 +238,17 @@ def lloyd_accumulate_pallas(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Drop-in replacement for ops.kmeans_ops._accumulate (f32 only).
 
-    Pads rows to the 512-row block, k and d to 128-lane multiples.  Dummy
-    centers are placed at 1e15 so no real row selects them; their
-    counts/sums come back zero and are sliced off.
+    One registry-tracked jitted program per input signature (padding
+    included — see ``_accumulate_jit``).
     """
-    mode = _check_mode(mode)
-    n, d = x.shape
-    k = centers.shape[0]
-    x_p, w_p, c_p = _pad_operands(x, weights, centers)
-    sums, counts, cost = _call(x_p, w_p, c_p, mode=mode, interpret=interpret)
-    return sums[:k, :d], counts[0, :k], cost[0, 0]
-
-
-def _pad_operands(x, weights, centers):
-    n, d = x.shape
-    k = centers.shape[0]
-    n_pad = _pad_to(max(n, _BLOCK_ROWS), _BLOCK_ROWS)
-    d_pad = _pad_to(d, _LANE)
-    k_pad = _pad_to(k, _LANE)
-    x_p = jnp.zeros((n_pad, d_pad), jnp.float32).at[:n, :d].set(x.astype(jnp.float32))
-    w_p = jnp.zeros((n_pad, 1), jnp.float32).at[:n, 0].set(weights.astype(jnp.float32))
-    c_p = jnp.full((k_pad, d_pad), 1e15, jnp.float32).at[:k, :d].set(
-        centers.astype(jnp.float32)
+    mode = check_mode(mode)
+    progcache.note(
+        "kmeans.pallas_accumulate",
+        (progcache.backend_fingerprint(),
+         progcache.array_key(x, weights, centers), mode, interpret),
     )
-    # dummy feature columns of real centers must be 0 (match padded x cols)
-    c_p = c_p.at[:k, d:].set(0.0)
-    return x_p, w_p, c_p
+    with kernel_launch("kmeans.accumulate"):
+        return _accumulate_jit(x, weights, centers, mode, interpret, True)
 
 
 @functools.partial(jax.jit, static_argnames=("max_iter", "mode", "interpret"))
@@ -256,8 +262,8 @@ def _lloyd_loop_padded(x_p, w_p, c_p, max_iter, tol, mode="highest", interpret=F
 
     def body(state):
         centers, it, _ = state
-        sums, counts, _ = _call(
-            x_p, w_p, centers, mode=mode, interpret=interpret, need_cost=False
+        sums, counts, _ = _pallas_accumulate(
+            x_p, w_p, centers, mode, interpret, need_cost=False
         )
         counts_col = counts[0][:, None]  # (k_pad, 1)
         new_centers = jnp.where(
@@ -272,20 +278,24 @@ def _lloyd_loop_padded(x_p, w_p, c_p, max_iter, tol, mode="highest", interpret=F
     # final cost + counts w.r.t. the returned centers, always at full
     # precision — the user-facing objective should not carry the fast
     # tiers' distance error
-    _, counts, cost = _call(x_p, w_p, centers, mode="highest", interpret=interpret)
+    _, counts, cost = _pallas_accumulate(
+        x_p, w_p, centers, "highest", interpret, need_cost=True
+    )
     return centers, n_iter, cost[0, 0], counts[0]
 
 
 def lloyd_run_pallas(x, weights, init_centers, max_iter, tol,
                      mode: str = "highest", interpret: bool = False):
     """Fused-kernel Lloyd loop; same contract as ops.kmeans_ops.lloyd_run
-    (f32, adds per-cluster counts). Pads once outside the loop, slices the
-    result back."""
-    mode = _check_mode(mode)
+    (f32, adds per-cluster counts). Pads once outside the loop (one
+    compiled pad program), slices the result back."""
+    mode = check_mode(mode)
     d = x.shape[1]
     k = init_centers.shape[0]
-    x_p, w_p, c_p = _pad_operands(x, weights, init_centers)
-    centers, n_iter, cost, counts = _lloyd_loop_padded(
-        x_p, w_p, c_p, max_iter, jnp.asarray(tol, jnp.float32), mode, interpret
-    )
+    with kernel_launch("kmeans.lloyd_loop"):
+        x_p, w_p, c_p = _pad_operands(x, weights, init_centers)
+        centers, n_iter, cost, counts = _lloyd_loop_padded(
+            x_p, w_p, c_p, max_iter, jnp.asarray(tol, jnp.float32), mode,
+            interpret,
+        )
     return centers[:k, :d], n_iter, cost, counts[:k]

@@ -1,0 +1,230 @@
+"""Fused streaming PCA moments: centered Gram + column sums in one kernel.
+
+The XLA covariance pass (ops/pca_ops._covariance_jit and the streamed
+``_gram_chunk``) materializes the centered, mask-scaled copy ``xc = (x -
+mean) * mask`` in HBM before the Gram matmul — an extra O(n*d) write +
+read per pass on top of streaming X.  This kernel fuses center + mask +
+Gram per row tile in VMEM: each (bn, d) block is centered on the VPU,
+contracted on the MXU into the (d, d) Gram accumulator, and its raw
+masked column sums + weighted row count accumulate alongside (the
+"X-tile -> X^T X partial + colsum in VMEM" shape of ISSUE 9) —
+exploiting the TPU grid's sequential execution for read-modify-write
+accumulation exactly like the K-Means kernel.  HBM traffic per pass
+drops from O(2*n*d + d^2) to O(n*d + d^2).
+
+Two-pass numerics are preserved: the covariance wrapper
+(ops/pca_ops.covariance) first runs the kernel with ``need_gram=False``
+(column sums only — the mean pass), then with the mean and
+``need_gram=True`` (the centered Gram pass).  The raw-moment one-pass
+form stays banned (catastrophic cancellation — see pca_ops).
+
+Precision tiers (``mode``, shared vocabulary in ops/pallas/_tiers.py):
+``highest`` = f32 Precision.HIGHEST Gram (parity tier; column sums and
+the row count ALWAYS reduce f32 on the VPU at every tier); ``high`` =
+hand-rolled bf16_3x — both Gram operands hi/lo-split, three bf16 passes,
+~1e-5 of full f32; ``default`` = single-pass all-bf16 with f32
+accumulation (~1e-3).  Policy aliases (f32/tf32/bf16) map through
+``check_mode``, which is what prices the bf16 compute policy ON Pallas
+(utils/precision.kernel_tier — the ISSUE 9 workaround retirement).
+
+Caller contract (``pca_moments_pallas``): rows pad to the 512-row block
+with mask 0, d pads to lane multiples with zero columns (zero in x, mean
+and therefore in every output slice).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from oap_mllib_tpu.ops.pallas._tiers import (
+    LANE,
+    check_mode,
+    kernel_launch,
+    pad_to,
+    tiered_dot,
+)
+from oap_mllib_tpu.utils import progcache
+
+_BLOCK_ROWS = 512
+
+
+def _make_kernel(mode, need_gram):
+    def _kernel(x_ref, m_ref, mean_ref, gram_ref, colsum_ref, count_ref):
+        """One grid step: fold a (bn, d) row block into the moments."""
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            gram_ref[:] = jnp.zeros_like(gram_ref)
+            colsum_ref[:] = jnp.zeros_like(colsum_ref)
+            count_ref[0, 0] = jnp.float32(0.0)
+
+        x = x_ref[:]  # (bn, d)
+        m = m_ref[:]  # (bn, 1)
+        xm = x * m
+        # raw masked column sums + weighted row count: always exact f32
+        # VPU reductions (the mean numerator must not carry tier rounding)
+        colsum_ref[:] += jnp.sum(xm, axis=0, keepdims=True)
+        count_ref[0, 0] += jnp.sum(m)
+        if need_gram:
+            xc = (x - mean_ref[:]) * m  # centered in f32, masked
+            # (d, d) += xc^T @ xc — contract the row axis on the MXU at
+            # the requested tier (hi/lo splits round xc ONCE per operand)
+            gram_ref[:] += tiered_dot(
+                xc, xc, (((0,), (0,)), ((), ())), mode
+            )
+
+    return _kernel
+
+
+def _pallas_moments(x, m, mean, mode, interpret, need_gram):
+    """Raw pallas_call on pre-padded operands (traced inside the jitted
+    wrappers — no jit of its own)."""
+    n, d = x.shape
+    grid = (n // _BLOCK_ROWS,)
+    gram, colsum, count = pl.pallas_call(
+        _make_kernel(mode, need_gram),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((d, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((d, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, m, mean)
+    return gram, colsum, count
+
+
+def _pad_rows_cols(x, mask, mean):
+    """Pad rows to the block multiple (mask 0) and d to the lane multiple
+    (zero columns — zero in x AND mean, so they vanish from every
+    output).  Traced only (inside the jitted wrappers)."""
+    n, d = x.shape
+    n_pad = pad_to(max(n, _BLOCK_ROWS), _BLOCK_ROWS)
+    d_pad = pad_to(d, LANE)
+    x_p = jnp.zeros((n_pad, d_pad), jnp.float32).at[:n, :d].set(
+        x.astype(jnp.float32)
+    )
+    m_p = jnp.zeros((n_pad, 1), jnp.float32).at[:n, 0].set(
+        mask.astype(jnp.float32)
+    )
+    mean_p = jnp.zeros((1, d_pad), jnp.float32).at[0, :d].set(
+        mean.astype(jnp.float32)
+    )
+    return x_p, m_p, mean_p
+
+
+def moments_traced(x, mask, mean, mode, interpret, need_gram):
+    """Traced pad + kernel + slice (no jit of its own) — the seam the
+    streamed per-chunk accumulators jit around (ops/stream_ops)."""
+    d = x.shape[1]
+    x_p, m_p, mean_p = _pad_rows_cols(x, mask, mean)
+    gram, colsum, count = _pallas_moments(
+        x_p, m_p, mean_p, mode, interpret, need_gram
+    )
+    return gram[:d, :d], colsum[0, :d], count[0, 0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mode", "interpret", "need_gram")
+)
+def _moments_jit(x, mask, mean, mode, interpret, need_gram):
+    """Pad + kernel + slice in ONE jitted program (the
+    kmeans_kernel._accumulate_jit pattern — progcache sees one program
+    per input signature, never eager padding dispatches)."""
+    return moments_traced(x, mask, mean, mode, interpret, need_gram)
+
+
+def pca_moments_pallas(
+    x: jax.Array,
+    mask: jax.Array,
+    mean: jax.Array = None,
+    mode: str = "highest",
+    interpret: bool = False,
+    need_gram: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused PCA moments over one table/chunk: returns (gram (d, d),
+    colsum (d,), wcount scalar), all f32.
+
+    ``gram`` is the CENTERED masked Gram ``((x - mean) * mask)^T @ ...``
+    (zeros when ``need_gram=False`` — the mean pass, which skips the MXU
+    work entirely); ``colsum``/``wcount`` are the raw masked column sums
+    and total mask weight, tier-independent f32.  ``mean=None`` means a
+    zero vector (pass-1 usage).
+    """
+    mode = check_mode(mode)
+    if mean is None:
+        mean = jnp.zeros((x.shape[1],), jnp.float32)
+    progcache.note(
+        "pca.pallas_moments",
+        (progcache.backend_fingerprint(),
+         progcache.array_key(x, mask), mode, interpret, need_gram),
+    )
+    with kernel_launch("pca.moments"):
+        return _moments_jit(x, mask, mean, mode, interpret, need_gram)
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "interpret"))
+def _covariance_pallas_jit(x, mask, n_rows, mode, interpret):
+    """Both covariance passes — colsum/mean then centered Gram — over ONE
+    padded copy of the table, in one jitted program.  Numerics match
+    pca_ops._covariance_jit's two-pass mean-centered form (the raw-moment
+    form stays banned; see that docstring)."""
+    d = x.shape[1]
+    x_p, m_p, zero_mean = _pad_rows_cols(
+        x, mask, jnp.zeros((d,), jnp.float32)
+    )
+    _, colsum, _ = _pallas_moments(
+        x_p, m_p, zero_mean, mode, interpret, need_gram=False
+    )
+    mean_p = colsum / n_rows  # (1, d_pad); padded columns stay 0
+    gram, _, _ = _pallas_moments(
+        x_p, m_p, mean_p, mode, interpret, need_gram=True
+    )
+    cov = gram[:d, :d] / jnp.maximum(n_rows - 1.0, 1.0)
+    # numerical symmetry guard before eigh (same as the XLA pass)
+    return 0.5 * (cov + cov.T), mean_p[0, :d]
+
+
+def covariance_pallas(
+    x: jax.Array, mask: jax.Array, n_rows: jax.Array,
+    mode: str = "highest", interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused-kernel replacement for pca_ops._covariance_jit: (cov (d, d),
+    mean (d,)) — same two-pass centered numerics, one padded table copy,
+    no HBM-materialized centered temp."""
+    mode = check_mode(mode)
+    progcache.note(
+        "pca.pallas_covariance",
+        (progcache.backend_fingerprint(),
+         progcache.array_key(x, mask), mode, interpret),
+    )
+    with kernel_launch("pca.covariance"):
+        return _covariance_pallas_jit(x, mask, n_rows, mode, interpret)
+
+
+def pallas_gram_preferred(d: int, precision: str) -> bool:
+    """Shape/tier rule for pca_kernel="auto": the fused kernel holds the
+    full (d, d) Gram block in VMEM, so past ~4M padded elements (16 MB
+    f32) Mosaic cannot place it — those fits stay on the XLA pass.  All
+    three tiers qualify (the kernel ships the same hand-rolled hi/lo
+    split tiers as the K-Means kernel, so the bf16 policy prices ON
+    Pallas — the ISSUE 9 workaround retirement)."""
+    d_pad = pad_to(d, LANE)
+    if d_pad * d_pad > (1 << 22):  # 16 MB per f32 VMEM block
+        return False
+    return precision in ("highest", "high", "default")

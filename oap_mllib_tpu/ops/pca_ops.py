@@ -21,6 +21,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 from typing import Tuple
@@ -79,6 +80,32 @@ def _covariance_jit(
     return 0.5 * (cov + cov.T), mean
 
 
+def use_pallas_gram(kernel_cfg: str, d: int, precision: str, dtype) -> bool:
+    """Single source of truth for the PCA Gram kernel dispatch (in-memory
+    AND streamed entries, like kmeans_ops.use_pallas_path): the fused
+    Pallas moments kernel runs only when configured/preferred AND its
+    preconditions hold — TPU backend, one device, one process, f32.
+    ``precision`` here is the kernel tier the policy mapped onto
+    (utils/precision.kernel_tier), so the bf16 policy's "default" tier
+    prices ON Pallas (the ISSUE 9 workaround retirement)."""
+    if kernel_cfg not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"pca_kernel must be auto|xla|pallas, got {kernel_cfg!r}"
+        )
+    from oap_mllib_tpu.ops.pallas.pca_kernel import pallas_gram_preferred
+
+    want = kernel_cfg == "pallas" or (
+        kernel_cfg == "auto" and pallas_gram_preferred(d, precision)
+    )
+    return (
+        want
+        and jax.default_backend() == "tpu"
+        and len(jax.devices()) == 1
+        and jax.process_count() == 1
+        and np.dtype(dtype) == np.float32
+    )
+
+
 def covariance(
     x: jax.Array, mask: jax.Array, n_rows: jax.Array,
     precision: str = "highest",
@@ -89,7 +116,26 @@ def covariance(
     its docstring): the launch is noted with the program-cache registry
     (utils/progcache) and, when ``timings`` is given, its wall is booked
     under ``<phase>/compile`` (first program) or ``<phase>/execute``.
-    ``policy`` is the compute-precision policy (utils/precision.py)."""
+    ``policy`` is the compute-precision policy (utils/precision.py).
+
+    Dispatches to the fused Pallas moments kernel
+    (ops/pallas/pca_kernel.covariance_pallas — same two-pass centered
+    numerics, no HBM centered temp) when :func:`use_pallas_gram` says so;
+    the kernel's tier IS the mapped policy, so ``policy`` needs no
+    separate plumbing there."""
+    from oap_mllib_tpu.config import get_config
+
+    if use_pallas_gram(
+        get_config().pca_kernel, x.shape[1], precision, x.dtype
+    ):
+        from oap_mllib_tpu.ops.pallas.pca_kernel import covariance_pallas
+
+        key = (
+            progcache.backend_fingerprint(),
+            progcache.array_key(x, mask), precision, "pallas",
+        )
+        with progcache.launch("pca.covariance_pallas", key, timings, phase):
+            return covariance_pallas(x, mask, n_rows, mode=precision)
     key = (
         progcache.backend_fingerprint(),
         progcache.array_key(x, mask),
@@ -173,6 +219,10 @@ def covariance_model_sharded(
     from oap_mllib_tpu.config import get_config
 
     cfg = get_config()
+    # pca_kernel validation must run on EVERY accelerated fit (the
+    # covariance/use_pallas_gram invariant): a typo'd value raises here
+    # too, even though the model-sharded Gram stays on the shard_map path
+    use_pallas_gram(cfg.pca_kernel, x.shape[1], precision, x.dtype)
     fn = _model_sharded_cov_fn(
         mesh, cfg.data_axis, cfg.model_axis, precision, policy
     )

@@ -229,18 +229,114 @@ def _materialize(arrays, guard: "_PassGuard | None"):
     return [np.asarray(a) for a in arrays]
 
 
+def _ring_mesh():
+    """The device mesh for the streamed ring reduction, or None when the
+    psum/host path must run: multi-process world, pure data-parallel
+    mesh (a model axis would misalign the one-slot-per-device stacking),
+    and Config.ring_reduction armed with >= 2 devices on the data axis
+    (kmeans_ops.ring_enabled — the shared fallback contract)."""
+    if _world() == 1:
+        return None
+    from oap_mllib_tpu.config import get_config
+
+    cfg = get_config()
+    if cfg.model_parallel != 1:
+        return None
+    from oap_mllib_tpu.ops.kmeans_ops import ring_enabled
+    from oap_mllib_tpu.parallel.mesh import get_mesh
+
+    mesh = get_mesh()
+    if not ring_enabled(mesh, cfg.data_axis, cfg):
+        return None
+    return mesh
+
+
+def _ring_reduce_f32(arrays, mesh, axis: str):
+    """Sum a list of f32 host arrays across processes through ONE packed
+    ring reduction (ops/pallas/ring_reduce): the payloads flatten into a
+    (D, ceil(total/D)) segment sheet — each ring segment is a real chunk
+    of the moments — ride a one-slot-per-device stacked array onto the
+    mesh, and come back fully summed on every slot.  This is the
+    streamed multi-host half of the ISSUE 9 ring plane: the per-pass
+    centroid/Gram moments stop paying a standalone host-mediated
+    allgather serialized behind the pass."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from oap_mllib_tpu.ops.pallas.ring_reduce import stacked_ring_fn
+
+    d_ax = mesh.shape[axis]
+    flat = np.concatenate(
+        [np.asarray(a, np.float32).ravel() for a in arrays]
+    )
+    total = flat.size
+    cols = max(1, -(-total // d_ax))
+    buf = np.zeros((d_ax, cols), np.float32)
+    buf.ravel()[:total] = flat
+    sanitizers.note_collective(
+        "ring_allreduce", axis, (d_ax, cols), "float32"
+    )
+    n_slots = d_ax // max(1, jax.process_count())
+    local = np.zeros((n_slots, d_ax, cols), np.float32)
+    local[0] = buf  # this process's payload in its first device slot
+    sharding = NamedSharding(mesh, P(axis, None, None))
+    stacked = jax.make_array_from_process_local_data(sharding, local)
+    out = stacked_ring_fn(mesh, axis)(stacked)
+    summed = np.asarray(out.addressable_shards[0].data)[0].ravel()[:total]
+    res, off = [], 0
+    for a in arrays:
+        n = int(np.asarray(a).size)
+        res.append(
+            summed[off : off + n].reshape(np.shape(a)).astype(a.dtype)
+        )
+        off += n
+    return res
+
+
 def _psum_host(arrays, guard: "_PassGuard | None" = None):
     """Sum each array across processes; identity single-process.  Returns
     np arrays, identical on every process.  The gather runs under an x64
     scope: process_allgather device_puts its payload, which would
     silently demote f64/i64 (row counts, reservoir state) when the
     session default is x64-off.  ``guard``: see _PassGuard — when given,
-    an error flag rides the gather and all ranks fail together."""
+    an error flag rides the gather and all ranks fail together.
+
+    With the ring plane armed (:func:`_ring_mesh`), the f32 moment
+    payloads reduce through ONE packed device ring instead of the
+    host-mediated allgather; the error flag and any non-f32 payloads
+    (row counts, reservoir state) keep the host gather, which runs FIRST
+    so a failed rank still aborts every peer before the ring launches —
+    the route decision is a pure function of dtypes, so every rank
+    issues the same collective sequence."""
     arrays = _materialize(arrays, guard)
-    gathered = _gather_with_guard(arrays, guard)
-    if gathered is None:
+    if _world() == 1:
+        if guard is not None and guard.err is not None:
+            raise guard.err
         return arrays
-    return [g.sum(axis=0) for g in gathered]
+    mesh = _ring_mesh()
+    f32_idx = [
+        i for i, a in enumerate(arrays)
+        if np.asarray(a).dtype == np.float32
+    ]
+    if mesh is None or not f32_idx:
+        gathered = _gather_with_guard(arrays, guard)
+        return [g.sum(axis=0) for g in gathered]
+    from oap_mllib_tpu.config import get_config
+
+    rest_idx = [i for i in range(len(arrays)) if i not in f32_idx]
+    gathered_rest = (
+        _gather_with_guard([arrays[i] for i in rest_idx], guard)
+        if rest_idx or guard is not None
+        else []
+    )
+    ringed = _ring_reduce_f32(
+        [arrays[i] for i in f32_idx], mesh, get_config().data_axis
+    )
+    out: list = [None] * len(arrays)
+    for j, i in enumerate(f32_idx):
+        out[i] = ringed[j]
+    for j, i in enumerate(rest_idx):
+        out[i] = gathered_rest[j].sum(axis=0)
+    return out
 
 
 def _allgather_host(arrays, guard: "_PassGuard | None" = None):
@@ -745,6 +841,67 @@ def _gram_chunk_comp(gram, comp, chunk, w, mean, precision, policy):
     return t, comp
 
 
+# -- fused-kernel per-chunk accumulators (ops/pallas/pca_kernel) ------------
+# Same accumulation structure as the XLA chunk fns above, with the
+# center+mask+Gram (and the colsum reduction) fused into one Pallas
+# program per chunk — no HBM-materialized centered temp.  Dispatch is
+# pca_ops.use_pallas_gram (TPU + single device + f32); the ``interpret``
+# static exists so tier-1 can exercise the kernels on CPU.
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret",), donate_argnums=(0,)
+)
+def _colsum_chunk_pallas(total, chunk, w, interpret=False):
+    from oap_mllib_tpu.ops.pallas import pca_kernel as _pk
+
+    _, cs, _ = _pk.moments_traced(
+        chunk, w, jnp.zeros((chunk.shape[1],), jnp.float32),
+        "highest", interpret, False,
+    )
+    return total + cs
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret",), donate_argnums=(0, 1)
+)
+def _colsum_chunk_pallas_comp(total, comp, chunk, w, interpret=False):
+    from oap_mllib_tpu.ops.pallas import pca_kernel as _pk
+
+    _, s, _ = _pk.moments_traced(
+        chunk, w, jnp.zeros((chunk.shape[1],), jnp.float32),
+        "highest", interpret, False,
+    )
+    y = s - comp
+    t = total + y
+    comp = (t - total) - y
+    return t, comp
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mode", "interpret"), donate_argnums=(0,)
+)
+def _gram_chunk_pallas(gram, chunk, w, mean, mode, interpret=False):
+    from oap_mllib_tpu.ops.pallas import pca_kernel as _pk
+
+    g, _, _ = _pk.moments_traced(chunk, w, mean, mode, interpret, True)
+    return gram + g
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mode", "interpret"), donate_argnums=(0, 1)
+)
+def _gram_chunk_pallas_comp(gram, comp, chunk, w, mean, mode,
+                            interpret=False):
+    from oap_mllib_tpu.ops.pallas import pca_kernel as _pk
+
+    g, _, _ = _pk.moments_traced(chunk, w, mean, mode, interpret, True)
+    y = g - comp
+    t = gram + y
+    comp = (t - gram) - y
+    return t, comp
+
+
 def covariance_streamed(
     source: ChunkSource, dtype, precision: str = "highest", timings=None,
     policy: str = "f32", checkpoint=None,
@@ -774,7 +931,16 @@ def covariance_streamed(
     d = source.n_features
     stage_dtype = psn.staging_dtype(policy, dtype)
     compensated = policy == "bf16"
+    from oap_mllib_tpu.config import get_config
+    from oap_mllib_tpu.ops import pca_ops
     from oap_mllib_tpu.utils.resilience import check_finite
+
+    # fused-kernel route (ops/pallas/pca_kernel): same per-chunk
+    # accumulation at the kernel tier, one Pallas program per chunk —
+    # validated on EVERY streamed fit so a typo'd pca_kernel raises here
+    use_pk = pca_ops.use_pallas_gram(
+        get_config().pca_kernel, d, precision, dtype
+    )
 
     resume = checkpoint.restore() if checkpoint is not None else None
     base_key = (
@@ -801,7 +967,13 @@ def covariance_streamed(
                     "pca.stream_colsum", base_key, timings,
                     "covariance_streamed", record_execute=False,
                 ):
-                    if compensated:
+                    if use_pk and compensated:
+                        total, comp = _colsum_chunk_pallas_comp(
+                            total, comp, cj, wj
+                        )
+                    elif use_pk:
+                        total = _colsum_chunk_pallas(total, cj, wj)
+                    elif compensated:
                         total, comp = _colsum_chunk_comp(total, comp, cj, wj)
                     else:
                         total = _colsum_chunk(total, cj, wj)
@@ -834,7 +1006,13 @@ def covariance_streamed(
                 "pca.stream_gram", base_key, timings,
                 "covariance_streamed", record_execute=False,
             ):
-                if compensated:
+                if use_pk and compensated:
+                    gram, gcomp = _gram_chunk_pallas_comp(
+                        gram, gcomp, cj, wj, mean, precision
+                    )
+                elif use_pk:
+                    gram = _gram_chunk_pallas(gram, cj, wj, mean, precision)
+                elif compensated:
                     gram, gcomp = _gram_chunk_comp(
                         gram, gcomp, cj, wj, mean, precision, policy
                     )

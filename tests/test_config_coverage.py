@@ -159,6 +159,39 @@ class TestConfigCoverage:
         with pytest.raises(ValueError, match="nonfinite_policy"):
             KMeans(k=2, init_mode="random", max_iter=1).fit(src)
 
+    def test_pca_kernel_typo_raises_at_fit(self, rng):
+        """The kmeans_kernel contract for the PCA Gram kernel knob
+        (ISSUE 9): a typo raises at fit entry, not silently keeping the
+        XLA pass."""
+        from oap_mllib_tpu.models.pca import PCA
+
+        set_config(pca_kernel="bogus")
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        with pytest.raises(ValueError, match="pca_kernel"):
+            PCA(k=2).fit(x)
+
+    def test_als_solve_kernel_typo_raises_at_fit(self, rng):
+        """Same contract for the ALS solve-kernel knob (ISSUE 9): the
+        resolver runs at every runner entry."""
+        from oap_mllib_tpu.models.als import ALS
+
+        set_config(als_solve_kernel="bogus")
+        u = rng.integers(0, 20, 100)
+        i = rng.integers(0, 15, 100)
+        r = (rng.random(100) * 4 + 1).astype(np.float32)
+        with pytest.raises(ValueError, match="als_solve_kernel"):
+            ALS(rank=4, max_iter=1).fit(u, i, r)
+
+    def test_ring_reduction_typo_raises_at_fit(self, rng):
+        """Same contract for the ring knob (ISSUE 9): validated on every
+        accelerated K-Means dispatch, single-device included."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        set_config(ring_reduction="ring")
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        with pytest.raises(ValueError, match="ring_reduction"):
+            KMeans(k=2, init_mode="random", max_iter=1).fit(x)
+
     def test_compute_precision_typo_raises_at_fit(self, rng):
         """The kmeans_kernel/als_kernel contract for the precision
         policy: a typo'd tier must raise at fit entry, not silently run

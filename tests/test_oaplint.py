@@ -190,6 +190,47 @@ def test_collective_facade_and_own_module_are_clean():
                 rules=["raw-collective"]) == []
 
 
+PALLAS = "oap_mllib_tpu/ops/pallas/fake_kernel.py"
+
+_REMOTE_DMA = (
+    "from jax.experimental.pallas import tpu as pltpu\n\n\n"
+    "def _kernel(src, dst, send_sem, recv_sem):\n"
+    "    rdma = pltpu.make_async_remote_copy(\n"
+    "        src_ref=src, dst_ref=dst, send_sem=send_sem,\n"
+    "        recv_sem=recv_sem, device_id=(1,),\n"
+    "    )\n"
+    "    rdma.start()\n"
+    "    rdma.wait()\n"
+    "    pltpu.semaphore_signal(send_sem, inc=1, device_id=(1,))\n"
+    "    pltpu.semaphore_wait(recv_sem, 1)\n"
+)
+
+
+def test_remote_dma_exempt_inside_pallas_flagged_outside():
+    """ISSUE 9 R3 extension: pltpu remote-DMA/semaphore primitives are
+    the kernel plane's collectives — exempt inside ops/pallas/, findings
+    anywhere else (an ad-hoc remote DMA in ops/ would bypass every
+    accounting seam)."""
+    assert lint(PALLAS, _REMOTE_DMA, rules=["raw-collective"]) == []
+    found = lint(OPS, _REMOTE_DMA, rules=["raw-collective"])
+    assert rules_of(found) == ["raw-collective"]
+    assert len(found) == 3  # remote copy + signal + wait all fire
+
+
+def test_raw_psum_inside_pallas_kernel_body_still_fires():
+    """Seeded mutation: the ops/pallas/ exemption is primitive-scoped —
+    a raw lax.psum snuck into a kernel body must still be a finding
+    (the ring kernel's host-level reductions go through the facade)."""
+    text = (
+        "from jax import lax\n\n\n"
+        "def _kernel(x_ref, o_ref):\n"
+        "    o_ref[...] = lax.psum(x_ref[...], 'data')\n"
+    )
+    assert rules_of(lint(PALLAS, text, rules=["raw-collective"])) == [
+        "raw-collective"
+    ]
+
+
 # ---------------------------------------------------------------------------
 # R4: streamed-loop host sync
 # ---------------------------------------------------------------------------
@@ -410,7 +451,10 @@ def test_r16_interprocedural_reach_and_provenance_chain():
     )
     (f,) = lint(OPS, text, rules=["collective-divergence"])
     assert "_psum_host" in f.detail
-    assert "process_allgather" in f.detail  # the reach chain
+    # the reach chain ends at a collective — since ISSUE 9 the shortest
+    # path runs through the ring plane (ring_allreduce) rather than
+    # process_allgather, either endpoint proves transitive reach
+    assert "ring_allreduce" in f.detail or "process_allgather" in f.detail
     assert "process_index" in f.detail  # the provenance chain
     assert f.line == 9
 

@@ -405,11 +405,14 @@ class TestExposure:
         assert m.summary["precision"] == "bf16"
 
     def test_pallas_mode_aliases(self):
-        from oap_mllib_tpu.ops.pallas.kmeans_kernel import _check_mode
+        # the alias table moved to the shared kernel-plane vocabulary
+        # (ops/pallas/_tiers, ISSUE 9) so every kernel resolves policies
+        # identically
+        from oap_mllib_tpu.ops.pallas._tiers import check_mode
 
-        assert _check_mode("f32") == "highest"
-        assert _check_mode("tf32") == "high"
-        assert _check_mode("bf16") == "default"
-        assert _check_mode("highest") == "highest"
+        assert check_mode("f32") == "highest"
+        assert check_mode("tf32") == "high"
+        assert check_mode("bf16") == "default"
+        assert check_mode("highest") == "highest"
         with pytest.raises(ValueError, match="mode"):
-            _check_mode("fp8")
+            check_mode("fp8")

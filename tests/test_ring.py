@@ -1,0 +1,240 @@
+"""ISSUE 9 ring-reduction tests (8-device CPU pseudo-cluster): the
+ppermute-schedule ring vs the psum reference, the clean <2-device
+fallback, the default ring-fused model-sharded Lloyd, and the collective
+census proving the standalone per-pass centroid allreduces are gone.
+
+The remote-DMA TPU kernel shares the exact segment schedule tested here
+(ops/pallas/ring_reduce module notes); its compiled leg lives in
+``tests_tpu/test_kernels_tpu.py``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.ops import kmeans_ops
+from oap_mllib_tpu.ops.pallas.ring_reduce import (
+    ring_allreduce,
+    stacked_ring_fn,
+)
+from oap_mllib_tpu.parallel.mesh import get_mesh
+from oap_mllib_tpu.telemetry import metrics as tm
+from oap_mllib_tpu.utils.jax_compat import shard_map
+
+
+def _mesh8():
+    return jax.make_mesh((8,), ("data",))
+
+
+def _ring_program(mesh, world):
+    def body(blk):
+        return ring_allreduce(blk[0], "data", world)[None]
+
+    return jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=P("data", None, None),
+            out_specs=P("data", None, None), check_vma=False,
+        )
+    )
+
+
+class TestRingAllreduce:
+    @pytest.mark.parametrize(
+        "rows,cols", [(13, 37), (3, 5), (8, 256), (1, 1), (40, 130)]
+    )
+    def test_matches_sum_and_is_rank_identical(self, rng, rows, cols):
+        mesh = _mesh8()
+        g = rng.normal(size=(8, rows, cols)).astype(np.float32)
+        gd = jax.device_put(
+            jnp.asarray(g), NamedSharding(mesh, P("data", None, None))
+        )
+        out = np.asarray(_ring_program(mesh, 8)(gd))
+        ref = g.sum(axis=0)
+        np.testing.assert_allclose(out[0], ref, atol=2e-5)
+        for i in range(1, 8):
+            assert np.array_equal(out[0], out[i])  # deterministic ring
+
+    def test_matches_psum_reference_1e5(self, rng):
+        """The acceptance bound: ring vs the psum path at 1e-5 on the
+        8-device virtual mesh."""
+        mesh = _mesh8()
+        g = rng.normal(size=(8, 50, 70)).astype(np.float32) * 10.0
+        gd = jax.device_put(
+            jnp.asarray(g), NamedSharding(mesh, P("data", None, None))
+        )
+        ring = np.asarray(_ring_program(mesh, 8)(gd))[0]
+        from oap_mllib_tpu.parallel import collective
+
+        psum_fn = jax.jit(
+            shard_map(
+                lambda b: collective.psum(b[0], "data")[None],
+                mesh=mesh, in_specs=P("data", None, None),
+                out_specs=P("data", None, None), check_vma=False,
+            )
+        )
+        ref = np.asarray(psum_fn(gd))[0]
+        np.testing.assert_allclose(
+            ring, ref, rtol=1e-5, atol=1e-5 * np.abs(ref).max()
+        )
+
+    def test_world_one_falls_back_to_psum(self, rng):
+        mesh1 = jax.make_mesh((1,), ("data",), devices=jax.devices()[:1])
+        g = rng.normal(size=(1, 6, 4)).astype(np.float32)
+        gd = jax.device_put(
+            jnp.asarray(g), NamedSharding(mesh1, P("data", None, None))
+        )
+        out = np.asarray(_ring_program(mesh1, 1)(gd))
+        assert np.array_equal(out[0], g[0])
+
+    def test_stacked_entry_registry_cached(self, rng):
+        mesh = _mesh8()
+        fn1 = stacked_ring_fn(mesh, "data")
+        fn2 = stacked_ring_fn(mesh, "data")
+        assert fn1 is fn2  # progcache get_or_build hit
+        g = rng.normal(size=(8, 9, 11)).astype(np.float32)
+        gd = jax.device_put(
+            jnp.asarray(g), NamedSharding(mesh, P("data", None, None))
+        )
+        out = np.asarray(fn1(gd))
+        np.testing.assert_allclose(out[3], g.sum(0), atol=2e-5)
+
+
+class TestRingEnabled:
+    def test_resolution_and_fallback(self):
+        mesh = get_mesh()
+        assert kmeans_ops.ring_enabled(mesh, "data")  # default auto, 8 dev
+        set_config(ring_reduction="off")
+        assert not kmeans_ops.ring_enabled(mesh, "data")
+        set_config(ring_reduction="on")
+        assert kmeans_ops.ring_enabled(mesh, "data")
+        mesh1 = get_mesh(n_devices=1)
+        assert not kmeans_ops.ring_enabled(mesh1, "data")  # <2 devices
+
+    def test_typo_raises(self):
+        set_config(ring_reduction="ring")
+        with pytest.raises(ValueError, match="ring_reduction"):
+            kmeans_ops.ring_enabled(get_mesh(), "data")
+
+
+class TestModelShardedRing:
+    def _fit(self, rng, max_iter, seed=0):
+        n, d, k = 512, 16, 5
+        data_rng = np.random.default_rng(seed)
+        x = data_rng.normal(size=(n, d)).astype(np.float32)
+        w = np.ones((n,), np.float32)
+        c0 = x[data_rng.choice(n, k, replace=False)]
+        mesh = get_mesh()
+        xs = jax.device_put(
+            jnp.asarray(x), NamedSharding(mesh, P("data", "model"))
+        )
+        ws = jax.device_put(jnp.asarray(w), NamedSharding(mesh, P("data")))
+        tol = jnp.asarray(1e-6, jnp.float32)
+        return kmeans_ops.lloyd_run_model_sharded(
+            xs, ws, jnp.asarray(c0), max_iter, tol, mesh, "data", "model"
+        )
+
+    def test_ring_default_matches_psum_path(self, rng):
+        set_config(model_parallel=2)
+        c_r, it_r, cost_r, cnt_r = self._fit(rng, 20)
+        set_config(ring_reduction="off")
+        c_p, it_p, cost_p, cnt_p = self._fit(rng, 20)
+        assert int(it_r) == int(it_p)
+        np.testing.assert_allclose(
+            np.asarray(c_r), np.asarray(c_p), atol=1e-5
+        )
+        np.testing.assert_allclose(float(cost_r), float(cost_p), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(cnt_r), np.asarray(cnt_p), atol=1e-3
+        )
+
+    def test_census_zero_standalone_centroid_allreduces(self, rng):
+        """The acceptance assertion, via the trace-time collective
+        census: building the ring-fused Lloyd emits psum ONLY for the
+        model-axis assignment reduction (loop body + final cost pass)
+        and the convergence move — the three standalone centroid-moment
+        psums (sums, counts, cost) are gone, replaced by ring ppermutes
+        and booked as ring.allreduce kernel emissions."""
+        set_config(model_parallel=2)  # (data=4, model=2) mesh
+        psum_c = tm.counter("oap_collective_emitted_total", {"op": "psum"})
+        perm_c = tm.counter(
+            "oap_collective_emitted_total", {"op": "ppermute"}
+        )
+        ring_c = tm.counter(
+            "oap_kernel_emitted_total", {"kernel": "ring.allreduce"}
+        )
+        p0, q0, r0 = psum_c.value, perm_c.value, ring_c.value
+        self._fit(rng, 23)  # unique max_iter -> fresh program build
+        psums = psum_c.value - p0
+        # score psum (loop accum) + d2 psum (final accum) + move psum
+        assert psums == 3, psums
+        assert ring_c.value - r0 == 2  # loop + final-pass rings
+        # bi-directional ring: 2 directions x 2*(world-1) steps per ring
+        assert perm_c.value - q0 == 2 * (2 * 2 * (4 - 1))
+
+    def test_ring_off_build_emits_moment_psums(self, rng):
+        set_config(model_parallel=2, ring_reduction="off")
+        psum_c = tm.counter("oap_collective_emitted_total", {"op": "psum"})
+        p0 = psum_c.value
+        self._fit(rng, 29)
+        # score + sums + counts (loop) / d2 + sums + counts + cost
+        # (final) / move
+        assert psum_c.value - p0 == 8
+
+    def test_x64_lane_keeps_psum_path(self, rng):
+        """The ring packs f32; the x64 parity lane must resolve to the
+        psum path (ring flag off for f64 inputs) without error."""
+        set_config(model_parallel=2)
+        from oap_mllib_tpu.utils.timing import x64_scope
+
+        with x64_scope(True):
+            n, d, k = 64, 8, 3
+            x = rng.normal(size=(n, d)).astype(np.float64)
+            mesh = get_mesh()
+            xs = jax.device_put(
+                jnp.asarray(x), NamedSharding(mesh, P("data", "model"))
+            )
+            ws = jax.device_put(
+                jnp.ones((n,)), NamedSharding(mesh, P("data"))
+            )
+            c, it, cost, cnt = kmeans_ops.lloyd_run_model_sharded(
+                xs, ws, jnp.asarray(x[:k]), 5,
+                jnp.asarray(1e-6, jnp.float64), mesh, "data", "model",
+            )
+            assert np.asarray(c).dtype == np.float64
+            assert np.isfinite(float(cost))
+
+
+class TestStreamedRingRoute:
+    def test_single_process_identity_unchanged(self):
+        from oap_mllib_tpu.ops import stream_ops
+
+        arrays = [
+            np.ones((3, 4), np.float32), np.asarray([7], np.int64)
+        ]
+        out = stream_ops._psum_host(arrays)
+        assert np.array_equal(out[0], arrays[0])
+        assert np.array_equal(out[1], arrays[1])
+        assert stream_ops._ring_mesh() is None  # world == 1
+
+    def test_ring_reduce_f32_packs_and_unpacks(self, rng):
+        """Single-process exercise of the packed-sheet shape logic
+        through the stacked ring program on the 8-device mesh (the
+        multi-process leg rides the pseudo-cluster suite)."""
+        from oap_mllib_tpu.ops import stream_ops
+
+        mesh = get_mesh()
+        sums = rng.normal(size=(5, 7)).astype(np.float32)
+        counts = rng.normal(size=(5,)).astype(np.float32)
+        cost = np.float32(3.25)
+        out = stream_ops._ring_reduce_f32(
+            [sums, counts, cost], mesh, "data"
+        )
+        # one process contributing -> the sum IS the payload
+        np.testing.assert_allclose(out[0], sums, atol=1e-6)
+        np.testing.assert_allclose(out[1], counts, atol=1e-6)
+        np.testing.assert_allclose(out[2], cost, atol=1e-6)
+        assert out[0].shape == sums.shape and out[1].shape == counts.shape
