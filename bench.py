@@ -849,6 +849,25 @@ def bench_streamed(rows: int, d: int = 256, k: int = 1000,
             "fault_backoff_sec": round(res["backoff_s"], 3),
         }
 
+    def _checkpoint_extras(summary):
+        """Checkpoint write overhead for a streamed run (ROADMAP item 4
+        follow-on): when elastic-worlds checkpointing is armed, report
+        the per-interval insurance premium — bytes and seconds per
+        checkpoint interval — next to the per-pass numbers it taxes."""
+        ck = (
+            summary.get("checkpoint") if isinstance(summary, dict)
+            else getattr(summary, "checkpoint", None)
+        )
+        if not ck or not ck.get("writes"):
+            return {}
+        return {
+            "ckpt_writes": ck["writes"],
+            "ckpt_bytes_per_interval": round(
+                ck["bytes_written"] / ck["writes"]),
+            "ckpt_sec_per_interval": round(
+                ck["write_seconds"] / ck["writes"], 4),
+        }
+
     def _overlap_extras(timings, phase):
         """Prefetch-pipeline report for a streamed phase: the
         stage/transfer/compute split (data/prefetch.py) and the fraction
@@ -887,6 +906,7 @@ def bench_streamed(rows: int, d: int = 256, k: int = 1000,
         **_compile_extras(m.summary.timings, "lloyd_loop",
                           getattr(m.summary, "progcache", None)),
         **_resilience_extras(m.summary),
+        **_checkpoint_extras(m.summary),
     )
     # span-tree view of the same fit (telemetry/export.report): per-phase
     # walls, overlap, compile split — the human cross-check of the JSON
@@ -910,6 +930,7 @@ def bench_streamed(rows: int, d: int = 256, k: int = 1000,
         **_compile_extras(p.summary["timings"], "covariance_streamed",
                           p.summary.get("progcache")),
         **_resilience_extras(p.summary),
+        **_checkpoint_extras(p.summary),
     )
     print(telemetry.report(p.summary), flush=True)
 

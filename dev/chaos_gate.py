@@ -1,0 +1,278 @@
+#!/usr/bin/env python
+"""CI gate: the live-world fault-tolerance loop (ISSUE 10) must hold its
+contracts — detect → abort → relaunch → resume, drilled deterministically.
+
+Legs:
+
+1. **Chaos parity** — a streamed K-Means fit with the seeded chaos
+   schedule armed (transient kinds) completes and matches the
+   undisturbed fit bit-for-bit: every injected fault was absorbed by
+   the resilience ladder, at least one actually fired, and the fit
+   summary shows the retries.
+2. **Kill-relaunch-resume, deterministic** — a supervised 1-process
+   world is SIGKILLed mid-pass 3; the supervisor relaunches, the resumed
+   fit restores step 2 and the final centers are BIT-IDENTICAL to the
+   undisturbed supervised run.  Runs on every host (no multiprocess
+   collectives involved).
+3. **Chaos kill drill** — the same loop driven by the chaos plane
+   (`seed:rate:kill:1`, supervisor re-seeding per attempt): attempt 0
+   dies by schedule, the relaunch resumes and lands bit-identical.
+4. **2-process drills** — the supervised 2-process kill-relaunch leg and
+   the shrink-to-1 resharded leg (≤1e-5 parity), plus the
+   pseudo-cluster collective-timeout suite
+   (tests/test_pseudo_cluster.py::TestLiveWorldRecovery: every survivor
+   raises CollectiveTimeoutError within the deadline, no hang).  Hosts
+   whose jax build cannot form multiprocess CPU worlds skip these, like
+   every pseudo-cluster suite.
+5. **Disarmed overhead** — `collective_timeout=0` keeps the dispatch
+   seam at one config check: its measured cost must be <1% of the
+   20-fit K-Means microbench wall.
+
+Exit 1 with the offending evidence on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import numpy as np  # noqa: E402
+
+failures = []
+
+
+def check(ok: bool, what: str) -> None:
+    if not ok:
+        failures.append(what)
+        print(f"FAIL: {what}")
+
+
+# mirror tests/test_pseudo_cluster.py: a host whose jax build cannot
+# form multiprocess CPU worlds skips the world legs, not fails them
+_ENV_FAILURE_MARKERS = (
+    "Multiprocess computations aren't implemented",
+    "UNIMPLEMENTED",
+    "Unable to initialize backend",
+    "failed to join world",
+    "DEADLINE_EXCEEDED",
+    "Failed to connect to coordinator",
+)
+
+
+def _env_incapable(sup) -> bool:
+    for att in sup.attempts:
+        for e in att.exits:
+            if any(m in (e.output or "") for m in _ENV_FAILURE_MARKERS):
+                return True
+    return False
+
+
+def _results(summary):
+    out = {}
+    for o in summary["outputs"]:
+        for line in o.splitlines():
+            if line.startswith("RESULT "):
+                r = json.loads(line[len("RESULT "):])
+                out[r["rank"]] = r
+    return out
+
+
+# -- leg 1: chaos parity ------------------------------------------------------
+
+print("== chaos gate: seeded chaos fit completes at parity "
+      "(transient kinds absorbed by the ladder) ==")
+from oap_mllib_tpu.config import set_config  # noqa: E402
+from oap_mllib_tpu.data.stream import ChunkSource  # noqa: E402
+from oap_mllib_tpu.models.kmeans import KMeans  # noqa: E402
+from oap_mllib_tpu.utils import faults  # noqa: E402
+
+rng = np.random.default_rng(11)
+x = rng.normal(size=(1024, 8)).astype(np.float32)
+
+
+def _streamed_fit():
+    return KMeans(k=4, seed=3, init_mode="random", max_iter=3).fit(
+        ChunkSource.from_array(x, chunk_rows=256)
+    )
+
+
+baseline = _streamed_fit()
+# seed pinned so the schedule fires on this exact call sequence; the
+# decision is a pure hash of (seed, rank, site, call), so this is stable
+set_config(chaos="17:0.03:fail")
+chaotic = _streamed_fit()
+st = faults.stats().get("chaos", {})
+set_config(chaos="")
+check(st.get("fired", 0) >= 1,
+      f"chaos schedule fired nothing (stats: {st}) — the leg proved "
+      "nothing; re-pin the seed")
+check(chaotic.summary.training_cost == baseline.summary.training_cost,
+      f"chaos fit diverged: {chaotic.summary.training_cost} vs "
+      f"{baseline.summary.training_cost}")
+check(np.array_equal(np.asarray(chaotic.cluster_centers_),
+                     np.asarray(baseline.cluster_centers_)),
+      "chaos fit centers are not bit-identical to the undisturbed fit")
+check(chaotic.summary.resilience["retries"] >= 1,
+      f"chaos faults fired but no retries recorded: "
+      f"{chaotic.summary.resilience}")
+print(f"  chaos fired {st.get('fired')} fault(s) over "
+      f"{sum(st.get('calls', {}).values())} site calls; "
+      f"{chaotic.summary.resilience['retries']} retries, parity exact")
+
+# -- legs 2+3: supervised kill-relaunch-resume (single-process world) --------
+
+from dev.supervise import supervise  # noqa: E402
+
+print("== chaos gate: deterministic kill-relaunch-resume, supervised "
+      "(1-process world — runs on every host) ==")
+tmp = tempfile.mkdtemp(prefix="chaos_gate_")
+
+
+def _run_supervised(tag, **kw):
+    return supervise(
+        kw.pop("procs", 1),
+        os.path.join(tmp, tag, "ck"), os.path.join(tmp, tag, "crash"),
+        backoff=0.1, collective_timeout=10.0, **kw,
+    )
+
+
+undisturbed, _ = _run_supervised("full", budget=0)
+check(undisturbed["ok"], f"undisturbed supervised run failed: {undisturbed}")
+base_res = _results(undisturbed)[0]
+check(base_res["decision"] == "fresh", f"unexpected restore: {base_res}")
+
+killed, _ = _run_supervised("kill", budget=3, kill_rank=0, kill_walk=4)
+check(killed["ok"], f"kill drill did not recover: {killed}")
+check(killed["relaunches"] == 1,
+      f"expected exactly 1 relaunch, got {killed['relaunches']}")
+check(killed["attempts"][0]["exits"][0]["classification"] == "killed",
+      f"kill not classified: {killed['attempts'][0]}")
+kill_res = _results(killed)[0]
+check(kill_res["decision"] == "found" and kill_res["restored_step"] == 2,
+      f"resume did not restore the durable step: {kill_res}")
+check(kill_res["centers_hex"] == base_res["centers_hex"],
+      "kill-relaunch-resume is not bit-identical to the undisturbed run")
+print(f"  killed at pass 3, resumed at step {kill_res['restored_step']}, "
+      "centers bit-identical")
+
+print("== chaos gate: chaos-driven kill drill (seeded schedule, "
+      "re-seeded per relaunch) ==")
+# seed 5 @ rate .004, kill budget 1: attempt 0 dies mid-fit, the
+# re-seeded attempt 1 completes — pinned like every chaos seed here
+chaos_killed, sup_ck = _run_supervised("chaos", budget=3,
+                                       chaos="5:0.004:kill:1")
+check(chaos_killed["ok"], f"chaos kill drill did not recover: {chaos_killed}")
+check(chaos_killed["relaunches"] >= 1,
+      "chaos schedule killed nothing — re-pin the seed")
+ck_res = _results(chaos_killed)[0]
+check(ck_res["centers_hex"] == base_res["centers_hex"],
+      "chaos-killed supervised run is not bit-identical to undisturbed")
+print(f"  chaos killed attempt 0, {chaos_killed['relaunches']} relaunch(es), "
+      f"resume decision {ck_res['decision']}, centers bit-identical")
+
+# -- leg 4: 2-process drills (skip when the host cannot form worlds) ---------
+
+print("== chaos gate: 2-process supervised drills (skip if the host "
+      "cannot form multiprocess jax worlds) ==")
+# capability probe doubles as the shrink leg's undisturbed oracle —
+# budget 0, so an incapable host fails it in ONE attempt and skips
+full2, supf2 = _run_supervised("full2", procs=2, budget=0)
+if _env_incapable(supf2):
+    print("  SKIP: multiprocess jax worlds unavailable on this host")
+else:
+    check(full2["ok"], f"undisturbed 2-process run failed: {full2}")
+    base2 = _results(full2)[0]
+
+    two_proc, sup2 = _run_supervised("kill2", procs=2, budget=3,
+                                     kill_rank=1, kill_walk=4)
+    check(two_proc["ok"], f"2-process kill drill did not recover: {two_proc}")
+    res2 = _results(two_proc)
+    check(res2[0]["centers_hex"] == res2[1]["centers_hex"],
+          "ranks disagree after resume")
+    check(res2[0]["centers_hex"] == base2["centers_hex"],
+          "2-process kill-relaunch-resume not bit-identical to undisturbed")
+    check(res2[0]["ladder"] == "supervised",
+          f"multi-process ladder not stamped supervised: {res2[0]}")
+    # the survivor must have converted the hang into a timeout record
+    att0 = two_proc["attempts"][0]
+    classes = {e["rank"]: e["classification"] for e in att0["exits"]}
+    check(classes[1] == "killed", f"culprit misclassified: {att0}")
+    check(att0["culprit"] == 1, f"culprit misattributed: {att0}")
+
+    print("== chaos gate: shrink-to-1 resharded resume (rank 1 bad on "
+          "every multi-process attempt) ==")
+    shrunk, sups = _run_supervised(
+        "shrink", procs=2, budget=3, shrink_after=1, kill_rank=1,
+        kill_walk=4, kill_scope="multi",
+    )
+    check(shrunk["ok"], f"shrink drill did not recover: {shrunk}")
+    check(shrunk["final_world"] == 1 and shrunk["shrinks"] == 1,
+          f"world did not shrink: {shrunk}")
+    sh_res = _results(shrunk)[0]
+    check(sh_res["decision"] == "resharded",
+          f"shrunken world did not reshard: {sh_res}")
+    rel = abs(sh_res["cost"] - base2["cost"]) / abs(base2["cost"])
+    check(rel <= 1e-5,
+          f"resharded resume parity {rel:.2e} > 1e-5 "
+          f"({sh_res['cost']} vs {base2['cost']})")
+    print(f"  shrunk 2->1, resharded resume parity {rel:.2e}")
+
+print("== chaos gate: pseudo-cluster collective-timeout legs ==")
+proc = subprocess.run(
+    [sys.executable, "-m", "pytest",
+     "tests/test_pseudo_cluster.py::TestLiveWorldRecovery", "-q",
+     "-p", "no:cacheprovider"],
+    cwd=ROOT, capture_output=True, text=True, timeout=600,
+)
+print("  " + (proc.stdout.strip().splitlines()[-1]
+              if proc.stdout.strip() else ""))
+check(proc.returncode == 0,
+      f"pseudo-cluster recovery legs failed:\n{proc.stdout[-2000:]}")
+
+# -- leg 5: disarmed overhead -------------------------------------------------
+
+print("== chaos gate: collective_timeout=0 (disarmed) overhead on the "
+      "20-fit microbench ==")
+from oap_mllib_tpu.utils import recovery  # noqa: E402
+
+set_config(collective_timeout=0.0, crash_dir="", chaos="")
+xs = rng.normal(size=(128, 8)).astype(np.float32)
+KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(xs)  # warm
+t0 = time.perf_counter()
+for _ in range(20):
+    KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(xs)
+fit_wall = time.perf_counter() - t0
+
+# the disarmed seam: one config check + the inline fn call.  Price 100
+# dispatch seams per fit — an overestimate — 2000 times, and scale.
+reps = 2000
+noop = (lambda: None)
+t0 = time.perf_counter()
+for _ in range(reps):
+    for _ in range(100):
+        recovery.guarded_dispatch("psum", "data", noop)
+seam_wall = (time.perf_counter() - t0) * (20.0 / reps)
+pct = 100.0 * seam_wall / fit_wall
+print(f"  20-fit wall {fit_wall*1e3:.1f} ms; disarmed seam cost "
+      f"{seam_wall*1e3:.3f} ms (~{pct:.2f}%)")
+check(seam_wall < max(0.01 * fit_wall, 0.005),
+      f"disarmed collective-deadline seam measurable: {seam_wall:.4f}s "
+      f"vs {fit_wall:.4f}s fit wall (>{pct:.1f}%)")
+
+if failures:
+    print(f"\nchaos gate: {len(failures)} failure(s)")
+    sys.exit(1)
+print("\nchaos gate: OK")

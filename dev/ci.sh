@@ -101,6 +101,14 @@ echo "   sanitizers-off overhead unmeasurable on the 20-fit K-Means"
 echo "   microbench (dev/sanitizer_gate.py) =="
 python dev/sanitizer_gate.py
 
+echo "== chaos gate: live-world fault tolerance — seeded chaos fit at exact"
+echo "   parity, deterministic + chaos-driven kill-relaunch-resume drills"
+echo "   bit-identical (supervised, 1-process everywhere; 2-process + shrink"
+echo "   -to-1 resharded at 1e-5 where the host can form worlds), survivors"
+echo "   raise CollectiveTimeoutError within the deadline, and the disarmed"
+echo "   dispatch seam is <1% of the 20-fit microbench (dev/chaos_gate.py) =="
+python dev/chaos_gate.py
+
 echo "== kernel gate: interpret-mode parity across the Pallas kernel plane"
 echo "   (K-Means accumulate, PCA moments, ALS solve, factor Gram),"
 echo "   bf16-on-Pallas routing asserted, and 8-device virtual-mesh ring"

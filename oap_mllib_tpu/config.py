@@ -214,6 +214,48 @@ class Config:
     # seconds: connection attempts retry with backoff until this
     # deadline, then fail with an error naming coordinator/rank/elapsed.
     bootstrap_timeout: float = 60.0
+    # -- live-world recovery plane (utils/recovery.py, utils/supervisor.py) --
+    # Collective deadline in seconds: > 0 arms a watchdog on every
+    # host-level collective dispatch (the eager facade in
+    # parallel/collective.py, the host-mediated reductions in
+    # ops/stream_ops.py, the checkpoint agreement gathers, the sanitizer
+    # cross-check) in multi-process worlds.  A peer that never shows up
+    # raises CollectiveTimeoutError on every surviving rank — naming
+    # op/axis/elapsed and the last-completed dispatch fingerprint —
+    # instead of hanging until the distributed timeout.  0 (default) =
+    # disarmed: the hot path is one config check per dispatch.  Negative
+    # values raise.
+    collective_timeout: float = 0.0
+    # Recovery sideband directory: non-empty arms coordinated abort — a
+    # rank's fatal fault writes a machine-readable crash record
+    # (crash.rank<r>.json: rank, site, fault class, last durable
+    # checkpoint step, telemetry snapshot) that poisons its peers: ranks
+    # waiting inside a deadline-armed collective see the record and
+    # raise PeerAbortError promptly instead of timing out.  The
+    # supervisor (utils/supervisor.py) sets this for every rank it
+    # launches and classifies the records at exit.  Multi-process worlds
+    # need a filesystem shared by every rank.  Empty (default) = off.
+    crash_dir: str = ""
+    # Supervisor restart budget: how many relaunches
+    # utils/supervisor.Supervisor may spend before giving up on a world.
+    restart_budget: int = 3
+    # Supervisor relaunch backoff base in seconds: relaunch n sleeps
+    # restart_backoff * 2^(n-1) before spawning the new world.
+    restart_backoff: float = 1.0
+    # How many CONSECUTIVE failures attributed to the same rank before
+    # the supervisor shrinks the world by one (excluding the repeatedly
+    # bad slot) and lets resume=auto reshard state onto the new layout.
+    shrink_after: int = 2
+    # Seeded randomized chaos schedule over every registered fault site
+    # (utils/faults.py): "seed:rate[:kinds[:budget]]" — e.g. "7:0.02"
+    # fires a transient fault on ~2% of site calls, "7:0.01:kill:1"
+    # hard-kills the process (SIGKILL — a preemption) at most once.
+    # kinds is a "+"-separated subset of fail|oom|nan|err|kill (cycled
+    # deterministically); budget caps total fired faults ("*" =
+    # unbounded).  The schedule is a pure function of
+    # (seed, process index, site, call index), so drills are
+    # reproducible and ranks fail independently.  Empty = off.
+    chaos: str = ""
     # -- elastic worlds: sharded checkpoint/resume (utils/checkpoint.py) -----
     # Checkpoint directory for iterate-state checkpoints.  Non-empty arms
     # periodic per-rank sharded checkpoints on every fit path (K-Means

@@ -33,8 +33,10 @@ import numpy as np
 from oap_mllib_tpu.data.prefetch import Prefetcher, PrefetchStats
 from oap_mllib_tpu.data.stream import ChunkSource
 from oap_mllib_tpu.ops import kmeans_ops
+from oap_mllib_tpu.utils import faults
 from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
+from oap_mllib_tpu.utils import recovery
 from oap_mllib_tpu.utils import sanitizers
 from oap_mllib_tpu.utils.timing import tick
 
@@ -188,19 +190,27 @@ def _gather_with_guard(arrays, guard: "_PassGuard | None"):
     if guard is not None:
         flag = np.asarray([0 if guard.err is None else 1], np.int64)
         arrays = [flag] + arrays
-    # collective sanitizer seam: the host-mediated reductions are THE
-    # collectives of every streamed multi-process pass, so their
-    # signature (payload shapes + dtypes) is fingerprinted and
-    # cross-checked across ranks before the gather — a rank arriving
-    # here with a divergent payload raises on every rank instead of
-    # wedging process_allgather (utils/sanitizers.py)
+    # the host-mediated reductions are THE collectives of every streamed
+    # multi-process pass: a dead peer surfaces exactly here, so the
+    # gather is a fault site (collective.dispatch) and runs under the
+    # recovery plane's deadline watchdog (utils/recovery) — a rank that
+    # never arrives converts this from a hang into a
+    # CollectiveTimeoutError on every survivor
+    faults.maybe_fault("collective.dispatch")
+    # collective sanitizer seam: the signature (payload shapes + dtypes)
+    # is fingerprinted and cross-checked across ranks before the gather —
+    # a rank arriving here with a divergent payload raises on every rank
+    # instead of wedging process_allgather (utils/sanitizers.py)
     sanitizers.note_collective(
         "process_allgather", "host",
         tuple(tuple(np.shape(a)) for a in arrays),
         ",".join(str(getattr(a, "dtype", "?")) for a in arrays),
     )
     with x64_scope(True):
-        gathered = multihost_utils.process_allgather(arrays)
+        gathered = recovery.guarded_dispatch(
+            "process_allgather", "host",
+            lambda: multihost_utils.process_allgather(arrays),
+        )
     if guard is not None:
         if int(np.asarray(gathered[0]).sum()) > 0:
             raise RuntimeError(

@@ -35,7 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from oap_mllib_tpu.config import get_config
 from oap_mllib_tpu.telemetry import metrics as _tm
 from oap_mllib_tpu.telemetry.spans import current_span
-from oap_mllib_tpu.utils import sanitizers
+from oap_mllib_tpu.utils import faults, recovery, sanitizers
 from oap_mllib_tpu.utils.jax_compat import shard_map
 
 
@@ -79,14 +79,19 @@ def _instrumented(op: str, x: jax.Array, dispatch):
     and dispatch wall, booked to the registry and the active span; with
     the ``collective`` sanitizer armed, the dispatch signature is also
     fingerprinted and cross-checked across ranks first
-    (utils/sanitizers.note_collective)."""
+    (utils/sanitizers.note_collective).  The dispatch itself is a fault
+    site (``collective.dispatch`` — where a dead peer surfaces) and runs
+    under the recovery plane's deadline watchdog when
+    ``Config.collective_timeout`` is armed (utils/recovery
+    .guarded_dispatch; disarmed = one config check)."""
+    faults.maybe_fault("collective.dispatch")
     nbytes = _payload_bytes(x)
+    axis = get_config().data_axis
     sanitizers.note_collective(
-        op, get_config().data_axis, getattr(x, "shape", ()),
-        getattr(x, "dtype", ""),
+        op, axis, getattr(x, "shape", ()), getattr(x, "dtype", ""),
     )
     t0 = time.perf_counter()
-    out = dispatch()
+    out = recovery.guarded_dispatch(op, axis, dispatch)
     dt = time.perf_counter() - t0
     lab = {"op": op}
     _tm.counter("oap_collective_ops_total", lab,

@@ -57,6 +57,7 @@ import json
 import logging
 import os
 import re
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -77,6 +78,26 @@ _SHARD_RE = re.compile(r"step(\d{8})\.rank(\d+)\.npz$")
 DECISION_FOUND = "found"
 DECISION_FRESH = "fresh"
 DECISION_RESHARDED = "resharded"
+
+# newest step this process has durably committed or restored, across
+# every Checkpointer in the process — the "last durable checkpoint
+# step" field of recovery-plane crash records (utils/recovery.py), so a
+# supervisor classifying an exit knows how much work a relaunch loses
+_durable_lock = threading.Lock()
+_LAST_DURABLE = {"step": -1}
+
+
+def _note_durable(step: int) -> None:
+    with _durable_lock:
+        if step > _LAST_DURABLE["step"]:
+            _LAST_DURABLE["step"] = int(step)
+
+
+def last_durable_step() -> int:
+    """The newest checkpoint step this process committed or restored
+    (-1 when none) — stamped into crash records by the recovery plane."""
+    with _durable_lock:
+        return _LAST_DURABLE["step"]
 
 
 class CheckpointError(RuntimeError):
@@ -282,6 +303,7 @@ class Checkpointer:
         self.world, self.rank = _world()
         self.writes = 0
         self.bytes_written = 0
+        self.write_s = 0.0
         self.last_step = -1
         self._result: Optional[RestoreResult] = None
 
@@ -374,9 +396,12 @@ class Checkpointer:
                 )
             return False
         self._gc()
+        dt = elapsed()
         self.writes += 1
         self.bytes_written += nbytes
+        self.write_s += dt
         self.last_step = step
+        _note_durable(step)
         _tm.counter(
             "oap_checkpoint_writes_total", {"algo": self.algo},
             help="Checkpoint shard writes that landed durably",
@@ -392,7 +417,7 @@ class Checkpointer:
         _tm.counter(
             "oap_checkpoint_write_seconds_total",
             help="Wall spent writing checkpoints",
-        ).inc(elapsed())
+        ).inc(dt)
         self._note_span()
         return True
 
@@ -448,14 +473,21 @@ class Checkpointer:
             return ok
         from jax.experimental import multihost_utils
 
-        from oap_mllib_tpu.utils import sanitizers
+        from oap_mllib_tpu.utils import recovery, sanitizers
 
         flag = np.asarray([0 if ok else 1], np.int64)
         sanitizers.note_collective(
             "process_allgather", "host", ((1,),), "int64"
         )
+        # the agreement gather is a host collective like any other: a
+        # peer preempted mid-write must convert into a diagnosis on the
+        # survivors, not a hang (utils/recovery.guarded_dispatch —
+        # disarmed = one config check)
         with x64_scope(True):
-            gathered = multihost_utils.process_allgather(flag)
+            gathered = recovery.guarded_dispatch(
+                "ckpt.sync", "host",
+                lambda: multihost_utils.process_allgather(flag),
+            )
         return int(np.asarray(gathered).sum()) == 0
 
     def _gc(self) -> None:
@@ -605,6 +637,7 @@ class Checkpointer:
                         (0, widths.get(name, 1)), np.float32),
                 )
         self.last_step = step
+        _note_durable(step)
         return RestoreResult(
             decision=decision, step=step, old_world=old_world,
             new_world=self.world, arrays=arrays, sharded=sharded,
@@ -638,6 +671,7 @@ class Checkpointer:
             "interval": self.interval,
             "writes": self.writes,
             "bytes_written": self.bytes_written,
+            "write_seconds": round(self.write_s, 6),
             "last_step": self.last_step,
         }
         res = self._result

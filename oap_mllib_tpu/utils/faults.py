@@ -29,6 +29,13 @@ site                  fires at
                       here is a corrupt/unreadable checkpoint: fresh fit
                       under ``Config.resume="auto"``, CheckpointError
                       under ``resume="require"``
+``collective.dispatch``  every host-level collective dispatch (the eager
+                      facade in parallel/collective.py and the
+                      host-mediated ``process_allgather`` reductions in
+                      ops/stream_ops.py) — where a dead peer, a network
+                      partition, or a preemption surfaces; drives the
+                      recovery plane's deadline/abort tiers
+                      (utils/recovery.py)
 ====================  =====================================================
 
 Arming: ``Config.fault_spec`` / env ``OAP_MLLIB_TPU_FAULT_SPEC``, a
@@ -43,30 +50,44 @@ Kinds: ``fail`` = transient (classified TRANSIENT — the retry tier),
 ``oom`` = device memory exhaustion (classified OOM — the halved-chunk
 rung), ``nan`` = non-finite iterate (classified NONFINITE — drives the
 precision-degradation rung and the ``nonfinite_policy`` tiers), ``err``
-= permanent (classified as no fault — propagates raw).  ``count`` is a
+= permanent (classified as no fault — propagates raw), ``kill`` = the
+process is SIGKILLed on the spot (no exception, no cleanup — a
+preemption; drives the live-world recovery drills).  ``count`` is a
 positive int (the first N calls raise) or ``*`` (persistent).  The
 registry is deterministic: same spec + same call sequence = same
 faults, so gates can assert exact retry counters (dev/fault_gate.py,
 dev/precision_gate.py).
+
+**Chaos mode** (``Config.chaos`` / env ``OAP_MLLIB_TPU_CHAOS``) layers a
+seeded *randomized* schedule over every registered site on top of any
+explicit spec: ``seed:rate[:kinds[:budget]]`` fires a fault on ~``rate``
+of site calls, cycling through ``kinds`` (``+``-separated, default
+``fail``), capped at ``budget`` total fires (default unbounded).  The
+decision is a pure hash of (seed, process index, site, call index) —
+reproducible end to end, and DIFFERENT per rank, so one rank of a world
+can be killed while its peers survive into the collective-deadline path
+(dev/chaos_gate.py drills exactly that loop).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+import zlib
+from typing import Dict, List, Optional
 
 from oap_mllib_tpu.config import get_config
 
 SITES = (
     "stream.read", "prefetch.stage", "bootstrap.connect", "fit.execute",
-    "ckpt.write", "ckpt.restore",
+    "ckpt.write", "ckpt.restore", "collective.dispatch",
 )
 
 KIND_FAIL = "fail"
 KIND_OOM = "oom"
 KIND_NONFINITE = "nan"
 KIND_ERR = "err"
-_KINDS = (KIND_FAIL, KIND_OOM, KIND_NONFINITE, KIND_ERR)
+KIND_KILL = "kill"
+_KINDS = (KIND_FAIL, KIND_OOM, KIND_NONFINITE, KIND_ERR, KIND_KILL)
 
 
 class FaultInjected(Exception):
@@ -105,6 +126,20 @@ class InjectedNonFiniteError(FaultInjected, FloatingPointError):
     without needing data that actually overflows."""
 
     kind = KIND_NONFINITE
+
+
+def _hard_kill(site: str, nth: int) -> None:
+    """The ``kill`` kind: SIGKILL this process on the spot — no
+    exception, no atexit, no flushing beyond this warning.  The closest
+    injectable analog of a preemption notice arriving mid-collective."""
+    import logging
+    import os
+    import signal
+
+    logging.getLogger("oap_mllib_tpu").warning(
+        "fault injection: hard-killing process at %s (fire %d)", site, nth
+    )
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 def _make_fault(kind: str, site: str, nth: int) -> FaultInjected:
@@ -176,15 +211,122 @@ def parse_spec(spec: str) -> Dict[str, _SiteState]:
     return out
 
 
+class ChaosState:
+    """Seeded randomized fault schedule over EVERY registered site.
+
+    The fire decision for one site call is a pure function of
+    (seed, process index, site, per-site call index): a crc32 hash
+    mapped to [0, 1) and compared against ``rate``.  Including the
+    process index makes ranks fail *independently* — the property the
+    live-world drills need (one rank killed, peers surviving into the
+    collective-deadline path) — while keeping every rank's schedule
+    reproducible from the spec alone.  The fired-fault kind cycles
+    deterministically through ``kinds``; ``budget`` caps total fires
+    per process (-1 = unbounded)."""
+
+    __slots__ = ("seed", "rate", "kinds", "budget", "calls", "fired")
+
+    def __init__(self, seed: int, rate: float, kinds: List[str],
+                 budget: int):
+        self.seed = seed
+        self.rate = rate
+        self.kinds = list(kinds)
+        self.budget = budget  # -1 = unbounded
+        self.calls: Dict[str, int] = {}
+        self.fired = 0
+
+    def decide(self, site: str, call: int, rank: int) -> bool:
+        """Pure fire decision (no state) — unit-testable determinism."""
+        h = zlib.crc32(f"{self.seed}:{rank}:{site}:{call}".encode())
+        return (h / 0xFFFFFFFF) < self.rate
+
+    def maybe_fire(self, site: str, rank: int):
+        """Advance this site's call counter; returns the fault kind to
+        fire, or None."""
+        call = self.calls.get(site, 0)
+        self.calls[site] = call + 1
+        if self.budget != -1 and self.fired >= self.budget:
+            return None
+        if not self.decide(site, call, rank):
+            return None
+        kind = self.kinds[self.fired % len(self.kinds)]
+        self.fired += 1
+        return kind
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "seed": self.seed, "rate": self.rate, "kinds": list(self.kinds),
+            "budget": self.budget, "fired": self.fired,
+            "calls": dict(self.calls),
+        }
+
+
+def parse_chaos(spec: str) -> Optional[ChaosState]:
+    """Parse ``Config.chaos`` (``seed:rate[:kinds[:budget]]``); None for
+    the empty spec, ValueError naming the grammar on anything malformed
+    (a chaos spec that silently arms nothing defeats the drill)."""
+    spec = spec.strip()
+    if not spec:
+        return None
+    parts = spec.split(":")
+    if len(parts) < 2 or len(parts) > 4:
+        raise ValueError(
+            f"malformed chaos spec {spec!r} — expected "
+            "'seed:rate[:kinds[:budget]]' (e.g. '7:0.02' or "
+            "'7:0.01:fail+kill:3')"
+        )
+    try:
+        seed = int(parts[0])
+        rate = float(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"chaos seed must be an int and rate a float, got "
+            f"{parts[0]!r}:{parts[1]!r}"
+        ) from None
+    if not 0.0 <= rate <= 1.0:
+        raise ValueError(f"chaos rate must be in [0, 1], got {rate}")
+    kinds = ["fail"]
+    if len(parts) >= 3 and parts[2].strip():
+        kinds = [k.strip() for k in parts[2].split("+") if k.strip()]
+        bad = [k for k in kinds if k not in _KINDS]
+        if bad:
+            raise ValueError(
+                f"unknown chaos kind(s) {bad}; valid kinds: "
+                f"{', '.join(_KINDS)}"
+            )
+    budget = -1
+    if len(parts) == 4 and parts[3].strip() not in ("", "*"):
+        try:
+            budget = int(parts[3])
+        except ValueError:
+            raise ValueError(
+                f"chaos budget must be an int or '*', got {parts[3]!r}"
+            ) from None
+        if budget < 0:
+            raise ValueError(f"chaos budget must be >= 0, got {budget}")
+    return ChaosState(seed, rate, kinds, budget)
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # noqa: BLE001 — chaos must work before a backend
+        return 0
+
+
 class FaultRegistry:
     """Process-wide armed-site table.  ``maybe_fault`` re-arms lazily
-    whenever ``Config.fault_spec`` changes, so tests and services drive
-    injection purely through config/env."""
+    whenever ``Config.fault_spec`` or ``Config.chaos`` changes, so tests
+    and services drive injection purely through config/env."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._spec: Optional[str] = None
         self._sites: Dict[str, _SiteState] = {}
+        self._chaos_spec: Optional[str] = None
+        self._chaos: Optional[ChaosState] = None
 
     def arm(self, spec: str) -> None:
         sites = parse_spec(spec)  # validate before swapping state
@@ -192,35 +334,58 @@ class FaultRegistry:
             self._spec = spec
             self._sites = sites
 
+    def arm_chaos(self, spec: str) -> None:
+        chaos = parse_chaos(spec)  # validate before swapping state
+        with self._lock:
+            self._chaos_spec = spec
+            self._chaos = chaos
+
     def maybe_fault(self, site: str) -> None:
-        spec = get_config().fault_spec
+        cfg = get_config()
+        spec, chaos_spec = cfg.fault_spec, cfg.chaos
         if spec != self._spec:  # unlocked read: a racing double-arm is
             self.arm(spec)  # idempotent (same spec, fresh counters)
+        if chaos_spec != self._chaos_spec:
+            self.arm_chaos(chaos_spec)
         with self._lock:
             st = self._sites.get(site)
-            if st is None:
-                return
-            st.calls += 1
-            if st.limit == -1 or st.fired < st.limit:
-                st.fired += 1
-                raise _make_fault(st.kind, site, st.fired)
+            if st is not None:
+                st.calls += 1
+                if st.limit == -1 or st.fired < st.limit:
+                    st.fired += 1
+                    if st.kind == KIND_KILL:
+                        _hard_kill(site, st.fired)
+                    raise _make_fault(st.kind, site, st.fired)
+            if self._chaos is not None:
+                kind = self._chaos.maybe_fire(site, _process_index())
+                if kind is not None:
+                    nth = self._chaos.fired
+                    if kind == KIND_KILL:
+                        _hard_kill(site, nth)
+                    raise _make_fault(kind, site, nth)
 
     def stats(self) -> Dict[str, Dict[str, int]]:
-        """Per-armed-site counters: calls seen, faults fired, the limit."""
+        """Per-armed-site counters: calls seen, faults fired, the limit.
+        The chaos schedule's counters ride under the ``"chaos"`` key."""
         with self._lock:
-            return {
+            out = {
                 s: {"calls": st.calls, "fired": st.fired, "limit": st.limit,
                     "kind": st.kind}
                 for s, st in self._sites.items()
             }
+            if self._chaos is not None:
+                out["chaos"] = self._chaos.stats()
+            return out
 
     def reset(self) -> None:
-        """Re-arm the current spec with fresh counters (gates run the
+        """Re-arm the current specs with fresh counters (gates run the
         same injection sequence twice and need call counts to restart)."""
         with self._lock:
-            spec = self._spec
+            spec, chaos_spec = self._spec, self._chaos_spec
         if spec is not None:
             self.arm(spec)
+        if chaos_spec is not None:
+            self.arm_chaos(chaos_spec)
 
 
 _REGISTRY = FaultRegistry()

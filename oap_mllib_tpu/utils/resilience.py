@@ -36,7 +36,13 @@ masked — they propagate unchanged from the first attempt.
 contract, docs/distributed.md): a rank-local retry would desync the
 collective schedule and strand peers, so faults there keep the
 fail-fast-together semantics of ``_PassGuard`` and recovery stays
-restart-level.
+restart-level.  With the recovery sideband armed (``Config.crash_dir``
+— set by utils/supervisor for every rank it launches) that restart
+level is *supervised*: a fatal fault writes a crash record that poisons
+the peers out of their collectives, and the supervisor relaunches the
+world with ``resume=auto`` restoring the last durable checkpoint; the
+fit summary's ``resilience.ladder`` reads ``"supervised"`` instead of
+``"bypassed(static-world)"``.
 
 Per-fit :class:`ResilienceStats` (retries, degradations, faults seen,
 history) merge into the fit summaries next to the ``progcache`` delta.
@@ -354,8 +360,25 @@ def resilient_fit(
 
     stats = stats or ResilienceStats()
     if _world() > 1:
-        stats.ladder = "bypassed(static-world)"
-        return attempt(False)
+        # the static-world contract: no rank-local rung may fire.  But
+        # when the recovery sideband is armed (Config.crash_dir — the
+        # supervisor sets it for every rank it launches), recovery is
+        # SUPERVISED rather than absent: a fatal fault here poisons the
+        # peers (they abort their collectives promptly instead of
+        # hanging) and the supervisor relaunches the world with
+        # resume=auto restoring the last durable checkpoint
+        # (utils/recovery.py, utils/supervisor.py).
+        if get_config().crash_dir:
+            stats.ladder = "supervised"
+        else:
+            stats.ladder = "bypassed(static-world)"
+        try:
+            return attempt(False)
+        except Exception as e:
+            from oap_mllib_tpu.utils import recovery
+
+            recovery.record_fatal(f"{algo}.fit", e)
+            raise
     stats.ladder = "active"
     policy = policy or RetryPolicy.from_config()
     deadline = time.monotonic() + policy.deadline_s

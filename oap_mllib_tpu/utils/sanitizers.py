@@ -146,10 +146,18 @@ def _gather_frames(frame: bytes) -> List[bytes]:
     import numpy as np
     from jax.experimental import multihost_utils
 
+    from oap_mllib_tpu.utils import recovery
+
     buf = np.zeros((_SIG_BYTES,), np.uint8)
     raw = frame[:_SIG_BYTES]
     buf[: len(raw)] = np.frombuffer(raw, np.uint8)
-    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    # the cross-check itself is a host collective: a peer that died
+    # before ITS check must not wedge the checker — the deadline
+    # watchdog applies here like at every other dispatch seam
+    gathered = np.asarray(recovery.guarded_dispatch(
+        "sanitizer.crosscheck", "host",
+        lambda: multihost_utils.process_allgather(buf),
+    ))
     return [bytes(gathered[r]).rstrip(b"\x00") for r in range(gathered.shape[0])]
 
 

@@ -1,0 +1,397 @@
+"""Supervised relaunch: the restart half of the live-world recovery loop.
+
+utils/recovery.py converts a dead peer into a prompt, machine-readable
+exit on every rank (collective deadlines + the crash-record sideband);
+utils/checkpoint.py makes the lost work resumable.  This module closes
+the loop: a :class:`Supervisor` launches the world's rank processes,
+watches them, **classifies** the exit (crash records + exit codes +
+signals), and relaunches under a bounded restart budget with exponential
+backoff — shrinking the world by one when the same rank keeps failing
+(``Config.shrink_after`` consecutive times), so a repeatedly bad host
+stops taking the fleet down with it.  Relaunched worlds run with
+``Config.resume="auto"``: same-world resumes are bit-identical
+continuations, shrunken worlds redistribute factor shards through
+``parallel/shuffle.reshard_factor_rows`` (the elastic-training pattern
+of PAPERS.md arXiv:2112.01075).
+
+The supervisor is deliberately jax-free: it spawns and reaps plain
+subprocesses, reads JSON from the sideband, and never joins the world
+itself — so it survives everything the workers can do to themselves,
+including SIGKILL mid-collective.  ``dev/supervise.py`` is the CLI
+driver; dev/chaos_gate.py drills the whole loop in CI.
+
+Exit classification, per rank:
+
+==================  =========================================================
+classification      meaning
+==================  =========================================================
+``ok``              exit code 0, no crash record
+``killed``          died on a signal (negative returncode) with no record —
+                    a preemption; the prime relaunch candidate
+``collective_timeout``  the rank's own deadline expired waiting for a peer
+                    (a *victim*, not a culprit)
+``peer_abort``      the rank aborted because a peer's record appeared
+                    (also a victim)
+<fault class>       the crash record's class (transient/oom/nonfinite/
+                    unclassified) for ranks that faulted locally
+``error``           nonzero exit with no record and no signal
+==================  =========================================================
+
+The *culprit* of a failed attempt is the first non-victim failure
+(killed/faulted/errored rank); pure-victim attempts (every failure a
+timeout/peer-abort — the dead rank left no trace, e.g. SIGKILL) fall
+back to the first signal-killed rank, then the first failure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import subprocess
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.utils import recovery
+
+log = logging.getLogger("oap_mllib_tpu")
+
+_VICTIM_CLASSES = (recovery.FAULT_TIMEOUT, recovery.FAULT_PEER_ABORT)
+
+
+class SupervisorError(RuntimeError):
+    """The restart budget ran out before a world completed."""
+
+
+@dataclasses.dataclass
+class RankExit:
+    """One rank's exit from one attempt."""
+
+    rank: int
+    returncode: Optional[int]
+    classification: str
+    record: Optional[Dict[str, Any]] = None
+    output: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.classification == "ok"
+
+    @property
+    def victim(self) -> bool:
+        return self.classification in _VICTIM_CLASSES
+
+    def as_dict(self) -> Dict[str, Any]:
+        out = {
+            "rank": self.rank,
+            "returncode": self.returncode,
+            "classification": self.classification,
+        }
+        if self.record is not None:
+            out["record"] = {
+                k: self.record.get(k)
+                for k in ("fault_class", "site", "op", "last_checkpoint_step")
+            }
+        return out
+
+
+@dataclasses.dataclass
+class Attempt:
+    """One launched world: its size, per-rank exits, and outcome."""
+
+    index: int
+    world: int
+    exits: List[RankExit] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return bool(self.exits) and all(e.ok for e in self.exits)
+
+    def culprit(self) -> Optional[int]:
+        """The rank to blame for a failed attempt (None when ok)."""
+        if self.ok:
+            return None
+        bad = [e for e in self.exits if not e.ok]
+        for e in bad:  # a non-victim local failure names itself
+            if not e.victim:
+                return e.rank
+        for e in bad:  # all victims: blame a signal death if any
+            if e.returncode is not None and e.returncode < 0:
+                return e.rank
+        return bad[0].rank if bad else None
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "index": self.index,
+            "world": self.world,
+            "ok": self.ok,
+            "culprit": self.culprit(),
+            "exits": [e.as_dict() for e in self.exits],
+        }
+
+
+class Supervisor:
+    """Launch → watch → classify → relaunch/shrink, under a budget.
+
+    ``build_argv(rank, world, coord, attempt)`` returns the argv for one
+    rank's process (``coord`` is a fresh ``host:port`` rendezvous per
+    attempt — reusing a dead world's port races its TIME_WAIT sockets).
+    The supervisor injects into every worker's environment:
+
+    - ``OAP_MLLIB_TPU_CRASH_DIR`` — the shared sideband (and clears
+      stale records between attempts);
+    - ``OAP_MLLIB_TPU_RESUME=auto`` — relaunches resume the last durable
+      checkpoint (callers arm ``OAP_MLLIB_TPU_CHECKPOINT_DIR`` in
+      ``env``);
+    - ``OAP_MLLIB_TPU_CHAOS`` — when a base ``chaos`` spec is given, its
+      seed is re-seeded ``+attempt`` so a deterministic kill schedule
+      does not re-kill the resumed world at the same point;
+    - ``SUPERVISE_ATTEMPT`` — the attempt index (drill workers key
+      one-shot faults off it).
+
+    Restart policy: at most ``restart_budget`` relaunches (Config
+    default), backoff ``restart_backoff * 2^(n-1)`` seconds before
+    relaunch *n*; ``shrink_after`` consecutive failures blamed on the
+    same rank shrink the world by one (never below 1) and reset the
+    blame counter — ``resume=auto`` reshards state onto the new layout.
+    """
+
+    def __init__(self, build_argv: Callable[[int, int, str, int], List[str]],
+                 world: int, crash_dir: str, *,
+                 env: Optional[Dict[str, str]] = None,
+                 restart_budget: Optional[int] = None,
+                 restart_backoff: Optional[float] = None,
+                 shrink_after: Optional[int] = None,
+                 chaos: str = "",
+                 attempt_timeout: float = 600.0,
+                 grace_s: float = 30.0,
+                 poll_s: float = 0.2,
+                 coord_host: str = "127.0.0.1"):
+        cfg = get_config()
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        self.build_argv = build_argv
+        self.world = world
+        self.crash_dir = crash_dir
+        self.env = dict(env or os.environ)
+        self.restart_budget = (
+            int(cfg.restart_budget) if restart_budget is None
+            else int(restart_budget)
+        )
+        self.restart_backoff = (
+            float(cfg.restart_backoff) if restart_backoff is None
+            else float(restart_backoff)
+        )
+        self.shrink_after = (
+            int(cfg.shrink_after) if shrink_after is None
+            else int(shrink_after)
+        )
+        if self.restart_budget < 0 or self.restart_backoff < 0:
+            raise ValueError(
+                "restart_budget and restart_backoff must be >= 0, got "
+                f"{self.restart_budget}/{self.restart_backoff}"
+            )
+        if self.shrink_after < 1:
+            raise ValueError(
+                f"shrink_after must be >= 1, got {self.shrink_after}"
+            )
+        self.chaos = chaos
+        self.attempt_timeout = attempt_timeout
+        self.grace_s = grace_s
+        self.poll_s = poll_s
+        self.coord_host = coord_host
+        self.attempts: List[Attempt] = []
+        self.relaunches = 0
+        self.shrinks = 0
+        self._blame_rank: Optional[int] = None
+        self._blame_count = 0
+
+    # -- world lifecycle -----------------------------------------------------
+
+    def _coord(self) -> str:
+        from oap_mllib_tpu.parallel.bootstrap import free_port
+
+        return f"{self.coord_host}:{free_port(self.coord_host, 4000)}"
+
+    def _worker_env(self, attempt: int) -> Dict[str, str]:
+        env = dict(self.env)
+        env["OAP_MLLIB_TPU_CRASH_DIR"] = self.crash_dir
+        env["OAP_MLLIB_TPU_RESUME"] = "auto"
+        env["SUPERVISE_ATTEMPT"] = str(attempt)
+        if self.chaos:
+            from oap_mllib_tpu.utils.faults import parse_chaos
+
+            base = parse_chaos(self.chaos)
+            if base is not None:
+                parts = self.chaos.split(":")
+                parts[0] = str(base.seed + attempt)
+                env["OAP_MLLIB_TPU_CHAOS"] = ":".join(parts)
+        return env
+
+    def _launch(self, attempt: int, world: int):
+        os.makedirs(self.crash_dir, exist_ok=True)
+        recovery.clear_crash_records(self.crash_dir)
+        coord = self._coord()
+        env = self._worker_env(attempt)
+        procs = []
+        for rank in range(world):
+            procs.append(subprocess.Popen(
+                self.build_argv(rank, world, coord, attempt),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env,
+            ))
+        return procs
+
+    def _reap(self, procs) -> List[str]:
+        """Wait out the grace window for survivors of a failure, then
+        SIGKILL stragglers; returns per-rank captured output."""
+        deadline = time.monotonic() + self.grace_s
+        while any(p.poll() is None for p in procs) \
+                and time.monotonic() < deadline:
+            time.sleep(self.poll_s)
+        outs = []
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            try:
+                out, _ = p.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                out = ""
+            outs.append(out or "")
+        return outs
+
+    def _watch(self, procs) -> bool:
+        """Block until the world completes or fails.  Returns True when
+        every rank exited 0 before the attempt timeout; False on the
+        first nonzero exit (or timeout), leaving survivors to _reap."""
+        deadline = time.monotonic() + self.attempt_timeout
+        while time.monotonic() < deadline:
+            codes = [p.poll() for p in procs]
+            if any(c is not None and c != 0 for c in codes):
+                return False
+            if all(c == 0 for c in codes):
+                return True
+            time.sleep(self.poll_s)
+        return False
+
+    # -- classification ------------------------------------------------------
+
+    def _classify(self, attempt: int, world: int, procs,
+                  outs: List[str]) -> Attempt:
+        att = Attempt(index=attempt, world=world)
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            code = p.poll()
+            record = None
+            path = recovery.crash_record_path(self.crash_dir, rank)
+            if os.path.exists(path):
+                try:
+                    import json
+
+                    with open(path) as f:
+                        record = json.load(f)
+                except Exception:  # noqa: BLE001 — torn record
+                    record = {"rank": rank}
+            if code == 0 and record is None:
+                cls = "ok"
+            elif record is not None and record.get("fault_class"):
+                cls = str(record["fault_class"])
+            elif code is not None and code < 0:
+                cls = "killed"
+            else:
+                cls = "error"
+            att.exits.append(RankExit(
+                rank=rank, returncode=code, classification=cls,
+                record=record, output=out,
+            ))
+        return att
+
+    # -- the supervision loop ------------------------------------------------
+
+    def run(self) -> Dict[str, Any]:
+        """Supervise until a world completes or the budget runs out.
+
+        Returns the machine-readable summary (``ok``, ``attempts``,
+        ``relaunches``, ``shrinks``, ``final_world``, ``outputs`` — the
+        final attempt's per-rank stdout).  Telemetry:
+        ``oap_recovery_relaunches_total``,
+        ``oap_recovery_restart_budget_spent_total``,
+        ``oap_recovery_world_shrinks_total``, and the detect→respawn
+        wall in the ``oap_recovery_time_to_recovery_seconds``
+        histogram."""
+        world = self.world
+        attempt = 0
+        outs: List[str] = []
+        while True:
+            log.info("supervisor: attempt %d, world %d", attempt, world)
+            procs = self._launch(attempt, world)
+            clean = self._watch(procs)
+            t_detect = time.monotonic()
+            outs = self._reap(procs)
+            att = self._classify(attempt, world, procs, outs)
+            self.attempts.append(att)
+            if att.ok and clean:
+                return self._summary(True, world, outs)
+            culprit = att.culprit()
+            log.warning(
+                "supervisor: attempt %d failed (world %d, culprit rank "
+                "%s): %s", attempt, world, culprit,
+                [e.as_dict() for e in att.exits if not e.ok],
+            )
+            if self.relaunches >= self.restart_budget:
+                summary = self._summary(False, world, outs)
+                log.error(
+                    "supervisor: restart budget (%d) exhausted",
+                    self.restart_budget,
+                )
+                return summary
+            if culprit == self._blame_rank:
+                self._blame_count += 1
+            else:
+                self._blame_rank, self._blame_count = culprit, 1
+            if (self._blame_count >= self.shrink_after and world > 1
+                    and culprit is not None):
+                world -= 1
+                self.shrinks += 1
+                self._blame_rank, self._blame_count = None, 0
+                _tm.counter(
+                    "oap_recovery_world_shrinks_total",
+                    help="Supervisor world-shrink decisions (a repeatedly "
+                         "bad rank excluded)",
+                ).inc()
+                log.warning(
+                    "supervisor: rank %s failed %d consecutive times — "
+                    "shrinking world to %d (resume=auto reshards state)",
+                    culprit, self.shrink_after, world,
+                )
+            self.relaunches += 1
+            _tm.counter(
+                "oap_recovery_relaunches_total",
+                help="Supervisor world relaunches",
+            ).inc()
+            _tm.counter(
+                "oap_recovery_restart_budget_spent_total",
+                help="Restart-budget units consumed",
+            ).inc()
+            backoff = self.restart_backoff * (2.0 ** (self.relaunches - 1))
+            if backoff > 0:
+                time.sleep(backoff)
+            attempt += 1
+            _tm.histogram(
+                "oap_recovery_time_to_recovery_seconds",
+                help="Wall from failure detection to the relaunched world "
+                     "spawning (factor-4 log buckets)",
+            ).observe(time.monotonic() - t_detect)
+
+    def _summary(self, ok: bool, world: int,
+                 outs: List[str]) -> Dict[str, Any]:
+        return {
+            "ok": ok,
+            "final_world": world,
+            "relaunches": self.relaunches,
+            "restart_budget": self.restart_budget,
+            "shrinks": self.shrinks,
+            "attempts": [a.as_dict() for a in self.attempts],
+            "outputs": list(outs),
+        }

@@ -217,6 +217,49 @@ class TestConfigCoverage:
         with pytest.raises(ValueError, match="kmeans_precision"):
             psn.resolve("kmeans")
 
+    def test_collective_timeout_negative_raises_at_dispatch(self):
+        """The kmeans_kernel/fault_spec contract for the recovery plane:
+        a nonsense deadline raises at the dispatch seam, not silently
+        disarming the watchdog (utils/recovery.py)."""
+        from oap_mllib_tpu.utils import recovery
+
+        set_config(collective_timeout=-1.0)
+        with pytest.raises(ValueError, match="collective_timeout"):
+            recovery.guarded_dispatch("psum", "data", lambda: 1)
+
+    def test_chaos_typo_raises(self):
+        """A malformed chaos spec must raise naming the grammar — a
+        chaos drill that silently injects nothing proves nothing."""
+        from oap_mllib_tpu.utils import faults
+
+        set_config(chaos="garbage")
+        with pytest.raises(ValueError, match="seed:rate"):
+            faults.maybe_fault("stream.read")
+        set_config(chaos="7:0.1:boom")
+        with pytest.raises(ValueError, match="kind"):
+            faults.maybe_fault("stream.read")
+
+    def test_supervisor_knobs_reach_supervisor(self, tmp_path):
+        """restart_budget / restart_backoff / shrink_after flow into
+        Supervisor defaults (utils/supervisor.py)."""
+        from oap_mllib_tpu.utils.supervisor import Supervisor
+
+        set_config(restart_budget=9, restart_backoff=0.5, shrink_after=3)
+        sup = Supervisor(lambda r, w, c, a: ["true"], 1,
+                         str(tmp_path / "sb"))
+        assert sup.restart_budget == 9
+        assert sup.restart_backoff == 0.5
+        assert sup.shrink_after == 3
+
+    def test_crash_dir_arms_the_sideband(self, tmp_path):
+        from oap_mllib_tpu.utils import recovery
+
+        set_config(crash_dir="")
+        assert recovery.write_crash_record("s", "oom", "x") is None
+        set_config(crash_dir=str(tmp_path))
+        path = recovery.write_crash_record("s", "oom", "x")
+        assert path is not None and path.startswith(str(tmp_path))
+
     def test_retry_knobs_reach_policy(self):
         """retry_limit / retry_backoff / retry_deadline flow into
         RetryPolicy.from_config with float coercion intact."""

@@ -610,6 +610,100 @@ class TestElasticWorlds:
         )
 
 
+_RECOVERY_WORKER = os.path.join(
+    os.path.dirname(__file__), "pseudo_cluster_worker_recovery.py"
+)
+
+
+class TestLiveWorldRecovery:
+    """ISSUE 10 acceptance: the recovery plane across a REAL 2-process
+    world — a SIGKILLed rank converts every survivor's hang into a
+    prompt CollectiveTimeoutError, and a poisoned sideband aborts peers
+    out of their collectives (utils/recovery.py)."""
+
+    def _launch_recovery_world(self, mode, crash_dir, timeout=120):
+        """Spawn the 2-rank drill world.  Unlike the elastic-worlds kill
+        leg, the parent never reaps the survivor: the plane under test
+        is that EVERY rank exits on its own, within the deadline."""
+        import time
+
+        from oap_mllib_tpu.parallel.bootstrap import free_port
+
+        coord = f"127.0.0.1:{free_port('127.0.0.1', 4000)}"
+        env = _worker_env()
+        env.update({
+            "RECOVERY_WORKER_MODE": mode, "RECOVERY_CRASH_DIR": crash_dir,
+        })
+        t0 = time.monotonic()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, _RECOVERY_WORKER, str(r), "2", coord, "1"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=_REPO,
+            )
+            for r in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=timeout)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        _skip_if_environment_cannot_spawn(procs, outs)
+        return procs, outs, time.monotonic() - t0
+
+    def test_rank_kill_raises_timeout_on_survivors(self, tmp_path):
+        """Satellite leg: rank 1 is SIGKILLed mid-collective; rank 0
+        must raise CollectiveTimeoutError within collective_timeout —
+        exiting BY ITSELF, well inside the 120 s watchdog — with its
+        crash record (fault class, last-completed fingerprint) in the
+        sideband for the supervisor to classify."""
+        crash_dir = str(tmp_path / "sideband")
+        procs, outs, elapsed = self._launch_recovery_world(
+            "hang", crash_dir
+        )
+        assert procs[1].returncode == -9, outs[1]  # genuinely SIGKILLed
+        assert procs[0].returncode == 0, f"survivor did not self-exit:\n{outs[0]}"
+        assert "TIMEOUT_CAUGHT" in outs[0], outs[0]
+        # the survivor's diagnosis landed in the sideband, machine-readable
+        rec_path = os.path.join(crash_dir, "crash.rank0.json")
+        assert os.path.exists(rec_path), os.listdir(crash_dir)
+        rec = json.load(open(rec_path))
+        assert rec["fault_class"] == "collective_timeout"
+        assert rec["rank"] == 0 and rec["world"] == 2
+        assert rec["last_checkpoint_step"] == -1  # no checkpointing armed
+        assert "telemetry" in rec
+        # the whole drill completed well under the distributed timeout
+        assert elapsed < 90, f"world took {elapsed:.0f}s to diagnose"
+
+    def test_peer_crash_record_aborts_collectives(self, tmp_path):
+        """Coordinated abort: rank 1's fatal fault never reaches a
+        collective — only the sideband can tell rank 0, which must
+        raise PeerAbortError promptly instead of burning the full
+        deadline."""
+        crash_dir = str(tmp_path / "sideband")
+        procs, outs, elapsed = self._launch_recovery_world(
+            "abort", crash_dir
+        )
+        assert procs[1].returncode == 3, outs[1]
+        assert "ABORT_RECORDED" in outs[1], outs[1]
+        assert procs[0].returncode == 0, f"survivor did not self-exit:\n{outs[0]}"
+        assert "PEER_ABORT_CAUGHT" in outs[0], outs[0]
+        assert "peer=1" in outs[0], outs[0]
+        # both ranks' records in the sideband: the culprit's fault and
+        # the victim's abort
+        recs = {
+            f: json.load(open(os.path.join(crash_dir, f)))
+            for f in os.listdir(crash_dir) if f.endswith(".json")
+        }
+        assert recs["crash.rank1.json"]["fault_class"] == "unclassified"
+        assert recs["crash.rank0.json"]["fault_class"] == "peer_abort"
+        assert elapsed < 90, f"world took {elapsed:.0f}s to abort"
+
+
 class TestSanitizerPlane:
     """The runtime sanitizer plane (utils/sanitizers.py) across a REAL
     2-process world — the configuration it exists for."""

@@ -1,0 +1,236 @@
+"""Supervisor units (ISSUE 10, utils/supervisor.py): launch/classify/
+relaunch/shrink with tiny jax-free subprocess workers, so the whole
+policy surface is asserted on any host — the real jax-world drills ride
+dev/chaos_gate.py and the pseudo-cluster legs."""
+
+import os
+import sys
+
+import pytest
+
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.utils import recovery
+from oap_mllib_tpu.utils.supervisor import Attempt, RankExit, Supervisor
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _script_argv(script: str):
+    """build_argv for a tiny inline-python worker: argv[1:] =
+    rank world coord attempt."""
+
+    def build(rank, world, coord, attempt):
+        return [sys.executable, "-c", script, str(rank), str(world),
+                coord, str(attempt)]
+
+    return build
+
+
+def _mk(tmp_path, script, world=2, **kw):
+    kw.setdefault("restart_backoff", 0.01)
+    kw.setdefault("grace_s", 5.0)
+    kw.setdefault("attempt_timeout", 60.0)
+    return Supervisor(
+        _script_argv(script), world, str(tmp_path / "sideband"),
+        env={**os.environ, "PYTHONPATH": _REPO}, **kw
+    )
+
+
+class TestHappyPath:
+    def test_clean_world_no_relaunch(self, tmp_path):
+        sup = _mk(tmp_path, "print('RESULT ok')", restart_budget=3)
+        s = sup.run()
+        assert s["ok"] and s["relaunches"] == 0 and s["shrinks"] == 0
+        assert s["final_world"] == 2
+        assert all("RESULT ok" in o for o in s["outputs"])
+        assert [e["classification"] for e in s["attempts"][0]["exits"]] == [
+            "ok", "ok"
+        ]
+
+    def test_invalid_world_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="world"):
+            _mk(tmp_path, "pass", world=0)
+
+    def test_config_defaults_flow(self, tmp_path):
+        set_config(restart_budget=7, restart_backoff=0.25, shrink_after=4)
+        sup = Supervisor(
+            _script_argv("pass"), 1, str(tmp_path / "sb"),
+        )
+        assert sup.restart_budget == 7
+        assert sup.restart_backoff == 0.25
+        assert sup.shrink_after == 4
+
+
+# worker: rank 1 fails until a marker file exists (attempt 0 fails,
+# attempt 1 succeeds) — the transient-host relaunch scenario
+_FLAKY = """
+import os, sys
+rank, world, coord, attempt = sys.argv[1:5]
+marker = os.environ["FLAKY_MARKER"]
+if rank == "1" and not os.path.exists(marker):
+    open(marker, "w").close()
+    sys.exit(1)
+print("RESULT attempt=" + attempt)
+"""
+
+
+class TestRelaunch:
+    def test_fail_then_succeed_consumes_one_restart(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("FLAKY_MARKER", str(tmp_path / "marker"))
+        sup = _mk(tmp_path, _FLAKY, restart_budget=3)
+        s = sup.run()
+        assert s["ok"] and s["relaunches"] == 1 and s["shrinks"] == 0
+        assert [a["ok"] for a in s["attempts"]] == [False, True]
+        assert s["attempts"][0]["culprit"] == 1
+        # the relaunched attempt index reached the workers (resume keying)
+        assert any("attempt=1" in o for o in s["outputs"])
+
+    def test_budget_exhausted_reports_not_ok(self, tmp_path):
+        sup = _mk(tmp_path, "import sys; sys.exit(2)", world=1,
+                  restart_budget=2)
+        s = sup.run()
+        assert not s["ok"]
+        assert s["relaunches"] == 2  # the budget, fully spent
+        assert len(s["attempts"]) == 3  # initial + 2 relaunches
+        assert all(not a["ok"] for a in s["attempts"])
+
+    def test_stale_crash_records_cleared_between_attempts(self, tmp_path,
+                                                          monkeypatch):
+        """A record from attempt N must not poison attempt N+1."""
+        monkeypatch.setenv("FLAKY_MARKER", str(tmp_path / "marker"))
+        record_then_ok = _FLAKY.replace(
+            'open(marker, "w").close()',
+            'open(marker, "w").close()\n'
+            '    import json\n'
+            '    json.dump({"rank": 1, "fault_class": "oom"}, '
+            'open(os.environ["OAP_MLLIB_TPU_CRASH_DIR"] '
+            '+ "/crash.rank1.json", "w"))',
+        )
+        sup = _mk(tmp_path, record_then_ok, restart_budget=2)
+        s = sup.run()
+        assert s["ok"]
+        # attempt 0 classified from the record, attempt 1 clean
+        assert s["attempts"][0]["exits"][1]["classification"] == "oom"
+        assert recovery.check_poison(sup.crash_dir, 99) is None
+
+
+# worker: rank (world-1) dies whenever the world is multi-process —
+# the repeatedly-bad-host scenario the shrink policy exists for
+_BAD_LAST_RANK = """
+import sys
+rank, world = int(sys.argv[1]), int(sys.argv[2])
+if world > 1 and rank == world - 1:
+    sys.exit(3)
+print("RESULT world=" + str(world))
+"""
+
+
+class TestShrink:
+    def test_repeated_culprit_shrinks_world(self, tmp_path):
+        sup = _mk(tmp_path, _BAD_LAST_RANK, world=2, restart_budget=4,
+                  shrink_after=2)
+        s = sup.run()
+        assert s["ok"]
+        assert s["final_world"] == 1 and s["shrinks"] == 1
+        # two blamed failures at world 2, then the shrunken world passes
+        assert [a["world"] for a in s["attempts"]] == [2, 2, 1]
+        assert any("world=1" in o for o in s["outputs"])
+
+    def test_shrink_after_one_is_immediate(self, tmp_path):
+        sup = _mk(tmp_path, _BAD_LAST_RANK, world=3, restart_budget=4,
+                  shrink_after=1)
+        s = sup.run()
+        assert s["ok"] and s["final_world"] == 1
+        assert [a["world"] for a in s["attempts"]] == [3, 2, 1]
+        assert s["shrinks"] == 2
+
+    def test_world_never_shrinks_below_one(self, tmp_path):
+        sup = _mk(tmp_path, "import sys; sys.exit(1)", world=1,
+                  restart_budget=2, shrink_after=1)
+        s = sup.run()
+        assert not s["ok"] and s["final_world"] == 1 and s["shrinks"] == 0
+
+
+class TestClassification:
+    def test_signal_death_is_killed(self, tmp_path):
+        script = """
+import os, signal, sys
+if sys.argv[1] == "0":
+    os.kill(os.getpid(), signal.SIGKILL)
+print("RESULT ok")
+"""
+        sup = _mk(tmp_path, script, world=2, restart_budget=0)
+        s = sup.run()
+        e = s["attempts"][0]["exits"][0]
+        assert e["classification"] == "killed"
+        assert e["returncode"] == -9
+        assert s["attempts"][0]["culprit"] == 0
+
+    def test_crash_record_class_wins_over_exit_code(self, tmp_path):
+        script = """
+import json, os, sys
+if sys.argv[1] == "1":
+    json.dump(
+        {"rank": 1, "fault_class": "oom", "site": "als.fit",
+         "last_checkpoint_step": 4},
+        open(os.environ["OAP_MLLIB_TPU_CRASH_DIR"] + "/crash.rank1.json",
+             "w"))
+    sys.exit(1)
+print("RESULT ok")
+"""
+        sup = _mk(tmp_path, script, world=2, restart_budget=0)
+        s = sup.run()
+        e = s["attempts"][0]["exits"][1]
+        assert e["classification"] == "oom"
+        assert e["record"]["site"] == "als.fit"
+        assert e["record"]["last_checkpoint_step"] == 4
+
+    def test_victims_are_not_culprits(self):
+        """Timeout/peer-abort ranks are casualties of the real fault —
+        blame must land on the killed/faulted rank so shrink excludes
+        the right host."""
+        att = Attempt(index=0, world=3, exits=[
+            RankExit(0, 0, recovery.FAULT_TIMEOUT,
+                     record={"fault_class": recovery.FAULT_TIMEOUT}),
+            RankExit(1, -9, "killed"),
+            RankExit(2, 0, recovery.FAULT_PEER_ABORT,
+                     record={"fault_class": recovery.FAULT_PEER_ABORT}),
+        ])
+        assert att.culprit() == 1
+
+    def test_all_victims_blames_signal_death(self):
+        att = Attempt(index=0, world=2, exits=[
+            RankExit(0, 0, recovery.FAULT_TIMEOUT,
+                     record={"fault_class": recovery.FAULT_TIMEOUT}),
+            RankExit(1, -9, recovery.FAULT_TIMEOUT,
+                     record={"fault_class": recovery.FAULT_TIMEOUT}),
+        ])
+        assert att.culprit() == 1
+
+    def test_chaos_reseeds_per_attempt(self, tmp_path, monkeypatch):
+        """The deterministic kill schedule must MOVE on relaunch, or the
+        resumed world dies at the same call forever."""
+        monkeypatch.setenv("FLAKY_MARKER", str(tmp_path / "marker"))
+        script = _FLAKY.replace(
+            'print("RESULT attempt=" + attempt)',
+            'print("RESULT chaos=" + os.environ["OAP_MLLIB_TPU_CHAOS"])',
+        )
+        sup = _mk(tmp_path, script, restart_budget=2,
+                  chaos="5:0.01:kill:1")
+        s = sup.run()
+        assert s["ok"]
+        assert any("chaos=6:0.01:kill:1" in o for o in s["outputs"])
+
+    def test_telemetry_counters(self, tmp_path, monkeypatch):
+        from oap_mllib_tpu.telemetry import metrics as tm
+
+        monkeypatch.setenv("FLAKY_MARKER", str(tmp_path / "marker"))
+        before = tm.counter("oap_recovery_relaunches_total").value
+        hist = tm.histogram("oap_recovery_time_to_recovery_seconds")
+        count_before = hist.count
+        sup = _mk(tmp_path, _FLAKY, restart_budget=3)
+        assert sup.run()["ok"]
+        assert tm.counter("oap_recovery_relaunches_total"
+                          ).value == before + 1
+        assert hist.count == count_before + 1
