@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Perf-trajectory regression gate: the newest BENCH_r<NN>.json vs the
+best prior round, per headline metric.
+
+Every driver-captured bench round lands as ``BENCH_r<NN>.json``
+({"n": round, "tail": last stdout lines, "parsed": last JSON metric
+line}).  The trajectory only helps if someone reads it — this gate does:
+for every metric in the NEWEST round it finds the best value any PRIOR
+round recorded for the same metric name and fails (exit 1) on a >10%
+regression, naming the metric and the diff.  Direction comes from the
+unit: ``*/sec`` rates are higher-is-better, ``sec*`` walls are
+lower-is-better.
+
+Soft-gate semantics: with only one recorded round (or a metric with no
+prior — e.g. a renamed headline or a new backend's proxy metric) there
+is nothing to regress against, so it WARNS and exits 0.  Metrics are
+compared strictly by name, so CPU-proxy headlines
+(``*_cpuproxy``, bench.py on accelerator-less hosts) never get diffed
+against accelerator rounds.
+
+Usage: python dev/bench_regress.py [--dir REPO_ROOT] [--threshold 0.10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def find_rounds(root: str) -> List[Tuple[int, str]]:
+    """[(round number, path)] sorted ascending."""
+    out = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def metrics_of(path: str) -> Dict[str, Dict]:
+    """metric name -> line dict, from ``parsed`` (dict or list) plus any
+    JSON metric lines embedded in ``tail`` — rounds whose driver only
+    parsed the last line still contribute every line they captured."""
+    with open(path) as f:
+        rec = json.load(f)
+    lines: List[Dict] = []
+    parsed = rec.get("parsed")
+    if isinstance(parsed, dict):
+        lines.append(parsed)
+    elif isinstance(parsed, list):
+        lines.extend(p for p in parsed if isinstance(p, dict))
+    for raw in str(rec.get("tail", "")).splitlines():
+        raw = raw.strip()
+        if raw.startswith("{"):
+            try:
+                lines.append(json.loads(raw))
+            except json.JSONDecodeError:
+                pass
+    out: Dict[Tuple[str, str], Dict] = {}
+    for line in lines:
+        name = line.get("metric")
+        if name and isinstance(line.get("value"), (int, float)):
+            # keyed by (metric, backend): rounds captured on different
+            # backends are different trajectories — never diffed.
+            # Legacy rounds without a backend field land in "unknown"
+            # and only ever compare with each other.
+            out[(str(name), str(line.get("backend", "unknown")))] = line
+    return out
+
+
+def higher_is_better(unit: str) -> bool:
+    """Rates (iters/sec, rows/sec, QPS) improve upward; walls (sec,
+    sec/iter, sec/pass) improve downward."""
+    unit = (unit or "").lower()
+    if "/sec" in unit or unit.endswith("ps"):
+        return True
+    return not unit.startswith("sec")
+
+
+def compare(root: str, threshold: float):
+    """(failures, warnings, report lines) for the newest round."""
+    rounds = find_rounds(root)
+    if not rounds:
+        return [], ["no BENCH_r*.json rounds recorded yet"], []
+    newest_n, newest_path = rounds[-1]
+    newest = metrics_of(newest_path)
+    # (metric, backend) -> (best value, round, unit)
+    prior: Dict[Tuple[str, str], Tuple[float, int, str]] = {}
+    for n, path in rounds[:-1]:
+        for key, line in metrics_of(path).items():
+            v, unit = float(line["value"]), str(line.get("unit", ""))
+            best = prior.get(key)
+            if best is None:
+                prior[key] = (v, n, unit)
+            else:
+                better = (
+                    v > best[0] if higher_is_better(unit) else v < best[0]
+                )
+                if better:
+                    prior[key] = (v, n, unit)
+    failures, warnings, report = [], [], []
+    if len(rounds) < 2:
+        warnings.append(
+            f"only one bench round recorded (r{newest_n:02d}) — nothing "
+            "to regress against; gate is warn-only"
+        )
+    for key, line in sorted(newest.items()):
+        name = f"{key[0]}[{key[1]}]"
+        v, unit = float(line["value"]), str(line.get("unit", ""))
+        if key not in prior:
+            warnings.append(
+                f"{name}: no prior round records this metric on this "
+                "backend (new headline or new backend) — skipped"
+            )
+            continue
+        best, best_n, _ = prior[key]
+        hib = higher_is_better(unit)
+        if best == 0:
+            continue
+        change = (v - best) / abs(best)
+        regress = -change if hib else change
+        arrow = f"{v:.4g} vs best r{best_n:02d}={best:.4g} {unit}"
+        if regress > threshold:
+            failures.append(
+                f"{name}: REGRESSION {regress:+.1%} beyond the "
+                f"{threshold:.0%} gate ({arrow})"
+            )
+        else:
+            report.append(f"{name}: ok ({change:+.1%}; {arrow})")
+    return failures, warnings, report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="allowed fractional regression (default 10%%)")
+    args = ap.parse_args(argv)
+    failures, warnings, report = compare(args.dir, args.threshold)
+    for line in report:
+        print(f"  {line}")
+    for w in warnings:
+        print(f"  WARN: {w}")
+    if failures:
+        for fline in failures:
+            print(f"  FAIL: {fline}")
+        print(f"bench regression gate: {len(failures)} regression(s)")
+        return 1
+    print("bench regression gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,6 +109,19 @@ echo "   raise CollectiveTimeoutError within the deadline, and the disarmed"
 echo "   dispatch seam is <1% of the 20-fit microbench (dev/chaos_gate.py) =="
 python dev/chaos_gate.py
 
+echo "== fleet gate: live /metrics + /healthz endpoints parse, per-pass"
+echo "   rollups equal a numpy hand-fold on the 8-device pseudo-mesh, a"
+echo "   deliberately delayed rank shows skew > 1.5 naming it (2-process"
+echo "   legs skip where worlds cannot form), oaptrace output validates"
+echo "   against the Chrome trace-event schema, and the disarmed seam is"
+echo "   <1% of the 20-fit microbench (dev/fleet_gate.py) =="
+python dev/fleet_gate.py
+
+echo "== bench regression gate (soft): newest BENCH_r*.json vs the best"
+echo "   prior round per headline metric+backend; >10% fails, a single"
+echo "   recorded round warns only (dev/bench_regress.py) =="
+python dev/bench_regress.py
+
 echo "== kernel gate: interpret-mode parity across the Pallas kernel plane"
 echo "   (K-Means accumulate, PCA moments, ALS solve, factor Gram),"
 echo "   bf16-on-Pallas routing asserted, and 8-device virtual-mesh ring"

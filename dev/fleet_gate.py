@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""CI gate: the fleet observability control plane (ISSUE 11) must hold
+its contracts.
+
+Legs:
+
+1. **Live endpoint** — a streamed fit with ``metrics_port`` armed
+   serves ``/metrics`` (parses as promtext, carries ``oap_fleet_*``
+   families) and ``/healthz`` (parses as JSON, names the fit root and
+   step) from the per-rank http thread.
+2. **Rollup correctness** — on the 8-device pseudo-mesh, rank 0's
+   per-pass fold equals a hand-fold of the gathered frames
+   (min/max/mean/p99 recomputed with numpy), and the fit summary's
+   ``fleet`` block is consistent with the recorded window.
+3. **Straggler analytics** — a synthetic 2-rank frame set with one
+   deliberately slowed rank folds to skew_ratio > 1.5 naming that rank;
+   the REAL 2-process leg (a slow rank 1 chunk source) rides
+   ``tests/test_pseudo_cluster.py::TestFleetObservability`` and skips
+   only where the host cannot form multiprocess worlds.
+4. **Merged timelines** — ``dev/oaptrace.py`` over the leg-1 JSONL sink
+   emits a Chrome-trace file that validates against the trace-event
+   schema (every event carries name/ph/ts/pid/tid, X slices carry dur).
+5. **Disarmed seam** — fleet off + recorder off + no metrics port costs
+   <1% of the 20-fit K-Means microbench (the PR 4/7 off-path contract).
+
+Exit 1 with the offending evidence on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "dev"))
+
+import numpy as np  # noqa: E402
+
+failures = []
+
+
+def check(ok: bool, what: str) -> None:
+    if not ok:
+        failures.append(what)
+        print(f"FAIL: {what}")
+
+
+from oap_mllib_tpu.config import set_config  # noqa: E402
+from oap_mllib_tpu.data.stream import ChunkSource  # noqa: E402
+from oap_mllib_tpu.models.kmeans import KMeans  # noqa: E402
+from oap_mllib_tpu.parallel.bootstrap import free_port  # noqa: E402
+from oap_mllib_tpu.telemetry import fleet, flightrec  # noqa: E402
+
+import oaptrace  # noqa: E402
+
+
+def _source(rows=2000, d=8, chunk=500, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(rows, d)).astype(np.float32)
+
+    def gen():
+        for lo in range(0, rows, chunk):
+            yield x[lo:lo + chunk]
+
+    return ChunkSource(gen, d, chunk, n_rows=rows)
+
+
+# -- leg 1 + 4 setup: one armed streamed fit -----------------------------------
+
+print("== fleet gate: live endpoint + armed streamed fit ==")
+sink = os.path.join(tempfile.mkdtemp(), "fleet.jsonl")
+port = free_port("127.0.0.1", 9300)
+set_config(
+    fleet_stats="on", flight_recorder=256, metrics_port=port,
+    telemetry_log=sink,
+)
+m = KMeans(k=4, seed=0, init_mode="random", max_iter=4, tol=0.0).fit(
+    _source()
+)
+block = m.summary.fleet
+check(block.get("enabled") and block.get("passes", 0) >= 4,
+      f"fleet block missing or empty: {block}")
+
+mtxt = urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/metrics", timeout=10
+).read().decode()
+fleet_lines = [ln for ln in mtxt.splitlines()
+               if ln.startswith("oap_fleet_")]
+check(len(fleet_lines) > 20,
+      f"/metrics carries too few oap_fleet_* samples: {len(fleet_lines)}")
+# promtext sanity: every non-comment line is "name{labels} value"
+for ln in mtxt.splitlines():
+    if not ln or ln.startswith("#"):
+        continue
+    parts = ln.rsplit(" ", 1)
+    ok = len(parts) == 2
+    if ok:
+        try:
+            float(parts[1].replace("+Inf", "inf"))
+        except ValueError:
+            ok = False
+    if not ok:
+        check(False, f"/metrics line does not parse: {ln!r}")
+        break
+
+hz = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/healthz", timeout=10
+).read())
+check(hz.get("ok") is True and hz.get("fit") == "kmeans.fit",
+      f"/healthz payload wrong: {hz}")
+check(hz.get("flight_recorder_seq", -1) >= 0,
+      f"/healthz missing recorder seq: {hz}")
+print(f"  /metrics: {len(fleet_lines)} oap_fleet_* samples; "
+      f"/healthz fit={hz.get('fit')} step={hz.get('step')}")
+
+# -- leg 2: rollup correctness (hand-fold) -------------------------------------
+
+print("== fleet gate: rollup fold equals a numpy hand-fold ==")
+rng = np.random.default_rng(7)
+frames = rng.random((8, len(fleet.FRAME_FIELDS))) + 0.1
+rec = fleet.fold_pass("gate_pass", frames)
+for i, f in enumerate(fleet.FRAME_FIELDS):
+    col = frames[:, i]
+    hand = {
+        "min": float(col.min()), "max": float(col.max()),
+        "mean": float(col.mean()), "p99": float(np.percentile(col, 99)),
+    }
+    got = rec["fields"][f]
+    check(
+        all(abs(hand[s] - got[s]) < 1e-12 for s in hand),
+        f"fold of {f} != hand-fold: {got} vs {hand}",
+    )
+walls = frames[:, 0]
+check(rec["slowest_rank"] == int(np.argmax(walls)),
+      f"slowest_rank {rec['slowest_rank']} != argmax {np.argmax(walls)}")
+check(abs(rec["skew_ratio"] - walls.max() / walls.mean()) < 1e-12,
+      "skew_ratio != max/mean of pass walls")
+
+# -- leg 3: straggler analytics ------------------------------------------------
+
+print("== fleet gate: a delayed rank folds to skew > 1.5 naming it ==")
+fleet._reset_for_tests()
+slow = np.ones((2, len(fleet.FRAME_FIELDS)))
+slow[1, 0] = 4.0  # rank 1's pass wall is 4x rank 0's
+for _ in range(3):
+    rec = fleet.fold_pass("lloyd_loop", slow)
+check(rec["skew_ratio"] > 1.5 and rec["slowest_rank"] == 1,
+      f"skewed fold wrong: {rec['skew_ratio']:.2f} rank "
+      f"{rec['slowest_rank']}")
+blk = fleet.summary_block()
+check(blk["slowest_rank"] == 1 and blk["fit_skew_ratio"] > 1.5,
+      f"summary block misses the straggler: {blk}")
+fleet._reset_for_tests()
+
+print("== fleet gate: 2-process pseudo-cluster legs (skip if the host "
+      "cannot form multiprocess worlds) ==")
+proc = subprocess.run(
+    [sys.executable, "-m", "pytest",
+     "tests/test_pseudo_cluster.py::TestFleetObservability", "-q",
+     "-p", "no:cacheprovider"],
+    cwd=ROOT, capture_output=True, text=True, timeout=900,
+)
+print(proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "")
+check(proc.returncode == 0,
+      f"pseudo-cluster fleet legs failed:\n{proc.stdout[-2000:]}")
+
+# -- leg 4: merged timeline validates against the trace-event schema -----------
+
+print("== fleet gate: oaptrace output validates (Chrome trace schema) ==")
+trace_out = os.path.join(tempfile.mkdtemp(), "trace.json")
+rc = oaptrace.main([sink, "-o", trace_out])
+check(rc == 0, f"oaptrace exited {rc}")
+with open(trace_out) as f:
+    trace = json.load(f)
+problems = oaptrace.validate_trace(trace)
+check(problems == [], f"trace schema problems: {problems[:5]}")
+check(trace["otherData"]["mode"] == "recorder",
+      f"expected recorder-mode timeline, got {trace['otherData']}")
+spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+check(len(spans) > 0, "merged timeline has no span slices")
+print(f"  {len(trace['traceEvents'])} events, {len(spans)} slices, "
+      f"mode={trace['otherData']['mode']}")
+
+# -- leg 5: disarmed seam ------------------------------------------------------
+
+print("== fleet gate: disarmed seam on the 20-fit microbench ==")
+fleet.stop_server()
+set_config(fleet_stats="off", flight_recorder=0, metrics_port=0,
+           telemetry_log="")
+xs = np.random.default_rng(0).normal(size=(128, 8)).astype(np.float32)
+KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(xs)  # warm
+t0 = time.perf_counter()
+for _ in range(20):
+    KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(xs)
+fit_wall = time.perf_counter() - t0
+
+# the disarmed path per fit: a few armed() / flightrec.enabled() config
+# checks (pass boundaries, span entries, finalize hook).  Price 100 seam
+# touches per fit — an overestimate — 2000 times, and scale to 20 fits.
+reps = 2000
+world = 1
+t0 = time.perf_counter()
+for _ in range(reps):
+    for _ in range(100):
+        flightrec.enabled()
+        fleet.armed(world)
+    fleet.finalize_fit(None, None)
+seam_wall = (time.perf_counter() - t0) * (20.0 / reps)
+pct = 100.0 * seam_wall / fit_wall
+print(f"  20-fit wall {fit_wall*1e3:.1f} ms; disarmed seam cost "
+      f"{seam_wall*1e3:.3f} ms (~{pct:.2f}%)")
+check(seam_wall < max(0.01 * fit_wall, 0.005),
+      f"disarmed fleet seam measurable: {seam_wall:.4f}s vs "
+      f"{fit_wall:.4f}s fit wall")
+
+if failures:
+    print(f"\nfleet gate: {len(failures)} failure(s)")
+    sys.exit(1)
+print("\nfleet gate: OK")

@@ -336,6 +336,39 @@ class Config:
     # (telemetry/export.py; docs/observability.md).  Empty = off (the
     # near-zero-overhead default: no file is ever opened).
     telemetry_log: str = ""
+    # -- fleet observability control plane (telemetry/fleet.py,
+    #    telemetry/flightrec.py) --------------------------------------------
+    # Live metrics exposition port: > 0 starts one stdlib http.server
+    # daemon thread per rank serving GET /metrics (the Prometheus text
+    # exposition of the process registry) and GET /healthz (fit root,
+    # step, last-collective fingerprint, resilience ladder state) on
+    # port metrics_port + process_id — every rank of a co-hosted
+    # pseudo-cluster world gets its own scrape surface.  0 (default) =
+    # no server, zero overhead; negative raises.
+    metrics_port: int = 0
+    # Cross-rank fleet rollups: "auto" (default) arms per-pass rollups
+    # only in multi-process worlds (single-process fits pay one config
+    # check); "on" arms them everywhere (a 1-rank world folds its own
+    # frame — useful for tests and single-host dashboards); "off"
+    # disarms them.  Armed, every streamed pass allgathers one
+    # fixed-shape per-rank stat frame (pass wall, stage/transfer/compute
+    # split, bytes staged, retries, kernel dispatch wall) over the host
+    # collective plane (deadline-watchdog guarded), folds it into
+    # oap_fleet_* gauges/histograms on rank 0, and lands a `fleet` block
+    # (slowest rank, skew ratio, imbalance trend) in the fit summary.
+    # A typo raises.
+    fleet_stats: str = "auto"
+    # Flight recorder ring size, in event slots: > 0 arms a
+    # constant-memory per-rank ring buffer (telemetry/flightrec.py) of
+    # recent events — span open/close, host-collective dispatch
+    # fingerprints, fault/retry/degradation events, checkpoint commits —
+    # each stamped with a monotonic seq.  Crash records
+    # (utils/recovery.py) embed the tail, so every post-mortem shows the
+    # last N events on every rank; the JSONL telemetry sink drains new
+    # events at each fit finalization (dev/oaptrace.py merges them into
+    # a Perfetto-loadable timeline).  0 (default) = off, one config
+    # check per would-be event; negative raises.
+    flight_recorder: int = 0
 
     @classmethod
     def from_env(cls) -> "Config":

@@ -51,6 +51,7 @@ import threading
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.telemetry import flightrec
 from oap_mllib_tpu.telemetry import metrics as _tm
 from oap_mllib_tpu.utils import sanitizers
 from oap_mllib_tpu.utils.faults import maybe_fault
@@ -413,9 +414,20 @@ class Prefetcher:
             sanitizers.RetraceWatch("prefetch")
             if sanitizers.enabled("retrace") else None
         )
+        # flight recorder (telemetry/flightrec.py): one "chunk" event per
+        # consumed chunk when armed, so a post-mortem tail shows how far
+        # into a pass each rank got.  Off = one config check per pass.
+        if flightrec.enabled():
+            it = self._recorded(it)
         if not guard and watch is None:
             return it
         return self._sanitized(it, guard, watch)
+
+    @staticmethod
+    def _recorded(it):
+        for i, item in enumerate(it):
+            flightrec.record("chunk", "prefetch", f"#{i}")
+            yield item
 
     @staticmethod
     def _sanitized(it, guard: bool, watch):

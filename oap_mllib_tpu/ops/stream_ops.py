@@ -33,6 +33,7 @@ import numpy as np
 from oap_mllib_tpu.data.prefetch import Prefetcher, PrefetchStats
 from oap_mllib_tpu.data.stream import ChunkSource
 from oap_mllib_tpu.ops import kmeans_ops
+from oap_mllib_tpu.telemetry import fleet, flightrec
 from oap_mllib_tpu.utils import faults
 from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
@@ -197,6 +198,14 @@ def _gather_with_guard(arrays, guard: "_PassGuard | None"):
     # never arrives converts this from a hang into a
     # CollectiveTimeoutError on every survivor
     faults.maybe_fault("collective.dispatch")
+    if flightrec.enabled():
+        # dispatch fingerprint into the event ring BEFORE the
+        # cross-check/gather — the seq a divergence diagnosis or a
+        # timeout post-mortem points at (telemetry/flightrec.py)
+        flightrec.record(
+            "collective", "process_allgather",
+            "|".join(str(tuple(np.shape(a))) for a in arrays),
+        )
     # collective sanitizer seam: the signature (payload shapes + dtypes)
     # is fingerprinted and cross-checked across ranks before the gather —
     # a rank arriving here with a divergent payload raises on every rank
@@ -282,6 +291,10 @@ def _ring_reduce_f32(arrays, mesh, axis: str):
     cols = max(1, -(-total // d_ax))
     buf = np.zeros((d_ax, cols), np.float32)
     buf.ravel()[:total] = flat
+    if flightrec.enabled():
+        flightrec.record(
+            "collective", "ring_allreduce", f"{axis}|({d_ax},{cols})"
+        )
     sanitizers.note_collective(
         "ring_allreduce", axis, (d_ax, cols), "float32"
     )
@@ -358,6 +371,27 @@ def _allgather_host(arrays, guard: "_PassGuard | None" = None):
     if gathered is None:
         return [a[None] for a in arrays]
     return gathered
+
+
+def _fleet_pass(phase: str, stats: PrefetchStats, pass_wall_s: float,
+                timings=None) -> None:
+    """Fleet rollup seam (telemetry/fleet.py, ISSUE 11): after a pass's
+    reduction succeeded on every rank, allgather one FIXED-shape
+    per-rank stat frame over the same host-collective plane (so the
+    rollup inherits the deadline watchdog and the collective
+    sanitizer's fingerprinting) and fold it into the ``oap_fleet_*``
+    metrics + the per-fit fleet window.  Disarmed
+    (``Config.fleet_stats``) this is one config check; armed, the
+    decision is a pure function of (config, world) so every rank
+    issues the identical extra collective."""
+    if not fleet.armed(_world()):
+        return
+    elapsed = tick()
+    frame = fleet.local_frame(stats, pass_wall_s)
+    (gathered,) = _allgather_host([frame])
+    fleet.fold_pass(phase, gathered)
+    if timings is not None:
+        timings.add("fleet", elapsed())
 
 
 def _checked_entry(validate) -> None:
@@ -459,8 +493,11 @@ def streamed_accumulate(
                         sums, counts, cost, cj, wj, centers, precision,
                         need_cost, policy,
                     )
-    stats.finalize(timings, phase, elapsed())
-    return _psum_host([sums, counts, cost], guard=guard)
+    pass_wall = elapsed()
+    stats.finalize(timings, phase, pass_wall)
+    out = _psum_host([sums, counts, cost], guard=guard)
+    _fleet_pass(phase, stats, pass_wall, timings)
+    return out
 
 
 @jax.jit
@@ -988,10 +1025,12 @@ def covariance_streamed(
                     else:
                         total = _colsum_chunk(total, cj, wj)
                 n += n_valid
-        stats.finalize(timings, "covariance_streamed", elapsed())
+        pass_wall = elapsed()
+        stats.finalize(timings, "covariance_streamed", pass_wall)
         total, n_arr = _psum_host(
             [total, np.asarray([n], np.int64)], guard=guard
         )
+        _fleet_pass("covariance_streamed", stats, pass_wall, timings)
         # per-pass guardrails (Config.nonfinite_policy): an overflowed
         # f32 column sum or Gram silently yields Inf/NaN eigenvectors
         # passes later
@@ -1028,8 +1067,10 @@ def covariance_streamed(
                     )
                 else:
                     gram = _gram_chunk(gram, cj, wj, mean, precision, policy)
-    stats.finalize(timings, "covariance_streamed", elapsed())
+    pass_wall = elapsed()
+    stats.finalize(timings, "covariance_streamed", pass_wall)
     (gram,) = _psum_host([gram], guard=guard)
+    _fleet_pass("covariance_streamed", stats, pass_wall, timings)
     check_finite(gram, "PCA Gram accumulator (streamed Gram pass)")
     cov = gram.astype(np.float64 if dtype == np.float64 else np.float32)
     cov = cov / max(n - 1.0, 1.0)

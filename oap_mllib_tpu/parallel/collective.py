@@ -33,6 +33,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.telemetry import flightrec
 from oap_mllib_tpu.telemetry import metrics as _tm
 from oap_mllib_tpu.telemetry.spans import current_span
 from oap_mllib_tpu.utils import faults, recovery, sanitizers
@@ -87,6 +88,15 @@ def _instrumented(op: str, x: jax.Array, dispatch):
     faults.maybe_fault("collective.dispatch")
     nbytes = _payload_bytes(x)
     axis = get_config().data_axis
+    if flightrec.enabled():
+        # the dispatch fingerprint lands in the event ring BEFORE the
+        # cross-check/dispatch, so a divergence diagnosis or a timeout
+        # post-mortem can point at this exact event's seq
+        flightrec.record(
+            "collective", op,
+            f"{axis}|{tuple(getattr(x, 'shape', ()))}"
+            f"|{getattr(x, 'dtype', '')}",
+        )
     sanitizers.note_collective(
         op, axis, getattr(x, "shape", ()), getattr(x, "dtype", ""),
     )

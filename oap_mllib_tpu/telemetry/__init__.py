@@ -20,7 +20,7 @@ Entry points::
     set_config(telemetry_log="/tmp/fits.jsonl")   # arm the JSONL sink
 """
 
-from oap_mllib_tpu.telemetry import metrics
+from oap_mllib_tpu.telemetry import fleet, flightrec, metrics
 from oap_mllib_tpu.telemetry.export import (
     emit_fit,
     finalize_fit,
@@ -39,6 +39,8 @@ __all__ = [
     "emit_fit",
     "enter",
     "finalize_fit",
+    "fleet",
+    "flightrec",
     "metrics",
     "render_prometheus",
     "report",

@@ -112,6 +112,21 @@ def emit_fit(root: Span) -> None:
         if sp.attrs:
             rec["attrs"] = sp.attrs
         records.append(rec)
+    # flight-recorder drain (telemetry/flightrec.py): the events recorded
+    # since the last fit's drain ride the sink as one batch, so
+    # dev/oaptrace.py can rebuild a real per-rank timeline (span
+    # open/close walls + collective fingerprints) and align ranks
+    from oap_mllib_tpu.telemetry import flightrec
+
+    events = flightrec.drain_new()
+    if events:
+        records.append({
+            "type": "flightrec",
+            "fit": root.name,
+            "rank": rank,
+            "seq": next(_seq),
+            "events": events,
+        })
     records.append({
         "type": "metrics",
         "fit": root.name,
@@ -155,6 +170,13 @@ def finalize_fit(summary) -> None:
     root = timings.root
     if root.count == 0:
         root.duration_s = sum(c.duration_s for c in root.children)
+    # fleet fit-boundary hook (telemetry/fleet.py): land the fleet block
+    # + fleet span attrs, refresh /healthz state, and (metrics_port
+    # armed) make sure the live endpoint is up.  One config check each
+    # when the control plane is disarmed.
+    from oap_mllib_tpu.telemetry import fleet as _fleet
+
+    _fleet.finalize_fit(summary, root)
     _metrics.counter(
         "oap_fit_total", {"fit": root.name},
         help="Completed fits by root span name",

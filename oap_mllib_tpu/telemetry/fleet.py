@@ -1,0 +1,467 @@
+"""Fleet observability control plane: live endpoints + cross-rank
+rollups with straggler analytics.
+
+The per-rank telemetry plane (spans + metrics registry + JSONL sink) is
+rich but strictly *local* and mostly *post-hoc*: nothing answers the
+operator's fleet-shaped questions — "which rank is slow", "how skewed is
+the world", "is the imbalance getting worse" — while a fit is running.
+This module closes both gaps, in the stack's own idiom (fleet rollups
+are one more map-reduce over per-rank state — the DrJAX primitive
+decomposition, PAPERS.md arXiv:2403.07128):
+
+- **Live exposition** (``Config.metrics_port`` > 0): one stdlib
+  ``http.server`` daemon thread per rank on port
+  ``metrics_port + process_id`` serving ``GET /metrics`` (the
+  Prometheus text exposition of the process registry — scrape it
+  mid-fit) and ``GET /healthz`` (fit root, step, resilience ladder
+  state, last-completed collective fingerprint, flight-recorder seq).
+
+- **Fleet rollups** (``Config.fleet_stats``): at per-pass granularity,
+  every streamed pass allgathers one FIXED-shape per-rank stat frame
+  (:data:`FRAME_FIELDS`: pass wall, stage/transfer/compute split, bytes
+  staged, retries, kernel dispatch wall) over the existing host
+  collective plane — so the rollup inherits the deadline watchdog
+  (utils/recovery.py) and the collective sanitizer's fingerprinting for
+  free, and rank-uniformity is by construction (the decision to collect
+  is a pure function of config + world size).  Rank 0 folds the frames
+  into ``oap_fleet_*`` gauges/histograms (min/max/mean/p99 across ranks
+  per field, a skew ratio, the slowest rank); every rank lands a
+  ``fleet`` block (slowest rank, skew ratio, imbalance trend) in the
+  fit summary plus a ``fleet`` child span — the measurement layer the
+  ROADMAP's straggler detector (item 5) and serving SLOs (item 1)
+  presuppose.
+
+The collection seam lives in ops/stream_ops.py (it owns the pass
+structure and the sanctioned ``_allgather_host``); this module is pure
+fold + exposition and issues no collectives itself.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.telemetry import metrics as _tm
+
+log = logging.getLogger("oap_mllib_tpu")
+
+# The fixed per-rank stat frame, one float64 per field.  Walls are
+# per-pass; bytes are the pass's staged payload; retries and kernel
+# dispatch wall are this rank's process-cumulative totals as of the
+# pass (a straggling rank shows a growing gap, which is the signal).
+FRAME_FIELDS = (
+    "pass_wall_s",
+    "stage_s",
+    "transfer_s",
+    "compute_s",
+    "bytes_staged",
+    "retries",
+    "kernel_dispatch_s",
+)
+
+# metric family per frame field (Prometheus naming: unit suffixes)
+_FIELD_METRICS = {
+    "pass_wall_s": "oap_fleet_pass_seconds",
+    "stage_s": "oap_fleet_stage_seconds",
+    "transfer_s": "oap_fleet_transfer_seconds",
+    "compute_s": "oap_fleet_compute_seconds",
+    "bytes_staged": "oap_fleet_bytes_staged",
+    "retries": "oap_fleet_retries",
+    "kernel_dispatch_s": "oap_fleet_kernel_dispatch_seconds",
+}
+
+_STATS = ("min", "max", "mean", "p99")
+
+# rollup history kept per fit for the summary block; passes beyond the
+# cap fold into the running aggregates but drop their raw frames (the
+# constant-memory contract, like the flight recorder)
+_WINDOW_CAP = 512
+
+
+def fleet_stats_cfg(cfg=None) -> str:
+    """Validated ``Config.fleet_stats`` — a typo must raise, not
+    silently disarm (the kmeans_kernel/fault_spec contract)."""
+    cfg = cfg or get_config()
+    mode = cfg.fleet_stats
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(
+            f"fleet_stats must be auto|on|off, got {mode!r}"
+        )
+    return mode
+
+
+def metrics_port_cfg(cfg=None) -> int:
+    """Validated ``Config.metrics_port`` — negative must raise."""
+    cfg = cfg or get_config()
+    port = int(cfg.metrics_port)
+    if port < 0:
+        raise ValueError(
+            f"metrics_port must be >= 0 (0 = no live endpoint), got {port}"
+        )
+    return port
+
+
+def armed(world: int, cfg=None) -> bool:
+    """Should this fit collect per-pass fleet rollups?  A pure function
+    of (config, world size) so every rank decides identically — the
+    rank-uniform-collective contract."""
+    mode = fleet_stats_cfg(cfg)
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return world > 1
+
+
+def _rank() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def local_frame(stats, pass_wall_s: float) -> np.ndarray:
+    """This rank's stat frame for one finished pass, from the pass's
+    PrefetchStats + the process registry — shape ``(len(FRAME_FIELDS),)``
+    float64, identical on every rank by construction."""
+    reg = _tm.registry()
+    return np.asarray(
+        [
+            float(pass_wall_s),
+            float(stats.stage_s),
+            float(stats.transfer_s),
+            max(float(pass_wall_s) - float(stats.wait_s), 0.0),
+            float(stats.bytes_staged),
+            reg.family_total("oap_resilience_retries_total"),
+            reg.family_total("oap_kernel_dispatch_seconds"),
+        ],
+        np.float64,
+    )
+
+
+# -- per-fit rollup state ------------------------------------------------------
+
+_state_lock = threading.Lock()
+_window: List[Dict[str, Any]] = []  # per-pass {phase, frames(list), skew}
+_passes = 0
+_rank_wall_totals: Optional[np.ndarray] = None  # per-rank summed pass walls
+_health: Dict[str, Any] = {"fit": "", "step": 0, "ladder": "", "phase": ""}
+
+
+def note_state(**kw) -> None:
+    """Merge fields into the /healthz state (fit root, ladder, ...)."""
+    with _state_lock:
+        _health.update(kw)
+
+
+def fold_pass(phase: str, frames: np.ndarray) -> Dict[str, Any]:
+    """Fold one pass's gathered frames (``(world, len(FRAME_FIELDS))``)
+    into the fleet metrics (rank 0) and the per-fit window (every rank —
+    the data is identical everywhere, only the metric booking is
+    deduplicated).  Returns the per-pass stats dict (tests + gate)."""
+    frames = np.asarray(frames, np.float64)
+    if frames.ndim != 2 or frames.shape[1] != len(FRAME_FIELDS):
+        raise ValueError(
+            f"fleet frame shape {frames.shape} != (world, "
+            f"{len(FRAME_FIELDS)})"
+        )
+    world = frames.shape[0]
+    walls = frames[:, 0]
+    mean_wall = float(walls.mean())
+    skew = float(walls.max() / mean_wall) if mean_wall > 0 else 1.0
+    slowest = int(np.argmax(walls))
+    per_field = {
+        f: {
+            "min": float(frames[:, i].min()),
+            "max": float(frames[:, i].max()),
+            "mean": float(frames[:, i].mean()),
+            "p99": float(np.percentile(frames[:, i], 99)),
+        }
+        for i, f in enumerate(FRAME_FIELDS)
+    }
+    rec = {
+        "phase": phase,
+        "world": world,
+        "skew_ratio": skew,
+        "slowest_rank": slowest,
+        "frames": frames.tolist(),
+        "fields": per_field,
+    }
+    global _passes, _rank_wall_totals
+    with _state_lock:
+        _passes += 1
+        if _rank_wall_totals is None or len(_rank_wall_totals) != world:
+            _rank_wall_totals = np.zeros((world,), np.float64)
+        _rank_wall_totals += walls
+        if len(_window) < _WINDOW_CAP:
+            _window.append(rec)
+        _health["step"] = _passes
+        _health["phase"] = phase
+    if _rank() == 0:
+        for i, f in enumerate(FRAME_FIELDS):
+            fam = _FIELD_METRICS[f]
+            for stat in _STATS:
+                _tm.gauge(
+                    fam, {"stat": stat},
+                    help=f"Fleet rollup of per-rank {f} (last pass, "
+                         "across ranks)",
+                ).set(per_field[f][stat])
+        _tm.gauge(
+            "oap_fleet_skew_ratio",
+            help="Max/mean per-rank pass wall of the last rolled-up pass",
+        ).set(skew)
+        _tm.gauge(
+            "oap_fleet_slowest_rank",
+            help="Rank with the largest pass wall in the last rollup",
+        ).set(slowest)
+        _tm.counter(
+            "oap_fleet_passes_total",
+            help="Streamed passes folded into fleet rollups",
+        ).inc()
+        hist = _tm.histogram(
+            "oap_fleet_pass_wall_seconds",
+            help="Per-rank pass walls observed by fleet rollups",
+        )
+        for w in walls:
+            hist.observe(float(w))
+    maybe_serve()
+    return rec
+
+
+def _trend(skews: List[float]) -> str:
+    """Imbalance trend over a fit's passes: compare the mean skew of the
+    first and second halves — "rising" means the world is drifting
+    apart (a cold-cache relaunch warming up reads "falling")."""
+    if len(skews) < 4:
+        return "flat"
+    half = len(skews) // 2
+    first = float(np.mean(skews[:half]))
+    second = float(np.mean(skews[half:]))
+    if first <= 0:
+        return "flat"
+    ratio = second / first
+    if ratio > 1.1:
+        return "rising"
+    if ratio < 0.9:
+        return "falling"
+    return "flat"
+
+
+def summary_block() -> Optional[Dict[str, Any]]:
+    """The per-fit ``fleet`` block, or None when no pass was rolled up
+    (disarmed, or a fit with no streamed passes)."""
+    with _state_lock:
+        if _passes == 0:
+            return None
+        window = list(_window)
+        passes = _passes
+        totals = (
+            None if _rank_wall_totals is None
+            else np.array(_rank_wall_totals)
+        )
+    world = window[-1]["world"] if window else 1
+    skews = [w["skew_ratio"] for w in window]
+    block: Dict[str, Any] = {
+        "world": world,
+        "passes": passes,
+        "skew_ratio": skews[-1] if skews else 1.0,
+        "imbalance_trend": _trend(skews),
+        "window_truncated": passes > len(window),
+    }
+    if totals is not None and len(totals) == world:
+        mean = float(totals.mean())
+        block["slowest_rank"] = int(np.argmax(totals))
+        block["per_rank_pass_s"] = [round(float(t), 6) for t in totals]
+        block["fit_skew_ratio"] = (
+            float(totals.max() / mean) if mean > 0 else 1.0
+        )
+    return block
+
+
+def last_window() -> List[Dict[str, Any]]:
+    """The current fit's per-pass rollup records (tests + gate)."""
+    with _state_lock:
+        return list(_window)
+
+
+def finalize_fit(summary, root) -> None:
+    """Fit-boundary hook (telemetry/export.finalize_fit): land the
+    ``fleet`` block in the summary + a ``fleet`` child span under the
+    root carrying the straggler analytics, then reset the per-fit
+    window.  One config check when the plane is disarmed."""
+    cfg = get_config()
+    try:
+        import jax
+
+        world = jax.process_count()
+    except Exception:  # noqa: BLE001 — exposition must not kill a fit
+        world = 1
+    if cfg.metrics_port:
+        maybe_serve(cfg)
+    if not armed(world, cfg):
+        return
+    block = summary_block()
+    _reset_fit_window()
+    if summary is None:
+        return
+    if block is None:
+        block = {"world": world, "passes": 0}
+    block = dict(block, enabled=True)
+    if isinstance(summary, dict):
+        summary["fleet"] = block
+    else:
+        summary.fleet = block
+    if root is not None:
+        attrs = {
+            k: block[k]
+            for k in ("world", "passes", "skew_ratio", "slowest_rank",
+                      "imbalance_trend", "fit_skew_ratio")
+            if k in block
+        }
+        root.node("fleet").attrs.update(attrs)
+    ladder = None
+    res = (
+        summary.get("resilience") if isinstance(summary, dict)
+        else getattr(summary, "resilience", None)
+    )
+    if isinstance(res, dict):
+        ladder = res.get("ladder")
+    note_state(
+        fit=getattr(root, "name", "") if root is not None else "",
+        ladder=ladder or "",
+    )
+
+
+def _reset_fit_window() -> None:
+    global _passes, _rank_wall_totals
+    with _state_lock:
+        _window.clear()
+        _passes = 0
+        _rank_wall_totals = None
+
+
+# -- live exposition (stdlib http.server, one daemon thread per rank) ---------
+
+_server_lock = threading.Lock()
+_server: Optional[http.server.ThreadingHTTPServer] = None
+_server_port: Optional[int] = None
+_failed_ports: set = set()
+
+
+def _healthz_payload() -> Dict[str, Any]:
+    from oap_mllib_tpu.telemetry import flightrec
+    from oap_mllib_tpu.utils import recovery
+
+    cfg = get_config()
+    with _state_lock:
+        health = dict(_health)
+    return {
+        "ok": True,
+        "rank": int(cfg.process_id),
+        "world": int(cfg.num_processes),
+        "fit": health.get("fit", ""),
+        "phase": health.get("phase", ""),
+        "step": health.get("step", 0),
+        "ladder": health.get("ladder", ""),
+        "last_collective": recovery.last_completed(),
+        "flight_recorder_seq": flightrec.last_seq(),
+        "fleet_passes": health.get("step", 0),
+    }
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — stdlib handler contract
+        if self.path.split("?")[0] == "/metrics":
+            body = _tm.render_prometheus().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif self.path.split("?")[0] == "/healthz":
+            body = (json.dumps(_healthz_payload(), sort_keys=True)
+                    + "\n").encode()
+            ctype = "application/json"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # noqa: D102 — silence stdlib logs
+        pass
+
+
+def maybe_serve(cfg=None) -> Optional[int]:
+    """Start (once) the per-rank metrics endpoint when
+    ``Config.metrics_port`` > 0; returns the bound port or None.  The
+    rank offsets the port (``metrics_port + process_id``) so co-hosted
+    pseudo-cluster ranks each get their own scrape surface.  A bind
+    failure warns once per port and never fails the fit."""
+    global _server, _server_port
+    cfg = cfg or get_config()
+    base = metrics_port_cfg(cfg)
+    if base == 0:
+        return None
+    port = base + int(cfg.process_id)
+    with _server_lock:
+        if _server is not None and _server_port == port:
+            return port
+        if port in _failed_ports:
+            return None
+        if _server is not None:
+            _shutdown_locked()
+        try:
+            srv = http.server.ThreadingHTTPServer(("", port), _Handler)
+        except OSError as e:
+            _failed_ports.add(port)
+            log.warning(
+                "fleet: metrics endpoint bind failed on port %d (%s); "
+                "live exposition disabled for this port", port, e,
+            )
+            return None
+        srv.daemon_threads = True
+        thread = threading.Thread(
+            target=srv.serve_forever, daemon=True,
+            name=f"oap-metrics-{port}",
+        )
+        thread.start()
+        _server, _server_port = srv, port
+    log.info("fleet: serving /metrics and /healthz on port %d", port)
+    return port
+
+
+def server_port() -> Optional[int]:
+    with _server_lock:
+        return _server_port
+
+
+def _shutdown_locked() -> None:
+    global _server, _server_port
+    if _server is not None:
+        try:
+            _server.shutdown()
+            _server.server_close()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+    _server, _server_port = None, None
+
+
+def stop_server() -> None:
+    """Tear down the live endpoint (tests; atexit is unnecessary — the
+    serving thread is a daemon)."""
+    with _server_lock:
+        _shutdown_locked()
+    _failed_ports.clear()
+
+
+def _reset_for_tests() -> None:
+    stop_server()
+    _reset_fit_window()
+    with _state_lock:
+        _health.update({"fit": "", "step": 0, "ladder": "", "phase": ""})

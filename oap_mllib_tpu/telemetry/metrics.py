@@ -121,8 +121,25 @@ class Registry:
             m = self._metrics.get(key)
             if m is None:
                 m = self._metrics[key] = make()
-                self._meta.setdefault(name, (kind, help_))
+            # a family registered help-less at one call site must still
+            # pick up the help a richer site supplies later — every
+            # family then renders with a real # HELP line
+            if prev is None or (not prev[1] and help_):
+                self._meta[name] = (kind, help_)
             return m
+
+    def family_total(self, name: str) -> float:
+        """Sum of one family's values across all its label sets
+        (histograms contribute their observation ``sum``); 0.0 for an
+        unregistered family.  The cheap cross-label read the fleet
+        rollup frames use (telemetry/fleet.local_frame)."""
+        with _LOCK:
+            total = 0.0
+            for (n, _), m in self._metrics.items():
+                if n != name:
+                    continue
+                total += m.sum if isinstance(m, Histogram) else m.value
+            return total
 
     def counter(self, name: str, labels: Optional[Dict[str, str]] = None,
                 help: str = "") -> Counter:
@@ -179,8 +196,14 @@ class Registry:
                 if name not in seen_meta:
                     seen_meta.add(name)
                     kind, help_ = self._meta.get(name, ("untyped", ""))
-                    if help_:
-                        lines.append(f"# HELP {name} {help_}")
+                    # the promtext spec wants one # HELP + # TYPE per
+                    # family; families registered without help get a
+                    # self-naming fallback so scrapers never see a bare
+                    # family (tests/test_telemetry.py round-trips this)
+                    lines.append(
+                        f"# HELP {name} "
+                        f"{_escape_help(help_ or name)}"
+                    )
                     lines.append(f"# TYPE {name} {kind}")
                 lab = _render_labels(labels)
                 if isinstance(m, Histogram):
@@ -218,8 +241,20 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+def _escape_help(text: str) -> str:
+    """Promtext HELP escaping: backslash and newline only."""
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _escape_label(value: str) -> str:
+    """Promtext label-value escaping: backslash, double-quote, newline."""
+    return (
+        value.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+    )
+
+
 def _render_labels(labels: LabelSet, le: Optional[str] = None) -> str:
-    parts = [f'{k}="{v}"' for k, v in labels]
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in labels]
     if le is not None:
         parts.append(f'le="{le}"')
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -248,6 +283,10 @@ def histogram(name: str, labels: Optional[Dict[str, str]] = None,
               bounds: Tuple[float, ...] = DURATION_BUCKETS,
               help: str = "") -> Histogram:
     return _REGISTRY.histogram(name, labels, bounds, help)
+
+
+def family_total(name: str) -> float:
+    return _REGISTRY.family_total(name)
 
 
 def snapshot() -> Dict[str, Any]:

@@ -153,7 +153,12 @@ def enter(span: Span, annotate: bool = True):
     """Time one entry of ``span``: push it as the thread's active span,
     record the monotonic wall on exit, and — only when a jax.profiler
     trace is running (one bool check) — emit a TraceAnnotation so the
-    span shows up on the XProf timeline under the same name."""
+    span shows up on the XProf timeline under the same name.  With the
+    flight recorder armed (telemetry/flightrec.py — one config check
+    when off), span open/close land in the event ring so post-mortems
+    and merged timelines see which phases were in flight."""
+    from oap_mllib_tpu.telemetry import flightrec
+
     stack = getattr(_tls, "stack", None)
     if stack is None:
         stack = _tls.stack = []
@@ -167,11 +172,16 @@ def enter(span: Span, annotate: bool = True):
 
             ann = jax.profiler.TraceAnnotation(span.name)
             ann.__enter__()
+    if flightrec.enabled():
+        flightrec.record("span_open", span.name)
     t0 = time.perf_counter()
     try:
         yield span
     finally:
-        span.record(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        span.record(dt)
+        if flightrec.enabled():
+            flightrec.record("span_close", span.name, f"{dt:.6f}s")
         if ann is not None:
             ann.__exit__(None, None, None)
         stack.pop()

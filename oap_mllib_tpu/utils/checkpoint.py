@@ -64,6 +64,7 @@ import numpy as np
 
 from oap_mllib_tpu.config import get_config
 from oap_mllib_tpu.data import io as _io
+from oap_mllib_tpu.telemetry import flightrec
 from oap_mllib_tpu.telemetry import metrics as _tm
 from oap_mllib_tpu.utils import faults
 from oap_mllib_tpu.utils.timing import phase_timer, tick, x64_scope
@@ -402,6 +403,11 @@ class Checkpointer:
         self.write_s += dt
         self.last_step = step
         _note_durable(step)
+        if flightrec.enabled():
+            # the commit (manifest flip agreed world-wide) is the event a
+            # post-mortem aligns against — "the crash was N events after
+            # the last durable step" (telemetry/flightrec.py)
+            flightrec.record("ckpt_commit", self.algo, f"step={step}")
         _tm.counter(
             "oap_checkpoint_writes_total", {"algo": self.algo},
             help="Checkpoint shard writes that landed durably",

@@ -52,7 +52,11 @@ from oap_mllib_tpu.telemetry.spans import current_span
 
 log = logging.getLogger("oap_mllib_tpu")
 
-CRASH_RECORD_VERSION = 1
+# v2 (ISSUE 11): records gained the ``flight_recorder`` field — the
+# tail of the per-rank event ring (telemetry/flightrec.py, [] when the
+# recorder is off), so every post-mortem shows the last N events on
+# every rank, not just a final snapshot.
+CRASH_RECORD_VERSION = 2
 _CRASH_PREFIX = "crash.rank"
 
 # sideband poll cadence while blocked inside a guarded dispatch: fast
@@ -174,8 +178,13 @@ def write_crash_record(site: str, fault_class: str, error: str, *,
         return None
     try:
         from oap_mllib_tpu.data import io as _io
+        from oap_mllib_tpu.telemetry import flightrec
         from oap_mllib_tpu.utils import checkpoint as _ckpt
 
+        # the crash itself becomes the ring's final event, so the
+        # embedded tail always ends with what killed this rank
+        if flightrec.enabled():
+            flightrec.record("crash", site, fault_class)
         rank = _rank()
         record = {
             "version": CRASH_RECORD_VERSION,
@@ -189,6 +198,9 @@ def write_crash_record(site: str, fault_class: str, error: str, *,
             "last_completed": last_completed(),
             "sanitizer_fingerprint": _sanitizer_digest(),
             "last_checkpoint_step": _ckpt.last_durable_step(),
+            "flight_recorder": flightrec.tail(
+                flightrec.CRASH_TAIL_EVENTS
+            ),
             "telemetry": _tm.snapshot(),
         }
         os.makedirs(cfg.crash_dir, exist_ok=True)

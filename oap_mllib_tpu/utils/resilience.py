@@ -57,6 +57,7 @@ import zlib
 from typing import Callable, List, Optional
 
 from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.telemetry import flightrec
 from oap_mllib_tpu.telemetry import metrics as _tm
 from oap_mllib_tpu.utils.faults import FaultInjected
 
@@ -201,6 +202,8 @@ class ResilienceStats:
     def record(self, site: str, kind: Optional[str], exc: BaseException) -> None:
         self.faults += 1
         self.history.append(f"{site}[{kind or 'unclassified'}]: {exc}")
+        if flightrec.enabled():
+            flightrec.record("fault", site, kind or "unclassified")
         _tm.counter(
             "oap_resilience_faults_total",
             {"kind": kind or "unclassified"},
@@ -212,6 +215,8 @@ class ResilienceStats:
         process metrics registry."""
         self.retries += 1
         self.backoff_s += delay_s
+        if flightrec.enabled():
+            flightrec.record("retry", "transient", f"{delay_s:.3f}s")
         _tm.counter(
             "oap_resilience_retries_total",
             help="Transient-fault retries taken",
@@ -224,6 +229,8 @@ class ResilienceStats:
     def note_degradation(self) -> None:
         """Book one ladder rung stepped (halved-chunk or CPU fallback)."""
         self.degradations += 1
+        if flightrec.enabled():
+            flightrec.record("degrade", "ladder")
         _tm.counter(
             "oap_resilience_degradations_total",
             help="Degradation-ladder rungs stepped",

@@ -164,6 +164,8 @@ def _gather_frames(frame: bytes) -> List[bytes]:
 def _raise_divergence(kind: str, mine: str, frames: List[bytes]) -> None:
     import jax
 
+    from oap_mllib_tpu.telemetry import flightrec
+
     me = jax.process_index()
     peers = []
     first_bad = None
@@ -177,12 +179,24 @@ def _raise_divergence(kind: str, mine: str, frames: List[bytes]) -> None:
         help="Sanitizer-witnessed invariant violations",
     ).inc()
     assert first_bad is not None
+    # with the flight recorder armed, the dispatch being cross-checked is
+    # the newest collective event in THIS rank's ring — the per-op check
+    # fires on the first disagreement, so that seq IS the first
+    # diverging event; the peers' rings ride their crash records
+    recorder_note = ""
+    if flightrec.enabled():
+        recorder_note = (
+            f"  Flight recorder: first diverging event on this rank is "
+            f"seq {flightrec.last_seq()} (peer tails ride crash records "
+            "— docs/observability.md#flight-recorder).\n"
+        )
     raise CollectiveDivergenceError(
         f"collective sanitizer: rank-divergent {kind} — rank {me} is "
         f"dispatching [{mine}] but rank {first_bad[0]} is dispatching "
         f"[{first_bad[1]}]; every rank must issue the same collective "
-        "sequence (static-world contract, docs/distributed.md).  Full "
-        "world view:\n" + "\n".join(peers)
+        "sequence (static-world contract, docs/distributed.md).\n"
+        + recorder_note
+        + "Full world view:\n" + "\n".join(peers)
     )
 
 

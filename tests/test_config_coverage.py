@@ -239,6 +239,38 @@ class TestConfigCoverage:
         with pytest.raises(ValueError, match="kind"):
             faults.maybe_fault("stream.read")
 
+    def test_fleet_stats_typo_raises_at_pass(self, rng):
+        """The kmeans_kernel contract for the fleet plane (ISSUE 11): a
+        typo'd mode raises at the first streamed pass, not silently
+        disarming the rollups."""
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        set_config(fleet_stats="always")
+        x = rng.normal(size=(200, 4)).astype(np.float32)
+
+        def gen():
+            for lo in range(0, 200, 100):
+                yield x[lo:lo + 100]
+
+        src = ChunkSource(gen, 4, 100, n_rows=200)
+        with pytest.raises(ValueError, match="fleet_stats"):
+            KMeans(k=2, init_mode="random", max_iter=1).fit(src)
+
+    def test_metrics_port_negative_raises(self):
+        from oap_mllib_tpu.telemetry import fleet
+
+        set_config(metrics_port=-5)
+        with pytest.raises(ValueError, match="metrics_port"):
+            fleet.maybe_serve()
+
+    def test_flight_recorder_negative_raises(self):
+        from oap_mllib_tpu.telemetry import flightrec
+
+        set_config(flight_recorder=-3)
+        with pytest.raises(ValueError, match="flight_recorder"):
+            flightrec.record("span_open", "x")
+
     def test_supervisor_knobs_reach_supervisor(self, tmp_path):
         """restart_budget / restart_backoff / shrink_after flow into
         Supervisor defaults (utils/supervisor.py)."""

@@ -1,0 +1,215 @@
+"""Fleet control plane units (ISSUE 11): knob validation, frame
+building, rollup folds, straggler analytics, the summary block, and the
+live /metrics + /healthz endpoints."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.data.prefetch import PrefetchStats
+from oap_mllib_tpu.data.stream import ChunkSource
+from oap_mllib_tpu.models.kmeans import KMeans
+from oap_mllib_tpu.parallel.bootstrap import free_port
+from oap_mllib_tpu.telemetry import fleet
+from oap_mllib_tpu.telemetry import metrics as tm
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    set_config(fleet_stats="auto", metrics_port=0, flight_recorder=0)
+    fleet._reset_for_tests()
+    yield
+    set_config(fleet_stats="auto", metrics_port=0, flight_recorder=0)
+    fleet._reset_for_tests()
+
+
+def _source(rows=1200, d=6, chunk=300, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(rows, d)).astype(np.float32)
+
+    def gen():
+        for lo in range(0, rows, chunk):
+            yield x[lo:lo + chunk]
+
+    return ChunkSource(gen, d, chunk, n_rows=rows)
+
+
+class TestKnobs:
+    def test_fleet_stats_modes(self):
+        assert fleet.armed(1) is False  # auto, single process
+        assert fleet.armed(2) is True  # auto, world
+        set_config(fleet_stats="on")
+        assert fleet.armed(1) is True
+        set_config(fleet_stats="off")
+        assert fleet.armed(8) is False
+
+    def test_fleet_stats_typo_raises(self):
+        set_config(fleet_stats="onn")
+        with pytest.raises(ValueError, match="fleet_stats"):
+            fleet.armed(2)
+
+    def test_metrics_port_negative_raises(self):
+        set_config(metrics_port=-1)
+        with pytest.raises(ValueError, match="metrics_port"):
+            fleet.maybe_serve()
+
+
+class TestFrames:
+    def test_local_frame_shape_and_contents(self):
+        stats = PrefetchStats()
+        stats.stage_s, stats.transfer_s, stats.wait_s = 0.2, 0.05, 0.1
+        stats.bytes_staged = 4096
+        frame = fleet.local_frame(stats, 1.0)
+        assert frame.shape == (len(fleet.FRAME_FIELDS),)
+        assert frame.dtype == np.float64
+        named = dict(zip(fleet.FRAME_FIELDS, frame))
+        assert named["pass_wall_s"] == 1.0
+        assert named["stage_s"] == pytest.approx(0.2)
+        assert named["transfer_s"] == pytest.approx(0.05)
+        assert named["compute_s"] == pytest.approx(0.9)  # wall - wait
+        assert named["bytes_staged"] == 4096
+
+    def test_fold_rejects_wrong_shape(self):
+        with pytest.raises(ValueError, match="frame shape"):
+            fleet.fold_pass("p", np.zeros((3, 2)))
+
+    def test_fold_matches_hand_fold(self):
+        rng = np.random.default_rng(3)
+        frames = rng.random((4, len(fleet.FRAME_FIELDS)))
+        rec = fleet.fold_pass("p", frames)
+        for i, f in enumerate(fleet.FRAME_FIELDS):
+            col = frames[:, i]
+            assert rec["fields"][f]["min"] == pytest.approx(col.min())
+            assert rec["fields"][f]["max"] == pytest.approx(col.max())
+            assert rec["fields"][f]["mean"] == pytest.approx(col.mean())
+            assert rec["fields"][f]["p99"] == pytest.approx(
+                np.percentile(col, 99)
+            )
+
+    def test_fold_books_fleet_metrics_with_stats_labels(self):
+        frames = np.ones((2, len(fleet.FRAME_FIELDS)))
+        frames[1, 0] = 3.0
+        fleet.fold_pass("p", frames)
+        text = tm.render_prometheus()
+        assert 'oap_fleet_pass_seconds{stat="max"} 3' in text
+        assert 'oap_fleet_pass_seconds{stat="min"} 1' in text
+        assert "oap_fleet_skew_ratio 1.5" in text
+        assert "oap_fleet_slowest_rank 1" in text
+        assert "oap_fleet_pass_wall_seconds_bucket" in text
+
+
+class TestStragglerAnalytics:
+    def test_skewed_rank_named(self):
+        frames = np.ones((4, len(fleet.FRAME_FIELDS)))
+        frames[2, 0] = 5.0
+        rec = fleet.fold_pass("lloyd_loop", frames)
+        assert rec["slowest_rank"] == 2
+        assert rec["skew_ratio"] == pytest.approx(5.0 / 2.0)
+
+    def test_summary_block_aggregates_across_passes(self):
+        even = np.ones((2, len(fleet.FRAME_FIELDS)))
+        slow = even.copy()
+        slow[1, 0] = 4.0
+        for _ in range(3):
+            fleet.fold_pass("p", slow)
+        block = fleet.summary_block()
+        assert block["passes"] == 3
+        assert block["slowest_rank"] == 1
+        assert block["fit_skew_ratio"] > 1.5
+        assert block["per_rank_pass_s"][1] == pytest.approx(12.0)
+
+    def test_imbalance_trend(self):
+        assert fleet._trend([1.0, 1.0, 1.0, 1.0]) == "flat"
+        assert fleet._trend([1.0, 1.0, 1.5, 1.6]) == "rising"
+        assert fleet._trend([1.6, 1.5, 1.0, 1.0]) == "falling"
+        assert fleet._trend([1.0]) == "flat"  # too short to call
+
+
+class TestFitIntegration:
+    def test_streamed_fit_lands_fleet_block_and_span(self):
+        set_config(fleet_stats="on")
+        m = KMeans(k=3, seed=0, init_mode="random", max_iter=3,
+                   tol=0.0).fit(_source())
+        block = m.summary.fleet
+        assert block["enabled"] is True
+        assert block["world"] == 1
+        # per-pass granularity: >= max_iter passes (+ the final
+        # cost/counts pass)
+        assert block["passes"] >= 3
+        assert block["slowest_rank"] == 0
+        assert block["skew_ratio"] == pytest.approx(1.0)
+        spans = m.summary.telemetry["spans"]
+        names = [c["name"] for c in spans["children"]]
+        assert "fleet" in names
+        fleet_span = next(c for c in spans["children"]
+                          if c["name"] == "fleet")
+        assert fleet_span["attrs"]["passes"] == block["passes"]
+
+    def test_window_resets_between_fits(self):
+        set_config(fleet_stats="on")
+        KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(_source())
+        assert fleet.last_window() == []  # finalize drained it
+        m = KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(
+            _source()
+        )
+        assert m.summary.fleet["passes"] >= 2
+
+    def test_disarmed_fit_has_no_fleet_block(self):
+        set_config(fleet_stats="off")
+        m = KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(
+            _source()
+        )
+        assert not hasattr(m.summary, "fleet")
+
+    def test_streamed_pca_collects_passes(self):
+        from oap_mllib_tpu.models.pca import PCA
+
+        set_config(fleet_stats="on")
+        summary = {}
+        model = PCA(k=2).fit(_source(seed=5))
+        block = model.summary.get("fleet") if isinstance(
+            model.summary, dict) else model.summary.fleet
+        assert block["passes"] >= 2  # colsum + gram
+        del summary
+
+
+class TestLiveEndpoints:
+    def test_metrics_and_healthz_serve(self):
+        port = free_port("127.0.0.1", 9500)
+        set_config(fleet_stats="on", metrics_port=port, flight_recorder=64)
+        m = KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(
+            _source()
+        )
+        assert fleet.server_port() == port
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "# TYPE oap_fleet_pass_seconds gauge" in text
+        assert "oap_fit_total" in text
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ).read())
+        assert hz["ok"] is True
+        assert hz["fit"] == "kmeans.fit"
+        assert hz["step"] >= 2
+        assert hz["ladder"] == "active"
+        assert hz["flight_recorder_seq"] >= 0
+        assert "last_collective" in hz
+        del m
+
+    def test_unknown_path_404s(self):
+        port = free_port("127.0.0.1", 9500)
+        set_config(metrics_port=port)
+        assert fleet.maybe_serve() == port
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=5
+            )
+
+    def test_port_zero_never_serves(self):
+        assert fleet.maybe_serve() is None
+        assert fleet.server_port() is None

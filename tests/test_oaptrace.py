@@ -1,0 +1,244 @@
+"""dev/oaptrace.py + dev/bench_regress.py units (ISSUE 11): merged
+Chrome-trace timelines from per-rank JSONL sinks, and the perf
+trajectory regression gate."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dev")
+)
+
+import bench_regress  # noqa: E402
+import oaptrace  # noqa: E402
+
+
+def _flightrec_record(rank, events, seq=0):
+    return {
+        "type": "flightrec", "rank": rank, "seq": seq,
+        "events": events, "fit": "kmeans.fit",
+    }
+
+
+def _event(seq, t, kind, name, detail="", tid=1):
+    return {"seq": seq, "t": t, "tid": tid, "kind": kind,
+            "name": name, "detail": detail}
+
+
+def _write_sink(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+class TestRecorderMode:
+    def _two_rank_sinks(self, tmp_path, rank1_offset=100.0, skew=0.0):
+        """Two ranks running the same two-pass fit; rank 1's monotonic
+        clock starts at +offset and its pass is `skew` seconds slower —
+        the alignment must recover the offset from the collective
+        sequence."""
+        base = str(tmp_path / "fits.jsonl")
+        for rank, off, lag in ((0, 0.0, 0.0), (1, rank1_offset, skew)):
+            t = off + 10.0
+            events = [
+                _event(0, t, "span_open", "lloyd_loop"),
+                _event(1, t + 0.1, "chunk", "prefetch", "#0"),
+                _event(2, t + 0.4 + lag, "collective",
+                       "process_allgather", "(2,2)"),
+                _event(3, t + 0.5 + lag, "span_close", "lloyd_loop",
+                       "0.5s"),
+                _event(4, t + 0.6 + lag, "collective",
+                       "process_allgather", "(2,2)"),
+            ]
+            _write_sink(f"{base}.rank{rank}", [
+                _flightrec_record(rank, events),
+                {"type": "metrics", "rank": rank, "seq": 99,
+                 "metrics": {}},
+            ])
+        return base
+
+    def test_merges_one_track_per_rank(self, tmp_path):
+        base = self._two_rank_sinks(tmp_path)
+        trace = oaptrace.merge_trace(oaptrace.expand_paths([base]))
+        assert trace["otherData"]["mode"] == "recorder"
+        assert trace["otherData"]["ranks"] == [0, 1]
+        pids = {e["pid"] for e in trace["traceEvents"]}
+        assert pids == {0, 1}
+        assert oaptrace.validate_trace(trace) == []
+
+    def test_clock_alignment_via_collective_seqs(self, tmp_path):
+        """Rank 1's raw clock is +100 s — aligned via the collective
+        sequence, its span must land within the trace near rank 0's,
+        not 100 s later."""
+        base = self._two_rank_sinks(tmp_path, rank1_offset=100.0)
+        trace = oaptrace.merge_trace(oaptrace.expand_paths([base]))
+        spans = {
+            e["pid"]: e for e in trace["traceEvents"]
+            if e.get("ph") == "X" and e["name"] == "lloyd_loop"
+        }
+        assert set(spans) == {0, 1}
+        # identical workloads + alignment => near-identical start times
+        assert abs(spans[0]["ts"] - spans[1]["ts"]) < 1e5  # < 100 ms
+        assert spans[0]["dur"] == pytest.approx(0.5e6, rel=0.01)
+
+    def test_skewed_rank_reads_staircased(self, tmp_path):
+        base = self._two_rank_sinks(tmp_path, skew=1.0)
+        trace = oaptrace.merge_trace(oaptrace.expand_paths([base]))
+        spans = {
+            e["pid"]: e for e in trace["traceEvents"]
+            if e.get("ph") == "X" and e["name"] == "lloyd_loop"
+        }
+        # the slow rank's span is visibly longer
+        assert spans[1]["dur"] > spans[0]["dur"] + 0.5e6
+
+    def test_cross_rank_flow_arrows_per_collective(self, tmp_path):
+        base = self._two_rank_sinks(tmp_path)
+        trace = oaptrace.merge_trace(oaptrace.expand_paths([base]))
+        starts = [e for e in trace["traceEvents"] if e.get("ph") == "s"]
+        finishes = [e for e in trace["traceEvents"] if e.get("ph") == "f"]
+        assert len(starts) == 2  # one flow per collective index
+        assert len(finishes) == 2
+        assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+        assert all(e["cat"] == "collective" for e in starts + finishes)
+
+    def test_cli_writes_validated_file(self, tmp_path):
+        base = self._two_rank_sinks(tmp_path)
+        out = str(tmp_path / "trace.json")
+        assert oaptrace.main([base, "-o", out]) == 0
+        trace = json.load(open(out))
+        assert oaptrace.validate_trace(trace) == []
+
+
+class TestSynthesizedMode:
+    def test_span_only_sink_lays_out_tree(self, tmp_path):
+        path = str(tmp_path / "solo.jsonl")
+        _write_sink(path, [
+            {"type": "span", "fit": "pca.fit", "path": "pca.fit",
+             "name": "pca.fit", "duration_s": 1.0, "count": 1,
+             "rank": 0, "seq": 0},
+            {"type": "span", "fit": "pca.fit",
+             "path": "pca.fit/covariance", "name": "covariance",
+             "duration_s": 0.6, "count": 1, "rank": 0, "seq": 1},
+            {"type": "span", "fit": "pca.fit", "path": "pca.fit/eigh",
+             "name": "eigh", "duration_s": 0.4, "count": 1,
+             "rank": 0, "seq": 2},
+            {"type": "metrics", "rank": 0, "seq": 3, "metrics": {}},
+        ])
+        trace = oaptrace.merge_trace([path])
+        assert trace["otherData"]["mode"] == "synthesized"
+        assert oaptrace.validate_trace(trace) == []
+        by_name = {e["name"]: e for e in trace["traceEvents"]
+                   if e.get("ph") == "X"}
+        assert by_name["pca.fit"]["ts"] == 0
+        # children lay out sequentially inside the parent
+        assert by_name["covariance"]["ts"] == 0
+        assert by_name["eigh"]["ts"] == pytest.approx(0.6e6)
+
+    def test_missing_files_raise(self):
+        with pytest.raises(FileNotFoundError):
+            oaptrace.expand_paths(["/nonexistent/sink.jsonl"])
+
+
+class TestBenchRegress:
+    def _round(self, tmp_path, n, metrics):
+        path = str(tmp_path / f"BENCH_r{n:02d}.json")
+        tail = "\n".join(json.dumps(m) for m in metrics)
+        with open(path, "w") as f:
+            json.dump({"n": n, "cmd": "python bench.py", "rc": 0,
+                       "tail": tail, "parsed": metrics[-1]}, f)
+        return path
+
+    def _metric(self, name, value, unit="iters/sec", backend="tpu"):
+        return {"metric": name, "value": value, "unit": unit,
+                "backend": backend}
+
+    def test_single_round_warns_only(self, tmp_path):
+        self._round(tmp_path, 1, [self._metric("m", 10.0)])
+        failures, warnings, _ = bench_regress.compare(str(tmp_path), 0.10)
+        assert failures == []
+        assert any("only one bench round" in w for w in warnings)
+
+    def test_regression_fails_naming_metric(self, tmp_path):
+        self._round(tmp_path, 1, [self._metric("kmeans_ips", 100.0)])
+        self._round(tmp_path, 2, [self._metric("kmeans_ips", 80.0)])
+        failures, _, _ = bench_regress.compare(str(tmp_path), 0.10)
+        assert len(failures) == 1
+        assert "kmeans_ips" in failures[0]
+        assert "REGRESSION" in failures[0]
+
+    def test_improvement_and_small_drift_pass(self, tmp_path):
+        self._round(tmp_path, 1, [self._metric("a", 100.0),
+                                  self._metric("w", 2.0, unit="sec")])
+        self._round(tmp_path, 2, [self._metric("a", 95.0),
+                                  self._metric("w", 1.5, unit="sec")])
+        failures, _, report = bench_regress.compare(str(tmp_path), 0.10)
+        assert failures == []
+        assert len(report) == 2
+
+    def test_sec_units_are_lower_is_better(self, tmp_path):
+        self._round(tmp_path, 1, [self._metric("w", 1.0, unit="sec/iter")])
+        self._round(tmp_path, 2, [self._metric("w", 1.5, unit="sec/iter")])
+        failures, _, _ = bench_regress.compare(str(tmp_path), 0.10)
+        assert len(failures) == 1
+
+    def test_best_prior_not_just_previous(self, tmp_path):
+        """The gate compares against the BEST prior round, so two slow
+        rounds in a row cannot ratchet the bar down."""
+        self._round(tmp_path, 1, [self._metric("a", 100.0)])
+        self._round(tmp_path, 2, [self._metric("a", 85.0)])
+        self._round(tmp_path, 3, [self._metric("a", 85.0)])
+        failures, _, _ = bench_regress.compare(str(tmp_path), 0.10)
+        assert len(failures) == 1  # 85 vs best=100 is -15%
+
+    def test_backends_never_cross_compare(self, tmp_path):
+        self._round(tmp_path, 1, [
+            self._metric("kmeans_ips", 100.0, backend="tpu")])
+        self._round(tmp_path, 2, [
+            self._metric("kmeans_ips_cpuproxy", 2.0, backend="cpu")])
+        failures, warnings, _ = bench_regress.compare(str(tmp_path), 0.10)
+        assert failures == []
+        assert any("cpuproxy" in w and "skipped" in w for w in warnings)
+
+    def test_cli_exit_codes(self, tmp_path):
+        self._round(tmp_path, 1, [self._metric("a", 100.0)])
+        assert bench_regress.main(["--dir", str(tmp_path)]) == 0
+        self._round(tmp_path, 2, [self._metric("a", 50.0)])
+        assert bench_regress.main(["--dir", str(tmp_path)]) == 1
+
+    def test_repo_trajectory_is_currently_clean(self):
+        """The live repo's recorded rounds must pass the gate — this is
+        the tier-1 mirror of the ci.sh soft gate."""
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        failures, _, _ = bench_regress.compare(root, 0.10)
+        assert failures == [], failures
+
+    def test_real_fit_sink_merges(self, tmp_path):
+        """End-to-end: a real streamed fit's JSONL sink (recorder armed)
+        merges into a validated recorder-mode timeline."""
+        from oap_mllib_tpu.config import set_config
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        sink = str(tmp_path / "real.jsonl")
+        set_config(flight_recorder=256, telemetry_log=sink)
+        try:
+            rng = np.random.default_rng(0)
+            x = rng.normal(size=(600, 4)).astype(np.float32)
+
+            def gen():
+                for lo in range(0, 600, 200):
+                    yield x[lo:lo + 200]
+
+            src = ChunkSource(gen, 4, 200, n_rows=600)
+            KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(src)
+        finally:
+            set_config(flight_recorder=0, telemetry_log="")
+        trace = oaptrace.merge_trace(oaptrace.expand_paths([sink]))
+        assert trace["otherData"]["mode"] == "recorder"
+        assert oaptrace.validate_trace(trace) == []
+        assert any(e.get("ph") == "X" for e in trace["traceEvents"])

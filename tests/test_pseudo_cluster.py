@@ -751,3 +751,121 @@ class TestSanitizerPlane:
         assert r0["san_world_checked"] and r1["san_world_checked"]
         assert r0["san_fingerprint"] == r1["san_fingerprint"]
         assert r0["streamed_cost"] == r1["streamed_cost"]
+
+
+_FLEET_WORKER = os.path.join(
+    os.path.dirname(__file__), "pseudo_cluster_worker_fleet.py"
+)
+
+
+class TestFleetObservability:
+    """ISSUE 11 acceptance: the fleet control plane across a REAL
+    2-process world — per-pass rollups agree on every rank, a
+    deliberately slowed rank is named with skew > 1.5, the live
+    /metrics endpoint serves oap_fleet_* mid-fit, and a SIGKILL
+    drill's crash records carry >= 32-event flight-recorder tails."""
+
+    def _launch_fleet_world(self, mode, env_extra=None, timeout=180):
+        import time
+
+        from oap_mllib_tpu.parallel.bootstrap import free_port
+
+        coord = f"127.0.0.1:{free_port('127.0.0.1', 4000)}"
+        env = _worker_env()
+        env["FLEET_WORKER_MODE"] = mode
+        env.update(env_extra or {})
+        t0 = time.monotonic()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, _FLEET_WORKER, str(r), "2", coord, "1"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=_REPO,
+            )
+            for r in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=timeout)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        _skip_if_environment_cannot_spawn(procs, outs)
+        return procs, outs, time.monotonic() - t0
+
+    @staticmethod
+    def _tagged_json(out, tag, rank):
+        line = [
+            ln for ln in out.splitlines()
+            if ln.startswith(f"{tag} rank={rank} ")
+        ]
+        assert line, f"no {tag} line for rank {rank}:\n{out}"
+        return json.loads(line[0].split(" ", 2)[2])
+
+    def test_skewed_rank_named_and_rollups_agree(self):
+        """A slowed rank 1 must show up in every rank's identical fleet
+        window, the summary block must name it with skew > 1.5, and
+        rank 0's live endpoint must serve oap_fleet_* families while
+        the fit is running."""
+        from oap_mllib_tpu.parallel.bootstrap import free_port
+
+        port = free_port("127.0.0.1", 9400)
+        procs, outs, _ = self._launch_fleet_world(
+            "skew", {"FLEET_METRICS_PORT": str(port)}
+        )
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"worker failed:\n{out}"
+        blocks = [self._tagged_json(outs[r], "FLEETBLOCK", r)
+                  for r in range(2)]
+        windows = [self._tagged_json(outs[r], "WINDOW", r)
+                   for r in range(2)]
+        # the gathered per-pass frames are identical on every rank (the
+        # rollup is a rank-uniform allgather) ...
+        assert windows[0] == windows[1]
+        assert len(windows[0]) >= 4  # per-pass granularity: >= max_iter
+        # ... and rank 0's fold equals a hand-fold of the per-rank rows
+        for w in windows[0]:
+            frames = np.asarray(w["frames"])
+            assert frames.shape[0] == 2
+            for i, field in enumerate([
+                "pass_wall_s", "stage_s", "transfer_s", "compute_s",
+                "bytes_staged", "retries", "kernel_dispatch_s",
+            ]):
+                got = w["fields"][field]
+                col = frames[:, i]
+                assert abs(got["mean"] - col.mean()) < 1e-9
+                assert abs(got["min"] - col.min()) < 1e-9
+                assert abs(got["max"] - col.max()) < 1e-9
+        # the straggler analytics name the slowed rank with real skew
+        for block in blocks:
+            assert block["enabled"] and block["passes"] >= 4
+            assert block["slowest_rank"] == 1, block
+            assert block["fit_skew_ratio"] > 1.5, block
+        # the live endpoint served fleet families mid-fit on rank 0
+        assert "SCRAPE OK rank=0" in outs[0], outs[0]
+
+    def test_sigkill_crash_record_carries_recorder_tail(self, tmp_path):
+        """A SIGKILLed rank 1 mid-pass: the surviving rank's v2 crash
+        record must embed a >= 32-event flight-recorder tail whose
+        events cover chunk progress and collective dispatches — the
+        "what happened just before" a post-mortem needs."""
+        crash_dir = str(tmp_path / "sideband")
+        procs, outs, elapsed = self._launch_fleet_world(
+            "kill", {"FLEET_CRASH_DIR": crash_dir}, timeout=120
+        )
+        assert procs[1].returncode == -9, outs[1]
+        assert procs[0].returncode == 0, outs[0]
+        assert "TIMEOUT_CAUGHT" in outs[0], outs[0]
+        rec = json.load(
+            open(os.path.join(crash_dir, "crash.rank0.json"))
+        )
+        assert rec["version"] == 2
+        tail = rec["flight_recorder"]
+        assert len(tail) >= 32, f"only {len(tail)} recorder events"
+        kinds = {e["kind"] for e in tail}
+        assert "chunk" in kinds and "collective" in kinds, kinds
+        seqs = [e["seq"] for e in tail]
+        assert seqs == sorted(seqs)  # tails are seq-ordered
+        assert elapsed < 90, f"world took {elapsed:.0f}s to diagnose"

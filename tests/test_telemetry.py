@@ -199,6 +199,136 @@ class TestMetricsRegistry:
         assert "lat_seconds_count 2" in text
 
 
+class TestPrometheusRoundTrip:
+    """ISSUE 11 satellite: the text exposition must hold the promtext
+    spec — verified by PARSING it back and cross-checking against the
+    registry, not by substring spot checks."""
+
+    def setup_method(self):
+        tm.reset()
+
+    @staticmethod
+    def _parse(text):
+        """Minimal promtext parser: {family: {"type", "help",
+        "samples": {(suffix, labels-str): value}}}.  Raises on any line
+        that fits neither comment nor sample grammar."""
+        import re
+
+        fams = {}
+        sample_re = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (\S+)$"
+        )
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# HELP "):
+                _, _, name, help_ = line.split(" ", 3)
+                fams.setdefault(name, {"samples": {}})["help"] = help_
+            elif line.startswith("# TYPE "):
+                _, _, name, kind = line.split(" ", 3)
+                fams.setdefault(name, {"samples": {}})["type"] = kind
+            else:
+                m = sample_re.match(line)
+                assert m, f"unparsable exposition line: {line!r}"
+                name, labels, value = m.groups()
+                base = name
+                for suffix in ("_bucket", "_sum", "_count"):
+                    if name.endswith(suffix) and name[: -len(suffix)] in fams:
+                        base = name[: -len(suffix)]
+                        break
+                fams.setdefault(base, {"samples": {}})["samples"][
+                    (name, labels or "")
+                ] = float(value.replace("+Inf", "inf"))
+        return fams
+
+    def test_every_family_has_help_and_type(self):
+        tm.counter("rt_total", help="with help").inc()
+        tm.counter("rt_helpless_total").inc()  # registered help-less
+        fams = self._parse(tm.render_prometheus())
+        for name, fam in fams.items():
+            assert "type" in fam, f"{name} missing # TYPE"
+            assert "help" in fam, f"{name} missing # HELP"
+        assert fams["rt_total"]["help"] == "with help"
+        # help-less registration gets the self-naming fallback
+        assert fams["rt_helpless_total"]["help"]
+
+    def test_help_upgraded_when_richer_site_registers(self):
+        tm.counter("rt_lazy_total").inc()
+        tm.counter("rt_lazy_total", help="the real help").inc()
+        fams = self._parse(tm.render_prometheus())
+        assert fams["rt_lazy_total"]["help"] == "the real help"
+
+    def test_histogram_cumulative_inf_count_sum_consistent(self):
+        h = tm.histogram("rt_seconds", bounds=(0.1, 1.0, 10.0),
+                         help="hist")
+        values = [0.05, 0.1, 0.5, 2.0, 50.0, 50.0]
+        for v in values:
+            h.observe(v)
+        fams = self._parse(tm.render_prometheus())
+        samples = fams["rt_seconds"]["samples"]
+        buckets = {
+            labels: v for (name, labels), v in samples.items()
+            if name == "rt_seconds_bucket"
+        }
+        # cumulative and non-decreasing in le order, +Inf == _count
+        ordered = [buckets[f'{{le="{le}"}}']
+                   for le in ("0.1", "1", "10", "+Inf")]
+        assert ordered == sorted(ordered)
+        assert ordered[0] == 2  # 0.05 and the le-inclusive 0.1
+        assert ordered[-1] == len(values)
+        assert samples[("rt_seconds_count", "")] == len(values)
+        assert samples[("rt_seconds_sum", "")] == pytest.approx(
+            sum(values)
+        )
+
+    def test_label_values_escaped(self):
+        tm.counter(
+            "rt_esc_total",
+            {"path": 'a"b\\c', "msg": "two\nlines"},
+            help="escapes",
+        ).inc()
+        text = tm.render_prometheus()
+        assert '\\"' in text and "\\\\" in text and "\\n" in text
+        fams = self._parse(text)  # the escaped line still parses
+        assert any(
+            name == "rt_esc_total"
+            for (name, _) in fams["rt_esc_total"]["samples"]
+        )
+
+    def test_registry_values_round_trip(self):
+        tm.counter("rt_c_total", {"op": "a"}, help="c").inc(3)
+        tm.gauge("rt_g", help="g").set(2.5)
+        fams = self._parse(tm.render_prometheus())
+        assert fams["rt_c_total"]["samples"][
+            ("rt_c_total", '{op="a"}')
+        ] == 3
+        assert fams["rt_g"]["samples"][("rt_g", "")] == 2.5
+        assert fams["rt_c_total"]["type"] == "counter"
+        assert fams["rt_g"]["type"] == "gauge"
+
+    def test_family_total_sums_across_labels_and_histograms(self):
+        tm.counter("rt_f_total", {"op": "a"}).inc(1)
+        tm.counter("rt_f_total", {"op": "b"}).inc(2)
+        h = tm.histogram("rt_f_seconds")
+        h.observe(0.5)
+        h.observe(1.5)
+        assert tm.family_total("rt_f_total") == 3
+        assert tm.family_total("rt_f_seconds") == pytest.approx(2.0)
+        assert tm.family_total("rt_missing") == 0.0
+
+    def test_live_registry_exposition_parses_after_a_fit(self, rng):
+        """The whole live registry (every subsystem's families) must
+        parse — the scrape-surface contract behind /metrics."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = rng.normal(size=(256, 4)).astype(np.float32)
+        KMeans(k=2, max_iter=2, seed=0).fit(x)
+        fams = self._parse(tm.render_prometheus())
+        assert "oap_fit_total" in fams
+        for name, fam in fams.items():
+            assert "type" in fam and "help" in fam, name
+
+
 class TestCounterAbsorption:
     """The pre-existing stats objects must mirror into the registry at
     their native increment points."""
