@@ -74,6 +74,15 @@ echo "   with exact retry counters + 1e-6 parity; persistent OOM escalates"
 echo "   accelerated -> halved-chunk -> CPU fallback (dev/fault_gate.py) =="
 python dev/fault_gate.py
 
+echo "== oom gate: memory-budget-governed scale — deterministic route"
+echo "   decisions under synthetic budgets land in summary.route, strict"
+echo "   mode raises BudgetError instead of degrading scale, disk-streamed"
+echo "   fits are bit-identical (K-Means) / <=1e-6 (PCA) vs in-memory, a"
+echo "   seeded SIGKILL mid-spill relaunches via the supervisor and resumes"
+echo "   from disk bit-identical, and the planner seam is <1% of the 20-fit"
+echo "   microbench (dev/oom_gate.py) =="
+python dev/oom_gate.py
+
 echo "== precision gate: compute_precision='f32' is bit-compatible with the"
 echo "   pre-policy kernels, bf16 holds the registered parity bounds on all"
 echo "   three estimators, the chosen policy lands in summaries/span trees,"

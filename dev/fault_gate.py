@@ -11,8 +11,10 @@ Drives the retry tiers end to end on a streamed K-Means fit
   anything else means a retry tier regressed;
 - the fault registry's own accounting agrees (2 + 1 faults fired);
 - a persistent device OOM at the jitted-launch site escalates
-  accelerated -> halved-chunk retry -> CPU fallback with NO user-visible
-  exception when fallback=True (summary records both rungs), and raises
+  accelerated -> GEOMETRIC halved-chunk retries (256-row chunks have two
+  halvings above the 64-row floor: /2 then /4, the divisor trail in
+  ``resilience.halvings``) -> CPU fallback with NO user-visible
+  exception when fallback=True (summary records every rung), and raises
   a ResilienceError carrying the fault history when fallback=False.
 
 Exit 1 with the offending numbers on any violation.
@@ -112,14 +114,20 @@ def main() -> int:
         report["oom_ladder"] = {
             "accelerated": bool(oom_fit.summary.accelerated),
             "degradations": ores["degradations"],
+            "halvings": ores["halvings"],
             "history_len": len(ores["history"]),
         }
         if oom_fit.summary.accelerated:
             failures.append("persistent OOM did not land on the CPU path")
-        if ores["degradations"] != 2:
+        if ores["degradations"] != 3:
             failures.append(
-                "expected 2 degradations (halved-chunk rung + CPU rung), "
-                f"got {ores['degradations']}"
+                "expected 3 degradations (geometric halvings /2 and /4 "
+                f"+ CPU rung), got {ores['degradations']}"
+            )
+        if ores["halvings"] != [2, 4]:
+            failures.append(
+                f"expected geometric halving trail [2, 4], got "
+                f"{ores['halvings']}"
             )
 
     set_config(fallback=False)

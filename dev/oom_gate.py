@@ -1,0 +1,322 @@
+#!/usr/bin/env python
+"""CI gate: memory-budget-governed scale (ISSUE 12).
+
+Legs, all deterministic on any host:
+
+1. **Route decisions under synthetic budgets** — the planner picks
+   in-memory on an unlimited budget and streams the SAME fit under a
+   tiny HBM budget, recording the decision, every candidate's estimate,
+   and the rejection reasons in ``summary.route``.
+2. **Strict mode** — ``scale_policy=strict`` raises ``BudgetError`` at
+   fit entry instead of degrading scale.
+3. **Disk-streamed parity** — a fit from a disk-backed ``.npy``
+   ChunkSource is BIT-identical to the same streamed fit from memory
+   (K-Means) and within 1e-6 of the in-memory route (PCA).
+4. **Kill-mid-spill relaunch-resume drill** — a worker whose source
+   raises a host OOM mid-fit spills to disk; a seeded SIGKILL lands on
+   the 3rd spill chunk; the supervisor relaunches, the relaunched
+   attempt spills cleanly, resumes from the durable checkpoint, and
+   finishes BIT-identical to an uninterrupted reference run (the PR 8
+   same-world continuation contract composed with the spill rung).
+5. **Planner seam** — 20 plan+record cycles cost <1% of the 20-fit
+   K-Means microbench wall (route planning is arithmetic, not passes).
+
+Exit 1 with the offending numbers on any violation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+ROWS, D, K, MAX_ITER, CHUNK = 3000, 8, 4, 6, 500
+DATA_SEED = 777
+KILL_SPILL_CHUNK = 3  # SIGKILL mid-spill: the 3rd of 6 spill chunks
+
+
+def _single_device_env() -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(f for f in flags.split()
+                     if "xla_force_host_platform_device_count" not in f)
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=1"
+    ).strip()
+
+
+def _worker(rank: int, world: int, coord: str) -> int:
+    """One drill worker: streamed K-Means whose source raises a host
+    OOM at walk 2 (once per process) — the spill rung fires; checkpoint
+    + spill dirs from env; attempt 0 arms a SIGKILL on spill chunk 3."""
+    _single_device_env()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from oap_mllib_tpu.config import set_config
+    from oap_mllib_tpu.data.stream import ChunkSource
+    from oap_mllib_tpu.models.kmeans import KMeans
+
+    attempt = int(os.environ.get("SUPERVISE_ATTEMPT", "0"))
+    spec = ""
+    if attempt == 0 and os.environ.get("OOMGATE_KILL") == "1":
+        spec = f"spill.write:kill={KILL_SPILL_CHUNK}"
+    set_config(
+        checkpoint_dir=os.environ["OOMGATE_CKPT"],
+        spill_dir=os.environ["OOMGATE_SPILL"],
+        fault_spec=spec,
+        retry_backoff=0.001,
+    )
+
+    rng = np.random.default_rng(DATA_SEED)
+    x = rng.normal(size=(ROWS, D)).astype(np.float32)
+    oomed = {"fired": False}
+    walks = {"n": 0}
+
+    def gen():
+        walks["n"] += 1
+        # walk 1 = the reservoir init pass, walk 2 = Lloyd pass 1
+        # (checkpointed when it completes); the host OOM lands at the
+        # START of walk 3, once per process, so the spill (and the
+        # attempt-0 kill mid-spill) happen with a durable checkpoint
+        # behind them — the relaunch must resume AND re-spill.  The
+        # message deliberately avoids the device-OOM markers: a bare
+        # MemoryError is the HOST class (the spill rung).
+        if walks["n"] == 3 and not oomed["fired"]:
+            oomed["fired"] = True
+            raise MemoryError("synthetic host memory exhaustion")
+        for lo in range(0, ROWS, CHUNK):
+            yield x[lo: lo + CHUNK]
+
+    src = ChunkSource(gen, D, CHUNK, n_rows=ROWS)
+    try:
+        m = KMeans(k=K, seed=7, init_mode="random", max_iter=MAX_ITER,
+                   tol=0.0).fit(src)
+    except Exception as e:  # noqa: BLE001 — the gate reads the record
+        print(f"worker failed: {e!r}", flush=True)
+        return 3
+    centers = np.ascontiguousarray(m.cluster_centers_, np.float32)
+    print("RESULT " + json.dumps({
+        "sha": hashlib.sha256(centers.tobytes()).hexdigest(),
+        "cost": float(m.summary.training_cost),
+        "route": m.summary.route["route"],
+        "spilled": bool(m.summary.route.get("spilled", False)),
+        "ckpt_decision": m.summary.checkpoint.get("decision", "fresh"),
+    }), flush=True)
+    return 0
+
+
+def _reference_run(tmp: str) -> dict:
+    """The uninterrupted run: same worker, no kill, its own dirs."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["OOMGATE_CKPT"] = os.path.join(tmp, "ckpt-ref")
+    env["OOMGATE_SPILL"] = os.path.join(tmp, "spill-ref")
+    env["SUPERVISE_ATTEMPT"] = "1"  # never arms the kill
+    env.pop("OOMGATE_KILL", None)
+    os.makedirs(env["OOMGATE_SPILL"], exist_ok=True)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker", "0", "1",
+         ""],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    for line in out.stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise RuntimeError(
+        f"reference run printed no RESULT: {out.stdout}\n{out.stderr}"
+    )
+
+
+def main() -> int:
+    import time
+
+    import numpy as np
+
+    from oap_mllib_tpu.config import set_config
+    from oap_mllib_tpu.data.stream import ChunkSource
+    from oap_mllib_tpu.models.kmeans import KMeans
+    from oap_mllib_tpu.models.pca import PCA
+    from oap_mllib_tpu.utils import membudget as mb
+
+    failures = []
+    report = {}
+
+    def check(ok, msg):
+        if not ok:
+            failures.append(msg)
+
+    rng = np.random.default_rng(DATA_SEED)
+    # well-separated blobs: the streamed and in-memory init RNG streams
+    # legitimately differ, but both converge to the same optimum
+    proto = rng.normal(size=(3, 8)).astype(np.float32) * 4.0
+    x = (proto[rng.integers(3, size=1200)]
+         + rng.normal(size=(1200, 8)).astype(np.float32) * 0.2)
+    xs = (rng.normal(size=(1200, 8))
+          @ np.diag([5, 4, 3, 2, 1, .5, .3, .1])).astype(np.float32)
+
+    # -- leg 1: deterministic route decisions under synthetic budgets --------
+    set_config(memory_budget_hbm="unlimited",
+               memory_budget_host="unlimited", scale_policy="auto")
+    m_big = KMeans(k=3, seed=1, max_iter=20).fit(x)
+    set_config(memory_budget_hbm="3M")
+    m_small = KMeans(k=3, seed=1, max_iter=20).fit(x)
+    report["routes"] = {
+        "unlimited": m_big.summary.route["route"],
+        "3M": m_small.summary.route["route"],
+    }
+    check(m_big.summary.route["route"] == "in-memory",
+          f"unlimited budget routed {m_big.summary.route['route']}, "
+          "expected in-memory")
+    check(m_small.summary.route["route"] == "streamed",
+          f"3M budget routed {m_small.summary.route['route']}, "
+          "expected streamed")
+    check(m_small.summary.route.get("degraded_scale") is True,
+          "budget-forced reroute not flagged degraded_scale")
+    rejected = [e for e in m_small.summary.route["estimates"]
+                if e.get("reject")]
+    check(len(rejected) >= 1, "no rejection reasons recorded")
+    np.testing.assert_allclose(
+        m_small.summary.training_cost, m_big.summary.training_cost,
+        rtol=1e-4,
+    )
+
+    # -- leg 2: strict raises instead of degrading ---------------------------
+    set_config(scale_policy="strict")
+    try:
+        KMeans(k=3, seed=1, max_iter=2).fit(x)
+        check(False, "strict mode did NOT raise on an over-budget fit")
+    except mb.BudgetError:
+        pass
+    set_config(memory_budget_hbm="unlimited", scale_policy="auto")
+
+    # -- leg 3: disk-streamed parity ----------------------------------------
+    tmp = tempfile.mkdtemp(prefix="oom-gate.")
+    npy = os.path.join(tmp, "x.npy")
+    np.save(npy, x)
+    m_mem = KMeans(k=3, seed=5, max_iter=5).fit(
+        ChunkSource.from_array(x, chunk_rows=256)
+    )
+    m_disk = KMeans(k=3, seed=5, max_iter=5).fit(
+        ChunkSource.from_npy(npy, chunk_rows=256)
+    )
+    bit_dev = float(np.abs(
+        m_disk.cluster_centers_ - m_mem.cluster_centers_
+    ).max())
+    report["disk_bit_dev"] = bit_dev
+    check(bit_dev == 0.0,
+          f"disk-streamed K-Means deviates {bit_dev} from "
+          "memory-streamed (must be bit-identical)")
+    np.save(os.path.join(tmp, "xs.npy"), xs)
+    p_mem = PCA(k=3).fit(xs)
+    p_disk = PCA(k=3).fit(
+        ChunkSource.from_npy(os.path.join(tmp, "xs.npy"), chunk_rows=256)
+    )
+    pca_dev = float(max(
+        np.abs(np.abs(p_disk.components_) - np.abs(p_mem.components_)
+               ).max(),
+        np.abs(p_disk.explained_variance_ - p_mem.explained_variance_
+               ).max(),
+    ))
+    report["pca_disk_vs_inmem_dev"] = pca_dev
+    check(pca_dev <= 1e-6,
+          f"disk-streamed PCA deviates {pca_dev:.2e} from the in-memory "
+          "route (> 1e-6)")
+
+    # -- leg 4: seeded kill-mid-spill relaunch-resume drill ------------------
+    from oap_mllib_tpu.utils.supervisor import Supervisor
+
+    ref = _reference_run(tmp)
+    report["reference"] = ref
+    check(ref["spilled"] and ref["route"] == "streamed",
+          f"reference run did not spill+stream: {ref}")
+    check(ref["ckpt_decision"] == "found",
+          "reference run's post-spill attempt did not resume from its "
+          f"own checkpoint: {ref['ckpt_decision']}")
+    drill_env = dict(os.environ)
+    drill_env["OOMGATE_CKPT"] = os.path.join(tmp, "ckpt-drill")
+    drill_env["OOMGATE_SPILL"] = os.path.join(tmp, "spill-drill")
+    drill_env["OOMGATE_KILL"] = "1"
+    os.makedirs(drill_env["OOMGATE_SPILL"], exist_ok=True)
+    sup = Supervisor(
+        lambda rank, world, coord, attempt: [
+            sys.executable, os.path.abspath(__file__), "--worker",
+            str(rank), str(world), coord,
+        ],
+        1, os.path.join(tmp, "crash"), env=drill_env,
+        restart_budget=3, restart_backoff=0.1, attempt_timeout=300.0,
+    )
+    summary = sup.run()
+    report["drill"] = {
+        "ok": summary["ok"], "attempts": len(summary["attempts"]),
+        "first_attempt": summary["attempts"][0] if summary["attempts"]
+        else None,
+    }
+    check(summary["ok"], f"supervised drill did not complete: {summary}")
+    check(len(summary["attempts"]) == 2,
+          f"expected exactly 2 attempts (kill + relaunch), got "
+          f"{len(summary['attempts'])}")
+    if summary["attempts"]:
+        first = summary["attempts"][0]
+        kinds = [e.get("classification") for e in first.get("exits", [])]
+        check("killed" in kinds,
+              f"first attempt not classified killed: {first}")
+    drill = None
+    for out in summary.get("outputs", []):
+        for ln in str(out).splitlines():
+            if ln.startswith("RESULT "):
+                drill = json.loads(ln[len("RESULT "):])
+    report["drill_result"] = drill
+    check(drill is not None, "drill printed no RESULT line")
+    if drill is not None:
+        check(drill["sha"] == ref["sha"],
+              f"kill-mid-spill resume NOT bit-identical: drill sha "
+              f"{drill['sha'][:12]} vs reference {ref['sha'][:12]}")
+        check(drill["spilled"], "relaunched attempt did not spill")
+        check(drill["ckpt_decision"] == "found",
+              f"relaunched attempt did not resume from the checkpoint: "
+              f"{drill['ckpt_decision']}")
+
+    # -- leg 5: planner seam <1% of the 20-fit microbench --------------------
+    set_config(memory_budget_hbm="", memory_budget_host="")
+    xb = rng.normal(size=(512, 16)).astype(np.float32)
+    KMeans(k=4, seed=1, max_iter=3).fit(xb)  # warm the caches
+    t0 = time.perf_counter()
+    for _ in range(20):
+        KMeans(k=4, seed=1, max_iter=3).fit(xb)
+    fit_wall = time.perf_counter() - t0
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        plan = mb.plan_kmeans(512, 16, 4, row_chunks_hint=1)
+        mb.record_plan({"timings": None}, plan)
+    seam_wall = (time.perf_counter() - t0) * (20.0 / reps)
+    pct = 100.0 * seam_wall / fit_wall
+    report["seam"] = {"fit_wall_s": round(fit_wall, 4),
+                      "seam_wall_s": round(seam_wall, 6),
+                      "pct": round(pct, 3)}
+    check(seam_wall < max(0.01 * fit_wall, 0.005),
+          f"planner seam measurable: {seam_wall:.4f}s vs 20-fit wall "
+          f"{fit_wall:.3f}s (~{pct:.2f}%)")
+
+    print(json.dumps(report), flush=True)
+    for f in failures:
+        print(f"FAIL: {f}")
+    print(f"oom gate: {'FAIL' if failures else 'OK'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--worker":
+        sys.exit(_worker(int(sys.argv[2]), int(sys.argv[3]),
+                         sys.argv[4] if len(sys.argv) > 4 else ""))
+    sys.exit(main())

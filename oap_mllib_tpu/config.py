@@ -186,6 +186,35 @@ class Config:
     # only the overlap).  Each unit of depth holds one extra staged chunk
     # in device memory, so HBM grows by chunk_bytes * (depth - 1).
     prefetch_depth: int = 2
+    # -- memory-budget planner (utils/membudget.py) --------------------------
+    # HBM budget consulted by the route planner on every accelerated fit:
+    # the per-device accelerator memory the fit's working set may occupy.
+    # "" (default) = auto-detect (jax device memory_stats bytes_limit;
+    # a conservative host-RAM-derived bound on backends that report
+    # none); a size string ("4G", "512M", "1073741824") pins it; "0" or
+    # "unlimited" disables the HBM constraint.  Budgets only steer ROUTE
+    # selection (in-memory / chunked / streamed / streamed-block) — they
+    # never reject a fit outright unless scale_policy="strict".
+    memory_budget_hbm: str = ""
+    # Host-RAM budget for staged tables (same grammar): the planner
+    # routes fits whose staged host footprint exceeds it onto
+    # disk-backed streaming, and the resilience ladder's spill rung
+    # re-enters the streamed route from disk after a host-classified
+    # OOM.  "" = auto-detect from the machine's physical memory.
+    memory_budget_host: str = ""
+    # What the planner does when the budget forces a route below the
+    # fit's natural one: "auto" (default) picks the cheapest route that
+    # fits the budgets, degrading loudly (warning log + the full
+    # decision in summary.route) but never silently; "strict" raises
+    # BudgetError instead of degrading scale (operators who must never
+    # absorb a slow route without knowing); "pin:<route>" forces one of
+    # in-memory|chunked|streamed|streamed-block, budgets advisory.  A
+    # typo raises at fit entry (the kmeans_kernel contract).
+    scale_policy: str = "auto"
+    # Directory for spilled tables (the resilience ladder's host-OOM
+    # rung stages the source to disk here and re-enters the streamed
+    # route; utils/membudget.spill_source).  "" = the platform temp dir.
+    spill_dir: str = ""
     # -- resilience layer (utils/resilience.py, utils/faults.py) ------------
     # Fault-injection spec: comma-separated "site:kind=count" entries
     # arming deterministic faults at named runtime sites (stream.read,

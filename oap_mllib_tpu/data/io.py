@@ -17,16 +17,28 @@ persistence (models/*.save): atomic JSON manifests and npz shard files
 written tmp+``os.replace`` so a reader NEVER observes a torn file — a
 kill mid-write leaves either the old generation or a stray ``*.tmp``
 that validation ignores.
+
+The out-of-core read plane (ISSUE 12) lives here too: mmap'd ``.npy``
+row readers and parquet piece readers back the disk-backed
+``ChunkSource`` constructors (data/stream.py), and :class:`SpillWriter`
+is the resilience ladder's spill primitive — a host-OOM'd fit stages its
+table to disk chunk-by-chunk (same tmp+``os.replace`` protocol, so a
+kill mid-spill leaves no torn spill) and re-enters the streamed route
+reading it back.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
+import shutil
 import tempfile
-from typing import Dict, Optional, Tuple
+from typing import Dict, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
+
+log = logging.getLogger("oap_mllib_tpu")
 
 
 # -- atomic manifest/shard primitives (checkpoint + model persistence) --------
@@ -113,6 +125,183 @@ def atomic_save_npy(path: str, array: np.ndarray) -> int:
             pass
         raise
     return nbytes
+
+# -- out-of-core read plane: mmap'd .npy + parquet piece readers --------------
+
+
+def open_npy_mmap(path: str) -> np.ndarray:
+    """Open a 2-D ``.npy`` file as a read-only memory map: row slices
+    read from disk on demand, so a beyond-host-RAM table costs O(slice)
+    resident memory, not O(file)."""
+    arr = np.load(path, mmap_mode="r")
+    if arr.ndim != 2:
+        raise ValueError(
+            f"{path}: expected a 2-D array, got shape {arr.shape}"
+        )
+    return arr
+
+
+def iter_npy_rows(path: str, chunk_rows: int,
+                  fault_site: str = "disk.read") -> Iterator[np.ndarray]:
+    """Yield row slices of an mmap'd ``.npy`` file, ``chunk_rows`` at a
+    time.  Each slice read is a registered fault site (``disk.read``, or
+    ``spill.read`` for spill-backed sources) so the chaos/fault plane
+    covers the media path.  The mmap handle lives only for the walk —
+    re-iteration reopens it, so a concurrently replaced spill generation
+    is picked up cleanly."""
+    from oap_mllib_tpu.utils.faults import maybe_fault
+
+    arr = open_npy_mmap(path)
+    for lo in range(0, arr.shape[0], chunk_rows):
+        maybe_fault(fault_site)
+        # np.asarray forces the disk read here (inside the fault site's
+        # accounting) and detaches the yielded piece from the mmap
+        yield np.asarray(arr[lo: lo + chunk_rows])
+
+
+def iter_parquet_rows(
+    path: str, chunk_rows: int,
+    columns: Optional[Sequence[str]] = None,
+) -> Iterator[np.ndarray]:
+    """Yield dense row blocks of a parquet file, ``chunk_rows`` per
+    batch, reading piece by piece (pyarrow ``iter_batches`` — row groups
+    never materialize whole).  Requires pyarrow; raises a clear error
+    when the optional dep is absent instead of an opaque ImportError
+    deep in a pass."""
+    try:
+        import pyarrow.parquet as pq
+    except ImportError as e:  # pragma: no cover - dep present in CI
+        raise RuntimeError(
+            "parquet sources require pyarrow (pip install pyarrow); "
+            "use ChunkSource.from_npy / from_csv for stdlib-only reads"
+        ) from e
+    from oap_mllib_tpu.utils.faults import maybe_fault
+
+    pf = pq.ParquetFile(path)
+    cols = list(columns) if columns is not None else None
+    for batch in pf.iter_batches(batch_size=chunk_rows, columns=cols):
+        maybe_fault("disk.read")
+        arrays = [
+            np.asarray(batch.column(i), dtype=np.float64)
+            for i in range(batch.num_columns)
+        ]
+        yield np.stack(arrays, axis=1)
+
+
+def parquet_schema(path: str) -> Tuple[int, int]:
+    """(n_rows, n_columns) of a parquet file from its footer metadata —
+    the planner prices disk sources without touching row data."""
+    try:
+        import pyarrow.parquet as pq
+    except ImportError as e:  # pragma: no cover - dep present in CI
+        raise RuntimeError(
+            "parquet sources require pyarrow (pip install pyarrow)"
+        ) from e
+    meta = pq.ParquetFile(path).metadata
+    return int(meta.num_rows), int(meta.num_columns)
+
+
+class SpillWriter:
+    """Chunk-at-a-time writer of one 2-D ``.npy`` spill file.
+
+    The resilience ladder's host-OOM rung walks a source once, feeding
+    each piece to :meth:`write`, then :meth:`commit` atomically replaces
+    ``path`` (tmp+``os.replace``, the checkpoint protocol) — a reader
+    can never observe a torn spill, and a kill mid-spill leaves only a
+    stray ``*.tmp`` the next attempt overwrites.  Every chunk write is
+    the ``spill.write`` fault site, so a failed/killed spill is
+    drillable in CI (dev/oom_gate.py).
+
+    Rows may be unknown upfront (file sources discover their length on
+    the first pass): data lands in a raw tmp stream and the ``.npy``
+    header is written at commit, when the true shape is known.
+    """
+
+    def __init__(self, path: str, n_features: int, dtype=np.float32):
+        self.path = path
+        self.n_features = int(n_features)
+        self.dtype = np.dtype(dtype)
+        self.rows = 0
+        self.bytes_written = 0
+        d = os.path.dirname(path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, self._tmp = tempfile.mkstemp(
+            dir=d, prefix=os.path.basename(path) + ".", suffix=".tmp"
+        )
+        self._f = os.fdopen(fd, "wb")
+        self._committed = False
+
+    def write(self, piece: np.ndarray) -> None:
+        """Append one row block (C-order raw bytes at the spill dtype)."""
+        from oap_mllib_tpu.utils.faults import maybe_fault
+
+        maybe_fault("spill.write")
+        piece = np.ascontiguousarray(piece, dtype=self.dtype)
+        if piece.ndim != 2 or piece.shape[1] != self.n_features:
+            raise ValueError(
+                f"spill piece shape {piece.shape} does not match "
+                f"n_features={self.n_features}"
+            )
+        self._f.write(piece.tobytes())
+        self.rows += int(piece.shape[0])
+        self.bytes_written += piece.nbytes
+
+    def commit(self) -> str:
+        """Finalize: prepend the ``.npy`` header for the discovered
+        shape, fsync, and atomically replace ``path``.  Returns the
+        committed path."""
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        final_tmp = self._tmp + ".hdr"
+        try:
+            with open(final_tmp, "wb") as out:
+                np.lib.format.write_array_header_2_0(
+                    out,
+                    {"descr": np.lib.format.dtype_to_descr(self.dtype),
+                     "fortran_order": False,
+                     "shape": (self.rows, self.n_features)},
+                )
+                with open(self._tmp, "rb") as raw:
+                    shutil.copyfileobj(raw, out, 1 << 22)
+                out.flush()
+                os.fsync(out.fileno())
+            os.replace(final_tmp, self.path)
+        except BaseException:
+            for p in (final_tmp,):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            raise
+        finally:
+            try:
+                os.unlink(self._tmp)
+            except OSError:
+                pass
+        self._committed = True
+        return self.path
+
+    def abort(self) -> None:
+        """Drop the tmp stream (failed spill): ``path`` is untouched."""
+        try:
+            self._f.close()
+        except Exception:
+            pass
+        try:
+            os.unlink(self._tmp)
+        except OSError:
+            pass
+
+    def __enter__(self) -> "SpillWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.abort()
+        elif not self._committed:
+            self.commit()
+
 
 def _force_py() -> bool:
     """Env kill-switch for the native host layer: forces the pure-Python

@@ -52,6 +52,7 @@ class ChunkSource:
         chunk_rows: int = DEFAULT_CHUNK_ROWS,
         n_rows: Optional[int] = None,
         dtype=np.float32,
+        backing: str = "stream",
     ):
         if chunk_rows < 1:
             raise ValueError("chunk_rows must be >= 1")
@@ -59,6 +60,13 @@ class ChunkSource:
             raise ValueError("n_features must be >= 1")
         self._make_iter = make_iter
         self.n_features = int(n_features)
+        # what holds this source's rows between passes — the memory-
+        # budget planner (utils/membudget.py) prices host residency off
+        # it: "memory" (an in-RAM array/memmap'd-hot buffer), "disk"
+        # (file-backed: .npy/parquet/csv/libsvm readers — O(chunk) host),
+        # "spill" (a disk spill the resilience ladder staged), "stream"
+        # (an opaque generator: host cost unknown, assumed O(chunk))
+        self.backing = backing
         # shape-bucket the chunk width (data/bucketing.py): every
         # compiled per-chunk program is keyed on (chunk_rows, d), so
         # rounding requested widths up to geometric buckets lets sources
@@ -95,7 +103,7 @@ class ChunkSource:
         therefore per-step device memory) changes."""
         return ChunkSource(
             self._make_iter, self.n_features, chunk_rows,
-            n_rows=self._n_rows, dtype=self.dtype,
+            n_rows=self._n_rows, dtype=self.dtype, backing=self.backing,
         )
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, int]]:
@@ -144,7 +152,27 @@ class ChunkSource:
 
     @classmethod
     def from_array(cls, x, chunk_rows: int = DEFAULT_CHUNK_ROWS) -> "ChunkSource":
-        """Wrap an in-memory array or np.memmap (zero-copy row slices)."""
+        """Wrap an in-memory array, np.memmap (zero-copy row slices), or
+        SciPy sparse matrix.  Sparse inputs densify PER CHUNK at staging
+        time — peak host memory is O(chunk) dense + the CSR itself, not
+        the full dense table (the Spark sparse-vector ingestion analog,
+        without the up-front densify)."""
+        from oap_mllib_tpu.data.sparse import is_sparse
+
+        if is_sparse(x):
+            csr = x.tocsr()
+            if csr.ndim != 2:
+                raise ValueError(f"expected 2-D data, got shape {csr.shape}")
+            dtype = csr.dtype if csr.dtype.kind == "f" else np.float64
+
+            def sgen():
+                for start in range(0, csr.shape[0], chunk_rows):
+                    # the per-chunk densify: only this row slice is ever
+                    # dense on the host at once
+                    yield csr[start : start + chunk_rows].toarray()
+
+            return cls(sgen, csr.shape[1], chunk_rows, n_rows=csr.shape[0],
+                       dtype=dtype, backing="memory")
         x = np.asarray(x) if not isinstance(x, np.memmap) else x
         if x.ndim != 2:
             raise ValueError(f"expected 2-D data, got shape {x.shape}")
@@ -153,7 +181,80 @@ class ChunkSource:
             for start in range(0, x.shape[0], chunk_rows):
                 yield x[start : start + chunk_rows]
 
-        return cls(gen, x.shape[1], chunk_rows, n_rows=x.shape[0], dtype=x.dtype)
+        return cls(gen, x.shape[1], chunk_rows, n_rows=x.shape[0],
+                   dtype=x.dtype, backing="memory")
+
+    @classmethod
+    def from_npy(cls, path: str, chunk_rows: int = DEFAULT_CHUNK_ROWS,
+                 fault_site: str = "disk.read") -> "ChunkSource":
+        """Stream a 2-D ``.npy`` file via a read-only memory map: host
+        memory stays O(chunk) however large the file — the beyond-host-
+        RAM ingestion path (data/io.iter_npy_rows; each slice read is
+        the ``disk.read`` fault site).  ``fault_site="spill.read"`` is
+        how spill-backed sources tag their reads."""
+        from oap_mllib_tpu.data import io as _io
+
+        arr = _io.open_npy_mmap(path)  # validates 2-D, reads shape only
+        n, d = arr.shape
+        dtype = arr.dtype
+        del arr
+
+        def gen():
+            yield from _io.iter_npy_rows(path, chunk_rows, fault_site)
+
+        backing = "spill" if fault_site == "spill.read" else "disk"
+        return cls(gen, d, chunk_rows, n_rows=n, dtype=dtype,
+                   backing=backing)
+
+    @classmethod
+    def from_parquet(
+        cls, path: str, chunk_rows: int = DEFAULT_CHUNK_ROWS,
+        columns=None, dtype=np.float64,
+    ) -> "ChunkSource":
+        """Stream a parquet file piece by piece (pyarrow ``iter_batches``
+        — no row group materializes whole; data/io.iter_parquet_rows).
+        ``columns`` optionally selects/orders numeric columns; row and
+        column counts come from the footer, so the planner prices the
+        source without touching data."""
+        from oap_mllib_tpu.data import io as _io
+
+        n, d_all = _io.parquet_schema(path)
+        d = len(columns) if columns is not None else d_all
+
+        def gen():
+            yield from _io.iter_parquet_rows(path, chunk_rows, columns)
+
+        return cls(gen, d, chunk_rows, n_rows=n, dtype=dtype,
+                   backing="disk")
+
+    def spill_to_disk(self, path: Optional[str] = None) -> "ChunkSource":
+        """Stage this source's rows to one atomic ``.npy`` spill file and
+        return a disk-backed source over it (same chunk_rows / dtype /
+        row order — the streamed pass structure, and therefore the math,
+        is unchanged).  The resilience ladder's host-OOM rung calls this
+        (utils/membudget.spill_source); a kill mid-spill leaves only a
+        ``*.tmp`` the relaunched attempt overwrites (data/io.SpillWriter
+        protocol, drilled by dev/oom_gate.py)."""
+        import tempfile
+
+        from oap_mllib_tpu.config import get_config
+        from oap_mllib_tpu.data import io as _io
+
+        if path is None:
+            import os
+
+            d = get_config().spill_dir or tempfile.gettempdir()
+            os.makedirs(d, exist_ok=True)
+            fd, path = tempfile.mkstemp(
+                dir=d, prefix="oap-spill.", suffix=".npy"
+            )
+            os.close(fd)
+        with _io.SpillWriter(path, self.n_features, self.dtype) as w:
+            for chunk, n_valid in self:
+                w.write(chunk[:n_valid])
+        return ChunkSource.from_npy(
+            path, self.chunk_rows, fault_site="spill.read"
+        )
 
     @classmethod
     def from_csv(
@@ -182,7 +283,8 @@ class ChunkSource:
             if rows:
                 yield np.asarray(rows)
 
-        return cls(gen, n_features, chunk_rows, dtype=dtype)
+        return cls(gen, n_features, chunk_rows, dtype=dtype,
+                   backing="disk")
 
     @classmethod
     def from_libsvm(
@@ -218,4 +320,5 @@ class ChunkSource:
             if fill:
                 yield rows[:fill]
 
-        return cls(gen, n_features, chunk_rows, dtype=dtype)
+        return cls(gen, n_features, chunk_rows, dtype=dtype,
+                   backing="disk")

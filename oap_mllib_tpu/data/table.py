@@ -71,20 +71,38 @@ class DenseTable:
 
     @classmethod
     def from_numpy(cls, x: np.ndarray, mesh, dtype=None) -> "DenseTable":
-        x = np.asarray(x)
-        if x.ndim != 2:
-            raise ValueError(f"expected 2-D data, got shape {x.shape}")
-        if dtype is not None:
-            x = x.astype(dtype)
-        # pad so every data-axis shard has equal rows AND, with bucketing
-        # on (the default), so the padded count lands on a geometric
-        # bucket — every fit whose rows share a bucket reuses one
-        # compiled program, and the bucketed count's power-of-two chunk
-        # factors feed the chunked Lloyd cleanly
+        from oap_mllib_tpu.data import sparse as _sparse
+
         n_data = mesh.shape[mesh.axis_names[0]]
-        padded, n_valid = pad_rows(
-            x, _padded_row_target(x.shape[0], n_data * _ROW_MULTIPLE)
-        )
+        if _sparse.is_sparse(x):
+            # SciPy input: densify per row block straight into the
+            # padded table (data/sparse.densify_into) — peak host extra
+            # is the padded table + one block, never CSR + a second
+            # full dense copy
+            if x.ndim != 2:
+                raise ValueError(f"expected 2-D data, got shape {x.shape}")
+            n_valid = int(x.shape[0])
+            target = _padded_row_target(n_valid, n_data * _ROW_MULTIPLE)
+            out_dtype = np.dtype(
+                dtype if dtype is not None
+                else (x.dtype if x.dtype.kind == "f" else np.float64)
+            )
+            padded = np.zeros((target, int(x.shape[1])), out_dtype)
+            _sparse.densify_into(padded, x, n_valid)
+        else:
+            x = np.asarray(x)
+            if x.ndim != 2:
+                raise ValueError(f"expected 2-D data, got shape {x.shape}")
+            if dtype is not None:
+                x = x.astype(dtype)
+            # pad so every data-axis shard has equal rows AND, with
+            # bucketing on (the default), so the padded count lands on a
+            # geometric bucket — every fit whose rows share a bucket
+            # reuses one compiled program, and the bucketed count's
+            # power-of-two chunk factors feed the chunked Lloyd cleanly
+            padded, n_valid = pad_rows(
+                x, _padded_row_target(x.shape[0], n_data * _ROW_MULTIPLE)
+            )
         mask = np.zeros((padded.shape[0],), dtype=padded.dtype)
         mask[:n_valid] = 1.0
         sharding2 = data_sharding(mesh, 2)

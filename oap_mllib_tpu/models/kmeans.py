@@ -259,11 +259,16 @@ class KMeans:
         self.distance_measure = distance_measure
 
     def fit(self, x, sample_weight: Optional[np.ndarray] = None) -> KMeansModel:
+        from oap_mllib_tpu.data import sparse as _sparse
         from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.utils import membudget
 
         if isinstance(x, ChunkSource):
             return self._fit_source(x, sample_weight)
-        x = np.asarray(x)
+        if not _sparse.is_sparse(x):
+            # SciPy inputs stay sparse here: the chosen route densifies
+            # per chunk/block at staging time (data/sparse.py)
+            x = np.asarray(x)
         if x.ndim != 2:
             raise ValueError(f"expected 2-D data, got shape {x.shape}")
         if x.shape[0] < 1:
@@ -276,28 +281,81 @@ class KMeans:
             from oap_mllib_tpu.utils import resilience
             from oap_mllib_tpu.utils.profiling import maybe_trace
 
+            # memory-budget route plan (utils/membudget.py): an ndarray
+            # whose working set exceeds the HBM budget streams through
+            # the prefetch pipeline instead of silently assuming it fits
+            plan = membudget.plan_kmeans(
+                x.shape[0], x.shape[1], self.k,
+                row_chunks_hint=kmeans_ops.auto_row_chunks(
+                    x.shape[0], self.k
+                ),
+            )
+            if plan.route == membudget.ROUTE_STREAMED:
+                src = ChunkSource.from_array(
+                    x, chunk_rows=plan.chunk_rows
+                )
+                return self._fit_source(src, sample_weight, plan=plan)
             # degradation ladder (utils/resilience.py): transient faults
-            # retry the fit, a device OOM retries once with doubled Lloyd
-            # chunking (half the live distance buffer), and the final
-            # rung is the same CPU path the static gate falls back to
+            # retry the fit; device OOMs walk the geometric halved-chunk
+            # rungs; a HOST OOM spills the table to disk and re-enters
+            # the streamed route; the final rung is the same CPU path
+            # the static gate falls back to
             stats = resilience.ResilienceStats()
+            holder = {}
 
             def attempt(degraded):
+                if holder.get("source") is not None:
+                    # the spill rung fired: the table now lives on disk
+                    return self._stream_attempt(
+                        holder["source"], holder.get("weights"), degraded
+                    )
                 with maybe_trace():
                     return self._fit_tpu(x, sample_weight, degraded)
+
+            def spill():
+                return membudget.spill_array(
+                    holder, x, sample_weight, plan.chunk_rows, "KMeans"
+                )
 
             model = resilience.resilient_fit(
                 "KMeans", attempt,
                 lambda: self._fit_fallback(x, sample_weight),
-                stats=stats,
+                stats=stats, spill=spill,
             )
             resilience.merge_stats(model.summary, stats)
+            membudget.record_plan(
+                model.summary, plan, spilled=stats.spilled
+            )
             telemetry.finalize_fit(model.summary)
             return model
         return self._fit_fallback(x, sample_weight)
 
     # -- streamed (out-of-core) path -----------------------------------------
-    def _fit_source(self, source, sample_weight) -> KMeansModel:
+    def _stream_attempt(self, source, sample_weight, degraded):
+        """One streamed-fit attempt at halving level ``degraded`` (the
+        resilience ladder's geometric OOM rung: chunk width / 2^level,
+        floored at OOM_CHUNK_FLOOR_ROWS — never widened)."""
+        from oap_mllib_tpu.config import get_config as _gc
+        from oap_mllib_tpu.utils import resilience
+        from oap_mllib_tpu.utils.profiling import maybe_trace
+        from oap_mllib_tpu.utils.timing import x64_scope
+
+        cfg = _gc()
+        dtype = np.float64 if cfg.enable_x64 else np.float32
+        src, w = source, sample_weight
+        if degraded:
+            rows = max(
+                source.chunk_rows // (2 ** int(degraded)),
+                min(resilience.OOM_CHUNK_FLOOR_ROWS, source.chunk_rows),
+                1,
+            )
+            src = source.with_chunk_rows(rows)
+            if w is not None:
+                w = w.with_chunk_rows(rows)
+        with maybe_trace(), x64_scope(cfg.enable_x64):
+            return self._fit_stream_inner(src, w, dtype, cfg)
+
+    def _fit_source(self, source, sample_weight, plan=None) -> KMeansModel:
         """Out-of-core fit from a ChunkSource (ops/stream_ops.py): device
         memory bounded by O(chunk), one pass per Lloyd iteration.  Multi
         -process: every process passes its OWN shard as a local source;
@@ -347,41 +405,47 @@ class KMeans:
                 if sample_weight is not None else None
             )
             return self._fit_fallback(source.to_array(), w_arr)
-        from oap_mllib_tpu.utils import resilience
-        from oap_mllib_tpu.utils.profiling import maybe_trace
-        from oap_mllib_tpu.utils.timing import x64_scope
+        from oap_mllib_tpu.utils import membudget, resilience
 
-        cfg = get_config()
-        dtype = np.float64 if cfg.enable_x64 else np.float32
+        # route plan: source fits stream by construction; the planner
+        # records the decision + estimates (and raises under strict when
+        # even the streamed footprint exceeds the budget)
+        if plan is None:
+            plan = membudget.plan_kmeans(
+                source.n_rows, source.n_features, self.k,
+                source_backing=source.backing,
+                chunk_rows=source.chunk_rows,
+            )
         # degradation ladder: transient source/staging faults retry the
-        # fit, a device OOM re-chunks the source (and its lockstep weight
-        # source) at chunk_rows/2 for one degraded retry, then the CPU
-        # path (which materializes the source) is the final rung.  Multi
-        # -process worlds bypass the ladder — the fail-fast static-world
-        # contract (docs/distributed.md) — resilient_fit handles that.
+        # fit; device OOMs re-chunk the source (and its lockstep weight
+        # source) at chunk_rows/2^level geometrically down to the floor;
+        # a HOST OOM on a memory-backed source spills it to disk and
+        # re-enters this same streamed route; then the CPU path (which
+        # materializes the source) is the final rung.  Multi-process
+        # worlds bypass the ladder — the fail-fast static-world contract
+        # (docs/distributed.md) — resilient_fit handles that.
         stats = resilience.ResilienceStats()
+        holder = {"source": source, "weights": sample_weight}
 
         def attempt(degraded):
-            src, w = source, sample_weight
-            if degraded:
-                half = max(1, source.chunk_rows // 2)
-                src = source.with_chunk_rows(half)
-                if w is not None:
-                    w = w.with_chunk_rows(half)
-            with maybe_trace(), x64_scope(cfg.enable_x64):
-                return self._fit_stream_inner(src, w, dtype, cfg)
+            return self._stream_attempt(
+                holder["source"], holder.get("weights"), degraded
+            )
 
         def fallback():
-            w_arr = (
-                sample_weight.to_array().reshape(-1)
-                if sample_weight is not None else None
-            )
-            return self._fit_fallback(source.to_array(), w_arr)
+            w = holder.get("weights")
+            w_arr = w.to_array().reshape(-1) if w is not None else None
+            return self._fit_fallback(holder["source"].to_array(), w_arr)
 
+        spill = None
+        if source.backing not in ("disk", "spill"):
+            spill = lambda: membudget.spill_source(holder, "KMeans")  # noqa: E731
         model = resilience.resilient_fit(
-            "KMeans", attempt, fallback, stats=stats
+            "KMeans", attempt, fallback, stats=stats, spill=spill,
+            max_halvings=resilience.halvings_available(source.chunk_rows),
         )
         resilience.merge_stats(model.summary, stats)
+        membudget.record_plan(model.summary, plan, spilled=stats.spilled)
         telemetry.finalize_fit(model.summary)
         return model
 
@@ -483,7 +547,21 @@ class KMeans:
             # contribution) and slice them back off the final centers.
             # Skipped when no padding is needed or when "xla" forces the
             # GSPMD route — np.pad would copy the whole dataset.
-            x = np.pad(x, ((0, 0), (0, (-d_orig) % mp)))
+            from oap_mllib_tpu.data import sparse as _sparse
+
+            if _sparse.is_sparse(x):
+                # zero columns add no stored entries in CSR
+                import scipy.sparse as sp
+
+                x = sp.csr_matrix(
+                    sp.hstack(
+                        [x, sp.csr_matrix(
+                            (x.shape[0], (-d_orig) % mp), dtype=x.dtype
+                        )]
+                    )
+                )
+            else:
+                x = np.pad(x, ((0, 0), (0, (-d_orig) % mp)))
         with phase_timer(timings, "table_convert"):
             # multi-process: each host contributes its local shard
             # (README multi-host flow); single-process: the full table
@@ -635,9 +713,12 @@ class KMeans:
             else 1
         )
         if degraded and single_device:
-            # auto_row_chunks returns a chunk COUNT — doubling it halves
-            # the rows (and the live (chunk, k) buffer) per scan step
-            row_chunks = min(row_chunks * 2, max(table.n_padded, 1))
+            # auto_row_chunks returns a chunk COUNT — each geometric
+            # rung doubles it again, halving the rows (and the live
+            # (chunk, k) buffer) per scan step
+            row_chunks = min(
+                row_chunks * (2 ** int(degraded)), max(table.n_padded, 1)
+            )
 
         def run_iters(c0, iters):
             return kmeans_ops.lloyd_run(
@@ -695,7 +776,12 @@ class KMeans:
 
     # -- fallback path (~ trainWithML, KMeans.scala:355) ---------------------
     def _fit_fallback(self, x: np.ndarray, sample_weight: Optional[np.ndarray]) -> KMeansModel:
+        from oap_mllib_tpu.data import sparse as _sparse
+
         timings = Timings("kmeans.fit")
+        if _sparse.is_sparse(x):
+            # the NumPy reference semantics assume dense host data
+            x = x.toarray()
         x = x.astype(np.float64)
         with phase_timer(timings, "init_centers"):
             if self.init_mode == INIT_RANDOM:

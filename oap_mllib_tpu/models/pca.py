@@ -175,7 +175,9 @@ class PCA:
         return vals[: self.k], vecs, float(vals.sum()), "eigh"
 
     def fit(self, x) -> PCAModel:
+        from oap_mllib_tpu.data import sparse as _sparse
         from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.utils import membudget
 
         # validate up front, on EVERY path: a typo'd solver must fail
         # fast — before a (potentially multi-minute) streamed covariance
@@ -184,7 +186,10 @@ class PCA:
         _pca_solver_cfg()
         if isinstance(x, ChunkSource):
             return self._fit_source(x)
-        x = np.asarray(x)
+        if not _sparse.is_sparse(x):
+            # SciPy inputs stay sparse: the chosen route densifies per
+            # chunk/block at staging time (data/sparse.py)
+            x = np.asarray(x)
         if x.ndim != 2:
             raise ValueError(f"expected 2-D data, got shape {x.shape}")
         n, d = x.shape
@@ -195,26 +200,71 @@ class PCA:
             from oap_mllib_tpu.utils import resilience
             from oap_mllib_tpu.utils.profiling import maybe_trace
 
+            # memory-budget route plan (utils/membudget.py): a table
+            # whose resident footprint exceeds the HBM budget streams
+            # the two-pass covariance instead of assuming it fits
+            plan = membudget.plan_pca(n, d)
+            if plan.route == membudget.ROUTE_STREAMED:
+                src = ChunkSource.from_array(
+                    x, chunk_rows=plan.chunk_rows
+                )
+                return self._fit_source(src, plan=plan)
             # degradation ladder: transient faults retry; the in-memory
             # covariance has no chunk knob, so the OOM rung re-runs the
-            # same program once (a persistent OOM then falls through to
-            # the CPU path — the rung that actually sheds memory here)
+            # same program once; a HOST OOM spills the table to disk and
+            # re-enters the STREAMED covariance; then the CPU path
             stats = resilience.ResilienceStats()
+            holder = {}
 
             def attempt(degraded):
+                if holder.get("source") is not None:
+                    # the spill rung fired: stream from disk
+                    return self._stream_attempt(
+                        holder["source"], degraded
+                    )
                 with maybe_trace():
                     return self._fit_tpu(x)
 
+            def spill():
+                return membudget.spill_array(
+                    holder, x, None, plan.chunk_rows, "PCA"
+                )
+
             model = resilience.resilient_fit(
-                "PCA", attempt, lambda: self._fit_fallback(x), stats=stats
+                "PCA", attempt, lambda: self._fit_fallback(x),
+                stats=stats, spill=spill,
             )
             resilience.merge_stats(model.summary, stats)
+            membudget.record_plan(
+                model.summary, plan, spilled=stats.spilled
+            )
             telemetry.finalize_fit(model.summary)
             return model
         return self._fit_fallback(x)
 
     # -- streamed (out-of-core) path -----------------------------------------
-    def _fit_source(self, source) -> PCAModel:
+    def _stream_attempt(self, source, degraded):
+        """One streamed-fit attempt at halving level ``degraded``
+        (geometric chunk width / 2^level, floored — the K-Means
+        _stream_attempt contract)."""
+        from oap_mllib_tpu.utils import resilience
+        from oap_mllib_tpu.utils.profiling import maybe_trace
+        from oap_mllib_tpu.utils.timing import x64_scope
+
+        cfg = get_config()
+        dtype = np.float64 if cfg.enable_x64 else np.float32
+        src = source
+        if degraded:
+            rows = max(
+                source.chunk_rows // (2 ** int(degraded)),
+                min(resilience.OOM_CHUNK_FLOOR_ROWS, source.chunk_rows),
+                1,
+            )
+            src = source.with_chunk_rows(rows)
+        with maybe_trace(), x64_scope(cfg.enable_x64):
+            return self._fit_stream_inner(src, dtype, cfg)
+
+    def _fit_source(self, source, plan=None) -> PCAModel:
         """Out-of-core fit from a ChunkSource: two streamed passes (column
         sums, centered Gram — ops/stream_ops.covariance_streamed), device
         memory bounded by O(chunk + d^2).  Multi-process: every process
@@ -237,32 +287,39 @@ class PCA:
                     "path or fit in-memory"
                 )
             return self._fit_fallback(source.to_array())
-        from oap_mllib_tpu.utils import resilience
-        from oap_mllib_tpu.utils.profiling import maybe_trace
-        from oap_mllib_tpu.utils.timing import x64_scope
+        from oap_mllib_tpu.utils import membudget, resilience
 
-        cfg = get_config()
-        dtype = np.float64 if cfg.enable_x64 else np.float32
+        # route plan: source fits stream by construction; the decision,
+        # estimates, and any budget breach are recorded (strict raises
+        # when even the streamed footprint exceeds the budget)
+        if plan is None:
+            plan = membudget.plan_pca(
+                source.n_rows, d, source_backing=source.backing,
+                chunk_rows=source.chunk_rows,
+            )
         # degradation ladder: transient source/staging faults retry the
-        # two-pass covariance, a device OOM re-chunks the source at
-        # chunk_rows/2 for one degraded retry, then the CPU path (which
-        # materializes the source) — single-process only (resilient_fit)
+        # two-pass covariance; device OOMs re-chunk the source at
+        # chunk_rows/2^level geometrically down to the floor; a HOST OOM
+        # on a memory-backed source spills it to disk and re-enters this
+        # streamed route; then the CPU path (which materializes the
+        # source) — single-process only (resilient_fit)
         stats = resilience.ResilienceStats()
+        holder = {"source": source}
 
         def attempt(degraded):
-            src = (
-                source.with_chunk_rows(max(1, source.chunk_rows // 2))
-                if degraded else source
-            )
-            with maybe_trace(), x64_scope(cfg.enable_x64):
-                return self._fit_stream_inner(src, dtype, cfg)
+            return self._stream_attempt(holder["source"], degraded)
 
+        spill = None
+        if source.backing not in ("disk", "spill"):
+            spill = lambda: membudget.spill_source(holder, "PCA")  # noqa: E731
         model = resilience.resilient_fit(
             "PCA", attempt,
-            lambda: self._fit_fallback(source.to_array()),
-            stats=stats,
+            lambda: self._fit_fallback(holder["source"].to_array()),
+            stats=stats, spill=spill,
+            max_halvings=resilience.halvings_available(source.chunk_rows),
         )
         resilience.merge_stats(model.summary, stats)
+        membudget.record_plan(model.summary, plan, spilled=stats.spilled)
         telemetry.finalize_fit(model.summary)
         return model
 
@@ -343,7 +400,20 @@ class PCA:
             # model-sharded Gram needs d % model == 0; zero-pad feature
             # columns (they yield zero eigenvalues, which sort last) and
             # slice the component rows back after eigh
-            x = np.pad(x, ((0, 0), (0, (-d) % mp)))
+            from oap_mllib_tpu.data import sparse as _sparse
+
+            if _sparse.is_sparse(x):
+                import scipy.sparse as sp
+
+                x = sp.csr_matrix(
+                    sp.hstack(
+                        [x, sp.csr_matrix(
+                            (x.shape[0], (-d) % mp), dtype=x.dtype
+                        )]
+                    )
+                )
+            else:
+                x = np.pad(x, ((0, 0), (0, (-d) % mp)))
         if restored:
             # the in-memory iterate state is the covariance itself
             # (stored unpadded, so it restores onto any model-parallel
@@ -399,7 +469,12 @@ class PCA:
 
     # -- fallback path (~ vanilla mllib.feature.PCA, PCA.scala:110-116) ------
     def _fit_fallback(self, x: np.ndarray) -> PCAModel:
+        from oap_mllib_tpu.data import sparse as _sparse
+
         timings = Timings("pca.fit")
+        if _sparse.is_sparse(x):
+            # the NumPy reference semantics assume dense host data
+            x = x.toarray()
         with phase_timer(timings, "pca_np"):
             comps, ratio = pca_np(x, self.k)
         # the fallback always factorizes fully; recording it keeps a

@@ -36,6 +36,18 @@ site                  fires at
                       partition, or a preemption surfaces; drives the
                       recovery plane's deadline/abort tiers
                       (utils/recovery.py)
+``disk.read``         every piece pulled from a DISK-backed
+                      ``ChunkSource`` (mmap'd ``.npy`` / parquet piece
+                      readers, data/stream.py) — media faults on the
+                      out-of-core read path
+``spill.write``       every chunk written by the spill writer
+                      (data/io.SpillWriter) — a failed spill write
+                      warns + falls through the resilience ladder (the
+                      tmp+``os.replace`` protocol means it can never
+                      corrupt an existing spill)
+``spill.read``        every piece pulled from a SPILL-backed
+                      ``ChunkSource`` (a table the host-OOM rung staged
+                      to disk) — drives the spilled-route read tiers
 ====================  =====================================================
 
 Arming: ``Config.fault_spec`` / env ``OAP_MLLIB_TPU_FAULT_SPEC``, a
@@ -47,8 +59,11 @@ comma-separated list of ``site:kind=count`` entries::
                                                # (persistent fault)
 
 Kinds: ``fail`` = transient (classified TRANSIENT — the retry tier),
-``oom`` = device memory exhaustion (classified OOM — the halved-chunk
-rung), ``nan`` = non-finite iterate (classified NONFINITE — drives the
+``oom`` = device memory exhaustion (classified OOM — the geometric
+halved-chunk rung), ``oomhost`` = HOST memory exhaustion (classified
+OOM_HOST — drives the spill-to-disk rung: the staged table moves to a
+disk-backed source and the fit re-enters the streamed route), ``nan`` =
+non-finite iterate (classified NONFINITE — drives the
 precision-degradation rung and the ``nonfinite_policy`` tiers), ``err``
 = permanent (classified as no fault — propagates raw), ``kill`` = the
 process is SIGKILLed on the spot (no exception, no cleanup — a
@@ -80,14 +95,17 @@ from oap_mllib_tpu.config import get_config
 SITES = (
     "stream.read", "prefetch.stage", "bootstrap.connect", "fit.execute",
     "ckpt.write", "ckpt.restore", "collective.dispatch",
+    "disk.read", "spill.write", "spill.read",
 )
 
 KIND_FAIL = "fail"
 KIND_OOM = "oom"
+KIND_HOST_OOM = "oomhost"
 KIND_NONFINITE = "nan"
 KIND_ERR = "err"
 KIND_KILL = "kill"
-_KINDS = (KIND_FAIL, KIND_OOM, KIND_NONFINITE, KIND_ERR, KIND_KILL)
+_KINDS = (KIND_FAIL, KIND_OOM, KIND_HOST_OOM, KIND_NONFINITE, KIND_ERR,
+          KIND_KILL)
 
 
 class FaultInjected(Exception):
@@ -109,6 +127,14 @@ class InjectedOOMError(FaultInjected, MemoryError):
     ``RESOURCE_EXHAUSTED`` phrase the classifier keys on for real ones."""
 
     kind = KIND_OOM
+
+
+class InjectedHostOOMError(FaultInjected, MemoryError):
+    """Injected HOST-memory exhaustion (a bare ``MemoryError`` with no
+    device marker — the shape a failed np allocation raises): classified
+    OOM_HOST, driving the resilience ladder's spill-to-disk rung."""
+
+    kind = KIND_HOST_OOM
 
 
 class InjectedPermanentError(FaultInjected, RuntimeError):
@@ -150,6 +176,10 @@ def _make_fault(kind: str, site: str, nth: int) -> FaultInjected:
     if kind == KIND_FAIL:
         return InjectedTransientError(
             f"injected transient fault at {site} (call {nth})"
+        )
+    if kind == KIND_HOST_OOM:
+        return InjectedHostOOMError(
+            f"injected host memory exhaustion at {site} (call {nth})"
         )
     if kind == KIND_NONFINITE:
         return InjectedNonFiniteError(

@@ -15,10 +15,20 @@ The ladder, per accelerated fit (single-process; see below)::
       │ transient fault (I/O error, Unavailable, connection refused)
       ├──> retry the attempt under RetryPolicy (exponential backoff +
       │    deterministic jitter, bounded by retries AND deadline)
-      │ device OOM (XLA RESOURCE_EXHAUSTED / MemoryError)
-      ├──> ONE degraded retry: halved chunks (streamed sources re-chunk
-      │    at chunk_rows/2; in-memory K-Means doubles its Lloyd chunk
-      │    count; streamed ALS halves its upload blocks)
+      │ HOST-RAM OOM (a bare MemoryError with no device marker)
+      ├──> the SPILL rung: stage the source to a disk-backed spill
+      │    (data/io.SpillWriter, atomic) and re-enter the STREAMED route
+      │    reading from disk — host RAM sheds O(table), the pass
+      │    structure (and therefore the math) is unchanged.  A failed
+      │    spill write warns and falls through to the rungs below.
+      │ device OOM (XLA RESOURCE_EXHAUSTED)
+      ├──> GEOMETRIC halved-chunk retries: chunk width halves per rung
+      │    (streamed sources re-chunk at chunk_rows/2^level down to the
+      │    OOM_CHUNK_FLOOR_ROWS floor; in-memory K-Means doubles its
+      │    Lloyd chunk count per rung; streamed ALS halves its upload
+      │    blocks), bounded by retry_limit AND the caller's halving
+      │    headroom; the divisor sequence lands in
+      │    ``ResilienceStats.halvings``
       │ non-finite iterate while a REDUCED compute-precision policy
       │ (bf16/tf32, utils/precision.py) was active
       ├──> the PRECISION rung: ONE retry with every policy pinned to f32
@@ -65,8 +75,27 @@ log = logging.getLogger("oap_mllib_tpu")
 
 # fault kinds (classify_fault return values)
 TRANSIENT = "transient"
-OOM = "oom"
+OOM = "oom"  # device memory exhaustion (XLA RESOURCE_EXHAUSTED shapes)
+OOM_HOST = "oom-host"  # host-RAM exhaustion (bare MemoryError) — spills
 NONFINITE = "nonfinite"
+
+# streamed chunk widths never halve below this floor: a sub-64-row chunk
+# cannot OOM any real device, so further halving only multiplies pass
+# overhead — the rung falls through to the CPU path instead
+OOM_CHUNK_FLOOR_ROWS = 64
+
+
+def halvings_available(chunk_rows: int,
+                       floor: int = OOM_CHUNK_FLOOR_ROWS) -> int:
+    """How many times ``chunk_rows`` can halve before crossing ``floor``
+    — the per-fit bound streamed estimators hand the geometric OOM rung
+    (further capped by ``retry_limit`` inside :func:`resilient_fit`)."""
+    n = 0
+    rows = int(chunk_rows)
+    while rows // 2 >= floor:
+        rows //= 2
+        n += 1
+    return max(n, 1)  # every path keeps at least the legacy single rung
 
 # message markers for faults that only identify themselves textually
 # (jaxlib's XlaRuntimeError carries gRPC/XLA status names in the string)
@@ -113,7 +142,10 @@ def classify_fault(exc: BaseException) -> Optional[str]:
 
     - Injected faults (utils/faults.py) carry their kind explicitly.
     - :class:`NonFiniteError` -> NONFINITE (guardrail detections).
-    - ``MemoryError`` or XLA ``RESOURCE_EXHAUSTED``/OOM messages -> OOM.
+    - XLA ``RESOURCE_EXHAUSTED``/OOM messages -> OOM (device memory).
+    - A bare ``MemoryError`` with no device marker -> OOM_HOST (a failed
+      host allocation — np buffers, staging copies): the ladder's SPILL
+      rung, not the device halved-chunk rung.
     - ``ConnectionError``/``OSError`` (host I/O, refused sockets) and
       Unavailable/DeadlineExceeded-style messages -> TRANSIENT.
     - Everything else -> None (a programming error or bad input; the
@@ -125,13 +157,16 @@ def classify_fault(exc: BaseException) -> Optional[str]:
         return {
             faults.KIND_FAIL: TRANSIENT,
             faults.KIND_OOM: OOM,
+            faults.KIND_HOST_OOM: OOM_HOST,
             faults.KIND_NONFINITE: NONFINITE,
         }.get(exc.kind)
     if isinstance(exc, NonFiniteError):
         return NONFINITE
     msg = str(exc).lower()
-    if isinstance(exc, MemoryError) or any(m in msg for m in _OOM_MARKERS):
+    if any(m in msg for m in _OOM_MARKERS):
         return OOM
+    if isinstance(exc, MemoryError):
+        return OOM_HOST
     if isinstance(exc, (ConnectionError, TimeoutError)):
         return TRANSIENT
     if isinstance(exc, OSError):
@@ -183,14 +218,22 @@ class ResilienceStats:
     ``progcache`` delta (see :func:`merge_stats`)."""
 
     __slots__ = ("retries", "degradations", "faults", "backoff_s", "history",
-                 "ladder")
+                 "ladder", "halvings", "spilled")
 
     def __init__(self) -> None:
         self.retries = 0  # transient retries taken
-        self.degradations = 0  # ladder rungs stepped (halved-chunk, fallback)
+        self.degradations = 0  # ladder rungs stepped (spill, halved, fallback)
         self.faults = 0  # faults observed (classified exceptions)
         self.backoff_s = 0.0  # total wall slept in backoff
         self.history: List[str] = []  # "<site>[<kind>]: <message>" entries
+        # geometric OOM-rung trail: the chunk DIVISOR of each halving
+        # rung stepped (2, 4, 8, ...), so a summary shows not just that
+        # the fit degraded but how far the chunk width walked down
+        self.halvings: List[int] = []
+        # the host-OOM spill rung fired and the fit re-entered the
+        # streamed route from a disk spill (summary.route carries the
+        # spill source details)
+        self.spilled = False
         # which protections were live for this fit: "active" (the full
         # single-process ladder) vs "bypassed(static-world)" (multi-
         # process worlds keep fail-fast-together semantics; recovery
@@ -244,6 +287,8 @@ class ResilienceStats:
             "backoff_s": self.backoff_s,
             "history": list(self.history),
             "ladder": self.ladder,
+            "halvings": list(self.halvings),
+            "spilled": self.spilled,
         }
 
 
@@ -332,27 +377,40 @@ def run_with_retry(
 
 def resilient_fit(
     algo: str,
-    attempt: Callable[[bool], object],
+    attempt: Callable[[int], object],
     fallback: Optional[Callable[[], object]],
     *,
     stats: Optional[ResilienceStats] = None,
     policy: Optional[RetryPolicy] = None,
+    spill: Optional[Callable[[], bool]] = None,
+    max_halvings: Optional[int] = None,
 ):
     """Run an accelerated fit under the full degradation ladder.
 
-    ``attempt(degraded)`` runs the accelerated fit; ``degraded=True`` is
-    the halved-chunk rung (estimators map it to their chunk knob; paths
-    without one run the same program again — a persistent fault then
-    falls through to the next rung).  ``fallback()`` is the CPU/NumPy
-    path, consulted only when ``Config.fallback`` is True (via
-    ``dispatch.allow_fallback``, the same gate the static predicate
-    uses).  Multi-process worlds run ``attempt(False)`` once — the
-    ladder is a single-process facility (module docstring).
+    ``attempt(degraded)`` runs the accelerated fit; ``degraded`` is the
+    halved-chunk rung LEVEL (0 = full chunks, level n = chunk width
+    divided by 2^n — estimators map it to their chunk knob with the
+    OOM_CHUNK_FLOOR_ROWS floor; paths without a knob run the same
+    program again, and a persistent fault then falls through to the next
+    rung).  Legacy boolean callbacks keep working — level 0 is falsy.
+    ``max_halvings`` bounds the geometric walk (use
+    :func:`halvings_available` for chunked sources; None keeps the
+    legacy single rung), further capped by ``policy.max_retries``.
+    ``spill()`` is the host-OOM rung: stage the fit's source to disk and
+    swap the attempt onto the disk-backed streamed route (return True on
+    success; False/raise warns and falls through to the rungs below —
+    never corrupts, the SpillWriter atomic protocol).  ``fallback()`` is
+    the CPU/NumPy path, consulted only when ``Config.fallback`` is True
+    (via ``dispatch.allow_fallback``, the same gate the static predicate
+    uses).  Multi-process worlds run ``attempt(0)`` once — the ladder is
+    a single-process facility (module docstring).
 
     Fault routing: TRANSIENT retries under ``policy`` (count + deadline
-    bounded); the first OOM steps to the degraded rung (transient
-    retries still available there); a NONFINITE fault raised while the
-    attempt resolved a REDUCED compute-precision policy (bf16/tf32 —
+    bounded); an OOM_HOST fault steps the SPILL rung once (then behaves
+    like OOM); each device OOM steps one geometric halving rung while
+    headroom remains (transient retries still available there); a
+    NONFINITE fault raised while the attempt resolved a REDUCED
+    compute-precision policy (bf16/tf32 —
     utils/precision.reduced_active) first steps the PRECISION rung: one
     retry with every policy pinned to f32, BEFORE the
     ``nonfinite_policy`` decision, so a rounding-induced NaN degrades to
@@ -380,7 +438,7 @@ def resilient_fit(
         else:
             stats.ladder = "bypassed(static-world)"
         try:
-            return attempt(False)
+            return attempt(0)
         except Exception as e:
             from oap_mllib_tpu.utils import recovery
 
@@ -389,8 +447,13 @@ def resilient_fit(
     stats.ladder = "active"
     policy = policy or RetryPolicy.from_config()
     deadline = time.monotonic() + policy.deadline_s
-    degraded = False
+    halving_limit = min(
+        1 if max_halvings is None else max(int(max_halvings), 0),
+        max(policy.max_retries, 1),
+    )
+    degraded = 0  # halving level: chunk width / 2^degraded
     precision_degraded = False
+    spilled = False
     while True:
         try:
             _precision.begin_attempt()
@@ -414,12 +477,40 @@ def resilient_fit(
                     )
                     time.sleep(delay)
                     continue
-            if kind == OOM and not degraded:
-                degraded = True
+            if kind == OOM_HOST and spill is not None and not spilled:
+                # the spill rung: stage the table to disk and re-enter
+                # the streamed route — the ONLY rung that sheds host
+                # RAM.  A failed spill warns and falls through (the
+                # halving rungs below also shrink host staging buffers).
+                spilled = True
                 stats.note_degradation()
+                ok = False
+                try:
+                    ok = bool(spill())
+                except Exception as spill_err:  # noqa: BLE001 — rung must
+                    log.warning(  # fall through, never mask the ladder
+                        "%s: spill to disk raised (%s); falling through "
+                        "the ladder", site, spill_err,
+                    )
+                if ok:
+                    stats.spilled = True
+                    log.warning(
+                        "%s: host OOM (%s); spilled the staged table to "
+                        "disk and re-entering the streamed route", site, e,
+                    )
+                    continue
                 log.warning(
-                    "%s: device OOM (%s); retrying once with halved chunks",
-                    site, e,
+                    "%s: host OOM (%s) and the spill rung failed; "
+                    "continuing down the ladder", site, e,
+                )
+            if kind in (OOM, OOM_HOST) and degraded < halving_limit:
+                degraded += 1
+                stats.note_degradation()
+                stats.halvings.append(2 ** degraded)
+                log.warning(
+                    "%s: OOM (%s); retrying at chunk width /%d "
+                    "(halving %d/%d)",
+                    site, e, 2 ** degraded, degraded, halving_limit,
                 )
                 continue
             if (

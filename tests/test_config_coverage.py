@@ -292,6 +292,41 @@ class TestConfigCoverage:
         path = recovery.write_crash_record("s", "oom", "x")
         assert path is not None and path.startswith(str(tmp_path))
 
+    def test_memory_budget_typo_raises_at_fit(self, rng):
+        """The kmeans_kernel contract for the route planner (ISSUE 12):
+        a budget that parses to nothing must raise at fit entry, not
+        silently plan unbounded."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        set_config(memory_budget_hbm="12Q")
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        with pytest.raises(ValueError, match="memory budget"):
+            KMeans(k=2, init_mode="random", max_iter=1).fit(x)
+        set_config(memory_budget_hbm="")
+
+    def test_budget_knobs_reach_planner(self):
+        from oap_mllib_tpu.utils import membudget
+
+        set_config(memory_budget_hbm="64M", memory_budget_host="2G")
+        b = membudget.Budgets.resolve()
+        assert b.hbm == 64 << 20 and b.host == 2 << 30
+        assert b.hbm_source == "config" and b.host_source == "config"
+        set_config(memory_budget_hbm="", memory_budget_host="")
+
+    def test_spill_dir_reaches_spill(self, rng, tmp_path):
+        import os
+
+        from oap_mllib_tpu.data.stream import ChunkSource
+
+        set_config(spill_dir=str(tmp_path))
+        x = rng.normal(size=(100, 3)).astype(np.float32)
+        spilled = ChunkSource.from_array(x, chunk_rows=64).spill_to_disk()
+        np.testing.assert_array_equal(spilled.to_array(), x)
+        assert any(
+            f.startswith("oap-spill.") for f in os.listdir(tmp_path)
+        )
+        set_config(spill_dir="")
+
     def test_retry_knobs_reach_policy(self):
         """retry_limit / retry_backoff / retry_deadline flow into
         RetryPolicy.from_config with float coercion intact."""

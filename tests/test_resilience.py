@@ -19,12 +19,14 @@ from oap_mllib_tpu.utils import faults, resilience
 from oap_mllib_tpu.utils.resilience import (
     NONFINITE,
     OOM,
+    OOM_HOST,
     TRANSIENT,
     NonFiniteError,
     ResilienceError,
     ResilienceStats,
     RetryPolicy,
     classify_fault,
+    halvings_available,
 )
 
 
@@ -57,9 +59,19 @@ class TestClassifier:
         assert classify_fault(
             RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating")
         ) == OOM
-        assert classify_fault(MemoryError("host")) == OOM
         assert classify_fault(
             RuntimeError("failed to allocate 16.00G")
+        ) == OOM
+
+    def test_host_oom_is_distinct_from_device_oom(self):
+        """A bare MemoryError (a failed np allocation) is the HOST
+        class — the spill rung — while device markers stay OOM (the
+        halved-chunk rung); a MemoryError CARRYING a device marker is
+        still device (jaxlib raises MemoryError subclasses for XLA
+        RESOURCE_EXHAUSTED)."""
+        assert classify_fault(MemoryError("host")) == OOM_HOST
+        assert classify_fault(
+            MemoryError("RESOURCE_EXHAUSTED: out of memory")
         ) == OOM
 
     def test_non_faults_are_none(self):
@@ -71,6 +83,7 @@ class TestClassifier:
         assert classify_fault(
             faults.InjectedTransientError("x")) == TRANSIENT
         assert classify_fault(faults.InjectedOOMError("x")) == OOM
+        assert classify_fault(faults.InjectedHostOOMError("x")) == OOM_HOST
         assert classify_fault(faults.InjectedPermanentError("x")) is None
 
     def test_nonfinite(self):
@@ -363,6 +376,109 @@ class TestLadderRungs:
         np.testing.assert_allclose(
             m.item_factors_, baseline.item_factors_, atol=1e-6
         )
+
+    def test_geometric_halving_walks_to_the_floor(self, rng):
+        """chunk_rows=256 has TWO halvings above the 64-row floor
+        (256 -> 128 -> 64): a persistent device OOM steps both, records
+        the divisor trail in ``halvings``, then takes the CPU rung —
+        the geometric generalization of the old single halved retry."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        assert halvings_available(256) == 2
+        assert halvings_available(128) == 1
+        assert halvings_available(64) == 1  # legacy single rung floor
+        set_config(fault_spec="fit.execute:oom=*", fallback=True)
+        faults.reset()
+        x = _blobs(rng)
+        m = KMeans(k=3, seed=7, max_iter=8).fit(
+            ChunkSource.from_array(x, chunk_rows=256)
+        )
+        res = m.summary.resilience
+        assert not m.summary.accelerated
+        assert res["degradations"] == 3  # 2 halvings + the CPU rung
+        assert res["halvings"] == [2, 4]
+        assert len(res["history"]) == 3
+
+    def test_halvings_bounded_by_retry_limit(self, rng):
+        """retry_limit caps the geometric walk even with chunk headroom
+        left (a fit must not halve forever on a huge chunk)."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        set_config(
+            fault_spec="fit.execute:oom=*", fallback=True, retry_limit=1
+        )
+        faults.reset()
+        x = _blobs(rng)
+        m = KMeans(k=3, seed=7, max_iter=4).fit(
+            ChunkSource.from_array(x, chunk_rows=512)
+        )
+        res = m.summary.resilience
+        assert res["halvings"] == [2]  # one rung despite 3 of headroom
+        assert res["degradations"] == 2
+        set_config(retry_limit=5)
+
+    def test_host_oom_spills_to_disk_and_completes(self, rng):
+        """The spill rung: a host-classified OOM mid-pass stages the
+        memory-backed source to a disk spill and the fit completes
+        ACCELERATED through the streamed route, bit-identical to the
+        clean run (the spill preserves rows, order, and chunking)."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        baseline = self._fit(rng)
+        set_config(fault_spec="prefetch.stage:oomhost=1")
+        faults.reset()
+        m = self._fit(np.random.default_rng(42))
+        res = m.summary.resilience
+        assert res["spilled"] is True
+        assert res["degradations"] == 1  # the spill rung only
+        assert res["halvings"] == []
+        assert m.summary.accelerated
+        assert m.summary.route["spilled"] is True
+        np.testing.assert_allclose(
+            m.cluster_centers_, baseline.cluster_centers_, atol=1e-6
+        )
+
+    def test_failed_spill_falls_through_never_corrupts(self, rng, tmp_path):
+        """A spill whose writes fault falls through the ladder (here to
+        the halving rung, which absorbs the one-shot host OOM) — and
+        the spill dir holds no committed spill, only ignorable tmp."""
+        import os
+
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        set_config(
+            spill_dir=str(tmp_path),
+            fault_spec="prefetch.stage:oomhost=1,spill.write:fail=*",
+        )
+        faults.reset()
+        m = self._fit(rng)
+        res = m.summary.resilience
+        assert res["spilled"] is False  # the rung fired but failed
+        assert m.summary.accelerated  # halving rung absorbed it
+        committed = [
+            f for f in os.listdir(tmp_path) if not f.endswith(".tmp")
+            and os.path.getsize(os.path.join(tmp_path, f)) > 0
+        ]
+        assert committed == []
+        set_config(spill_dir="")
+
+    def test_disk_backed_sources_do_not_spill(self, rng, tmp_path):
+        """A source already on disk has nothing to spill: a host OOM
+        falls straight through to the halving rung."""
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = _blobs(rng)
+        path = str(tmp_path / "x.npy")
+        np.save(path, x)
+        set_config(fault_spec="prefetch.stage:oomhost=1")
+        faults.reset()
+        m = KMeans(k=3, seed=7, max_iter=8).fit(
+            ChunkSource.from_npy(path, chunk_rows=128)
+        )
+        res = m.summary.resilience
+        assert res["spilled"] is False
+        assert res["halvings"] == [2]
+        assert m.summary.accelerated
 
     def test_als_degraded_rung_matches(self, rng):
         """One OOM routes the ALS fit to the streamed kernels at halved
