@@ -1157,6 +1157,84 @@ def _tests_tpu_status(timeout=900):
     return f"FAILED: {tail}"
 
 
+def bench_serving(requests: int = 200, sweep_users: int = 1_000_000,
+                  emit: bool = True) -> dict:
+    """Serving-plane bench (ISSUE 13): the BENCH JSON's second headline
+    next to iters/sec.
+
+    Leg 1 — request storm: a served K-Means model answers ``requests``
+    jittered-size batches after a bucket-family warmup; reports
+    sustained QPS, p50/p99 tail latency (per-request walls, host
+    round-trip included), rows/sec, and the steady-state XLA compile
+    count (MUST be zero — ground truth via xla_compile_count).
+
+    Leg 2 — full-sweep top-k: ``recommend_for_all_users`` over a
+    ``sweep_users``-row synthetic factor table through the streamed,
+    prefetch-pipelined sweep (serving/sweep.py) — users/sec with the
+    quadratic score matrix never materialized."""
+    import numpy as np
+
+    from oap_mllib_tpu import serving
+    from oap_mllib_tpu.models.als import ALSModel
+    from oap_mllib_tpu.models.kmeans import KMeans
+    from oap_mllib_tpu.serving import sweep as sweep_mod
+    from oap_mllib_tpu.utils import progcache
+
+    rng = np.random.default_rng(7)
+    d, k, max_rows = 64, 64, 2048
+    x = rng.normal(size=(max_rows * 2, d)).astype(np.float32)
+    model = KMeans(k=k, seed=0, init_mode="random", max_iter=3).fit(x)
+    handle = serving.serve(model)
+    handle.warmup(max_rows)
+    sizes = rng.integers(1, max_rows, size=requests)
+    before = progcache.xla_compile_count()
+    walls = []
+    t0 = time.perf_counter()
+    for s in sizes:
+        t1 = time.perf_counter()
+        handle.predict(x[: int(s)])
+        walls.append(time.perf_counter() - t1)
+    storm_wall = time.perf_counter() - t0
+    steady_compiles = progcache.xla_compile_count() - before
+    walls.sort()
+    p50 = walls[len(walls) // 2]
+    p99 = walls[min(len(walls) - 1, int(len(walls) * 0.99))]
+    qps = requests / storm_wall
+    rows = int(np.sum(sizes))
+    block = serving.serving_summary()
+    if emit:
+        _emit(
+            "serving_kmeans_qps", qps, "req/sec", 0.0,
+            p50_ms=round(p50 * 1e3, 3), p99_ms=round(p99 * 1e3, 3),
+            rows_per_sec=round(rows / storm_wall, 1),
+            steady_compiles=steady_compiles,
+            pad_rows=block["pad_rows"], requests=requests,
+            batch_d=d, batch_k=k,
+        )
+
+    nu, ni, r, topk = int(sweep_users), 256, 16, 10
+    uf = rng.normal(size=(nu, r)).astype(np.float32)
+    itf = rng.normal(size=(ni, r)).astype(np.float32)
+    als = ALSModel(uf, itf)
+    t0 = time.perf_counter()
+    ids = sweep_mod.recommend_for_all_users(als, topk)
+    sweep_wall = time.perf_counter() - t0
+    assert ids.shape == (nu, topk)
+    users_per_sec = nu / sweep_wall
+    if emit:
+        _emit(
+            "serving_als_sweep_users_per_sec", users_per_sec,
+            "users/sec", 0.0,
+            sweep_users=nu, n_items=ni, rank=r, top_k=topk,
+            sweep_wall_sec=round(sweep_wall, 2),
+        )
+    return {
+        "qps": qps, "p50_s": p50, "p99_s": p99,
+        "steady_compiles": steady_compiles,
+        "users_per_sec": users_per_sec,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--all", action="store_true",
@@ -1185,6 +1263,11 @@ def main():
                     help="mixed-precision policy sweep: the three "
                          "estimators under f32/tf32/bf16, reporting "
                          "throughput + parity vs f32 per policy")
+    ap.add_argument("--serving", action="store_true",
+                    help="serving plane: sustained QPS + p50/p99 tail "
+                         "latency on a jittered request storm (zero "
+                         "steady-state compiles) and full-sweep top-k "
+                         "users/sec on a 1M-user synthetic factor table")
     args = ap.parse_args()
 
     if (args.precision_sweep or args.compile_sweep) \
@@ -1207,6 +1290,10 @@ def main():
 
     if args.precision_sweep:
         bench_precision_sweep()
+        return
+
+    if args.serving:
+        bench_serving()
         return
 
     if args.compile_sweep:

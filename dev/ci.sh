@@ -126,6 +126,14 @@ echo "   against the Chrome trace-event schema, and the disarmed seam is"
 echo "   <1% of the 20-fit microbench (dev/fleet_gate.py) =="
 python dev/fleet_gate.py
 
+echo "== serve gate: serving plane — zero steady-state XLA compiles under a"
+echo "   50-request jittered-size storm, served-vs-direct bit parity on all"
+echo "   three estimators, a 10M-user full-sweep top-k with bounded host"
+echo "   memory (no quadratic score matrix), ring-merged sharded sweep"
+echo "   parity on the 8-device pseudo-mesh, p99-within-bound-of-p50 tail"
+echo "   latency, and a <1% disarmed pin seam (dev/serve_gate.py) =="
+python dev/serve_gate.py
+
 echo "== bench regression gate (soft): newest BENCH_r*.json vs the best"
 echo "   prior round per headline metric+backend; >10% fails, a single"
 echo "   recorded round warns only (dev/bench_regress.py) =="

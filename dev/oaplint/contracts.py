@@ -113,10 +113,11 @@ def _jit_outside_progcache(ctx):
 _MATMUL_FNS = {"dot", "matmul", "einsum", "tensordot", "vdot"}
 
 
-@rule("raw-matmul", scope=rf"{PKG}/(ops|models)/",
-      doc="No raw jnp.dot/matmul/einsum/@ in ops/ or models/ — use "
-          "precision.pdot/peinsum so the compute-precision policy "
-          "(Config.compute_precision) governs every hot-path contraction. "
+@rule("raw-matmul", scope=rf"{PKG}/(ops|models|serving)/",
+      doc="No raw jnp.dot/matmul/einsum/@ in ops/, models/, or serving/ "
+          "— use precision.pdot/peinsum so the compute-precision policy "
+          "(Config.compute_precision, Config.serving_precision on the "
+          "request paths) governs every hot-path contraction. "
           "ops/pallas/ kernels are exempt (priced via "
           "precision.kernel_tier).")
 def _raw_matmul(ctx):

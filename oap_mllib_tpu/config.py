@@ -332,6 +332,24 @@ class Config:
     kmeans_precision: str = ""
     pca_precision: str = ""
     als_precision: str = ""
+    # -- serving plane (oap_mllib_tpu/serving/) ------------------------------
+    # Compute policy for serving-time scoring matmuls (the registry /
+    # micro-batcher request paths and the full-sweep top-k).  "" (the
+    # default) inherits the algorithm's resolved compute policy
+    # (compute_precision + per-algo overrides) — f32 stays
+    # bit-compatible with direct model calls; "f32"|"tf32"|"bf16"|
+    # "auto" override it for serving only (a bf16 serving tier halves
+    # the request staging bytes while fits keep f32).  A typo raises at
+    # request time.
+    serving_precision: str = ""
+    # Row-chunk width of the full-sweep top-k (serving/sweep.py): how
+    # many query (user) rows score per compiled step while the sweep
+    # streams the factor table through the prefetch pipeline.  0 (the
+    # default) derives the width from the shared scoring live-buffer
+    # budget (ops/kmeans_ops.rows_per_chunk — the same bound the
+    # models' chunked top-k uses), so the (chunk, n_items) score block
+    # stays bounded whatever the table sizes.  Negative raises.
+    sweep_chunk_rows: int = 0
     # -- telemetry layer (oap_mllib_tpu/telemetry/) --------------------------
     # jax.profiler trace directory: non-empty wraps every estimator fit
     # in a profiler trace written there (utils/profiling.maybe_trace),

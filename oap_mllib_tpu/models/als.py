@@ -35,28 +35,6 @@ from oap_mllib_tpu.utils.dispatch import should_accelerate
 from oap_mllib_tpu.utils.timing import Timings, phase_timer
 
 
-def _top_k_pairs(q: jax.Array, targets: jax.Array, n: int):
-    """Top-n (scores, ids) for a block of query rows — dispatched through
-    the program registry so the compiled program caches across
-    recommend_for_all_* calls (a per-call jit lambda would recompile
-    every time AND constant-fold the whole factor matrix into the
-    executable).  pdot's f32 default (HIGHEST) on purpose: the returned
-    scores are the model's predicted preferences and must match
-    predict() (TPU's default bf16 matmul drifts them ~1e-3 and can swap
-    near-tie rankings — caught on hardware, round 5)."""
-
-    def kernel(q, targets, n):
-        scores = psn.pdot(q, targets.T)
-        return jax.lax.top_k(scores, n)
-
-    fn = progcache.get_or_build(
-        "als.top_k_pairs",
-        (progcache.backend_fingerprint(),),
-        lambda: jax.jit(kernel, static_argnames=("n",)),
-    )
-    return fn(q, targets, n)
-
-
 class ALSModel:
     """Trained ALS factors.
 
@@ -89,6 +67,10 @@ class ALSModel:
         self._sharded_user = sharded_user
         self._sharded_item = sharded_item
         self.summary = summary or {}
+        # device-copy cache (serving/registry.pin): the top-k target
+        # table pins across chunks AND across calls — one upload per
+        # factor table per model lifetime, not one per recommend call
+        self._dev_cache: dict = {}
 
     @property
     def user_factors_(self) -> np.ndarray:
@@ -154,8 +136,7 @@ class ALSModel:
             )
         )
 
-    @staticmethod
-    def _top_k_scores(query: np.ndarray, targets: np.ndarray, n: int,
+    def _top_k_scores(self, query: np.ndarray, targets: np.ndarray, n: int,
                       row_chunk: int = 0, with_scores: bool = True):
         """Top-n (ids, scores) per query row, chunked over query rows so
         the (n_query, n_targets) score matrix never materializes (the
@@ -169,11 +150,22 @@ class ALSModel:
         of the float score blocks entirely (ids-only callers should not
         pay a second device->host copy); the scores slot is then None.
 
+        Scoring routes through the serving batcher (serving/batcher.py):
+        the target table PINS on-device across chunks and across calls
+        (the model's device cache — one upload per table per model
+        lifetime), the tail chunk rounds onto its geometric bucket, and
+        the pdot policy stays the serving default (f32 = HIGHEST,
+        bit-compatible: the returned scores must match predict() —
+        TPU's default bf16 matmul drifts them ~1e-3 and can swap
+        near-tie rankings, caught on hardware, round 5).
+
         ``n`` is clamped to the target count, like Spark's
         recommendForAll* which just returns fewer rows when asked for
         more than exist — without the clamp lax.top_k raises an opaque
         XLA error on an oversized request."""
         from oap_mllib_tpu.ops.kmeans_ops import rows_per_chunk
+        from oap_mllib_tpu.serving import batcher
+        from oap_mllib_tpu.serving.registry import pin
 
         if n < 0:
             raise ValueError(f"top-k count must be >= 0, got {n}")
@@ -186,13 +178,24 @@ class ALSModel:
         rows = row_chunk or rows_per_chunk(
             targets.shape[0], query.shape[1]
         )
-        tj = jnp.asarray(targets)
+        if targets is self._item_factors:
+            tj = pin(self._dev_cache, "targets:item", targets)
+        elif targets is self._user_factors:
+            tj = pin(self._dev_cache, "targets:user", targets)
+        else:  # a transient target table (tests, subsets): stage once
+            tj = batcher.stage(np.asarray(targets, np.float32))
         ids, scores = [], []
         for lo in range(0, query.shape[0], rows):
-            s, i = _top_k_pairs(jnp.asarray(query[lo : lo + rows]), tj, n)
-            ids.append(np.asarray(i))
+            q = np.asarray(query[lo : lo + rows], np.float32)
+            nv = q.shape[0]
+            if nv < rows:
+                # tail chunk rounds onto its bucket — one extra compiled
+                # shape at most, whatever the query size
+                q, _ = batcher.bucket_batch(q)
+            s, i = batcher.topk_pairs(batcher.stage(q), tj, n)
+            ids.append(jax.device_get(i)[:nv])
             if with_scores:
-                scores.append(np.asarray(s))
+                scores.append(jax.device_get(s)[:nv])
         return (
             np.concatenate(ids, axis=0),
             np.concatenate(scores, axis=0) if with_scores else None,

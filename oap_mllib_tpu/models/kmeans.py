@@ -65,6 +65,10 @@ class KMeansModel:
         self.cluster_centers_ = np.asarray(cluster_centers)
         self.distance_measure = distance_measure
         self.summary = summary
+        # device-copy cache (serving/registry.pin): identity-keyed on
+        # the host array, so scoring calls never re-upload the centers
+        # and a refit (fresh array) re-stages exactly once
+        self._dev_cache: dict = {}
 
     @property
     def k(self) -> int:
@@ -82,31 +86,54 @@ class KMeansModel:
             budget=self._PREDICT_BUDGET,
         )
 
+    def _centers_dev(self):
+        """The pinned device copy of the centers (serving/registry.pin)
+        — staged once per model lifetime, re-staged only on refit."""
+        from oap_mllib_tpu.serving.registry import pin
+
+        return pin(self._dev_cache, "centers", self.cluster_centers_)
+
+    def _predict_euclidean(self, x: np.ndarray) -> np.ndarray:
+        """Bucketed serving-program scoring (serving/batcher.py):
+        fixed-width row slices against the PINNED centers, each slice
+        rounded onto its geometric bucket — every full chunk shares one
+        compiled shape, the tail its bucket's, and no call re-uploads
+        the centers."""
+        from oap_mllib_tpu.serving import batcher
+
+        c = self._centers_dev()
+        rows = self._score_chunk_rows()
+        return np.concatenate([
+            batcher.assign_kmeans(c, x[lo : lo + rows])
+            for lo in range(0, max(len(x), 1), rows)
+        ])
+
     def predict(self, x) -> np.ndarray:
         """Nearest-center assignment (the shim's transform/predict surface).
         Accepts a ChunkSource for out-of-core scoring (labels are O(n)
-        host memory; at most two compiled chunk shapes)."""
+        host memory); disk-backed chunks route through the SAME bucketed
+        serving program as the ndarray path, so the results are
+        bit-identical and the compiled-shape count stays bounded."""
         from oap_mllib_tpu.data.stream import ChunkSource
 
         if isinstance(x, ChunkSource):
-            parts = [self.predict(c[:v]) for c, v in x]
+            if self.distance_measure == "euclidean":
+                parts = [
+                    self._predict_euclidean(
+                        np.asarray(
+                            c[:v], dtype=self.cluster_centers_.dtype
+                        )
+                    )
+                    for c, v in x
+                ]
+            else:
+                parts = [self.predict(c[:v]) for c, v in x]
             if not parts:  # empty source: same contract as an empty array
                 return self.predict(np.zeros((0, x.n_features)))
             return np.concatenate(parts)
         x = np.asarray(x, dtype=self.cluster_centers_.dtype)
         if self.distance_measure == "euclidean" and x.shape[0] >= 1:
-            c = jnp.asarray(self.cluster_centers_)
-            rows = self._score_chunk_rows()
-            # fixed-size slices (not array_split): every full chunk shares
-            # one compiled shape, only the tail adds a second
-            return np.concatenate([
-                np.asarray(
-                    kmeans_ops.assign_clusters(
-                        jnp.asarray(x[lo : lo + rows]), c
-                    )
-                )
-                for lo in range(0, len(x), rows)
-            ])
+            return self._predict_euclidean(x)
         return predict_np(x, self.cluster_centers_, self.distance_measure)
 
     def transform(self, x: np.ndarray) -> np.ndarray:
@@ -123,7 +150,7 @@ class KMeansModel:
 
             d = _sq_dists(x, self.cluster_centers_, self.distance_measure)
             return float(np.sum(np.min(d, axis=1)))
-        c = jnp.asarray(self.cluster_centers_)
+        c = self._centers_dev()  # pinned — no per-call re-upload
         rows = self._score_chunk_rows()
         return float(sum(
             float(jnp.sum(jnp.min(

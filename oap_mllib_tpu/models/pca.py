@@ -41,6 +41,9 @@ class PCAModel:
         self.components_ = np.asarray(components)
         self.explained_variance_ = np.asarray(explained_variance)
         self.summary = summary or {}
+        # device-copy cache (serving/registry.pin): transform never
+        # re-uploads the components; a refit re-stages exactly once
+        self._dev_cache: dict = {}
 
     @property
     def k(self) -> int:
@@ -49,8 +52,13 @@ class PCAModel:
     def transform(self, x) -> np.ndarray:
         """Project into the PC basis (no centering — Spark parity).
         Accepts a ChunkSource for out-of-core scoring (the (n, k)
-        projection is the caller's host memory)."""
+        projection is the caller's host memory).  Every path routes
+        through the bucketed serving program (serving/batcher.py)
+        against the PINNED components — no per-call re-upload, bounded
+        compiled-shape count under jittered batch sizes."""
         from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.serving import batcher
+        from oap_mllib_tpu.serving.registry import pin
 
         if isinstance(x, ChunkSource):
             parts = [self.transform(c[:v]) for c, v in x]
@@ -58,7 +66,8 @@ class PCAModel:
                 return self.transform(np.zeros((0, x.n_features)))
             return np.concatenate(parts)
         x = np.asarray(x, dtype=self.components_.dtype)
-        return np.asarray(pca_ops.project(jnp.asarray(x), jnp.asarray(self.components_)))
+        comp = pin(self._dev_cache, "components", self.components_)
+        return batcher.project_pca(comp, x)
 
     def save(self, path: str) -> None:
         """Atomic per-file writes, metadata last (data/io primitives) —

@@ -1,0 +1,53 @@
+"""Serving plane: fitted models as a high-availability, high-QPS workload.
+
+Everything through the fit planes optimizes ``fit()``; production value
+at the ROADMAP's "millions of users" scale is dominated by the FITTED
+model surface — ``predict`` / ``transform`` / ``recommend_for_all_*``
+(the reference's blockified recommendForAll, ALS.scala:383-401).  This
+package makes that surface a first-class workload composed from the
+existing subsystems instead of a per-call eager afterthought:
+
+- :mod:`~oap_mllib_tpu.serving.registry` — ``serve(model)`` pins fitted
+  state (centers / components / factor tables) on-device ONCE, keyed
+  like the program cache, so no scoring call ever re-uploads weights;
+  per-request telemetry (``oap_serve_*`` counters + factor-4 log-bucket
+  latency histograms) rides the PR 11 ``/metrics`` endpoint.
+- :mod:`~oap_mllib_tpu.serving.batcher` — request micro-batching:
+  incoming batches round up onto the ``data/bucketing.py`` geometric
+  buckets (pad rows are sliced back off — mask/weight-0 contract), and
+  every scoring program launches through ``utils/progcache.py``, so a
+  steady-state request storm of jittered sizes compiles ZERO new XLA
+  programs; scoring matmuls take staged (donated off-CPU) buffers and
+  route through ``precision.pdot`` under the serving dtype policy
+  (``Config.serving_precision``).
+- :mod:`~oap_mllib_tpu.serving.sweep` — full-sweep top-k at scale:
+  ``recommend_for_all_users`` as a streamed, prefetch-pipelined
+  (``data/prefetch.py``) sweep over 10M+ users that never materializes
+  the quadratic score matrix, and a factor-sharded ring sweep (the
+  PR 9 ring schedule: item blocks rotate around the mesh while partial
+  top-k merges stay put) serving block-sharded fits from their LIVE
+  layout instead of gathering factors to one host.
+- :mod:`~oap_mllib_tpu.serving.ha` — serving availability: replica
+  heartbeats over the deadline-watchdogged host collective plane
+  (utils/recovery.py); a replica that misses its deadline is EVICTED —
+  survivors keep answering in local mode and the supervisor
+  (utils/supervisor.py) relaunches the lost replica.
+
+Usage (docs/user-guide.md "Serving")::
+
+    handle = serving.serve(model)        # pins weights on-device once
+    handle.warmup(4096)                  # pre-compile the bucket family
+    ids = handle.predict(batch)          # zero steady-state compiles
+"""
+
+from oap_mllib_tpu.serving.registry import (  # noqa: F401
+    ServedALS,
+    ServedKMeans,
+    ServedModel,
+    ServedPCA,
+    serve,
+    served_models,
+    serving_summary,
+    unserve,
+)
+from oap_mllib_tpu.serving.ha import ReplicaGuard, heartbeat  # noqa: F401

@@ -1,0 +1,127 @@
+"""Serving availability: replica heartbeats, eviction, relaunch.
+
+A serving fleet is a set of replicas answering the same model's
+requests (replicated weights) or cooperating on sharded sweeps.  The
+failure mode that matters is the one PR 10 solved for fits: a replica
+that silently stops arriving at the rendezvous would wedge every
+healthy peer.  This module composes the same recovery plane for the
+serving side:
+
+- :func:`heartbeat` — a FIXED-shape per-rank stat frame (rank,
+  requests answered, queue depth) allgathered over the host collective
+  plane (``ops/stream_ops._allgather_host``), which inherits the
+  deadline watchdog (``Config.collective_timeout``), the crash-record
+  poison check, and the collective sanitizer's fingerprinting.  A
+  replica that misses the deadline converts every survivor's wait into
+  a ``CollectiveTimeoutError`` naming the op.
+- :class:`ReplicaGuard` — the eviction policy: serving legs run under
+  :meth:`ReplicaGuard.leg`; a recovery-plane error records the fatal
+  fault (crash record into ``Config.crash_dir`` when armed), EVICTS
+  the fleet view (survivors flip to local-only mode and keep
+  answering), and counts ``oap_serve_evictions_total``.  The
+  supervisor (``utils/supervisor.py`` / ``dev/supervise.py``) then
+  classifies the crash records and relaunches the lost replica while
+  the survivors never stopped serving.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.utils import recovery
+
+
+def heartbeat(requests: Optional[int] = None,
+              queue_depth: Optional[int] = None) -> Dict[str, Any]:
+    """One fleet heartbeat: allgather (rank, requests, queue_depth)
+    across the serving world and return the fleet view.  Single-process
+    worlds return the local view without a collective.  Riding the
+    sanctioned host-collective seam means a dead replica surfaces here
+    as ``CollectiveTimeoutError`` / ``PeerAbortError`` (when the
+    deadline / sideband are armed) instead of a silent wedge."""
+    import jax
+
+    from oap_mllib_tpu.ops.stream_ops import _allgather_host
+
+    if requests is None:
+        requests = int(_tm.family_total("oap_serve_requests_total"))
+    if queue_depth is None:
+        queue_depth = 0
+    rank = jax.process_index()
+    frame = np.asarray(
+        [float(rank), float(requests), float(queue_depth)], np.float64
+    )
+    # _allgather_host adds the rank axis single-process too, so the
+    # fleet view is shape-stable at any world size
+    (stacked,) = _allgather_host([frame])
+    stacked = np.asarray(stacked).reshape(-1, 3)
+    view = {
+        "world": stacked.shape[0],
+        "rank": rank,
+        "requests": [int(v) for v in stacked[:, 1]],
+        "queue_depth": [int(v) for v in stacked[:, 2]],
+    }
+    _tm.counter(
+        "oap_serve_heartbeats_total",
+        help="Serving fleet heartbeats completed",
+    ).inc()
+    return view
+
+
+class ReplicaGuard:
+    """Eviction wrapper for serving legs.
+
+    ::
+
+        guard = ReplicaGuard()
+        for batch in requests:
+            with guard.leg():
+                answer(batch)          # local scoring
+                ha.heartbeat()         # fleet rendezvous (skipped once
+                                       # local_only)
+
+    A recovery-plane error inside a leg evicts the fleet: the fault is
+    recorded (machine-readable crash record when ``Config.crash_dir``
+    is armed — the supervisor's classification input), the guard flips
+    to ``local_only``, and the leg RETURNS instead of raising — the
+    survivor keeps answering requests with identical results (the
+    weights are local; only the fleet view shrank)."""
+
+    def __init__(self):
+        self.local_only = False
+        self.evictions = 0
+        self.last_error: Optional[BaseException] = None
+
+    def leg(self):
+        return _Leg(self)
+
+    def _evict(self, exc: BaseException) -> None:
+        self.local_only = True
+        self.evictions += 1
+        self.last_error = exc
+        _tm.counter(
+            "oap_serve_evictions_total",
+            help="Serving replicas evicted after recovery-plane errors",
+        ).inc()
+        # the watchdog/poison path already wrote this rank's crash
+        # record (recovery-plane errors are the only ones absorbed
+        # here) — the sideband is the supervisor's relaunch signal;
+        # record_fatal covers any future non-recovery classes
+        recovery.record_fatal("serve.heartbeat", exc)
+
+
+class _Leg:
+    def __init__(self, guard: ReplicaGuard):
+        self._g = guard
+
+    def __enter__(self):
+        return self._g
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None and isinstance(exc, recovery.RecoveryError):
+            self._g._evict(exc)
+            return True  # absorbed: the survivor keeps serving
+        return False

@@ -1,0 +1,402 @@
+"""On-device model registry: serve fitted models without re-uploading.
+
+The eager model surface used to pay a host->device weight upload per
+call (``models/kmeans.py`` predict re-staged the centers, ``models/als
+.py`` re-staged whole factor tables) — at serving QPS that is the
+dominant cost and it scales with the MODEL, not the request.
+:func:`serve` pins a fitted model's state on-device ONCE, keyed like
+the program cache (serving the same model twice returns the same
+handle, no re-pin), and every request then routes through the
+micro-batcher (:mod:`oap_mllib_tpu.serving.batcher`) against the
+pinned weights.
+
+Per-request telemetry lands in the process registry —
+``oap_serve_requests_total`` / ``_batches_total`` / ``_pad_rows_total``
+/ ``_queue_depth`` plus the ``oap_serve_request_seconds`` factor-4
+log-bucket latency histogram (telemetry/metrics.py) — so the PR 11
+``/metrics`` endpoint exposes the serving plane live, and
+:func:`serving_summary` renders the "serving" block (request totals +
+p50/p99) for benches and reports.
+
+The :func:`pin` helper is also the models' own device-copy cache (the
+eager-path fix): identity-keyed on the HOST array object, so a refit —
+which constructs a fresh model/array — naturally invalidates it, and
+repeated calls against one model never re-upload.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from oap_mllib_tpu.telemetry import metrics as _tm
+
+_LOCK = threading.RLock()
+_SERVED: Dict[tuple, "ServedModel"] = {}
+
+
+def pin(cache: dict, name: str, host_array) -> Any:
+    """Device copy of ``host_array`` cached in ``cache[name]``, keyed by
+    the host array's IDENTITY: the same object returns the same device
+    buffer (zero re-uploads), a replaced array (a refit, a mutated
+    model) re-stages exactly once.  Staging is an explicit
+    ``jax.device_put`` (transfer-sanitizer clean)."""
+    import jax
+
+    ent = cache.get(name)
+    if ent is not None and ent[0] is host_array:
+        return ent[1]
+    dev = jax.device_put(np.asarray(host_array))
+    cache[name] = (host_array, dev)
+    return dev
+
+
+def _observe_request(kind: str, wall_s: float, rows: int) -> None:
+    lab = {"model": kind}
+    _tm.counter(
+        "oap_serve_requests_total", lab,
+        help="Serving requests answered by model kind",
+    ).inc()
+    _tm.counter(
+        "oap_serve_rows_total", lab,
+        help="Request rows scored by the serving plane",
+    ).inc(rows)
+    _tm.histogram(
+        "oap_serve_request_seconds", lab,
+        help="Per-request serving latency (staging + scoring + fetch)",
+    ).observe(wall_s)
+
+
+class ServedModel:
+    """One pinned model + its request accounting.  Subclasses expose the
+    estimator's scoring surface; every public request runs under
+    :meth:`_request`, which books the latency histogram and counters."""
+
+    kind = "model"
+
+    def __init__(self, model):
+        self.model = model
+        self._cache: dict = {}
+        self.requests = 0
+
+    # -- request accounting ---------------------------------------------------
+    def _request(self, rows: int, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        _observe_request(self.kind, time.perf_counter() - t0, rows)
+        self.requests += 1
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "requests": self.requests}
+
+    # -- micro-batch coalescing ----------------------------------------------
+    def _flush_many(self, batches, score_rows):
+        """Coalesce a queue of small requests into ONE bucketed launch:
+        concatenate rows, score once, split results back per request.
+        ``oap_serve_queue_depth`` tracks the coalesced depth while the
+        flush is in flight — the micro-batching win is 1 launch (and at
+        most one bucket's padding) for N requests."""
+        batches = [np.atleast_2d(np.asarray(b)) for b in batches]
+        if not batches:
+            return []
+        g = _tm.gauge(
+            "oap_serve_queue_depth",
+            help="Requests coalesced into the in-flight serving batch",
+        )
+        g.set(len(batches))
+        try:
+            out = score_rows(np.concatenate(batches, axis=0))
+        finally:
+            g.set(0)
+        parts = []
+        lo = 0
+        for b in batches:
+            parts.append(out[lo : lo + b.shape[0]])
+            lo += b.shape[0]
+        # each coalesced entry is a REQUEST (the batcher booked one
+        # batch, the caller's _request books the shared flush wall and
+        # the summed rows); count the remaining requests here
+        for _ in batches[1:]:
+            _observe_request(self.kind, 0.0, 0)
+            self.requests += 1
+        return parts
+
+    # -- compile pre-warm -----------------------------------------------------
+    def warmup(self, max_rows: int) -> int:
+        """Compile the scoring-program bucket family for request sizes
+        up to ``max_rows`` (one launch per geometric bucket).  After
+        warmup, a storm of ANY sizes <= max_rows compiles zero new XLA
+        programs — the steady-state serving contract
+        (dev/serve_gate.py asserts it against xla_compile_count)."""
+        from oap_mllib_tpu.serving import batcher
+
+        sizes = batcher.warm_sizes(max_rows)
+        for b in sizes:
+            self._warm_one(b)
+        return len(sizes)
+
+    def _warm_one(self, rows: int) -> None:
+        raise NotImplementedError
+
+
+class ServedKMeans(ServedModel):
+    kind = "kmeans"
+
+    def __init__(self, model):
+        super().__init__(model)
+        # pin now: the handle's reason to exist
+        self.centers_dev = pin(
+            self._cache, "centers", model.cluster_centers_
+        )
+
+    def predict(self, x) -> np.ndarray:
+        from oap_mllib_tpu.serving import batcher
+
+        x = np.atleast_2d(np.asarray(x))
+        return self._request(
+            x.shape[0],
+            lambda: batcher.assign_kmeans(self.centers_dev, x, self.kind),
+        )
+
+    transform = predict
+
+    def predict_many(self, batches):
+        """Answer a queue of requests with one coalesced launch (see
+        :meth:`ServedModel._flush_many`)."""
+        from oap_mllib_tpu.serving import batcher
+
+        return self._request(
+            sum(np.atleast_2d(np.asarray(b)).shape[0] for b in batches),
+            lambda: self._flush_many(
+                batches,
+                lambda x: batcher.assign_kmeans(
+                    self.centers_dev, x, self.kind
+                ),
+            ),
+        )
+
+    def _warm_one(self, rows: int) -> None:
+        from oap_mllib_tpu.serving import batcher
+
+        d = int(self.model.cluster_centers_.shape[1])
+        batcher.assign_kmeans(
+            self.centers_dev, np.zeros((rows, d), np.float32), self.kind
+        )
+
+
+class ServedPCA(ServedModel):
+    kind = "pca"
+
+    def __init__(self, model):
+        super().__init__(model)
+        self.components_dev = pin(
+            self._cache, "components", model.components_
+        )
+
+    def transform(self, x) -> np.ndarray:
+        from oap_mllib_tpu.serving import batcher
+
+        x = np.atleast_2d(np.asarray(x))
+        return self._request(
+            x.shape[0],
+            lambda: batcher.project_pca(self.components_dev, x, self.kind),
+        )
+
+    def _warm_one(self, rows: int) -> None:
+        from oap_mllib_tpu.serving import batcher
+
+        d = int(self.model.components_.shape[0])
+        batcher.project_pca(
+            self.components_dev, np.zeros((rows, d), np.float32), self.kind
+        )
+
+
+class ServedALS(ServedModel):
+    """Pinned ALS factors.  Block-sharded fits keep their LIVE device
+    layout (``sweep`` serves straight from it — no host gather); host
+    -factor models pin both tables once."""
+
+    kind = "als"
+
+    def __init__(self, model):
+        super().__init__(model)
+        self.sharded = model._sharded_user is not None
+        if not self.sharded:
+            self.user_dev = pin(
+                self._cache, "user", model.user_factors_
+            )
+            self.item_dev = pin(
+                self._cache, "item", model.item_factors_
+            )
+
+    def predict(self, users, items) -> np.ndarray:
+        return self._request(
+            len(np.atleast_1d(users)),
+            lambda: self.model.predict(users, items),
+        )
+
+    def recommend_for_users(self, user_ids, num_items: int,
+                            with_scores: bool = False):
+        """Subset recommendation against the pinned item table (the
+        bucketed request surface; ids validated by the model)."""
+        from oap_mllib_tpu.serving import batcher
+
+        user_ids = np.asarray(user_ids, np.int64)
+        if self.sharded:
+            # sharded layouts answer subset requests through the model
+            # (factor gather is the model's documented collective)
+            return self._request(
+                len(user_ids),
+                lambda: self.model.recommend_for_users(
+                    user_ids, num_items, with_scores
+                ),
+            )
+
+        def run():
+            q = self.model.user_factors_[user_ids]
+            ids, scores = batcher.topk_scores(
+                q, self.item_dev, num_items, self.kind
+            )
+            return (ids, scores) if with_scores else ids
+
+        return self._request(len(user_ids), run)
+
+    def recommend_for_all_users(self, num_items: int,
+                                with_scores: bool = False,
+                                chunk_rows: int = 0):
+        """Full-sweep top-k (serving/sweep.py): streamed + prefetched
+        over the whole user base, factor-sharded when the model's live
+        layout is — never materializing the quadratic score matrix."""
+        from oap_mllib_tpu.serving import sweep
+
+        n_users = (
+            int(self.model._sharded_user[1][-1]) if self.sharded
+            else int(self.model.user_factors_.shape[0])
+        )
+        return self._request(
+            n_users,
+            lambda: sweep.recommend_for_all_users(
+                self.model, num_items, with_scores=with_scores,
+                chunk_rows=chunk_rows, handle=self,
+            ),
+        )
+
+    def _warm_one(self, rows: int) -> None:
+        from oap_mllib_tpu.serving import batcher
+
+        if self.sharded:
+            return
+        r = int(self.model.user_factors_.shape[1])
+        batcher.topk_scores(
+            np.zeros((rows, r), np.float32), self.item_dev, 1, self.kind
+        )
+
+
+def serve(model, key: Optional[str] = None) -> ServedModel:
+    """Pin ``model`` on-device and return its serving handle.
+
+    Keyed like the program cache: serving the SAME model object again
+    returns the existing handle (weights stay pinned, nothing
+    re-uploads); an explicit ``key`` names the entry so callers can
+    address it across call sites.  Dispatch is structural (centers /
+    components / factors), so compat-layer proxies serve too."""
+    with _LOCK:
+        reg_key = (key,) if key is not None else ("id", id(model))
+        existing = _SERVED.get(reg_key)
+        if existing is not None and existing.model is model:
+            return existing
+    if hasattr(model, "cluster_centers_"):
+        handle: ServedModel = ServedKMeans(model)
+    elif hasattr(model, "components_"):
+        handle = ServedPCA(model)
+    elif hasattr(model, "rank") and (
+        getattr(model, "_sharded_user", None) is not None
+        or getattr(model, "_user_factors", None) is not None
+    ):
+        handle = ServedALS(model)
+    else:
+        raise TypeError(
+            f"cannot serve {type(model).__name__}: expected a fitted "
+            "KMeansModel, PCAModel, or ALSModel surface"
+        )
+    with _LOCK:
+        _SERVED[reg_key] = handle
+        _tm.gauge(
+            "oap_serve_models_pinned",
+            help="Models currently pinned in the serving registry",
+        ).set(len(_SERVED))
+    return handle
+
+
+def unserve(model_or_key) -> bool:
+    """Drop a served model from the registry (its pinned buffers free
+    with the handle).  Accepts the model object or the explicit key."""
+    with _LOCK:
+        for k in (("id", id(model_or_key)), (model_or_key,)):
+            if k in _SERVED:
+                del _SERVED[k]
+                _tm.gauge("oap_serve_models_pinned").set(len(_SERVED))
+                return True
+    return False
+
+
+def served_models() -> Dict[tuple, ServedModel]:
+    with _LOCK:
+        return dict(_SERVED)
+
+
+def clear() -> None:
+    """Tests: drop every handle (per-model pins die with them)."""
+    with _LOCK:
+        _SERVED.clear()
+        _tm.gauge("oap_serve_models_pinned").set(0)
+
+
+def serving_summary() -> Dict[str, Any]:
+    """The ``serving`` summary block: request/batch/pad totals plus
+    p50/p99 latency estimated from the factor-4 log-bucket histogram
+    (upper-bound bucket quantiles — telemetry/metrics.py)."""
+    reqs = _tm.family_total("oap_serve_requests_total")
+    block: Dict[str, Any] = {
+        "models_pinned": len(_SERVED),
+        "requests": int(reqs),
+        "batches": int(_tm.family_total("oap_serve_batches_total")),
+        "pad_rows": int(_tm.family_total("oap_serve_pad_rows_total")),
+        "rows": int(_tm.family_total("oap_serve_rows_total")),
+        "evictions": int(_tm.family_total("oap_serve_evictions_total")),
+    }
+    if reqs:
+        p50, p99 = _latency_quantiles()
+        block["latency_p50_s"] = p50
+        block["latency_p99_s"] = p99
+    return block
+
+
+def _latency_quantiles() -> tuple:
+    """(p50, p99) across every model kind's request-latency histogram —
+    merged bucket-wise (same fixed bounds) then read via
+    metrics.histogram_quantile."""
+    reg = _tm.registry()
+    merged: Optional[_tm.Histogram] = None
+    with _tm._LOCK:
+        series = [
+            m for (name, _), m in reg._metrics.items()
+            if name == "oap_serve_request_seconds"
+        ]
+    for h in series:
+        if merged is None:
+            merged = _tm.Histogram(h.bounds)
+        for i, c in enumerate(h.counts):
+            merged.counts[i] += c
+        merged.sum += h.sum
+        merged.count += h.count
+    if merged is None or merged.count == 0:
+        return (0.0, 0.0)
+    return (
+        _tm.histogram_quantile(merged, 0.50),
+        _tm.histogram_quantile(merged, 0.99),
+    )

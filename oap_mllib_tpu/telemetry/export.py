@@ -292,8 +292,23 @@ def _process_report() -> str:
         ("bytes staged", _series_total(snap, "oap_stream_bytes_staged_total"), "d"),
         ("resilience faults",
          _series_total(snap, "oap_resilience_faults_total"), "d"),
+        ("serve requests", _series_total(snap, "oap_serve_requests_total"), "d"),
+        ("serve batches", _series_total(snap, "oap_serve_batches_total"), "d"),
     ]
     for label, v, kind in rows:
         val = _fmt_s(v) if kind == "s" else str(int(v))
         lines.append(f"  {label:<20s} {val}")
+    # the serving summary block (registry/batcher/sweep totals + p50/p99
+    # latency from the factor-4 log-bucket histogram) when the plane
+    # answered anything this process lifetime
+    if _series_total(snap, "oap_serve_requests_total"):
+        from oap_mllib_tpu.serving.registry import serving_summary
+
+        sv = serving_summary()
+        lines.append(
+            f"  serving: {sv['requests']} requests / {sv['batches']} "
+            f"batches, {sv['pad_rows']} pad rows, p50 "
+            f"{_fmt_s(sv.get('latency_p50_s', 0.0))}, p99 "
+            f"{_fmt_s(sv.get('latency_p99_s', 0.0))}"
+        )
     return "\n".join(lines)

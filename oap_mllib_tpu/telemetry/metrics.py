@@ -102,6 +102,28 @@ class Histogram:
 _LOCK = threading.Lock()
 
 
+def histogram_quantile(h: Histogram, q: float) -> float:
+    """Upper-bound estimate of the ``q``-quantile from a cumulative
+    bucket read: the smallest bucket bound whose cumulative count
+    reaches ``q * count`` (the +Inf overflow returns the largest finite
+    bound).  With the factor-4 log buckets the estimate is within one
+    bucket factor of the true quantile — the resolution the serving
+    p50/p99 summary block and bench tail-latency lines report at."""
+    if not 0.0 < q <= 1.0:
+        raise ValueError(f"quantile must be in (0, 1], got {q}")
+    with _LOCK:
+        total = h.count
+        if total == 0:
+            return 0.0
+        target = q * total
+        cum = 0
+        for bound, c in zip(h.bounds, h.counts):
+            cum += c
+            if cum >= target:
+                return float(bound)
+        return float(h.bounds[-1])
+
+
 class Registry:
     """Name+labels -> metric instance, with per-name type/help metadata."""
 

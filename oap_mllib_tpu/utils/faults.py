@@ -95,7 +95,7 @@ from oap_mllib_tpu.config import get_config
 SITES = (
     "stream.read", "prefetch.stage", "bootstrap.connect", "fit.execute",
     "ckpt.write", "ckpt.restore", "collective.dispatch",
-    "disk.read", "spill.write", "spill.read",
+    "disk.read", "spill.write", "spill.read", "serve.request",
 )
 
 KIND_FAIL = "fail"

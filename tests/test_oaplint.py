@@ -694,6 +694,54 @@ def test_config_contract_matching_env_literal_is_clean(tmp_path):
 # ---------------------------------------------------------------------------
 # the gate: the live tree lints clean, with enough rules active
 # ---------------------------------------------------------------------------
+# ISSUE 13: the serving plane is covered by the invariant rules — one
+# seeded violation per rule, linted under a serving/ path, proving R1
+# (jit-only-via-progcache), R2 (precision-routed matmuls; scope grew
+# from ops|models to ops|models|serving), and R3 (facade-only
+# collectives) all fire inside the new package.
+# ---------------------------------------------------------------------------
+
+SERVING = "oap_mllib_tpu/serving/fake.py"
+
+_SERVING_SEEDED = [
+    ("jit-outside-progcache", "import jax\nf = jax.jit(score)(x)\n"),
+    ("raw-matmul", "import jax.numpy as jnp\ns = jnp.dot(q, t.T)\n"),
+    ("raw-matmul", "s = q @ t.T\n"),
+    ("raw-collective", "from jax import lax\ny = lax.ppermute(x, 'data', p)\n"),
+    ("raw-collective", "from jax import lax\ny = lax.psum(x, 'data')\n"),
+]
+
+
+@pytest.mark.parametrize(
+    "rule,text", _SERVING_SEEDED,
+    ids=[f"{r}-{i}" for i, (r, _) in enumerate(_SERVING_SEEDED)],
+)
+def test_serving_scope_seeded_violation_is_caught(rule, text):
+    found = lint(SERVING, text, rules=[rule])
+    assert rules_of(found) == [rule], (
+        f"seeded serving-scope {rule} violation was not caught: {found}")
+
+
+def test_serving_pdot_and_facade_are_clean():
+    text = (
+        "from oap_mllib_tpu.parallel import collective\n"
+        "from oap_mllib_tpu.utils import precision as psn\n\n\n"
+        "def score(q, t, axis):\n"
+        "    s = psn.pdot(q, t.T, 'f32', 'highest')\n"
+        "    return collective.ppermute(s, axis, [(0, 1)])\n"
+    )
+    assert lint(SERVING, text,
+                rules=["raw-matmul", "raw-collective"]) == []
+
+
+def test_serving_jit_inside_builder_is_allowed():
+    text = (
+        "import jax\nfrom oap_mllib_tpu.utils import progcache\n\n\n"
+        "def _build(tier):\n"
+        "    return jax.jit(lambda x: x)\n\n\n"
+        "fn = progcache.get_or_build('serve.x', ('k',), lambda: _build('hi'))\n"
+    )
+    assert lint(SERVING, text, rules=["jit-outside-progcache"]) == []
 
 
 def test_live_tree_lints_clean():

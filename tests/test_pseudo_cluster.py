@@ -869,3 +869,73 @@ class TestFleetObservability:
         seqs = [e["seq"] for e in tail]
         assert seqs == sorted(seqs)  # tails are seq-ordered
         assert elapsed < 90, f"world took {elapsed:.0f}s to diagnose"
+
+
+_SERVING_WORKER = os.path.join(
+    os.path.dirname(__file__), "pseudo_cluster_worker_serving.py"
+)
+
+
+def _answer_digests(out):
+    """leg -> digest from a serving worker's ANSWER lines."""
+    digests = {}
+    for ln in out.splitlines():
+        if ln.startswith("ANSWER "):
+            parts = dict(p.split("=") for p in ln.split()[1:])
+            digests[int(parts["leg"])] = parts["digest"]
+    return digests
+
+
+class TestServingPlane:
+    """ISSUE 13 serving availability: a REAL 2-replica serving fleet —
+    the replica that misses its collective deadline is EVICTED, the
+    survivor keeps answering bit-identical results in local-only mode,
+    and the supervisor's relaunched replacement answers exactly the
+    same requests (serving/ha.py composed with utils/recovery.py)."""
+
+    def test_replica_eviction_survivors_unchanged(self, tmp_path):
+        crash_dir = str(tmp_path / "sideband")
+        os.makedirs(crash_dir, exist_ok=True)
+        procs, outs, elapsed = _launch_world(
+            nproc=2, local_dev=1, timeout=120, worker=_SERVING_WORKER,
+            env_extra={
+                "SERVING_WORKER_MODE": "evict",
+                "SERVING_CRASH_DIR": crash_dir,
+            },
+        )
+        # rank 1 was genuinely preempted; rank 0 survived, evicted the
+        # fleet, and finished EVERY serving leg
+        assert procs[1].returncode == -9, outs[1]
+        assert procs[0].returncode == 0, f"survivor failed:\n{outs[0]}"
+        assert "EVICTED rank=0" in outs[0], outs[0]
+        assert "CollectiveTimeoutError" in outs[0], outs[0]
+        assert "SERVE_OK rank=0 legs=6 local_only=True" in outs[0], outs[0]
+        assert "FLEET rank=0 world=2" in outs[0], outs[0]
+        survivor = _answer_digests(outs[0])
+        assert sorted(survivor) == list(range(6)), survivor
+        # the evicted replica answered identically while it lived
+        victim = _answer_digests(outs[1])
+        for leg, dig in victim.items():
+            assert survivor[leg] == dig, (leg, survivor, victim)
+        # the survivor's diagnosis is in the sideband for the
+        # supervisor's classification
+        rec = json.load(
+            open(os.path.join(crash_dir, "crash.rank0.json"))
+        )
+        assert rec["fault_class"] == "collective_timeout"
+        assert elapsed < 90, f"fleet took {elapsed:.0f}s to evict"
+
+        # the supervisor's relaunch: a replacement replica (fresh
+        # 1-process world) serves the SAME requests and answers exactly
+        # what the survivor answered — eviction never changed results
+        procs2, outs2, _ = _launch_world(
+            nproc=1, local_dev=1, timeout=120, worker=_SERVING_WORKER,
+            env_extra={
+                "SERVING_WORKER_MODE": "relaunched",
+                "SERVING_CRASH_DIR": crash_dir,
+            },
+        )
+        assert procs2[0].returncode == 0, outs2[0]
+        assert "SERVE_OK rank=0 legs=6" in outs2[0], outs2[0]
+        relaunched = _answer_digests(outs2[0])
+        assert relaunched == survivor, (relaunched, survivor)
