@@ -113,6 +113,13 @@ def _emit(metric, value, unit, vs_baseline, **extra):
         # (dev/bench_regress.py) never diffs numbers across backends
         "backend": jax.default_backend(),
     }
+    if "locks" in _sanitizers_state():
+        # the locks sanitizer's hold-time tail rides the line so a
+        # locks-armed capture explains its own latency inflation
+        from oap_mllib_tpu.utils import locktrace
+
+        line["lock_hold_p99_ms"] = round(
+            locktrace.hold_quantile(0.99) * 1e3, 4)
     line.update(extra)
     print(json.dumps(line), flush=True)
 
@@ -1269,6 +1276,19 @@ def main():
                          "steady-state compiles) and full-sweep top-k "
                          "users/sec on a 1M-user synthetic factor table")
     args = ap.parse_args()
+
+    if args.serving and "locks" in _sanitizers_state():
+        # same policy as the sweep refusals below: the locks sanitizer
+        # adds per-acquisition bookkeeping on the serving registry and
+        # telemetry seams, so a QPS/tail-latency headline under it is
+        # not comparable to the locks-off baselines
+        ap.error(
+            f"--serving refuses to run with the locks sanitizer armed "
+            f"(Config.sanitizers={_sanitizers_state()!r}): tracked-lock "
+            "bookkeeping inflates request tail latency, so the QPS/p99 "
+            "headline would not be comparable to locks-off baselines; "
+            "unset OAP_MLLIB_TPU_SANITIZERS for benching"
+        )
 
     if (args.precision_sweep or args.compile_sweep) \
             and _sanitizers_state() != "off":

@@ -110,6 +110,16 @@ echo "   sanitizers-off overhead unmeasurable on the 20-fit K-Means"
 echo "   microbench (dev/sanitizer_gate.py) =="
 python dev/sanitizer_gate.py
 
+echo "== concurrency gate: static thread/lock model (oaplint R19-R22 +"
+echo "   atexit contract) required-clean on the live tree, seeded"
+echo "   lock-order/shared-write/blocking/unjoined mutations each fire"
+echo "   their rule, a scripted two-thread inversion raises LockOrderError"
+echo "   deterministically under the 'locks' sanitizer naming both witness"
+echo "   stacks, over-deadline holds are flagged (never killed), and the"
+echo "   disarmed tracked-lock seam is <1% of the 20-fit microbench"
+echo "   (dev/concurrency_gate.py) =="
+python dev/concurrency_gate.py
+
 echo "== chaos gate: live-world fault tolerance — seeded chaos fit at exact"
 echo "   parity, deterministic + chaos-driven kill-relaunch-resume drills"
 echo "   bit-identical (supervised, 1-process everywhere; 2-process + shrink"

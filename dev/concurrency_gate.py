@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""CI gate: the concurrency analysis plane (ISSUE 14) must hold its
+contracts.
+
+Legs:
+
+1. **Analyzer required-clean** — the concurrency rules (R19
+   lock-order-inversion, R20 unguarded-shared-write, R21
+   blocking-while-locked, R22 unjoined-thread, atexit-outside-shutdown)
+   analyze the live tree clean (every pre-existing true finding fixed
+   or carrying a reasoned suppression).
+2. **Seeded mutations** — one deliberately violating module per rule,
+   analyzed through the lint_text overlay seam, produces EXACTLY its
+   rule's finding (a refactor that weakens a rule fails here by name).
+3. **Inversion drill** — a scripted two-thread lock-order inversion
+   under the armed ``locks`` sanitizer raises ``LockOrderError``
+   deterministically (events sequence the two orders, so the second
+   thread always sees the recorded first ordering) naming both witness
+   stacks, BEFORE any real deadlock can form.
+4. **Hold-time watchdog** — a hold exceeding the collective deadline is
+   flagged (counter + histogram populated), never killed.
+5. **Disarmed seam** — tracked-lock operations with sanitizers off are
+   one cached config check each; their measured cost must stay <1% of
+   the 20-fit K-Means microbench wall (the sanitizer-plane overhead
+   contract, dev/sanitizer_gate.py's comparison point).
+
+Exit 1 with the offending evidence on any violation.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "dev"))
+
+import numpy as np  # noqa: E402
+
+import oaplint  # noqa: E402
+
+failures = []
+
+
+def check(ok: bool, what: str) -> None:
+    if not ok:
+        failures.append(what)
+        print(f"FAIL: {what}")
+
+
+CONCURRENCY_RULES = [
+    "lock-order-inversion",
+    "unguarded-shared-write",
+    "blocking-while-locked",
+    "unjoined-thread",
+    "atexit-outside-shutdown",
+]
+
+# -- leg 1: analyzer required-clean ------------------------------------------
+
+print("== concurrency gate: R19-R22 + atexit contract required-clean on "
+      "the live tree ==")
+findings, n_files = oaplint.run(Path(ROOT), rules=CONCURRENCY_RULES)
+for f in findings:
+    print("  " + f.render())
+check(findings == [],
+      f"live tree carries {len(findings)} concurrency finding(s)")
+check(n_files > 80, f"only {n_files} files enumerated")
+
+# -- leg 2: seeded mutations fire exactly their rule -------------------------
+
+print("== concurrency gate: seeded mutation per rule ==")
+OPS = "oap_mllib_tpu/ops/fake_conc.py"
+SEEDED = {
+    "lock-order-inversion": (
+        "import threading\n\n"
+        "_A = threading.Lock()\n_B = threading.Lock()\n\n\n"
+        "def f():\n    with _A:\n        with _B:\n            pass\n\n\n"
+        "def g():\n    with _B:\n        with _A:\n            pass\n"
+    ),
+    "unguarded-shared-write": (
+        "import threading\n\n_STATE = {}\n\n\n"
+        "def _worker():\n    _STATE['n'] = 1\n\n\n"
+        "def start():\n"
+        "    t = threading.Thread(target=_worker, daemon=True)\n"
+        "    t.start()\n"
+        "    _STATE['n'] = 2\n"
+    ),
+    "blocking-while-locked": (
+        "import threading\nimport time\n\n_lock = threading.Lock()\n\n\n"
+        "def f():\n    with _lock:\n        time.sleep(0.1)\n"
+    ),
+    "unjoined-thread": (
+        "import threading\n\n\n"
+        "def f(work):\n"
+        "    t = threading.Thread(target=work)\n"
+        "    t.start()\n"
+    ),
+    "atexit-outside-shutdown": (
+        "import atexit\n\n\ndef f():\n    atexit.register(f)\n"
+    ),
+}
+for rule_name, text in SEEDED.items():
+    found = oaplint.lint_text(OPS, text, rules=[rule_name])
+    got = sorted({f.rule for f in found})
+    check(got == [rule_name],
+          f"seeded {rule_name} mutation produced {got or 'nothing'}")
+    print(f"  {rule_name}: fires")
+
+# -- leg 3: the two-thread inversion drill -----------------------------------
+
+print("== concurrency gate: scripted two-thread inversion raises "
+      "LockOrderError under the locks sanitizer ==")
+from oap_mllib_tpu.config import set_config  # noqa: E402
+from oap_mllib_tpu.utils import locktrace  # noqa: E402
+from oap_mllib_tpu.utils import sanitizers as san  # noqa: E402
+
+set_config(sanitizers="locks")
+a = locktrace.TrackedLock("gate.drill.a")
+b = locktrace.TrackedLock("gate.drill.b")
+first_done = threading.Event()
+box = {}
+
+
+def leg1():
+    with a:
+        with b:
+            pass
+    first_done.set()
+
+
+def leg2():
+    first_done.wait(timeout=10.0)  # deterministic: order is recorded
+    try:
+        with b:
+            with a:
+                pass
+        box["err"] = None
+    except san.LockOrderError as e:
+        box["err"] = e
+
+
+t1 = threading.Thread(target=leg1, name="drill-leg1")
+t2 = threading.Thread(target=leg2, name="drill-leg2")
+t1.start()
+t2.start()
+t1.join(timeout=10.0)
+t2.join(timeout=10.0)
+err = box.get("err")
+check(isinstance(err, san.LockOrderError),
+      f"inversion drill produced {type(err).__name__} instead of "
+      "LockOrderError")
+if isinstance(err, san.LockOrderError):
+    msg = str(err)
+    check("gate.drill.a" in msg and "gate.drill.b" in msg,
+          "diagnostic does not name both locks")
+    check("This acquisition" in msg and "Recorded witness" in msg,
+          "diagnostic does not carry both witness stacks")
+    check("leg1" in msg, "recorded witness stack lost the first thread")
+    print("  LockOrderError raised; both witness stacks present")
+
+# -- leg 4: hold-time watchdog flags, never kills ----------------------------
+
+print("== concurrency gate: hold-time watchdog flags past the deadline ==")
+from oap_mllib_tpu.telemetry import metrics as _tm  # noqa: E402
+
+san._reset_for_tests()
+set_config(sanitizers="locks", collective_timeout=0.005)
+hold = locktrace.TrackedLock("gate.hold")
+flags0 = _tm.family_total("oap_lock_hold_flags_total")
+with hold:
+    time.sleep(0.02)
+check(_tm.family_total("oap_lock_hold_flags_total") == flags0 + 1,
+      "over-deadline hold was not flagged")
+check(_tm.family_total("oap_lock_hold_seconds") > 0,
+      "hold-time histogram not populated")
+check(locktrace.hold_quantile(0.99) > 0.0, "hold p99 reads zero")
+print(f"  flagged; hold p99 {locktrace.hold_quantile(0.99)*1e3:.2f} ms")
+set_config(sanitizers="", collective_timeout=0.0)
+san._reset_for_tests()
+
+# -- leg 5: disarmed seam <1% of the 20-fit microbench -----------------------
+
+print("== concurrency gate: disarmed tracked-lock seam on the 20-fit "
+      "microbench ==")
+from oap_mllib_tpu.models.kmeans import KMeans  # noqa: E402
+
+rng = np.random.default_rng(11)
+xs = rng.normal(size=(128, 8)).astype(np.float32)
+KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(xs)  # warm
+t0 = time.perf_counter()
+for _ in range(20):
+    KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(xs)
+fit_wall = time.perf_counter() - t0
+
+# price 20 tracked acquire/release pairs per fit — a generous
+# overestimate: the tracked seams (telemetry sink, fleet state/server,
+# serving registry, sanitizer seq) sit OUTSIDE the per-chunk hot loop,
+# so a disarmed fit touches them ~0-2 times (sink once per finalize
+# when armed, fleet/serving not at all).  Report the per-op cost too.
+probe = locktrace.TrackedLock("gate.seam")
+reps = 2000
+per_fit = 20
+t0 = time.perf_counter()
+for _ in range(reps):
+    for _ in range(per_fit):
+        with probe:
+            pass
+probe_wall = time.perf_counter() - t0
+seam_wall = probe_wall * (20.0 / reps)
+per_op_us = probe_wall / (reps * per_fit) * 1e6
+pct = 100.0 * seam_wall / fit_wall
+print(f"  20-fit wall {fit_wall*1e3:.1f} ms; disarmed seam cost "
+      f"{seam_wall*1e3:.3f} ms (~{pct:.2f}%, {per_op_us:.2f} us per "
+      f"acquire/release pair, {per_fit} pairs/fit priced)")
+check(seam_wall < max(0.01 * fit_wall, 0.002),
+      f"disarmed tracked-lock seam measurable: {seam_wall:.4f}s vs "
+      f"{fit_wall:.4f}s fit wall")
+
+if failures:
+    print(f"\nconcurrency gate: {len(failures)} failure(s)")
+    sys.exit(1)
+print("\nconcurrency gate: OK")

@@ -408,3 +408,4 @@ from . import style  # noqa: E402,F401  (registration side effect)
 from . import contracts  # noqa: E402,F401
 from . import project  # noqa: E402,F401
 from . import dataflow  # noqa: E402,F401
+from . import concurrency  # noqa: E402,F401

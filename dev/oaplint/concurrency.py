@@ -1,0 +1,1057 @@
+"""Interprocedural concurrency rules (R19-R22 + the atexit contract).
+
+The host thread plane grew PR by PR — prefetch producer threads (PR 1),
+the per-collective watchdog dispatch threads (PR 10), the per-rank
+metrics HTTP servers and the flight-recorder ring (PR 11), serving
+heartbeats and request coalescing (PR 13) — guarded by 17+ ad-hoc
+``threading.Lock``/``RLock`` instances across the package, none of which
+the static plane modeled.  A lock-order inversion between the serving
+registry and the telemetry registry, or an unguarded shared-state write
+between the batcher and a heartbeat eviction, surfaces today as a
+production hang that even the collective deadline watchdog cannot
+diagnose (it watches collectives, not host locks).  This module makes
+the thread/lock structure explicit and machine-checked — the DrJAX
+argument (PAPERS.md, arXiv:2403.07128) applied to the HOST thread plane:
+parallel structure should be analyzable, not implicit in runtime
+behavior.  It is the same find-statically + witness-at-runtime pairing
+PR 7 proved for SPMD collectives; the runtime half is the ``locks``
+sanitizer (utils/locktrace.py via ``Config.sanitizers``).
+
+Built on the PR 7 package index (dev/oaplint/dataflow.py), the model has
+three layers:
+
+- **lock identities** — module-global ``_lock = threading.Lock()``
+  assignments and ``self.x = threading.Lock()`` class attributes,
+  resolved at use sites through same-module bindings, the enclosing
+  class, and per-module import aliases (``_tm._LOCK`` names the metrics
+  registry lock) — the R17 axis-name resolution idea applied to locks;
+- **a per-function may-hold lattice** — ``with lock:`` blocks and
+  ``acquire()``/``release()`` pairs establish held sets, propagated
+  through the call graph: a helper only ever called under a lock
+  inherits that lock into its ``always_held`` context (intersection
+  over call sites), and a function's transitive *acquires* and
+  *may-block* facts close over the graph like R16's reachability;
+- **thread roots and a shared-state map** — ``threading.Thread``
+  targets, executor submissions, and ``http.server`` handler methods
+  are spawn roots; module globals touched both inside a root's closure
+  and outside it are *shared* and their writes must agree on a lock.
+
+Fed rules:
+
+- **R19 lock-order-inversion** — a cycle in the global lock-acquisition
+  -order graph (lock B acquired while A is held on one path, A while B
+  on another, directly or through calls).  The finding prints both
+  acquisition chains; two threads interleaving the two paths deadlock.
+- **R20 unguarded-shared-write** — a write to shared state (module
+  global reachable from >= 2 thread roots) with no lock common to every
+  write path.
+- **R21 blocking-while-locked** — a blocking operation (device dispatch
+  via progcache.launch/get_or_build, a host collective, ``time.sleep``,
+  file I/O, subprocess, a thread ``join``/server ``shutdown``) reachable
+  while a registered lock is held: every other thread needing that lock
+  stalls behind the slow operation — the deadlock-by-starvation shape.
+- **R22 unjoined-thread** — a ``threading.Thread`` spawn whose handle
+  never reaches ``join()`` and is not declared ``daemon`` (nor
+  daemonized later): process exit then blocks on the forgotten thread.
+  The runtime cross-check is the ``oap_prefetch_leaked_threads_total``
+  accounting (PrefetchStats.leaked_threads).
+- **atexit-outside-shutdown** — ``atexit.register`` anywhere in the
+  package outside ``telemetry/export.py``: interpreter-exit work must
+  serialize through the one registered shutdown hook
+  (telemetry/export.shutdown) or the JSONL final snapshot, the fleet
+  server teardown, and the flight-recorder drain race at exit.
+
+Known approximations (docs/static-analysis.md has the full table):
+call resolution is by name (same-module preferred, import aliases
+resolved, >4 ambiguous candidates dropped); callables passed as values
+(``self._stage``, ``fn()`` trampolines) are opaque, so thread closures
+under-approximate — the ``locks`` sanitizer witnesses those at runtime;
+lambdas evaluate where they appear; per-instance locks are merged per
+class attribute; ``Semaphore``/``Event`` are deliberately not locks
+(not mutual exclusion); R20 covers module globals, not instance
+attributes.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from . import PKG, rule
+from .contracts import _dotted, _tail
+from .dataflow import FuncInfo, PackageIndex, _collective_dispatch, build_index
+
+EXPORT_REL = f"{PKG}/telemetry/export.py"
+
+# constructors that create a mutual-exclusion lock worth modeling.
+# Semaphore/Event/Condition are deliberately excluded: they are signaling
+# primitives, and modeling them as locks would invent inversions that
+# cannot deadlock.  TrackedLock/tracked_lock is the runtime sanitizer's
+# registry wrapper (utils/locktrace.py) — same semantics as the inner
+# lock it wraps.
+_LOCK_TAILS = {"Lock", "RLock", "TrackedLock", "tracked_lock"}
+
+# container-mutation methods that count as WRITES to a module global
+_MUTATORS = {"append", "add", "update", "clear", "pop", "popitem",
+             "remove", "extend", "insert", "setdefault", "discard",
+             "appendleft"}
+
+_HANDLER_METHODS = {"do_GET", "do_POST", "do_PUT", "do_HEAD"}
+
+
+# ---------------------------------------------------------------------------
+# model dataclasses
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LockInfo:
+    ident: str  # "rel::name" | "rel::Cls.attr"
+    rel: str
+    line: int
+    simple: str  # the bare global/attr name used at call sites
+    is_attr: bool
+
+
+@dataclasses.dataclass
+class SpawnInfo:
+    fi: FuncInfo
+    line: int
+    target_names: List[str]  # candidate callee tails for root resolution
+    daemon: bool
+    assigned: List[str]  # "name" or "self.attr" forms the handle binds to
+
+
+@dataclasses.dataclass
+class Scan:
+    """One function's concurrency-relevant behavior, held-set annotated."""
+
+    acquires: List[Tuple[str, int, FrozenSet[str]]] = \
+        dataclasses.field(default_factory=list)
+    calls: List[Tuple[ast.Call, FrozenSet[str]]] = \
+        dataclasses.field(default_factory=list)
+    blocking: List[Tuple[str, int, FrozenSet[str]]] = \
+        dataclasses.field(default_factory=list)
+    gwrites: List[Tuple[str, int, FrozenSet[str]]] = \
+        dataclasses.field(default_factory=list)
+    greads: List[str] = dataclasses.field(default_factory=list)
+
+
+class ThreadModel:
+    """The whole-package thread/lock model (one per PackageIndex)."""
+
+    def __init__(self, idx: PackageIndex):
+        self.idx = idx
+        self.locks: Dict[str, LockInfo] = {}
+        self.global_locks: Dict[Tuple[str, str], str] = {}  # (rel, name)->id
+        self.by_simple: Dict[str, List[str]] = {}  # bare name -> idents
+        self.aliases: Dict[str, Dict[str, str]] = {}  # rel -> alias -> rel
+        self.foreign: Dict[str, Set[str]] = {}  # rel -> non-package imports
+        self.cls_of_fn: Dict[int, str] = {}  # id(fn node) -> class name
+        self.module_globals: Dict[str, Set[str]] = {}
+        self.scans: Dict[str, Scan] = {}  # qual -> Scan
+        self.fn_by_qual: Dict[str, FuncInfo] = {}
+        self.acq_trans: Dict[str, Dict[str, Tuple[int, str]]] = {}
+        self.blocks: Dict[str, Tuple[str, str, int]] = {}
+        self.always_held: Dict[str, Optional[FrozenSet[str]]] = {}
+        self.edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        self.thread_roots: Dict[str, Tuple[str, int, str]] = {}
+        self.spawns: List[SpawnInfo] = []
+        self._closures: Dict[str, Set[str]] = {}
+        self._build()
+
+    # -- construction --------------------------------------------------------
+
+    def _build(self) -> None:
+        for rel, mod in self.idx.modules.items():
+            self._index_module_statics(rel, mod.tree)
+        for rel, mod in self.idx.modules.items():
+            for fi in mod.functions:
+                self.fn_by_qual[fi.qual] = fi
+                self.scans[fi.qual] = self._scan_fn(fi)
+        self._find_roots()
+        self._acquires_fixpoint()
+        self._blocks_fixpoint()
+        self._always_held_fixpoint()
+        self._build_edges()
+
+    def _index_module_statics(self, rel: str, tree: ast.Module) -> None:
+        globals_here: Set[str] = set()
+        aliases: Dict[str, str] = {}
+        for n in tree.body:
+            if isinstance(n, ast.Assign):
+                names = [t.id for t in n.targets if isinstance(t, ast.Name)]
+                globals_here.update(names)
+                if isinstance(n.value, ast.Call) \
+                        and _tail(n.value.func) in _LOCK_TAILS:
+                    for name in names:
+                        self._register_lock(rel, name, n.lineno, False)
+            elif isinstance(n, ast.AnnAssign) \
+                    and isinstance(n.target, ast.Name):
+                globals_here.add(n.target.id)
+        self.module_globals[rel] = globals_here
+        foreign: Set[str] = set()
+
+        def mod_rel(dotted: str) -> Optional[str]:
+            base = dotted.replace(".", "/")
+            for cand in (base + ".py", base + "/__init__.py"):
+                if cand in self.idx.modules:
+                    return cand
+            return None
+
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Import):
+                for a in n.names:
+                    bound = a.asname or a.name.split(".")[0]
+                    mrel = mod_rel(a.name)
+                    if mrel is not None and a.asname:
+                        aliases[a.asname] = mrel
+                    elif mod_rel(bound) is None and mrel is None:
+                        foreign.add(bound)  # subprocess, np, jax, ...
+            elif isinstance(n, ast.ImportFrom) and n.module:
+                for a in n.names:
+                    bound = a.asname or a.name
+                    mrel = mod_rel(f"{n.module}.{a.name}")
+                    if mrel is not None:
+                        aliases[bound] = mrel
+                    elif mod_rel(n.module) is None:
+                        foreign.add(bound)  # from jax import lax, ...
+        self.aliases[rel] = aliases
+        self.foreign[rel] = foreign
+        # class membership + self.<attr> = threading.Lock() registration
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for n in ast.walk(cls):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.cls_of_fn.setdefault(id(n), cls.name)
+                if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                        and _tail(n.value.func) in _LOCK_TAILS:
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            self._register_lock(
+                                rel, f"{cls.name}.{t.attr}", n.lineno,
+                                True, simple=t.attr,
+                            )
+
+    def _register_lock(self, rel: str, name: str, line: int, is_attr: bool,
+                       simple: Optional[str] = None) -> None:
+        ident = f"{rel}::{name}"
+        simple = simple or name
+        self.locks[ident] = LockInfo(ident, rel, line, simple, is_attr)
+        if not is_attr:
+            self.global_locks[(rel, name)] = ident
+        self.by_simple.setdefault(simple, []).append(ident)
+
+    # -- lock resolution at a use site ---------------------------------------
+
+    def resolve_lock(self, fi: FuncInfo, expr: ast.AST) -> Optional[str]:
+        """The registered lock identity a ``with``/``acquire`` target
+        names, or None (opaque).  Same-module globals win; ``self.x``
+        resolves through the enclosing class then uniquely by attribute
+        name package-wide; ``alias.name`` resolves through the module's
+        import aliases; an ambiguous bare name resolves only if unique
+        package-wide (the conservative default — a wrong identity would
+        invent inversions)."""
+        if isinstance(expr, ast.Name):
+            ident = self.global_locks.get((fi.rel, expr.id))
+            if ident is not None:
+                return ident
+            cands = [i for i in self.by_simple.get(expr.id, ())
+                     if not self.locks[i].is_attr]
+            return cands[0] if len(cands) == 1 else None
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                cls = self.cls_of_fn.get(id(fi.node))
+                if cls is not None:
+                    ident = self.locks.get(f"{fi.rel}::{cls}.{expr.attr}")
+                    if ident is not None:
+                        return ident.ident if isinstance(ident, LockInfo) \
+                            else ident
+                cands = [i for i in self.by_simple.get(expr.attr, ())
+                         if self.locks[i].is_attr]
+                return cands[0] if len(cands) == 1 else None
+            if isinstance(base, ast.Name):
+                target_rel = self.aliases.get(fi.rel, {}).get(base.id)
+                if target_rel is not None:
+                    return self.global_locks.get((target_rel, expr.attr))
+                cands = self.by_simple.get(expr.attr, ())
+                return cands[0] if len(cands) == 1 else None
+        return None
+
+    # -- call resolution (alias-aware, ambiguity-capped) ---------------------
+
+    def resolve_call(self, fi: FuncInfo, call: ast.Call) -> List[FuncInfo]:
+        tail = _tail(call.func)
+        if not tail:
+            return []
+        if isinstance(call.func, ast.Attribute):
+            base = call.func.value
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                if base.id in self.foreign.get(fi.rel, ()):
+                    return []  # subprocess.run is not Supervisor.run
+                target_rel = self.aliases.get(fi.rel, {}).get(base.id)
+                if target_rel is not None:
+                    mod = self.idx.modules.get(target_rel)
+                    if mod is None:
+                        return []
+                    return [f for f in mod.functions if f.name == tail]
+        cands = self.idx.resolve(call, fi.rel)
+        # a wildly ambiguous name (close, fit, run, ...) would smear one
+        # function's facts over the whole package — drop it instead
+        if len(cands) > 4 and not (cands and cands[0].rel == fi.rel):
+            return []
+        return cands
+
+    # -- the per-function scan ----------------------------------------------
+
+    def _scan_fn(self, fi: FuncInfo) -> Scan:
+        scan = Scan()
+        mod_globals = self.module_globals.get(fi.rel, set())
+        declared_global: Set[str] = set()
+        local_bound: Set[str] = set(fi.params)
+        for n in ast.walk(fi.node):
+            if self.idx.owner.get(id(n)) is not fi:
+                continue
+            if isinstance(n, ast.Global):
+                declared_global.update(n.names)
+            elif isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                                ast.NamedExpr, ast.For, ast.AsyncFor)):
+                from .dataflow import _assign_targets
+
+                local_bound.update(_assign_targets(n))
+            elif isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    if isinstance(item.optional_vars, ast.Name):
+                        local_bound.add(item.optional_vars.id)
+            elif isinstance(n, ast.comprehension):
+                for x in ast.walk(n.target):
+                    if isinstance(x, ast.Name):
+                        local_bound.add(x.id)
+        local_bound -= declared_global
+
+        def is_global(name: str) -> bool:
+            return name in mod_globals and (
+                name in declared_global or name not in local_bound
+            )
+
+        def expr_scan(node: ast.AST, held: Tuple[str, ...]) -> None:
+            heldset = frozenset(held)
+            for n in ast.walk(node):
+                if self.idx.owner.get(id(n)) is not fi:
+                    continue
+                if isinstance(n, ast.Call):
+                    scan.calls.append((n, heldset))
+                    desc = _blocking_desc(n)
+                    if desc is not None:
+                        scan.blocking.append((desc, n.lineno, heldset))
+                    # container mutation of a module global is a write
+                    if isinstance(n.func, ast.Attribute) \
+                            and n.func.attr in _MUTATORS \
+                            and isinstance(n.func.value, ast.Name) \
+                            and is_global(n.func.value.id):
+                        scan.gwrites.append(
+                            (n.func.value.id, n.lineno, heldset))
+                elif isinstance(n, ast.Name) \
+                        and isinstance(n.ctx, ast.Load) \
+                        and is_global(n.id):
+                    scan.greads.append(n.id)
+
+        def note_store(target: ast.AST, line: int,
+                       held: Tuple[str, ...]) -> None:
+            heldset = frozenset(held)
+            for t in ast.walk(target):
+                if isinstance(t, ast.Name) and t.id in declared_global \
+                        and t.id in mod_globals:
+                    scan.gwrites.append((t.id, line, heldset))
+                elif isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and is_global(t.value.id):
+                    scan.gwrites.append((t.value.id, line, heldset))
+
+        def walk(stmts, held: List[str]) -> None:
+            manual: List[str] = []  # bare .acquire() state in this block
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue  # nested defs scan as their own functions
+                if isinstance(st, (ast.With, ast.AsyncWith)):
+                    entered: List[str] = []
+                    for item in st.items:
+                        expr_scan(item.context_expr, tuple(held))
+                        lid = self.resolve_lock(fi, item.context_expr)
+                        if lid is None and isinstance(
+                                item.context_expr, ast.Call):
+                            # `with lock:` vs `with lock.acquire_ctx()`:
+                            # only the bare lock form is modeled
+                            pass
+                        if lid is not None:
+                            scan.acquires.append(
+                                (lid, item.context_expr.lineno,
+                                 frozenset(held)))
+                            if lid not in held:
+                                held.append(lid)
+                                entered.append(lid)
+                    walk(st.body, held)
+                    for lid in entered:
+                        held.remove(lid)
+                    continue
+                if isinstance(st, (ast.If, ast.While)):
+                    expr_scan(st.test, tuple(held))
+                    walk(st.body, held)
+                    walk(st.orelse, held)
+                    continue
+                if isinstance(st, (ast.For, ast.AsyncFor)):
+                    expr_scan(st.iter, tuple(held))
+                    note_store(st.target, st.lineno, tuple(held))
+                    walk(st.body, held)
+                    walk(st.orelse, held)
+                    continue
+                if isinstance(st, ast.Try):
+                    walk(st.body, held)
+                    for h in st.handlers:
+                        walk(h.body, held)
+                    walk(st.orelse, held)
+                    walk(st.finalbody, held)
+                    continue
+                # bare acquire()/release() on a resolvable lock
+                acq_rel = _bare_acquire_release(st)
+                if acq_rel is not None:
+                    kind, expr, call = acq_rel
+                    lid = self.resolve_lock(fi, expr)
+                    if lid is not None:
+                        if kind == "acquire":
+                            scan.acquires.append(
+                                (lid, call.lineno, frozenset(held)))
+                            if lid not in held:
+                                held.append(lid)
+                                manual.append(lid)
+                        elif lid in manual:
+                            held.remove(lid)
+                            manual.remove(lid)
+                        continue
+                if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    targets = st.targets if isinstance(st, ast.Assign) \
+                        else [st.target]
+                    for t in targets:
+                        note_store(t, st.lineno, tuple(held))
+                    if getattr(st, "value", None) is not None:
+                        expr_scan(st.value, tuple(held))
+                    continue
+                expr_scan(st, tuple(held))
+            for lid in manual:  # unbalanced acquire ends with the block
+                if lid in held:
+                    held.remove(lid)
+
+        walk(getattr(fi.node, "body", []), [])
+        return scan
+
+    # -- thread roots + spawn inventory --------------------------------------
+
+    def _find_roots(self) -> None:
+        for rel, mod in self.idx.modules.items():
+            tree = mod.tree
+            for cls in ast.walk(tree):
+                if isinstance(cls, ast.ClassDef):
+                    for n in cls.body:
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+                                and n.name in _HANDLER_METHODS:
+                            for fi in mod.functions:
+                                if fi.node is n:
+                                    self.thread_roots[fi.qual] = (
+                                        rel, n.lineno, "http handler")
+        for rel, mod in self.idx.modules.items():
+            for fi in mod.functions:
+                for call in fi.own_calls:
+                    tail = _tail(call.func)
+                    d = _dotted(call.func)
+                    if tail == "Thread" and (
+                            d in ("threading.Thread", "Thread")):
+                        self._note_spawn(fi, call)
+                    elif tail == "submit" and call.args:
+                        for name in _callable_tails(call.args[0]):
+                            self._root_from_name(fi, name, call.lineno,
+                                                 "executor submit")
+
+    def _note_spawn(self, fi: FuncInfo, call: ast.Call) -> None:
+        daemon = False
+        target: Optional[ast.AST] = None
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+            elif kw.arg == "target":
+                target = kw.value
+        names = _callable_tails(target) if target is not None else []
+        assigned: List[str] = []
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Assign) and n.value is call:
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        assigned.append(t.id)
+                    elif isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        assigned.append(f"self.{t.attr}")
+        self.spawns.append(
+            SpawnInfo(fi, call.lineno, names, daemon, assigned))
+        for name in names:
+            self._root_from_name(fi, name, call.lineno, "thread target")
+
+    def _root_from_name(self, fi: FuncInfo, name: str, line: int,
+                        how: str) -> None:
+        cands = self.idx.by_name.get(name, [])
+        same = [c for c in cands if c.rel == fi.rel]
+        for c in same or cands[:2]:
+            self.thread_roots.setdefault(c.qual, (fi.rel, line, how))
+
+    def closure(self, root_qual: str) -> Set[str]:
+        hit = self._closures.get(root_qual)
+        if hit is not None:
+            return hit
+        seen: Set[str] = set()
+        stack = [root_qual]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            fi = self.fn_by_qual.get(q)
+            if fi is None:
+                continue
+            for call, _ in self.scans[q].calls:
+                for cand in self.resolve_call(fi, call):
+                    if cand.qual not in seen:
+                        stack.append(cand.qual)
+        self._closures[root_qual] = seen
+        return seen
+
+    # -- fixpoints ------------------------------------------------------------
+
+    def _acquires_fixpoint(self) -> None:
+        for q, scan in self.scans.items():
+            fi = self.fn_by_qual[q]
+            self.acq_trans[q] = {
+                lid: (line, f"{fi.name} acquires {_short(lid)} at "
+                            f"{fi.rel}:{line}")
+                for lid, line, _ in scan.acquires
+            }
+        changed = True
+        while changed:
+            changed = False
+            for q, scan in self.scans.items():
+                fi = self.fn_by_qual[q]
+                mine = self.acq_trans[q]
+                for call, _ in scan.calls:
+                    for cand in self.resolve_call(fi, call):
+                        if cand.qual == q:
+                            continue
+                        for lid, (line, chain) in self.acq_trans.get(
+                                cand.qual, {}).items():
+                            if lid not in mine:
+                                mine[lid] = (
+                                    call.lineno,
+                                    f"{fi.name} -> {chain}")
+                                changed = True
+
+    def _blocks_fixpoint(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for q, scan in self.scans.items():
+                if q in self.blocks:
+                    continue
+                fi = self.fn_by_qual[q]
+                for desc, line, _ in scan.blocking:
+                    self.blocks[q] = ("direct", desc, line)
+                    changed = True
+                    break
+                if q in self.blocks:
+                    continue
+                for call, _ in scan.calls:
+                    for cand in self.resolve_call(fi, call):
+                        if cand.qual in self.blocks and cand.qual != q:
+                            self.blocks[q] = (
+                                "via", cand.qual, call.lineno)
+                            changed = True
+                            break
+                    if q in self.blocks:
+                        break
+
+    def block_chain(self, qual: str, limit: int = 6) -> str:
+        parts: List[str] = []
+        seen: Set[str] = set()
+        while qual in self.blocks and qual not in seen and limit:
+            seen.add(qual)
+            limit -= 1
+            kind, what, line = self.blocks[qual]
+            name = qual.split("::", 1)[1]
+            if kind == "direct":
+                parts.append(f"{name} -> {what} (line {line})")
+                break
+            parts.append(name)
+            qual = what
+        return " -> ".join(parts)
+
+    def _always_held_fixpoint(self) -> None:
+        """Locks held on EVERY path into a function (intersection over
+        package call sites; entry points and thread roots start empty).
+        Gives ``_shutdown_locked``-style helpers their caller's lock."""
+        callsites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        for q, scan in self.scans.items():
+            fi = self.fn_by_qual[q]
+            for call, held in scan.calls:
+                for cand in self.resolve_call(fi, call):
+                    callsites.setdefault(cand.qual, []).append((q, held))
+        for q in self.scans:
+            has_sites = bool(callsites.get(q))
+            self.always_held[q] = None if has_sites else frozenset()
+            if q in self.thread_roots:
+                self.always_held[q] = frozenset()
+        for _ in range(12):
+            changed = False
+            for q, sites in callsites.items():
+                if self.always_held.get(q) == frozenset():
+                    continue
+                acc: Optional[FrozenSet[str]] = None
+                for caller, held in sites:
+                    ch = self.always_held.get(caller)
+                    if ch is None:
+                        continue  # caller unresolved yet: skip this site
+                    site_held = held | ch
+                    acc = site_held if acc is None else (acc & site_held)
+                if acc is not None and acc != self.always_held.get(q):
+                    self.always_held[q] = acc
+                    changed = True
+            if not changed:
+                break
+        for q in self.scans:
+            if self.always_held.get(q) is None:
+                self.always_held[q] = frozenset()
+
+    def effective_held(self, qual: str, held: FrozenSet[str]) -> FrozenSet[str]:
+        return held | (self.always_held.get(qual) or frozenset())
+
+    def _build_edges(self) -> None:
+        for q, scan in self.scans.items():
+            fi = self.fn_by_qual[q]
+            for lid, line, held in scan.acquires:
+                for h in self.effective_held(q, held):
+                    if h != lid and (h, lid) not in self.edges:
+                        self.edges[(h, lid)] = (
+                            fi.rel, line,
+                            f"{fi.name} acquires {_short(lid)} at "
+                            f"{fi.rel}:{line} while holding {_short(h)}")
+            for call, held in scan.calls:
+                eff = self.effective_held(q, held)
+                if not eff:
+                    continue
+                for cand in self.resolve_call(fi, call):
+                    for lid, (line, chain) in self.acq_trans.get(
+                            cand.qual, {}).items():
+                        for h in eff:
+                            if h != lid and (h, lid) not in self.edges:
+                                self.edges[(h, lid)] = (
+                                    fi.rel, call.lineno,
+                                    f"{fi.name} (holding {_short(h)}) -> "
+                                    f"{chain}")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _short(ident: str) -> str:
+    rel, name = ident.split("::", 1)
+    return f"{name} ({rel})"
+
+
+def _callable_tails(expr: Optional[ast.AST]) -> List[str]:
+    """Candidate function names a callable expression may denote:
+    ``f`` -> f, ``self._produce`` -> _produce, ``mod.fn`` -> fn."""
+    if expr is None:
+        return []
+    if isinstance(expr, ast.Name):
+        return [expr.id]
+    if isinstance(expr, ast.Attribute):
+        return [expr.attr]
+    return []
+
+
+def _bare_acquire_release(st: ast.stmt):
+    """('acquire'|'release', lock_expr, call) when a statement is a bare
+    ``lock.acquire(...)`` / ``lock.release()`` expression or assignment
+    of one; None otherwise."""
+    node = None
+    if isinstance(st, ast.Expr):
+        node = st.value
+    elif isinstance(st, ast.Assign):
+        node = st.value
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("acquire", "release")):
+        return None
+    return node.func.attr, node.func.value, node
+
+
+def _blocking_desc(call: ast.Call) -> Optional[str]:
+    """Why a call is considered blocking, or None.  The set is the
+    starvation-shaped operations: device dispatch/compile, host
+    collectives, sleeps, file I/O, subprocess, thread joins and server
+    shutdowns, event waits."""
+    d = _dotted(call.func)
+    t = _tail(call.func)
+    if d in ("time.sleep", "sleep") and t == "sleep":
+        return f"{d or 'sleep'}() sleep"
+    if d.startswith("subprocess."):
+        if t in ("run", "check_call", "check_output", "call", "Popen"):
+            return f"{d}() subprocess"
+        return None
+    if t == "open" and isinstance(call.func, ast.Name):
+        return "open() file I/O"
+    if d in ("os.replace", "os.rename", "os.fsync", "os.makedirs"):
+        return f"{d}() file I/O"
+    if t in ("block_until_ready", "device_get"):
+        return f"{t}() device sync"
+    if t in ("launch", "get_or_build") and (
+            d.startswith("progcache.") or d.startswith("_CACHE.")
+            or d.endswith(".progcache." + t)):
+        return f"{d}() device dispatch/compile"
+    op = _collective_dispatch(call)
+    if op is not None:
+        return f"host collective {op}"
+    if t in ("guarded_dispatch", "_allgather_host", "_psum_host",
+             "_gather_with_guard", "heartbeat"):
+        return f"{d or t}() host collective"
+    if t == "join" and isinstance(call.func, ast.Attribute):
+        numeric = (len(call.args) == 1
+                   and isinstance(call.args[0], ast.Constant)
+                   and isinstance(call.args[0].value, (int, float)))
+        kw_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+        if not call.args and not call.keywords:
+            return ".join() thread join"
+        if numeric or kw_timeout:
+            return ".join(timeout) thread join"
+        return None  # str.join(iterable)
+    if t == "shutdown" and isinstance(call.func, ast.Attribute) \
+            and not call.args and not call.keywords:
+        return ".shutdown() server/executor shutdown"
+    if t == "wait" and isinstance(call.func, ast.Attribute):
+        return ".wait() event/condition wait"
+    return None
+
+
+_MODEL_ATTR = "_concurrency_model"
+
+
+def _model(idx: PackageIndex) -> ThreadModel:
+    model = getattr(idx, _MODEL_ATTR, None)
+    if model is None:
+        model = ThreadModel(idx)
+        setattr(idx, _MODEL_ATTR, model)
+    return model
+
+
+# ---------------------------------------------------------------------------
+# R19: lock-order-inversion
+# ---------------------------------------------------------------------------
+
+
+@rule("lock-order-inversion", scope=rf"{PKG}/", kind="dataflow",
+      doc="No cycle in the global lock-acquisition-order graph: lock B "
+          "acquired while A is held on one path and A while B on "
+          "another (directly or through calls, always-held caller "
+          "context included) deadlocks the two paths the first time "
+          "they interleave.  The finding prints both acquisition "
+          "chains.  Runtime witness: the 'locks' sanitizer "
+          "(Config.sanitizers) raises LockOrderError on a live "
+          "inversion.")
+def _r19(root, extra=None):
+    idx = build_index(Path(root), extra)
+    model = _model(idx)
+    findings: List[Tuple[str, int, str]] = []
+    reported: Set[FrozenSet[str]] = set()
+    for (a, b), (rel, line, chain) in sorted(model.edges.items()):
+        back = model.edges.get((b, a))
+        if back is None:
+            continue
+        pair = frozenset((a, b))
+        if pair in reported:
+            continue
+        reported.add(pair)
+        brel, bline, bchain = back
+        detail = (
+            f"lock-order inversion between {_short(a)} and {_short(b)}: "
+            f"[{chain}] but also [{bchain}] — two threads interleaving "
+            "these paths deadlock; pick one global order (or collapse "
+            "the locks)")
+        findings.append((rel, line, detail))
+        if (brel, bline) != (rel, line):
+            findings.append((brel, bline, detail))
+    # longer cycles without a 2-cycle: walk SCCs
+    findings.extend(_long_cycles(model, reported))
+    return findings
+
+
+def _long_cycles(model: ThreadModel, reported: Set[FrozenSet[str]]):
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in model.edges:
+        adj.setdefault(a, []).append(b)
+    out: List[Tuple[str, int, str]] = []
+    for start in sorted(adj):
+        path: List[str] = []
+        on_path: Set[str] = set()
+
+        def dfs(node: str) -> Optional[List[str]]:
+            if node == start and path:
+                return list(path)
+            if node in on_path:
+                return None
+            on_path.add(node)
+            path.append(node)
+            for nxt in adj.get(node, ()):
+                got = dfs(nxt)
+                if got is not None:
+                    return got
+            path.pop()
+            on_path.discard(node)
+            return None
+
+        cyc = dfs(start)
+        if cyc and len(cyc) > 2:
+            key = frozenset(cyc)
+            if key in reported:
+                continue
+            reported.add(key)
+            loop = cyc + [cyc[0]]
+            chains = "; ".join(
+                model.edges[(loop[i], loop[i + 1])][2]
+                for i in range(len(cyc)))
+            rel, line, _ = model.edges[(loop[0], loop[1])]
+            out.append((
+                rel, line,
+                f"lock-order cycle over {len(cyc)} locks "
+                f"({' -> '.join(_short(c) for c in loop)}): {chains}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R20: unguarded-shared-write
+# ---------------------------------------------------------------------------
+
+
+@rule("unguarded-shared-write", scope=rf"{PKG}/", kind="dataflow",
+      doc="A module global touched both inside a spawned thread's "
+          "closure and outside it is SHARED; every write to it must "
+          "hold one common registered lock — a write with no common "
+          "lock races whichever thread reads next.  Thread closures: "
+          "threading.Thread targets, executor submissions, http "
+          "handler methods, traversed through the call graph.")
+def _r20(root, extra=None):
+    idx = build_index(Path(root), extra)
+    model = _model(idx)
+    findings: List[Tuple[str, int, str]] = []
+    # access table: (rel, global name) -> accessor quals + writes
+    touch: Dict[Tuple[str, str], Set[str]] = {}
+    writes: Dict[Tuple[str, str],
+                 List[Tuple[str, int, FrozenSet[str]]]] = {}
+    for q, scan in model.scans.items():
+        fi = model.fn_by_qual[q]
+        for name in scan.greads:
+            touch.setdefault((fi.rel, name), set()).add(q)
+        for name, line, held in scan.gwrites:
+            touch.setdefault((fi.rel, name), set()).add(q)
+            writes.setdefault((fi.rel, name), []).append(
+                (q, line, model.effective_held(q, held)))
+    closures = {r: model.closure(r) for r in model.thread_roots}
+    for key, ws in sorted(writes.items()):
+        rel, name = key
+        if (rel, name) in model.global_locks:
+            continue  # the locks themselves are not shared *state*
+        accessors = touch[key]
+        roots_touching = [r for r, cl in closures.items()
+                          if accessors & cl]
+        if not roots_touching:
+            continue
+        union = set()
+        for r in roots_touching:
+            union |= closures[r]
+        outside = [a for a in accessors if a not in union]
+        if len(roots_touching) < 2 and not outside:
+            continue
+        common: Optional[FrozenSet[str]] = None
+        for _, _, held in ws:
+            common = held if common is None else (common & held)
+        if common:
+            continue
+        q, line, held = min(
+            ws, key=lambda w: (len(w[2]), w[1]))
+        sites = ", ".join(
+            f"{wq.split('::', 1)[1]}:{wl}"
+            + (f" holding {{{', '.join(_short(h) for h in wh)}}}"
+               if wh else " holding no lock")
+            for wq, wl, wh in ws)
+        roots = ", ".join(
+            f"{r.split('::', 1)[1]} ({model.thread_roots[r][2]})"
+            for r in sorted(roots_touching))
+        findings.append((
+            rel, line,
+            f"module global '{name}' is shared across thread roots "
+            f"[{roots}] and the main flow, but its writes hold no "
+            f"common lock (writes: {sites}); guard every write with "
+            "one registered lock (the runtime 'locks' sanitizer "
+            "witnesses the dynamic interleavings this pass cannot "
+            "see)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R21: blocking-while-locked
+# ---------------------------------------------------------------------------
+
+
+@rule("blocking-while-locked", scope=rf"{PKG}/", kind="dataflow",
+      doc="No blocking operation (device dispatch via progcache, host "
+          "collectives, time.sleep, file I/O, subprocess, thread "
+          "join/server shutdown, event waits) reachable while a "
+          "registered lock is held — every other thread needing that "
+          "lock stalls behind the slow operation, and a blocked "
+          "collective under a lock is the deadlock-by-starvation "
+          "shape the collective deadline watchdog cannot see.  The "
+          "'locks' sanitizer's hold-time histogram + watchdog "
+          "(oap_lock_hold_seconds) witnesses the residue at runtime.")
+def _r21(root, extra=None):
+    idx = build_index(Path(root), extra)
+    model = _model(idx)
+    findings: List[Tuple[str, int, str]] = []
+    seen: Set[Tuple[str, int]] = set()
+    for q, scan in model.scans.items():
+        fi = model.fn_by_qual[q]
+        for desc, line, held in scan.blocking:
+            eff = model.effective_held(q, held)
+            if not eff or (fi.rel, line) in seen:
+                continue
+            seen.add((fi.rel, line))
+            findings.append((
+                fi.rel, line,
+                f"blocking operation ({desc}) while holding "
+                f"{{{', '.join(sorted(_short(h) for h in eff))}}}; "
+                "move the slow operation outside the critical section "
+                "(stage under the lock, act after release)"))
+        for call, held in scan.calls:
+            eff = model.effective_held(q, held)
+            if not eff:
+                continue
+            for cand in model.resolve_call(fi, call):
+                if cand.qual == q or cand.qual not in model.blocks:
+                    continue
+                if (fi.rel, call.lineno) in seen:
+                    continue
+                seen.add((fi.rel, call.lineno))
+                findings.append((
+                    fi.rel, call.lineno,
+                    f"call to '{cand.name}' blocks "
+                    f"({model.block_chain(cand.qual)}) while holding "
+                    f"{{{', '.join(sorted(_short(h) for h in eff))}}}; "
+                    "move the blocking work outside the critical "
+                    "section"))
+                break
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R22: unjoined-thread
+# ---------------------------------------------------------------------------
+
+
+@rule("unjoined-thread", scope=rf"{PKG}/", kind="dataflow",
+      doc="Every threading.Thread spawn must either be daemon=True at "
+          "construction (or daemonized via handle.daemon before start) "
+          "or have its handle reach a join() somewhere in the module — "
+          "a forgotten non-daemon thread blocks interpreter exit, and "
+          "a forgotten daemon producer is exactly what the "
+          "oap_prefetch_leaked_threads_total accounting counts at "
+          "runtime.")
+def _r22(root, extra=None):
+    idx = build_index(Path(root), extra)
+    model = _model(idx)
+    findings: List[Tuple[str, int, str]] = []
+    for sp in model.spawns:
+        if sp.daemon:
+            continue
+        mod = idx.modules.get(sp.fi.rel)
+        if mod is None:
+            continue
+        if sp.assigned and _handle_managed(mod.tree, sp.assigned):
+            continue
+        what = "never assigned to a handle" if not sp.assigned else (
+            f"handle {sp.assigned[0]!r} never reaches join() and is "
+            "never daemonized")
+        findings.append((
+            sp.fi.rel, sp.line,
+            f"thread spawned in '{sp.fi.name}' is not daemon=True and "
+            f"{what}; join it, daemonize it, or route it through a "
+            "supervised lifecycle (cross-check: PrefetchStats"
+            ".leaked_threads / oap_prefetch_leaked_threads_total "
+            "count producers that failed to join)"))
+    return findings
+
+
+def _handle_managed(tree: ast.Module, assigned: List[str]) -> bool:
+    """Does any ``<handle>.join(...)`` call or ``<handle>.daemon = True``
+    assignment appear in the module, for any of the spawn's bound
+    names (``t`` or ``self.attr`` forms)?"""
+    attrs = {a.split(".", 1)[1] for a in assigned if a.startswith("self.")}
+    names = {a for a in assigned if not a.startswith("self.")}
+
+    def matches(base: ast.AST) -> bool:
+        if isinstance(base, ast.Name) and base.id in names:
+            return True
+        return bool(
+            isinstance(base, ast.Attribute) and base.attr in attrs
+        )
+
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "join" and matches(n.func.value):
+            return True
+        if isinstance(n, ast.Assign) \
+                and isinstance(n.value, ast.Constant) \
+                and n.value.value is True:
+            for t in n.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                        and matches(t.value):
+                    return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the atexit ordering contract (per-file rule; ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+
+@rule("atexit-outside-shutdown", scope=rf"{PKG}/",
+      doc="atexit.register only in telemetry/export.py — interpreter-"
+          "exit work (the JSONL final snapshot, the fleet metrics "
+          "server teardown, the flight-recorder drain) must serialize "
+          "through the ONE registered shutdown hook "
+          "(telemetry/export.shutdown); independent atexit hooks run "
+          "in registration order across modules and race the sink.")
+def _atexit_outside_shutdown(ctx):
+    if ctx.rel == EXPORT_REL:
+        return
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Call) \
+                and _dotted(n.func) == "atexit.register":
+            yield (n.lineno,
+                   "atexit.register outside telemetry/export.py; add "
+                   "your teardown to telemetry/export.shutdown (the "
+                   "one ordered exit hook) instead of racing it")

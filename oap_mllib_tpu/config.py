@@ -359,21 +359,27 @@ class Config:
     # knob; the env var still applies through the standard coercion.
     profile_dir: str = ""
     # Runtime sanitizer plane (utils/sanitizers.py): comma-set of
-    # "collective", "transfer", "retrace"; empty (default) = all off.
-    # "collective" fingerprints every host-level collective dispatch as
-    # (op, axis, shape, dtype) and cross-checks the signature across
-    # ranks BEFORE dispatch (plus a per-fit fingerprint check at
-    # finalization), so a rank-divergent collective raises a diagnostic
-    # naming the mismatching op on every rank instead of hanging the
-    # world.  "transfer" runs streamed per-chunk consumer bodies under
-    # jax.transfer_guard("disallow") — implicit device<->host syncs in
-    # the hot loop fail loudly (the runtime ground truth behind oaplint
-    # R4).  "retrace" asserts zero new XLA compiles after warmup in
-    # steady-state chunk loops (and via sanitizers.steady_state scopes).
+    # "collective", "transfer", "retrace", "locks"; empty (default) =
+    # all off.  "collective" fingerprints every host-level collective
+    # dispatch as (op, axis, shape, dtype) and cross-checks the
+    # signature across ranks BEFORE dispatch (plus a per-fit
+    # fingerprint check at finalization), so a rank-divergent
+    # collective raises a diagnostic naming the mismatching op on every
+    # rank instead of hanging the world.  "transfer" runs streamed
+    # per-chunk consumer bodies under jax.transfer_guard("disallow") —
+    # implicit device<->host syncs in the hot loop fail loudly (the
+    # runtime ground truth behind oaplint R4).  "retrace" asserts zero
+    # new XLA compiles after warmup in steady-state chunk loops (and
+    # via sanitizers.steady_state scopes).  "locks" arms the tracked-
+    # lock seams (utils/locktrace.py): a live lock-order inversion
+    # raises LockOrderError naming both witness stacks instead of
+    # deadlocking, hold times feed the oap_lock_hold_seconds histogram,
+    # and holds exceeding collective_timeout are flagged (never killed)
+    # — the runtime half of the oaplint concurrency pass (R19-R22).
     # Off = one cached string check per seam (~0% overhead,
-    # dev/sanitizer_gate.py asserts it); on adds one tiny allgather per
-    # host collective under "collective".  docs/distributed.md
-    # "Sanitizers" has the when/why table.
+    # dev/sanitizer_gate.py and dev/concurrency_gate.py assert it); on
+    # adds one tiny allgather per host collective under "collective".
+    # docs/distributed.md "Sanitizers" has the when/why table.
     sanitizers: str = ""
     # JSON-lines telemetry sink: non-empty appends one record per span
     # close plus a registry snapshot at every fit finalization (and a

@@ -249,12 +249,67 @@ class _Sentinel:
         self.err = err
 
 
+# producer join budget at shutdown, seconds (module-level so tests can
+# shrink it when deliberately wedging a stage callable)
+JOIN_TIMEOUT_S = 5.0
+
+
+class _ClosableSource:
+    """Iterator wrapper the consumer can exhaust remotely: after
+    :meth:`close` the next pull raises StopIteration, so a wedged
+    producer that eventually wakes cannot keep reading a retired
+    source (ISSUE 14 satellite — the close() contract)."""
+
+    __slots__ = ("_it", "_closed")
+
+    def __init__(self, it: Iterator):
+        self._it = it
+        self._closed = False
+
+    def close(self) -> None:
+        self._closed = True
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        return next(self._it)
+
+
+class _PoisonQueue:
+    """The retired pipeline's queue stand-in: anything a late (wedged,
+    now woken) producer stages after close() is retired on the spot and
+    counted — it can never reach a consumer or pin device memory."""
+
+    __slots__ = ("_retire",)
+
+    def __init__(self, retire: bool):
+        self._retire = retire
+
+    def put(self, item) -> None:
+        if self._retire and not isinstance(item, _Sentinel):
+            _delete_jax_arrays(item)
+        _tm.counter(
+            "oap_prefetch_poisoned_puts_total",
+            help="Staged items discarded because the pipeline was "
+                 "already retired when the producer woke",
+        ).inc()
+
+    def get(self, *a, **kw):  # pragma: no cover - consumers are gone
+        raise queue.Empty
+
+    def get_nowait(self):
+        raise queue.Empty
+
+
 class _Threaded:
     """depth>=2: bounded background staging (module docstring)."""
 
     def __init__(self, items: Iterator, stage, depth: int,
                  stats: PrefetchStats, retire):
-        self._items = items
+        self._items = _ClosableSource(items)
         self._stage = stage
         self._stats = stats
         self._retire = retire
@@ -304,9 +359,13 @@ class _Threaded:
         """Join the producer; a thread still alive past the timeout is a
         wedged stage callable (hung device_put / IO).  It used to be
         ignored silently — now it is counted (``PrefetchStats
-        .leaked_threads``, asserted zero in tests) and logged with the
-        pending site, so leaks surface instead of accumulating."""
-        self._thread.join(timeout=5.0)
+        .leaked_threads``, asserted zero in tests), logged with the
+        pending site, AND quarantined: the source is marked exhausted
+        and the staging queue is swapped for a poison queue, so if the
+        wedged thread ever wakes it cannot stage into a retired
+        pipeline — its output is retired on arrival and its next source
+        pull ends it (the ISSUE 14 wedged-producer contract)."""
+        self._thread.join(timeout=JOIN_TIMEOUT_S)
         if self._thread.is_alive():
             self._stats.leaked_threads += 1
             _tm.counter(
@@ -314,9 +373,13 @@ class _Threaded:
                 help="Producer threads that failed to join at shutdown",
             ).inc()
             log.warning(
-                "prefetch producer thread failed to join within 5s at %s; "
-                "leaking daemon thread %r", where, self._thread.name,
+                "prefetch producer thread failed to join within %.1fs at "
+                "%s; leaking daemon thread %r (source poisoned: a late "
+                "wake cannot write into the retired pipeline)",
+                JOIN_TIMEOUT_S, where, self._thread.name,
             )
+            self._items.close()  # next pull raises StopIteration
+            self._q = _PoisonQueue(self._retire)
 
     def __iter__(self):
         return self
@@ -344,6 +407,7 @@ class _Threaded:
 
     def close(self):
         self._cancel.set()
+        self._items.close()  # a producer mid-pull ends at the source too
         # drain so a producer blocked on put/semaphore wakes and exits
         try:
             while True:

@@ -33,8 +33,12 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.utils import locktrace
 
-_LOCK = threading.RLock()
+# tracked (utils/locktrace.py): the serving registry lock nests the
+# telemetry registry lock (gauge bookings under registration) — the
+# prime cross-subsystem ordering seam the "locks" sanitizer watches
+_LOCK = locktrace.TrackedLock("serving.registry", threading.RLock())
 _SERVED: Dict[tuple, "ServedModel"] = {}
 
 

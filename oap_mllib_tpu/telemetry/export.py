@@ -7,7 +7,8 @@ the metrics registry):
   ``OAP_MLLIB_TPU_TELEMETRY_LOG``).  Every fit finalization appends one
   record per closed span (type ``"span"``: path, duration, count,
   attrs) followed by a full registry snapshot (type ``"metrics"``), and
-  an atexit hook appends one final snapshot when the process ends.
+  the ONE registered exit hook (:func:`shutdown`, below) appends a
+  final flight-recorder drain + snapshot when the process ends.
   Records are rank-tagged and multi-process worlds write per-rank files
   (``<path>.rank<r>`` when the world is larger than one process), so a
   world's files concatenate into one mergeable stream.  Records carry a
@@ -22,6 +23,16 @@ the metrics registry):
 
 Telemetry-off is one falsy-string check per fit (`Config.telemetry_log`
 empty -> no file is ever opened).
+
+**The atexit ordering contract (ISSUE 14):** interpreter-exit work used
+to race — the sink's final snapshot, the fleet metrics server teardown,
+and the flight-recorder drain each hung off their own implicit
+lifecycle, so which ran first depended on registration order across
+modules.  :func:`shutdown` is now the ONE registered exit hook (oaplint
+``atexit-outside-shutdown`` keeps it unique): it drains the flight
+recorder into the sink, appends the final metrics snapshot, and stops
+the fleet endpoint — in that order, so the last scrape surface outlives
+the last record it could be asked about and no recorder tail is lost.
 """
 
 from __future__ import annotations
@@ -35,10 +46,13 @@ from typing import Any, Dict, List, Optional
 from oap_mllib_tpu.config import get_config
 from oap_mllib_tpu.telemetry import metrics as _metrics
 from oap_mllib_tpu.telemetry.spans import Span
+from oap_mllib_tpu.utils import locktrace
 
 _seq = itertools.count()
-_lock = threading.Lock()
-_atexit_registered = False
+# tracked (utils/locktrace.py): the sink lock serializes writers from
+# fit threads and the exit hook — a seam the "locks" sanitizer watches
+_lock = locktrace.TrackedLock("telemetry.sink", threading.Lock())
+_shutdown_registered = False
 
 
 def _rank() -> int:
@@ -59,31 +73,69 @@ def sink_path() -> Optional[str]:
 
 
 def _write_lines(path: str, records: List[Dict[str, Any]]) -> None:
+    # the lock EXISTS to serialize appends into one sink file — the
+    # file write is the critical section, not an accident of it
+    # oaplint: disable=blocking-while-locked -- the sink lock's one job IS serializing this append
     with _lock, open(path, "a") as f:
         for rec in records:
             f.write(json.dumps(rec, sort_keys=True, default=str) + "\n")
 
 
-def _register_atexit() -> None:
-    global _atexit_registered
-    if _atexit_registered:
+def register_shutdown() -> None:
+    """Register :func:`shutdown` as the process's ONE exit hook
+    (idempotent).  Called by the first sink emit and by the fleet
+    endpoint arm — whichever exit-sensitive subsystem wakes first."""
+    global _shutdown_registered
+    if _shutdown_registered:
         return
-    _atexit_registered = True
-    atexit.register(_emit_final_snapshot)
+    _shutdown_registered = True
+    atexit.register(shutdown)
+
+
+def shutdown() -> None:
+    """The ordered interpreter-exit sequence (the atexit contract):
+
+    1. drain the flight recorder + append the final metrics snapshot to
+       the JSONL sink (one batch, so the tail and the snapshot land
+       together and post-mortem tooling sees a complete stream);
+    2. stop the fleet metrics endpoint LAST — a scraper can read the
+       final state up to the moment the process stops answering.
+
+    Each step is isolated: a failed sink write must not strand the
+    server, and a failed teardown must not mask the exit."""
+    try:
+        _emit_final_snapshot()
+    finally:
+        from oap_mllib_tpu.telemetry import fleet as _fleet
+
+        _fleet.stop_server()
 
 
 def _emit_final_snapshot() -> None:
     path = sink_path()
     if path is None:
         return
-    try:
-        _write_lines(path, [{
-            "type": "metrics",
+    from oap_mllib_tpu.telemetry import flightrec
+
+    records: List[Dict[str, Any]] = []
+    events = flightrec.drain_new()
+    if events:
+        records.append({
+            "type": "flightrec",
             "final": True,
             "rank": _rank(),
             "seq": next(_seq),
-            "metrics": _metrics.snapshot(),
-        }])
+            "events": events,
+        })
+    records.append({
+        "type": "metrics",
+        "final": True,
+        "rank": _rank(),
+        "seq": next(_seq),
+        "metrics": _metrics.snapshot(),
+    })
+    try:
+        _write_lines(path, records)
     except OSError:
         pass  # a torn-down filesystem at exit must not mask the real exit
 
@@ -95,7 +147,7 @@ def emit_fit(root: Span) -> None:
     path = sink_path()
     if path is None:
         return
-    _register_atexit()
+    register_shutdown()
     rank = _rank()
     records: List[Dict[str, Any]] = []
     for span_path, sp in root.walk():

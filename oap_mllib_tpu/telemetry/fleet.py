@@ -48,6 +48,7 @@ import numpy as np
 
 from oap_mllib_tpu.config import get_config
 from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.utils import locktrace
 
 log = logging.getLogger("oap_mllib_tpu")
 
@@ -146,7 +147,10 @@ def local_frame(stats, pass_wall_s: float) -> np.ndarray:
 
 # -- per-fit rollup state ------------------------------------------------------
 
-_state_lock = threading.Lock()
+# tracked (utils/locktrace.py): the /healthz handler thread reads under
+# this lock while fit passes write — exactly the cross-thread seam the
+# "locks" sanitizer watches; disarmed it is a plain lock + one check
+_state_lock = locktrace.TrackedLock("fleet.state", threading.Lock())
 _window: List[Dict[str, Any]] = []  # per-pass {phase, frames(list), skew}
 _passes = 0
 _rank_wall_totals: Optional[np.ndarray] = None  # per-rank summed pass walls
@@ -347,7 +351,7 @@ def _reset_fit_window() -> None:
 
 # -- live exposition (stdlib http.server, one daemon thread per rank) ---------
 
-_server_lock = threading.Lock()
+_server_lock = locktrace.TrackedLock("fleet.server", threading.Lock())
 _server: Optional[http.server.ThreadingHTTPServer] = None
 _server_port: Optional[int] = None
 _failed_ports: set = set()
@@ -402,36 +406,58 @@ def maybe_serve(cfg=None) -> Optional[int]:
     ``Config.metrics_port`` > 0; returns the bound port or None.  The
     rank offsets the port (``metrics_port + process_id``) so co-hosted
     pseudo-cluster ranks each get their own scrape surface.  A bind
-    failure warns once per port and never fails the fit."""
+    failure warns once per port and never fails the fit.
+
+    Locking discipline (oaplint R21): the lock covers only the registry
+    swap — a stale server is DETACHED under the lock and its blocking
+    ``shutdown()`` runs after release, so a scraping handler thread can
+    never stall fit threads queued on the lock."""
     global _server, _server_port
     cfg = cfg or get_config()
     base = metrics_port_cfg(cfg)
     if base == 0:
         return None
     port = base + int(cfg.process_id)
+    stale = None
     with _server_lock:
         if _server is not None and _server_port == port:
             return port
         if port in _failed_ports:
             return None
         if _server is not None:
-            _shutdown_locked()
-        try:
-            srv = http.server.ThreadingHTTPServer(("", port), _Handler)
-        except OSError as e:
+            stale, _server, _server_port = _server, None, None
+    _stop_http(stale)
+    try:
+        srv = http.server.ThreadingHTTPServer(("", port), _Handler)
+    except OSError as e:
+        with _server_lock:
             _failed_ports.add(port)
-            log.warning(
-                "fleet: metrics endpoint bind failed on port %d (%s); "
-                "live exposition disabled for this port", port, e,
-            )
-            return None
-        srv.daemon_threads = True
-        thread = threading.Thread(
-            target=srv.serve_forever, daemon=True,
-            name=f"oap-metrics-{port}",
+        log.warning(
+            "fleet: metrics endpoint bind failed on port %d (%s); "
+            "live exposition disabled for this port", port, e,
         )
-        thread.start()
-        _server, _server_port = srv, port
+        return None
+    srv.daemon_threads = True
+    thread = threading.Thread(
+        target=srv.serve_forever, daemon=True,
+        name=f"oap-metrics-{port}",
+    )
+    loser = None
+    with _server_lock:
+        if _server is not None:
+            loser = srv  # a racing arm won the registry; yield to it
+        else:
+            _server, _server_port = srv, port
+            thread.start()
+    if loser is not None:
+        loser.server_close()
+        return server_port()
+    # interpreter-exit teardown rides the ONE ordered shutdown hook
+    # (telemetry/export.shutdown — the atexit-outside-shutdown
+    # contract): final JSONL snapshot first, then this server stops
+    from oap_mllib_tpu.telemetry import export as _export
+
+    _export.register_shutdown()
     log.info("fleet: serving /metrics and /healthz on port %d", port)
     return port
 
@@ -441,23 +467,28 @@ def server_port() -> Optional[int]:
         return _server_port
 
 
-def _shutdown_locked() -> None:
-    global _server, _server_port
-    if _server is not None:
-        try:
-            _server.shutdown()
-            _server.server_close()
-        except Exception:  # noqa: BLE001 — teardown best-effort
-            pass
-    _server, _server_port = None, None
+def _stop_http(srv) -> None:
+    """Blocking teardown of a DETACHED server — call with no lock held
+    (``shutdown()`` waits for the serve loop to notice, which is
+    exactly the R21 blocking-while-locked shape when under a lock)."""
+    if srv is None:
+        return
+    try:
+        srv.shutdown()
+        srv.server_close()
+    except Exception:  # noqa: BLE001 — teardown best-effort
+        pass
 
 
 def stop_server() -> None:
-    """Tear down the live endpoint (tests; atexit is unnecessary — the
-    serving thread is a daemon)."""
+    """Tear down the live endpoint: detach under the lock, stop the
+    detached server after release (tests and the ordered exit hook —
+    telemetry/export.shutdown calls this last)."""
+    global _server, _server_port
     with _server_lock:
-        _shutdown_locked()
-    _failed_ports.clear()
+        srv, _server, _server_port = _server, None, None
+        _failed_ports.clear()
+    _stop_http(srv)
 
 
 def _reset_for_tests() -> None:

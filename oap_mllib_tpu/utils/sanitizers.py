@@ -38,6 +38,17 @@ sanitizers-off path is one cached string check per seam):
   compile gate uses) from the second consumed chunk on, and
   :func:`steady_state` offers the same assertion as a scope for
   fit/score loops (dev/sanitizer_gate.py drives it).
+- ``locks`` — the host thread plane's analog of ``collective``: the
+  registered :class:`~oap_mllib_tpu.utils.locktrace.TrackedLock` seams
+  (serving registry, fleet state/server, telemetry sink, this module's
+  sequence lock) record per-thread acquisition stacks and fold a
+  process-wide acquisition-order graph; a live lock-order inversion
+  raises :class:`LockOrderError` naming BOTH witness stacks before it
+  can deadlock, every release feeds the ``oap_lock_hold_seconds``
+  factor-4 histogram, and a hold exceeding the collective deadline is
+  flagged (never killed).  The runtime half of the static concurrency
+  pass (dev/oaplint/concurrency.py R19-R22), exactly as this module is
+  the runtime half of R16-R18.
 
 The cross-check protocol piggybacks on ``process_allgather`` with a
 FIXED-shape signature frame, so the check itself can never diverge in
@@ -58,8 +69,9 @@ from typing import Dict, FrozenSet, List, Optional, Tuple
 
 from oap_mllib_tpu.config import get_config
 from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.utils import locktrace
 
-VALID = ("collective", "transfer", "retrace")
+VALID = ("collective", "transfer", "retrace", "locks")
 
 # fixed signature frame for the cross-check gather: every rank always
 # contributes exactly this many bytes, whatever its op — the check
@@ -79,9 +91,21 @@ class RetraceError(SanitizerError):
     """A steady-state loop compiled a new XLA program after warmup."""
 
 
+class LockOrderError(SanitizerError):
+    """Two threads acquired the same two tracked locks in opposite
+    orders — a deadlock caught at acquisition time, naming both witness
+    stacks (raised by utils/locktrace before blocking)."""
+
+
 # -- Config.sanitizers parsing ------------------------------------------------
 
 _parse_cache: Dict[str, FrozenSet[str]] = {}
+# guards _parse_cache mutation: enabled_set is reachable from prefetch
+# producer threads (tracked-lock seams book metrics there), and a bare
+# dict write from two threads is exactly what oaplint R20 flags.  A
+# dedicated plain lock — NOT the tracked sequence lock — because the
+# locks sanitizer's own arming check routes through here (recursion).
+_parse_lock = threading.Lock()
 
 
 def enabled_set(cfg=None) -> FrozenSet[str]:
@@ -100,7 +124,8 @@ def enabled_set(cfg=None) -> FrozenSet[str]:
             f"Config.sanitizers names unknown sanitizer(s) {unknown}; "
             f"valid names: {VALID} (comma-separated)"
         )
-    _parse_cache[raw] = names
+    with _parse_lock:
+        _parse_cache[raw] = names
     return names
 
 
@@ -115,7 +140,9 @@ def enabled(name: str) -> bool:
 
 # -- collective fingerprinting + cross-check ----------------------------------
 
-_lock = threading.Lock()
+# the sequence lock rides the locks sanitizer's own seam (a tracked
+# lock is a plain lock + one cached config check while disarmed)
+_lock = locktrace.TrackedLock("sanitizers.seq", threading.Lock())
 _SEQ: List[str] = []  # host-level dispatch signatures, process-lifetime
 _finalized_idx = 0  # start of the current fit's window into _SEQ
 
@@ -268,6 +295,8 @@ def finalize_fit_sanitizers(summary) -> None:
         payload["collective"] = {
             "ops": count, "fingerprint": digest, "world_checked": checked,
         }
+    if "locks" in armed:
+        payload["locks"] = locktrace.summary_block()
     if summary is not None:
         if isinstance(summary, dict):
             summary["sanitizers"] = payload
@@ -381,7 +410,12 @@ def steady_state(label: str):
 def _reset_for_tests() -> None:
     """Drop the recorded sequence + fit window (test isolation only)."""
     global _finalized_idx
-    with _lock:
+    # the INNER lock, deliberately: reset must work under any config,
+    # including a typo'd sanitizer set whose validation would raise at
+    # the tracked seam (the raise belongs to real seams, not teardown)
+    with _lock._inner:
         _SEQ.clear()
-    _finalized_idx = 0
-    _parse_cache.clear()
+        _finalized_idx = 0
+    with _parse_lock:
+        _parse_cache.clear()
+    locktrace._reset_for_tests()

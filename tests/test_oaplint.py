@@ -93,6 +93,42 @@ SEEDED = {
         "    y = x.astype(jnp.bfloat16)\n"
         "    return jnp.sum(y)\n",
     ),
+    # ISSUE 14 concurrency rules (dev/oaplint/concurrency.py): one
+    # seeded violating module per rule, analyzed against the live
+    # package's thread/lock model
+    "lock-order-inversion": (
+        OPS,
+        "import threading\n\n"
+        "_A = threading.Lock()\n_B = threading.Lock()\n\n\n"
+        "def f():\n    with _A:\n        with _B:\n            pass\n\n\n"
+        "def g():\n    with _B:\n        with _A:\n            pass\n",
+    ),
+    "unguarded-shared-write": (
+        OPS,
+        "import threading\n\n_STATE = {}\n\n\n"
+        "def _worker():\n    _STATE['n'] = 1\n\n\n"
+        "def start():\n"
+        "    t = threading.Thread(target=_worker, daemon=True)\n"
+        "    t.start()\n"
+        "    _STATE['n'] = 2\n",
+    ),
+    "blocking-while-locked": (
+        OPS,
+        "import threading\nimport time\n\n_lock = threading.Lock()\n\n\n"
+        "def f():\n    with _lock:\n        time.sleep(0.1)\n",
+    ),
+    "unjoined-thread": (
+        OPS,
+        "import threading\n\n\n"
+        "def f(work):\n"
+        "    t = threading.Thread(target=work)\n"
+        "    t.start()\n",
+    ),
+    "atexit-outside-shutdown": (
+        OPS,
+        "import atexit\n\n\n"
+        "def f():\n    atexit.register(f)\n",
+    ),
 }
 
 
@@ -568,6 +604,194 @@ def test_r18_pallas_kernels_are_exempt():
     )
     assert lint("oap_mllib_tpu/ops/pallas/fake.py", text,
                 rules=["precision-flow"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R19-R22: the concurrency pass (dev/oaplint/concurrency.py, ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def test_r19_interprocedural_inversion_prints_both_chains():
+    """An inversion where one leg acquires through a HELPER is still a
+    cycle, and the finding names both acquisition chains."""
+    text = (
+        "import threading\n\n"
+        "_A = threading.Lock()\n_B = threading.Lock()\n\n\n"
+        "def helper():\n    with _B:\n        pass\n\n\n"
+        "def f():\n    with _A:\n        helper()\n\n\n"
+        "def g():\n    with _B:\n        with _A:\n            pass\n"
+    )
+    found = lint(OPS, text, rules=["lock-order-inversion"])
+    assert rules_of(found) == ["lock-order-inversion"]
+    assert any("helper" in f.detail and "_A" in f.detail
+               and "_B" in f.detail for f in found)
+
+
+def test_r19_consistent_global_order_is_clean():
+    text = (
+        "import threading\n\n"
+        "_A = threading.Lock()\n_B = threading.Lock()\n\n\n"
+        "def f():\n    with _A:\n        with _B:\n            pass\n\n\n"
+        "def g():\n    with _A:\n        with _B:\n            pass\n"
+    )
+    assert lint(OPS, text, rules=["lock-order-inversion"]) == []
+
+
+def test_r19_reentrant_same_lock_is_not_a_cycle():
+    text = (
+        "import threading\n\n_R = threading.RLock()\n\n\n"
+        "def f():\n    with _R:\n        with _R:\n            pass\n"
+    )
+    assert lint(OPS, text, rules=["lock-order-inversion"]) == []
+
+
+def test_r20_lock_guarded_writes_are_clean():
+    text = (
+        "import threading\n\n_STATE = {}\n_lock = threading.Lock()\n\n\n"
+        "def _worker():\n    with _lock:\n        _STATE['n'] = 1\n\n\n"
+        "def start():\n"
+        "    t = threading.Thread(target=_worker, daemon=True)\n"
+        "    t.start()\n"
+        "    with _lock:\n        _STATE['n'] = 2\n"
+    )
+    assert lint(OPS, text, rules=["unguarded-shared-write"]) == []
+
+
+def test_r20_helper_called_under_lock_inherits_the_guard():
+    """The _shutdown_locked convention: a helper only ever called with
+    the lock held writes under that lock for R20's purposes (the
+    always-held intersection over call sites)."""
+    text = (
+        "import threading\n\n_STATE = {}\n_lock = threading.Lock()\n\n\n"
+        "def _locked_write():\n    _STATE['n'] = 1\n\n\n"
+        "def _worker():\n    with _lock:\n        _locked_write()\n\n\n"
+        "def start():\n"
+        "    t = threading.Thread(target=_worker, daemon=True)\n"
+        "    t.start()\n"
+        "    with _lock:\n        _locked_write()\n"
+    )
+    assert lint(OPS, text, rules=["unguarded-shared-write"]) == []
+
+
+def test_r20_main_only_global_is_out_of_scope():
+    """A global never touched by any spawned-thread closure is not
+    shared state — single-threaded mutation needs no lock."""
+    text = (
+        "_CACHE = {}\n\n\n"
+        "def remember(k, v):\n    _CACHE[k] = v\n"
+    )
+    assert lint(OPS, text, rules=["unguarded-shared-write"]) == []
+
+
+def test_r20_finding_names_roots_and_write_sites():
+    rel, text = SEEDED["unguarded-shared-write"]
+    (f,) = lint(rel, text, rules=["unguarded-shared-write"])
+    assert "_STATE" in f.detail and "_worker" in f.detail
+    assert "thread target" in f.detail and "holding no lock" in f.detail
+
+
+def test_r21_interprocedural_block_chain():
+    """Blocking reached through a call chain under a lock is flagged at
+    the call site, printing the chain to the blocking op."""
+    text = (
+        "import threading\nimport time\n\n_lock = threading.Lock()\n\n\n"
+        "def slow():\n    time.sleep(0.1)\n\n\n"
+        "def f():\n    with _lock:\n        slow()\n"
+    )
+    found = lint(OPS, text, rules=["blocking-while-locked"])
+    assert rules_of(found) == ["blocking-while-locked"]
+    assert any("slow" in f.detail and "time.sleep" in f.detail
+               for f in found)
+
+
+def test_r21_blocking_outside_the_critical_section_is_clean():
+    text = (
+        "import threading\nimport time\n\n_lock = threading.Lock()\n\n\n"
+        "def f():\n    with _lock:\n        x = 1\n    time.sleep(0.1)\n"
+    )
+    assert lint(OPS, text, rules=["blocking-while-locked"]) == []
+
+
+def test_r21_str_join_is_not_a_thread_join():
+    text = (
+        "import threading\n\n_lock = threading.Lock()\n\n\n"
+        "def f(parts):\n    with _lock:\n"
+        "        return ', '.join(parts)\n"
+    )
+    assert lint(OPS, text, rules=["blocking-while-locked"]) == []
+
+
+def test_r21_collective_under_lock_is_the_starvation_shape():
+    text = (
+        "import threading\n\n"
+        "from oap_mllib_tpu.parallel import collective\n\n"
+        "_lock = threading.Lock()\n\n\n"
+        "def f(x, mesh):\n"
+        "    with _lock:\n"
+        "        return collective.allreduce_sum(x, mesh)\n"
+    )
+    found = lint(OPS, text, rules=["blocking-while-locked"])
+    assert rules_of(found) == ["blocking-while-locked"]
+
+
+def test_r22_daemon_and_joined_threads_are_clean():
+    daemon = (
+        "import threading\n\n\n"
+        "def f(work):\n"
+        "    t = threading.Thread(target=work, daemon=True)\n"
+        "    t.start()\n"
+    )
+    joined = (
+        "import threading\n\n\n"
+        "def f(work):\n"
+        "    t = threading.Thread(target=work)\n"
+        "    t.start()\n"
+        "    t.join()\n"
+    )
+    later_daemon = (
+        "import threading\n\n\n"
+        "def f(work):\n"
+        "    t = threading.Thread(target=work)\n"
+        "    t.daemon = True\n"
+        "    t.start()\n"
+    )
+    for text in (daemon, joined, later_daemon):
+        assert lint(OPS, text, rules=["unjoined-thread"]) == []
+
+
+def test_r22_self_attribute_handle_joined_elsewhere_is_clean():
+    """The prefetch shape: the handle lands on self in __init__ and a
+    different method joins it."""
+    text = (
+        "import threading\n\n\n"
+        "class P:\n"
+        "    def __init__(self, work):\n"
+        "        self._thread = threading.Thread(target=work)\n"
+        "        self._thread.start()\n\n"
+        "    def close(self):\n"
+        "        self._thread.join(timeout=5.0)\n"
+    )
+    assert lint(OPS, text, rules=["unjoined-thread"]) == []
+
+
+def test_atexit_register_allowed_only_in_export():
+    text = "import atexit\n\n\ndef g():\n    pass\n\n\natexit.register(g)\n"
+    assert lint("oap_mllib_tpu/telemetry/export.py", text,
+                rules=["atexit-outside-shutdown"]) == []
+    found = lint("oap_mllib_tpu/telemetry/fleet.py", text,
+                 rules=["atexit-outside-shutdown"])
+    assert rules_of(found) == ["atexit-outside-shutdown"]
+
+
+def test_concurrency_suppression_applies():
+    text = (
+        "import threading\nimport time\n\n_lock = threading.Lock()\n\n\n"
+        "def f():\n"
+        "    with _lock:\n"
+        "        # oaplint: disable=blocking-while-locked -- audited\n"
+        "        time.sleep(0.1)\n"
+    )
+    assert lint(OPS, text, rules=["blocking-while-locked"]) == []
 
 
 # ---------------------------------------------------------------------------

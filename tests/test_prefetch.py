@@ -295,6 +295,60 @@ class TestErrorsAndShutdown:
         assert stats.leaked_threads == 1
         assert time.perf_counter() - t0 < 20.0  # close() did not hang
 
+    def test_wedged_producer_is_poisoned_after_close(self, monkeypatch):
+        """ISSUE 14 satellite: close() on a wedged producer must not
+        just count the leak — it marks the source exhausted and swaps
+        the staging queue for a poison queue, so when the wedged thread
+        finally wakes it (a) cannot put its staged chunk anywhere a
+        consumer could see and (b) ends at its next source pull instead
+        of staging into a retired pipeline forever."""
+        from oap_mllib_tpu.data import prefetch as pf_mod
+
+        monkeypatch.setattr(pf_mod, "JOIN_TIMEOUT_S", 0.2)
+        release = threading.Event()
+        pulled = []
+
+        def source():
+            for i in range(8):
+                pulled.append(i)
+                yield i
+
+        def wedge(item):
+            if item == 1:
+                release.wait(timeout=30.0)  # deliberately blocked stage
+            return item
+
+        stats = PrefetchStats()
+        pf = Prefetcher(source(), stage=wedge, depth=2, stats=stats)
+        it = iter(pf)
+        assert next(it) == 0  # item 1 is now wedged inside the producer
+        impl = pf._impl
+        real_q = impl._q
+        pf.close()
+        assert stats.leaked_threads == 1
+        # the pipeline is quarantined: poison queue in place, source off
+        assert isinstance(impl._q, pf_mod._PoisonQueue)
+        assert impl._items._closed
+        producer = impl._thread
+        release.set()  # the wedged stage finally returns...
+        producer.join(timeout=5.0)
+        # ...and the thread EXITS: its put was discarded by the poison
+        # queue and its next source pull hit the closed source
+        assert not producer.is_alive()
+        assert real_q.empty(), "a late stage wrote into the retired queue"
+        assert len(pulled) <= 3, "a wedged producer kept draining the source"
+
+    def test_poison_queue_retires_late_jax_arrays(self):
+        """A late put's device buffers are retired on arrival (the
+        'cannot write into a retired buffer' half of the contract)."""
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.data import prefetch as pf_mod
+
+        arr = jnp.ones((4, 4))
+        pf_mod._PoisonQueue(True).put((arr, 1))
+        assert arr.is_deleted()
+
     def test_streamed_fit_leaks_no_threads(self, rng):
         """The estimator surface: a streamed fit's summary reports zero
         leaked prefetch threads (counter wired end to end)."""

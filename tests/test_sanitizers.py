@@ -301,6 +301,156 @@ class TestPayloadBytes:
         assert _payload_bytes(x) == x.nbytes
 
 
+class TestLocksSanitizer:
+    """The runtime half of the oaplint concurrency pass (ISSUE 14):
+    tracked-lock order witnessing, hold-time accounting, and the
+    off-path contract (utils/locktrace.py)."""
+
+    def test_off_is_a_plain_lock_recording_nothing(self):
+        from oap_mllib_tpu.utils import locktrace
+
+        a = locktrace.TrackedLock("t.off.a")
+        b = locktrace.TrackedLock("t.off.b")
+        with a:
+            with b:
+                pass
+        with b:  # the inversion that would raise when armed
+            with a:
+                pass
+        assert locktrace.order_edges() == {}
+
+    def test_live_inversion_raises_naming_both_stacks(self):
+        from oap_mllib_tpu.utils import locktrace
+
+        set_config(sanitizers="locks")
+        a = locktrace.TrackedLock("t.inv.a")
+        b = locktrace.TrackedLock("t.inv.b")
+
+        def first_order():
+            with a:
+                with b:
+                    pass
+
+        first_order()
+        assert ("t.inv.a", "t.inv.b") in locktrace.order_edges()
+        with pytest.raises(san.LockOrderError) as ei:
+            with b:
+                with a:
+                    pass
+        msg = str(ei.value)
+        assert "t.inv.a" in msg and "t.inv.b" in msg
+        # both witness stacks ride the diagnostic: the recorded
+        # first-ordering frames (inside first_order) and this one's
+        assert "first_order" in msg
+        assert "This acquisition" in msg and "Recorded witness" in msg
+
+    def test_two_thread_inversion_raises_in_the_second_thread(self):
+        import threading
+
+        from oap_mllib_tpu.utils import locktrace
+
+        set_config(sanitizers="locks")
+        a = locktrace.TrackedLock("t.thr.a")
+        b = locktrace.TrackedLock("t.thr.b")
+        box = {}
+
+        def leg1():
+            with a:
+                with b:
+                    pass
+
+        def leg2():
+            try:
+                with b:
+                    with a:
+                        pass
+            except san.LockOrderError as e:
+                box["err"] = e
+
+        t1 = threading.Thread(target=leg1)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=leg2)
+        t2.start()
+        t2.join()
+        assert isinstance(box.get("err"), san.LockOrderError)
+
+    def test_reentrant_rlock_neither_edges_nor_restarts_clock(self):
+        import threading
+
+        from oap_mllib_tpu.utils import locktrace
+
+        set_config(sanitizers="locks")
+        r = locktrace.TrackedLock("t.re.r", threading.RLock())
+        with r:
+            with r:
+                pass
+        assert locktrace.order_edges() == {}
+
+    def test_hold_time_histogram_populated(self):
+        import time
+
+        from oap_mllib_tpu.telemetry import metrics as _tm
+        from oap_mllib_tpu.utils import locktrace
+
+        set_config(sanitizers="locks")
+        lk = locktrace.TrackedLock("t.hold")
+        base = _tm.family_total("oap_lock_hold_seconds")
+        with lk:
+            time.sleep(0.001)
+        assert _tm.family_total("oap_lock_hold_seconds") > base
+        assert locktrace.hold_quantile(0.99) > 0.0
+
+    def test_hold_past_collective_deadline_flags_never_kills(self):
+        import time
+
+        from oap_mllib_tpu.telemetry import metrics as _tm
+        from oap_mllib_tpu.utils import locktrace
+
+        set_config(sanitizers="locks", collective_timeout=0.001)
+        lk = locktrace.TrackedLock("t.flag")
+        before = _tm.family_total("oap_lock_hold_flags_total")
+        with lk:  # exceeds the deadline; must flag, not raise
+            time.sleep(0.01)
+        assert _tm.family_total("oap_lock_hold_flags_total") == before + 1
+
+    def test_live_seams_are_tracked(self):
+        """The registered seams of ISSUE 14 exist by name: serving
+        registry, fleet state/server, telemetry sink, sanitizer seq."""
+        import oap_mllib_tpu.serving.registry  # noqa: F401 — registers
+        import oap_mllib_tpu.telemetry.export  # noqa: F401
+        import oap_mllib_tpu.telemetry.fleet  # noqa: F401
+        from oap_mllib_tpu.utils import locktrace
+
+        names = set(locktrace.tracked_names())
+        assert {"serving.registry", "fleet.state", "fleet.server",
+                "telemetry.sink", "sanitizers.seq"} <= names
+
+    def test_serving_request_path_runs_clean_armed(self, rng):
+        """A served-model storm under the locks sanitizer: the live
+        seams must be inversion-free (the runtime proof next to the
+        analyzer's clean R19 pass)."""
+        from oap_mllib_tpu import serving
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = rng.normal(size=(256, 8)).astype(np.float32)
+        model = KMeans(k=3, seed=1, init_mode="random", max_iter=2).fit(x)
+        set_config(sanitizers="locks")
+        handle = serving.serve(model)
+        for rows in (3, 17, 64):
+            handle.predict(x[:rows])
+        serving.registry.clear()
+
+    def test_locks_payload_lands_in_summary(self):
+        set_config(sanitizers="locks")
+        summary = {}
+        san.finalize_fit_sanitizers(summary)
+        payload = summary["sanitizers"]
+        assert payload["enabled"] == ["locks"]
+        assert set(payload["locks"]) == {"tracked", "order_edges",
+                                         "hold_p99_s"}
+
+
 class TestOverheadAndSummary:
     def test_sanitizers_off_is_summary_free(self, rng):
         from oap_mllib_tpu.models.kmeans import KMeans

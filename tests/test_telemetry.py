@@ -503,6 +503,71 @@ class TestExporters:
         assert "oap_reexport_check_total 1" in telemetry.render_prometheus()
 
 
+class TestOrderedShutdown:
+    """ISSUE 14 satellite: interpreter-exit work is ONE ordered hook —
+    flight-recorder drain + final snapshot into the sink first, fleet
+    endpoint teardown last — instead of independent atexit racers (the
+    oaplint atexit-outside-shutdown rule keeps it unique)."""
+
+    def test_shutdown_sequences_sink_before_server(self, tmp_path,
+                                                   monkeypatch):
+        from oap_mllib_tpu.telemetry import export, fleet
+
+        order = []
+        real_write = export._write_lines
+        monkeypatch.setattr(
+            export, "_write_lines",
+            lambda path, recs: (order.append("sink"),
+                                real_write(path, recs)),
+        )
+        monkeypatch.setattr(
+            fleet, "stop_server", lambda: order.append("server"))
+        set_config(telemetry_log=str(tmp_path / "s.jsonl"),
+                   flight_recorder=32)
+        from oap_mllib_tpu.telemetry import flightrec
+
+        flightrec._reset_for_tests()  # a prior test's drain cursor
+        flightrec.record("chunk", "probe", "#0")
+        export.shutdown()
+        assert order == ["sink", "server"]
+        records = [json.loads(ln) for ln in
+                   (tmp_path / "s.jsonl").read_text().splitlines()]
+        kinds = [r["type"] for r in records]
+        # the recorder tail and the final snapshot land in ONE batch,
+        # drain first so post-mortem tooling sees a complete stream
+        assert kinds == ["flightrec", "metrics"]
+        assert all(r.get("final") for r in records)
+        flightrec._reset_for_tests()
+
+    def test_sink_failure_still_stops_the_server(self, tmp_path,
+                                                 monkeypatch):
+        from oap_mllib_tpu.telemetry import export, fleet
+
+        stopped = []
+        monkeypatch.setattr(
+            fleet, "stop_server", lambda: stopped.append(True))
+        monkeypatch.setattr(
+            export, "_emit_final_snapshot",
+            lambda: (_ for _ in ()).throw(RuntimeError("torn fs")),
+        )
+        with pytest.raises(RuntimeError):
+            export.shutdown()
+        assert stopped == [True]
+
+    def test_register_shutdown_is_idempotent(self, monkeypatch):
+        import atexit
+
+        from oap_mllib_tpu.telemetry import export
+
+        registered = []
+        monkeypatch.setattr(
+            atexit, "register", lambda fn: registered.append(fn))
+        monkeypatch.setattr(export, "_shutdown_registered", False)
+        export.register_shutdown()
+        export.register_shutdown()
+        assert registered == [export.shutdown]
+
+
 class TestTelemetryOff:
     def test_no_sink_no_file(self, rng, tmp_path, monkeypatch):
         """With telemetry_log empty nothing is written anywhere and the
