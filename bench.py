@@ -961,6 +961,109 @@ def bench_streamed(rows: int, d: int = 256, k: int = 1000,
 
 
 # ---------------------------------------------------------------------------
+# Heterogeneous-fleet skew sweep (bench.py --skew, ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def bench_skew(rows: int = 1 << 18, d: int = 64, k: int = 64,
+               slow_factor: float = 4.0, emit: bool = True) -> dict:
+    """Equal vs capability-weighted layout on a synthetically slowed
+    rank (parallel/balance.py): a 2-rank world is SIMULATED in one
+    process — each rank's Lloyd assignment pass walks its planned
+    extent through the real per-chunk program, rank 1 paying a
+    per-chunk sleep calibrated to ``slow_factor`` x the measured chunk
+    time (a throttled host / CPU rank stand-in); the world's pass wall
+    is the slowest rank's (the pass barrier).  Emits the
+    ``hetero_speedup`` headline (equal wall / weighted wall — > 1 means
+    the capability plan pays) plus both walls and the cross-layout
+    parity, every line backend-tagged for dev/bench_regress.py's
+    per-(metric, backend) gating."""
+    from oap_mllib_tpu.data.stream import ChunkSource
+    from oap_mllib_tpu.ops import stream_ops
+    from oap_mllib_tpu.parallel import balance
+
+    chunk = 1 << 13
+    world = 2
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(rows, d)).astype(np.float32)
+    centers = np.ascontiguousarray(x[:k], np.float32)
+
+    def _src(lo, n_loc, sleep_s):
+        def gen():
+            for s in range(lo, lo + n_loc, chunk):
+                if sleep_s > 0:
+                    time.sleep(sleep_s)
+                yield x[s: s + min(chunk, lo + n_loc - s)]
+
+        return ChunkSource(gen, d, chunk, n_rows=n_loc)
+
+    def _pass(lo, n_loc, sleep_s):
+        t0 = time.perf_counter()
+        sums, counts, _ = stream_ops.streamed_accumulate(
+            _src(lo, n_loc, sleep_s), centers, np.float32,
+            "highest", need_cost=False,
+        )
+        return time.perf_counter() - t0, sums, counts
+
+    # calibrate: one warm pass over an equal shard measures the real
+    # per-chunk time; the slow rank then sleeps (slow_factor - 1) x that
+    # per chunk — its effective throughput is 1/slow_factor
+    half = (rows // 2 // chunk) * chunk
+    _pass(0, half, 0.0)  # warm (compile)
+    base_wall, _, _ = _pass(0, half, 0.0)
+    per_chunk = base_wall / max(1, half // chunk)
+    sleep_s = per_chunk * (slow_factor - 1.0)
+
+    weights = {
+        "equal": [1.0, 1.0],
+        "weighted": [1.0, 1.0 / slow_factor],
+    }
+    walls = {}
+    centers_out = {}
+    for layout, w in weights.items():
+        extents, _ = balance.plan_extents(rows, chunk, w)
+        rank_walls = []
+        agg_s = np.zeros((k, d), np.float32)
+        agg_c = np.zeros((k,), np.float32)
+        for r, (lo, n_loc) in enumerate(extents):
+            if n_loc == 0:
+                rank_walls.append(0.0)
+                continue
+            wall, sums, counts = _pass(
+                lo, n_loc, sleep_s if r == 1 else 0.0
+            )
+            rank_walls.append(wall)
+            agg_s += np.asarray(sums)
+            agg_c += np.asarray(counts)
+        walls[layout] = max(rank_walls)
+        centers_out[layout] = agg_s / np.maximum(agg_c[:, None], 1e-30)
+    speedup = walls["equal"] / max(walls["weighted"], 1e-9)
+    parity = float(np.max(np.abs(
+        centers_out["equal"] - centers_out["weighted"]
+    )))
+    out = {
+        "hetero_speedup": round(speedup, 4),
+        "equal_wall_s": round(walls["equal"], 4),
+        "weighted_wall_s": round(walls["weighted"], 4),
+        "parity": parity,
+        "slow_factor": slow_factor,
+    }
+    if emit:
+        _emit(
+            "hetero_speedup", speedup, "x", 1.0,
+            equal_wall_s=out["equal_wall_s"],
+            weighted_wall_s=out["weighted_wall_s"],
+            parity=round(parity, 8), slow_factor=slow_factor,
+            rows=rows, d=d, world=world,
+        )
+        _emit("hetero_equal_wall", walls["equal"], "sec", 1.0,
+              slow_factor=slow_factor, rows=rows, d=d)
+        _emit("hetero_weighted_wall", walls["weighted"], "sec", 1.0,
+              slow_factor=slow_factor, rows=rows, d=d)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Compile-amortization size sweep (bench.py --compile-sweep)
 # ---------------------------------------------------------------------------
 
@@ -1270,6 +1373,15 @@ def main():
                     help="mixed-precision policy sweep: the three "
                          "estimators under f32/tf32/bf16, reporting "
                          "throughput + parity vs f32 per policy")
+    ap.add_argument("--skew", action="store_true",
+                    help="heterogeneous-fleet sweep: equal vs "
+                         "capability-weighted layout on a synthetically "
+                         "slowed rank (simulated 2-rank world), emitting "
+                         "the hetero_speedup headline + parity")
+    ap.add_argument("--skew-factor", type=float, default=4.0,
+                    metavar="X",
+                    help="how many times slower the synthetic straggler "
+                         "runs (default 4.0)")
     ap.add_argument("--serving", action="store_true",
                     help="serving plane: sustained QPS + p50/p99 tail "
                          "latency on a jittered request storm (zero "
@@ -1318,6 +1430,12 @@ def main():
 
     if args.compile_sweep:
         bench_compile_sweep()
+        return
+
+    if args.skew:
+        if args.skew_factor <= 1.0:
+            ap.error("--skew-factor must be > 1.0")
+        bench_skew(slow_factor=args.skew_factor)
         return
 
     if args.streamed:

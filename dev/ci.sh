@@ -136,6 +136,16 @@ echo "   against the Chrome trace-event schema, and the disarmed seam is"
 echo "   <1% of the 20-fit microbench (dev/fleet_gate.py) =="
 python dev/fleet_gate.py
 
+echo "== hetero gate: capability-weighted sharding — planner properties"
+echo "   (extents sum to n, chunk-quantized, membudget caps honored,"
+echo "   world-1 degenerates to equal), a simulated skewed 2-rank world"
+echo "   beats the equal-shard layout with moment parity <= 1e-5, rebalance"
+echo "   decisions deterministic under pinned capabilities, the real"
+echo "   2-process skew/rebalance legs via pytest (skip where worlds cannot"
+echo "   form), and the disarmed seam <1% of the 20-fit microbench"
+echo "   (dev/hetero_gate.py) =="
+python dev/hetero_gate.py
+
 echo "== serve gate: serving plane — zero steady-state XLA compiles under a"
 echo "   50-request jittered-size storm, served-vs-direct bit parity on all"
 echo "   three estimators, a 10M-user full-sweep top-k with bounded host"

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""CI gate: capability-weighted sharding (ISSUE 15) must hold its
+contracts.
+
+Legs:
+
+1. **Planner properties** — extents sum to n at every (weights, caps)
+   shape, are chunk-quantized, respect membudget caps (with the
+   infeasible-cap overflow loud, never silent data loss), and a
+   1-rank world degenerates to the equal plan; block offsets honor the
+   deadband (near-equal worlds keep the exact uniform layout) and
+   monotone non-empty boundaries.
+2. **Skewed world beats equal shards, parity intact** — a 2-rank world
+   SIMULATED in one process (each rank's assignment pass walks its
+   planned extent through the real per-chunk program, the straggler
+   paying a calibrated per-chunk sleep): the capability-weighted
+   layout's wall (max over ranks, the pass barrier) must beat the
+   equal layout's by a real margin, with the combined centroid moments
+   within 1e-5.  The REAL 2-process legs (wall + parity + the
+   summary.balance decision trail + live rebalancing) ride
+   ``tests/test_pseudo_cluster.py::TestHeteroFleet`` and skip only
+   where the host cannot form multiprocess worlds.
+3. **Rebalance determinism** — the straggler controller, fed the same
+   pinned-capability plan and the same fleet frame sequence twice,
+   must produce byte-identical decisions and extents (drills are
+   reproducible; a nondeterministic controller would diverge ranks).
+4. **End-to-end balanced fit** — a single-process balanced streamed
+   fit lands ``summary.balance`` (origin, weights, extents) + the
+   ``balance`` span and is bit-identical to the plain-source fit (a
+   1-rank plan is the identity extent).
+5. **Disarmed seam** — capability_sharding=off costs <1% of the
+   20-fit K-Means microbench (the PR 4/7/11 off-path contract).
+
+Exit 1 with the offending evidence on any violation.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import numpy as np  # noqa: E402
+
+failures = []
+
+
+def check(ok: bool, what: str) -> None:
+    if not ok:
+        failures.append(what)
+        print(f"FAIL: {what}")
+
+
+from oap_mllib_tpu.config import set_config  # noqa: E402
+from oap_mllib_tpu.models.kmeans import KMeans  # noqa: E402
+from oap_mllib_tpu.parallel import balance  # noqa: E402
+from oap_mllib_tpu.telemetry import fleet  # noqa: E402
+
+# -- leg 1: planner properties -------------------------------------------------
+
+print("== hetero gate: planner properties ==")
+rng = np.random.default_rng(0)
+for trial in range(200):
+    world = int(rng.integers(1, 9))
+    chunk = int(2 ** rng.integers(4, 10))
+    n = int(rng.integers(1, 40 * chunk))
+    w = rng.random(world) * 2 + 0.05
+    caps = None
+    if rng.random() < 0.4:
+        caps = [int(c) for c in rng.integers(0, 20 * chunk, world)]
+    extents, over = balance.plan_extents(n, chunk, w, caps_rows=caps)
+    total = sum(r for _, r in extents)
+    check(total == n, f"extents sum {total} != n {n} (trial {trial})")
+    pos = 0
+    for s, r in extents:
+        check(s == pos, f"extent start {s} != running offset {pos}")
+        pos += r
+    # every boundary except the global tail is chunk-quantized
+    for s, r in extents[:-1]:
+        if r:
+            check((s + r) % chunk == 0 or s + r == n,
+                  f"unquantized boundary {s + r} (chunk {chunk})")
+    if caps is not None and not over and world > 1:
+        # effective cap: a participating rank floors at one chunk, and
+        # the global sub-chunk tail may ride the last populated rank
+        for r_i, ((_, rows), cap) in enumerate(zip(extents, caps)):
+            if cap > 0:
+                eff = max(1, cap // chunk) * chunk
+                check(rows <= eff + chunk,
+                      f"cap violated: rank {r_i} rows {rows} cap {cap} "
+                      f"(chunk {chunk})")
+ext1, _ = balance.plan_extents(12345, 256, [1.0])
+check(ext1 == [(0, 12345)], f"world-1 plan not identity: {ext1}")
+eq, _ = balance.plan_extents(4096, 256, [1.0, 1.0])
+check(eq[0][1] == eq[1][1] == 2048, f"equal weights uneven: {eq}")
+
+off = balance.plan_block_offsets(1000, [1.0, 1.02])
+check(off is None, f"deadband did not keep uniform layout: {off}")
+off = balance.plan_block_offsets(1000, [1.0, 0.25])
+check(off is not None and list(off) == sorted(list(off))
+      and off[0] == 0 and off[-1] == 1000,
+      f"weighted offsets malformed: {off}")
+check(off is not None and all(np.diff(off) >= 1),
+      f"empty block in weighted offsets: {off}")
+print(f"  200 randomized plans OK; weighted block offsets {list(off)}")
+
+# -- leg 2: simulated skewed world beats equal shards --------------------------
+
+print("== hetero gate: skewed 2-rank simulation (equal vs weighted) ==")
+sys.path.insert(0, ROOT)
+import bench  # noqa: E402
+
+res = bench.bench_skew(rows=1 << 17, d=32, k=32, slow_factor=4.0,
+                       emit=False)
+check(res["hetero_speedup"] > 1.3,
+      f"weighted layout speedup {res['hetero_speedup']} <= 1.3 "
+      f"(equal {res['equal_wall_s']}s, weighted {res['weighted_wall_s']}s)")
+check(res["parity"] <= 1e-5,
+      f"cross-layout moment parity {res['parity']} > 1e-5")
+print(f"  speedup {res['hetero_speedup']}x, parity {res['parity']:.2e}")
+
+# -- leg 3: rebalance determinism under pinned capabilities --------------------
+
+print("== hetero gate: rebalance decision determinism ==")
+set_config(capability_sharding="on", rebalance_threshold=1.4,
+           rebalance_patience=2, rank_capability="")
+F = len(fleet.FRAME_FIELDS)
+frames = np.ones((2, F))
+frames[0, 0], frames[1, 0] = 1.0, 4.0
+
+
+def drive():
+    balance._reset_for_tests()
+    cw = balance.fold_world(
+        np.asarray([[1.0, 1, 0, 0], [1.0, 1, 0, 0]])
+    )
+    plan = balance.make_plan(30000, 512, world=2, capworld=cw)
+    frames[0, 7] = plan.extents()[0][1]
+    frames[1, 7] = plan.extents()[1][1]
+    decs = []
+    for _ in range(6):
+        d = balance.observe_pass("lloyd_loop", frames)
+        if d is not None:
+            decs.append(d)
+    return plan.extents(), decs
+
+
+ext_a, dec_a = drive()
+ext_b, dec_b = drive()
+check(ext_a == ext_b, f"extents diverged: {ext_a} vs {ext_b}")
+check(dec_a == dec_b, "re-plan decisions diverged across identical runs")
+check(len(dec_a) >= 1, "no re-plan fired on a 4x-skewed frame sequence")
+check(dec_a[0]["slowest_rank"] == 1, f"wrong straggler: {dec_a[0]}")
+check(ext_a[1][1] < ext_a[0][1],
+      f"straggler extent did not shrink: {ext_a}")
+balance._reset_for_tests()
+print(f"  {len(dec_a)} identical decisions; final extents {ext_a}")
+
+# -- leg 4: end-to-end balanced fit + summary.balance --------------------------
+
+print("== hetero gate: balanced single-process fit (identity extent, "
+      "summary.balance) ==")
+set_config(capability_sharding="on", fleet_stats="on",
+           rebalance_threshold=1.5, rebalance_patience=3)
+x = np.random.default_rng(3).normal(size=(4000, 12)).astype(np.float32)
+src = balance.local_sources(x, chunk_rows=500)
+m_bal = KMeans(k=4, seed=1, init_mode="random", max_iter=3, tol=0.0).fit(src)
+blk = getattr(m_bal.summary, "balance", None)
+check(blk is not None, "summary.balance missing on a balanced fit")
+if blk is not None:
+    check(blk["extents"] == [[0, 4000]],
+          f"1-rank extent not identity: {blk['extents']}")
+    check(blk["origin"] in ("probe", "pinned"),
+          f"unexpected origin {blk['origin']}")
+spans = m_bal.summary.telemetry["spans"]
+check("balance" in [c["name"] for c in spans["children"]],
+      "balance span missing")
+flt = getattr(m_bal.summary, "fleet", None)
+check(flt is not None and flt.get("per_rank_rows") is not None,
+      "fleet block missing per_rank_rows")
+check(flt is not None and flt.get("per_rank_capability") is not None,
+      "fleet block missing per_rank_capability")
+
+balance._reset_for_tests()
+set_config(capability_sharding="off", fleet_stats="auto")
+from oap_mllib_tpu.data.stream import ChunkSource  # noqa: E402
+
+plain = ChunkSource.from_array(x, chunk_rows=500)
+m_plain = KMeans(k=4, seed=1, init_mode="random", max_iter=3,
+                 tol=0.0).fit(plain)
+delta = float(np.max(np.abs(
+    m_bal.cluster_centers_ - m_plain.cluster_centers_
+)))
+check(delta == 0.0,
+      f"1-rank balanced fit not bit-identical to plain source: {delta}")
+print(f"  summary.balance OK, bit-identical to plain source")
+
+# -- leg 2b: REAL 2-process legs (skip where worlds cannot form) ---------------
+
+print("== hetero gate: real 2-process skew + rebalance legs (pytest; "
+      "skips where the host cannot form worlds) ==")
+proc = subprocess.run(
+    [sys.executable, "-m", "pytest",
+     "tests/test_pseudo_cluster.py::TestHeteroFleet", "-q",
+     "-p", "no:cacheprovider"],
+    cwd=ROOT, capture_output=True, text=True, timeout=600,
+)
+print("  " + (proc.stdout.strip().splitlines()[-1]
+              if proc.stdout.strip() else ""))
+check(proc.returncode == 0,
+      f"pseudo-cluster hetero legs failed:\n{proc.stdout[-2000:]}")
+
+# -- leg 5: disarmed seam ------------------------------------------------------
+
+print("== hetero gate: disarmed seam on the 20-fit microbench ==")
+balance._reset_for_tests()
+set_config(capability_sharding="off", fleet_stats="off")
+xs = np.random.default_rng(0).normal(size=(128, 8)).astype(np.float32)
+KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(xs)  # warm
+t0 = time.perf_counter()
+for _ in range(20):
+    KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(xs)
+fit_wall = time.perf_counter() - t0
+
+# the disarmed path per fit: armed() config checks at pass boundaries
+# plus the finalize None-check.  Price 100 seam touches per fit — an
+# overestimate — 2000 times, and scale to 20 fits.
+reps = 2000
+t0 = time.perf_counter()
+for _ in range(reps):
+    for _ in range(100):
+        balance.armed(1)
+    balance.finalize_fit(None, None)
+seam_wall = (time.perf_counter() - t0) * (20.0 / reps)
+pct = 100.0 * seam_wall / fit_wall
+print(f"  20-fit wall {fit_wall*1e3:.1f} ms; disarmed seam cost "
+      f"{seam_wall*1e3:.3f} ms (~{pct:.2f}%)")
+check(seam_wall < max(0.01 * fit_wall, 0.005),
+      f"disarmed balance seam measurable: {seam_wall:.4f}s vs "
+      f"{fit_wall:.4f}s fit wall")
+
+if failures:
+    print(f"\nhetero gate: {len(failures)} failure(s)")
+    sys.exit(1)
+print("\nhetero gate: OK")

@@ -411,6 +411,37 @@ class Config:
     # (slowest rank, skew ratio, imbalance trend) in the fit summary.
     # A typo raises.
     fleet_stats: str = "auto"
+    # -- heterogeneous fleets: capability-weighted sharding
+    #    (parallel/balance.py, utils/dispatch.throughput_probe) ---------------
+    # Capability-weighted shard planning: "auto" (default) arms the
+    # balance plane in multi-process worlds — per-rank capability
+    # weights (probed or pinned) convert into uneven per-rank row
+    # extents for streamed fits built through balance.local_source and
+    # uneven user-block offsets for replicated-layout block ALS, so a
+    # mixed or degraded world finishes passes together instead of at
+    # the slowest rank's pace; "on" arms it everywhere (a 1-rank world
+    # degenerates to the equal plan — tests, dashboards); "off" keeps
+    # equal shards (the planner still runs where consulted, with
+    # origin="equal").  A typo raises.
+    capability_sharding: str = "auto"
+    # Per-rank capability override.  "" (default) = measure: a tiny
+    # deterministic-seeded matmul + host->device stream microbench
+    # (utils/dispatch.throughput_probe), cached per process.  A bare
+    # float ("0.25") pins THIS rank's capability; a comma map keyed by
+    # rank ("0:1.0,1:0.25") pins per rank (tests / known-heterogeneous
+    # deployments — ranks absent from the map fall back to the probe).
+    # Values must be > 0; a typo raises.
+    rank_capability: str = ""
+    # Live straggler rebalancing trigger (parallel/balance.py, riding
+    # the fleet rollups): when a pass's skew ratio (max/mean per-rank
+    # pass wall) exceeds this for rebalance_patience consecutive passes
+    # and the imbalance trend is not falling, the controller re-plans
+    # extents at the next pass boundary from the measured per-rank
+    # throughput.  Must be > 1.0; rebalancing also requires
+    # Config.fleet_stats armed (the rollups are its measurement layer).
+    rebalance_threshold: float = 1.5
+    # How many CONSECUTIVE over-threshold passes before a re-plan (>= 1).
+    rebalance_patience: int = 3
     # Flight recorder ring size, in event slots: > 0 arms a
     # constant-memory per-rank ring buffer (telemetry/flightrec.py) of
     # recent events — span open/close, host-collective dispatch

@@ -1238,9 +1238,28 @@ class ALS:
         item_sharded, use_grouped, sizes = self._block_dispatch(
             users, items, n_users, n_items, world
         )
+        # capability-weighted user blocks (parallel/balance.py, ISSUE
+        # 15): on the replicated-item layout a slow rank gets a smaller
+        # user block (offsets proportional to the gathered capability
+        # weights, HBM-priced) — every consumer of (offsets, upb)
+        # downstream is boundary-generic.  The 2-D sharded layout keeps
+        # the uniform split: its all_gather indexing is the identity
+        # mapping only uniform blocks provide.  Near-equal worlds return
+        # None here (deadband), keeping homogeneous fits bit-identical.
+        bal_offsets = None
+        if not item_sharded:
+            from oap_mllib_tpu.parallel import balance
+
+            # per-key resident bytes: one f32 factor row (r) + the
+            # per-key normal-equation moment block ((r+1)(r+2) flat)
+            bal_offsets = balance.block_offsets(
+                n_users, world,
+                bytes_per_key=4 * (self.rank
+                                   + (self.rank + 1) * (self.rank + 2)),
+            )
         with phase_timer(timings, "ratings_shuffle"):
             u_loc, i_glob, conf, valid, offsets, upb = als_block.prepare_block_inputs(
-                users, items, ratings, mesh, n_users
+                users, items, ratings, mesh, n_users, offsets=bal_offsets
             )
             item_shuffle = None
             if item_sharded:

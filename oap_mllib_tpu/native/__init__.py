@@ -312,6 +312,36 @@ def shuffle_prep(
     return users[perm], items[perm], ratings[perm], counts, perm
 
 
+def shuffle_prep_offsets(
+    users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
+    offsets: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Bucket + sort ratings by (user block, user, item) under EXPLICIT
+    block boundaries — the uneven-offset variant of :func:`shuffle_prep`
+    for capability-weighted block layouts (parallel/balance
+    .plan_block_offsets).  ``offsets`` is the ``(n_blocks + 1,)``
+    monotone key-boundary array; block b owns users in
+    ``[offsets[b], offsets[b+1])``.  Same return contract as
+    shuffle_prep.  Pure NumPy (searchsorted replaces the C library's
+    uniform-width division; the uneven layout only engages on
+    heterogeneous worlds, where the shuffle is not the bottleneck)."""
+    offsets = np.asarray(offsets, np.int64)
+    n_blocks = len(offsets) - 1
+    if n_blocks < 1:
+        raise ValueError("offsets must have >= 2 entries")
+    if np.any(np.diff(offsets) < 0):
+        raise ValueError("offsets must be monotone non-decreasing")
+    users = np.ascontiguousarray(users, dtype=np.int64)
+    items = np.ascontiguousarray(items, dtype=np.int64)
+    ratings = np.asarray(ratings)
+    block = np.clip(
+        np.searchsorted(offsets, users, side="right") - 1, 0, n_blocks - 1
+    ).astype(np.int32)
+    perm = np.lexsort((items, users, block))
+    counts = np.bincount(block, minlength=n_blocks).astype(np.int64)
+    return users[perm], items[perm], ratings[perm], counts, perm
+
+
 def distinct_count(sorted_keys: np.ndarray) -> int:
     sorted_keys = np.ascontiguousarray(sorted_keys, dtype=np.int64)
     lib = _load()

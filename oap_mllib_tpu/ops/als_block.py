@@ -822,6 +822,7 @@ def prepare_block_inputs(
     ratings: np.ndarray,
     mesh: Mesh,
     n_users: int,
+    offsets: "np.ndarray | None" = None,
 ):
     """Shuffle ratings into the block layout and build device inputs.
 
@@ -829,20 +830,27 @@ def prepare_block_inputs(
     arrays are block-sharded over the mesh and user ids are local to each
     rank's block (padded user rows run to ``upb`` per rank).
 
-    Identity-mapping note (load-bearing for the 2-D layout): blocks are
-    contiguous id ranges of width kpb = ceil(n/world) and ``upb == kpb``
-    whenever world > 1, so a GLOBAL id g living in block b sits at padded
-    row ``b * upb + (g - b * kpb) == g`` of the block-stacked factor
-    array.  The 2-D runners exploit this: the OTHER side's global ids in
-    each edge copy index the all_gathered padded factors directly, no
-    remap tensor needed.
+    Identity-mapping note (load-bearing for the 2-D layout): with the
+    default uniform layout, blocks are contiguous id ranges of width
+    kpb = ceil(n/world) and ``upb == kpb`` whenever world > 1, so a
+    GLOBAL id g living in block b sits at padded row
+    ``b * upb + (g - b * kpb) == g`` of the block-stacked factor array.
+    The 2-D runners exploit this: the OTHER side's global ids in each
+    edge copy index the all_gathered padded factors directly, no remap
+    tensor needed.  ``offsets`` (the capability-weighted uneven layout,
+    parallel/balance.plan_block_offsets) BREAKS that identity, so the
+    caller must only pass it on the replicated-item layout — the
+    models/als dispatch enforces this; the rebasing and every consumer
+    of (offsets, upb) here is boundary-generic.
     """
     from oap_mllib_tpu.parallel.shuffle import exchange_ratings
 
     cfg = get_config()
     axis = cfg.data_axis
     world = mesh.shape[axis]
-    u, i, r, valid, offsets = exchange_ratings(users, items, ratings, mesh, n_users)
+    u, i, r, valid, offsets = exchange_ratings(
+        users, items, ratings, mesh, n_users, offsets=offsets
+    )
     upb = int(np.max(np.diff(offsets))) if world > 1 else n_users
     upb = max(upb, 1)
     # rebase global user ids to block-local ids on device: id - offset[rank]

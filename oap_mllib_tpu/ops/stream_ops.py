@@ -383,15 +383,35 @@ def _fleet_pass(phase: str, stats: PrefetchStats, pass_wall_s: float,
     metrics + the per-fit fleet window.  Disarmed
     (``Config.fleet_stats``) this is one config check; armed, the
     decision is a pure function of (config, world) so every rank
-    issues the identical extra collective."""
+    issues the identical extra collective.
+
+    The straggler controller (parallel/balance.py, ISSUE 15) rides the
+    SAME gathered frames — every rank holds identical data, so every
+    rank computes the identical re-plan with no additional collective."""
     if not fleet.armed(_world()):
         return
     elapsed = tick()
     frame = fleet.local_frame(stats, pass_wall_s)
     (gathered,) = _allgather_host([frame])
     fleet.fold_pass(phase, gathered)
+    from oap_mllib_tpu.parallel import balance
+
+    balance.observe_pass(phase, gathered)
     if timings is not None:
         timings.add("fleet", elapsed())
+
+
+def capability_sync(frame: np.ndarray) -> np.ndarray:
+    """Fit-start capability gather (parallel/balance.py, ISSUE 15): one
+    fixed-shape allgather of each rank's ``[capability, origin, hbm,
+    host]`` frame over the sanctioned host-collective seam — it
+    inherits the deadline watchdog, the collective sanitizer's
+    fingerprinting, and the fault site like every other host
+    collective.  Called once per (process, world size); balance caches
+    the fold.  Returns the gathered ``(world, 4)`` frames, identical on
+    every rank."""
+    (gathered,) = _allgather_host([np.asarray(frame, np.float64)])
+    return gathered
 
 
 def _checked_entry(validate) -> None:

@@ -1,0 +1,1033 @@
+"""Capability-weighted shard planning + live straggler rebalancing
+(ISSUE 15 — ROADMAP item 5, "one world across unequal ranks").
+
+Every distributed pass in this stack used to assign EQUAL row/block
+shards, so a mixed or degraded world (TPU+CPU ranks, a relaunched rank
+with cold caches, a throttled host) finishes each pass at the slowest
+rank's pace — the unified-heterogeneous-cluster gap SparkCL names
+(PAPERS.md, arXiv:1505.01120).  PR 11 built the measurement layer (the
+``oap_fleet_*`` rollups: per-rank pass walls, skew ratio, imbalance
+trend); this module closes the loop, in the stack's own map-reduce
+idiom (DrJAX, arXiv:2403.07128): only the per-rank map EXTENT changes —
+the per-pass reductions keep their fixed shapes, so bucketed programs
+and the collective schedule are untouched.
+
+Three layers:
+
+- **Capability** — each rank's relative throughput, measured once per
+  process by a tiny deterministic-seeded microbench
+  (``utils/dispatch.throughput_probe``) or pinned via
+  ``Config.rank_capability``; allgathered ONCE per world size over the
+  sanctioned host-collective seam (``ops/stream_ops.capability_sync``
+  — so the gather inherits the deadline watchdog and the collective
+  sanitizer's fingerprinting) together with each rank's memory budgets
+  (``utils/membudget``), and cached.
+
+- **Planner** — :func:`plan_extents` converts capability weights into
+  uneven per-rank row ranges, QUANTIZED TO WHOLE CHUNKS so every rank
+  keeps launching the same bucketed per-chunk program (a rank's share
+  changes its chunk COUNT, never the chunk shape); per-rank host-budget
+  caps bound a fast-but-small rank's share (the membudget pricing — a
+  fast rank with little RAM must not be handed rows it cannot stage).
+  :func:`plan_block_offsets` is the block-ALS analog: uneven user-block
+  boundaries under a deadband (near-equal capabilities keep the exact
+  uniform layout, so homogeneous worlds are bit-identical to the
+  pre-balance code).
+
+- **Controller** — :func:`observe_pass` rides the fleet rollups
+  (ops/stream_ops._fleet_pass hands it the same gathered frames every
+  rank already holds, so every rank computes the IDENTICAL decision —
+  the rank-uniform-collective contract by construction): when the skew
+  ratio exceeds ``Config.rebalance_threshold`` for
+  ``rebalance_patience`` consecutive passes and the imbalance trend is
+  not falling (a cold-cache relaunch warming up heals itself), extents
+  re-plan at the next pass boundary from the measured per-rank
+  throughput (rows assigned / pass wall, EMA-blended).  A rank that
+  stays slowest through ``2 x patience`` over-threshold passes AFTER a
+  re-plan already tried is a persistent offender: rank 0 writes a
+  machine-readable hint (``balance.hint.json`` in ``Config.crash_dir``)
+  the supervisor (utils/supervisor.py) counts toward its shrink/evict
+  decision.
+
+Every decision lands in ``summary.balance``, a ``balance`` child span,
+and ``oap_balance_*`` metrics.  This module issues NO collectives
+itself — the gather seam lives in ops/stream_ops.py (the fleet.py
+precedent); everything here is pure planning + fold.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.data.stream import ChunkSource
+from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.utils import locktrace
+
+log = logging.getLogger("oap_mllib_tpu")
+
+ORIGIN_PROBE = "probe"
+ORIGIN_PINNED = "pinned"
+ORIGIN_EQUAL = "equal"
+ORIGIN_MIXED = "mixed"
+
+# near-equal capabilities keep the EXACT equal layout: probe noise on a
+# homogeneous world must not churn extents (or un-pin the block-ALS
+# uniform offsets the 2-D identity mapping depends on)
+DEADBAND = 0.05
+
+# fraction of a rank's host budget the planner lets a memory-backed
+# shard occupy (the rest covers staging buffers, the interpreter, and
+# the pre-existing resident table on single-host layouts)
+_HOST_FRACTION = 0.5
+
+# weight floor, relative to the mean: the planner never starves a rank
+# to zero on its own — eviction is the supervisor's decision, and a
+# zero-extent rank could never measure its way back in
+_WEIGHT_FLOOR = 0.05
+
+# EMA blend of current plan weights vs measured throughput on a re-plan
+# (damps oscillation between two layouts when the measurement is noisy)
+_EMA = 0.5
+
+_MAX_REPLANS = 8
+
+# phases at which a re-plan may take effect: between full-table passes
+# whose math is a pure function of the iterate (Lloyd passes, the PCA
+# moment passes).  The k-means|| init keeps per-chunk host state across
+# rounds (stream_ops dmin cache), so extents are frozen through init —
+# those passes never reach observe_pass anyway (no _fleet_pass seam).
+_REPLAN_PHASES = ("lloyd_loop", "covariance_streamed")
+
+HINT_FILENAME = "balance.hint.json"
+
+
+class BalanceError(RuntimeError):
+    """Invalid balance configuration or an unplannable layout."""
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+
+def capability_sharding_cfg(cfg=None) -> str:
+    """Validated ``Config.capability_sharding`` — a typo must raise, not
+    silently disarm (the kmeans_kernel/fault_spec contract)."""
+    cfg = cfg or get_config()
+    mode = cfg.capability_sharding
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(
+            f"capability_sharding must be auto|on|off, got {mode!r}"
+        )
+    return mode
+
+
+def rebalance_threshold_cfg(cfg=None) -> float:
+    cfg = cfg or get_config()
+    thr = float(cfg.rebalance_threshold)
+    if thr <= 1.0:
+        raise ValueError(
+            f"rebalance_threshold must be > 1.0 (a skew ratio), got {thr}"
+        )
+    return thr
+
+
+def rebalance_patience_cfg(cfg=None) -> int:
+    cfg = cfg or get_config()
+    pat = int(cfg.rebalance_patience)
+    if pat < 1:
+        raise ValueError(
+            f"rebalance_patience must be >= 1, got {pat}"
+        )
+    return pat
+
+
+def armed(world: int, cfg=None) -> bool:
+    """Should capability weighting apply?  A pure function of
+    (config, world size) so every rank decides identically."""
+    mode = capability_sharding_cfg(cfg)
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return world > 1
+
+
+def _rank() -> int:
+    import jax
+
+    try:
+        return int(jax.process_index())
+    except RuntimeError:
+        return int(get_config().process_id)
+
+
+def _world() -> int:
+    import jax
+
+    try:
+        return int(jax.process_count())
+    except RuntimeError:
+        return max(1, int(get_config().num_processes))
+
+
+# ---------------------------------------------------------------------------
+# capability gathering (the collective seam lives in ops/stream_ops.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CapabilityWorld:
+    """One world's gathered capability frame: normalized weights (mean
+    1.0), per-rank origins, and per-rank memory budgets (bytes; 0 =
+    unbounded)."""
+
+    world: int
+    weights: np.ndarray  # (world,) f64, mean 1.0
+    raw: np.ndarray  # (world,) the un-normalized capabilities
+    origins: Tuple[str, ...]
+    hbm: np.ndarray  # (world,) bytes
+    host: np.ndarray  # (world,) bytes
+
+    @property
+    def origin(self) -> str:
+        kinds = set(self.origins)
+        if kinds == {ORIGIN_PINNED}:
+            return ORIGIN_PINNED
+        if kinds == {ORIGIN_PROBE}:
+            return ORIGIN_PROBE
+        return ORIGIN_MIXED
+
+
+def local_capability_frame() -> np.ndarray:
+    """This rank's fixed-shape capability frame for the fit-start
+    allgather: ``[capability, origin_code, hbm_budget, host_budget]``
+    float64 — origin_code 1.0 = pinned, 0.0 = probed.  Budgets come from
+    the membudget resolution so the planner can cap a fast-but-small
+    rank (0 = unbounded)."""
+    from oap_mllib_tpu.utils import membudget
+    from oap_mllib_tpu.utils.dispatch import rank_capability
+
+    cap, origin = rank_capability()
+    budgets = membudget.Budgets.resolve()
+    return np.asarray(
+        [cap, 1.0 if origin == ORIGIN_PINNED else 0.0,
+         float(budgets.hbm), float(budgets.host)],
+        np.float64,
+    )
+
+
+def fold_world(gathered) -> CapabilityWorld:
+    """Fold the gathered ``(world, 4)`` capability frames — identical on
+    every rank — into a :class:`CapabilityWorld` (pure; tests feed
+    synthetic frames)."""
+    frames = np.asarray(gathered, np.float64)
+    if frames.ndim != 2 or frames.shape[1] != 4:
+        raise ValueError(
+            f"capability frame shape {frames.shape} != (world, 4)"
+        )
+    raw = np.maximum(frames[:, 0], 1e-9)
+    weights = raw / raw.mean()
+    origins = tuple(
+        ORIGIN_PINNED if c > 0.5 else ORIGIN_PROBE for c in frames[:, 1]
+    )
+    return CapabilityWorld(
+        world=frames.shape[0], weights=weights, raw=raw, origins=origins,
+        hbm=frames[:, 2].copy(), host=frames[:, 3].copy(),
+    )
+
+
+_sync_lock = locktrace.TrackedLock("balance.sync", threading.Lock())
+_sync_cache: Dict[int, CapabilityWorld] = {}
+
+
+def world_capabilities(world: Optional[int] = None) -> CapabilityWorld:
+    """The gathered capability world, allgathered once per world size
+    and cached (the "once at fit start" contract: the first armed plan
+    of a process pays one probe + one tiny fixed-shape allgather; every
+    later plan reads the cache).  Fits are serialized per process, so
+    the gather itself runs outside the cache lock (no collective under
+    a lock — the R21 contract) without risking a divergent double
+    gather."""
+    world = _world() if world is None else int(world)
+    with _sync_lock:
+        cached = _sync_cache.get(world)
+    if cached is not None:
+        return cached
+    frame = local_capability_frame()
+    if world == 1:
+        gathered = frame[None]
+    else:
+        from oap_mllib_tpu.ops.stream_ops import capability_sync
+
+        gathered = capability_sync(frame)
+    cw = fold_world(gathered)
+    with _sync_lock:
+        _sync_cache[world] = cw
+    if _rank() == 0:
+        for r in range(cw.world):
+            _tm.gauge(
+                "oap_balance_capability", {"rank": str(r)},
+                help="Per-rank capability weight (normalized, mean 1.0)",
+            ).set(float(cw.weights[r]))
+    log.info(
+        "balance: world capabilities (%s) = %s",
+        cw.origin, [round(float(w), 3) for w in cw.weights],
+    )
+    return cw
+
+
+# ---------------------------------------------------------------------------
+# planners (pure)
+# ---------------------------------------------------------------------------
+
+
+def _apportion(total: int, weights: np.ndarray,
+               caps: Optional[np.ndarray]) -> Tuple[np.ndarray, bool]:
+    """Integer apportionment of ``total`` units proportional to
+    ``weights``, each rank bounded by ``caps`` (None / <= 0 entries =
+    uncapped).  Waterfill + largest-remainder: capped ranks saturate and
+    their excess redistributes among the uncapped; deterministic ties
+    (lower rank first).  Returns ``(units (world,), over_cap)`` —
+    ``over_cap`` means the caps were infeasible (sum(caps) < total) and
+    the planner overflowed them proportionally rather than drop data
+    (budgets steer, they never reject — the membudget auto contract)."""
+    world = len(weights)
+    w = np.maximum(np.asarray(weights, np.float64), 1e-12)
+    cap_arr = np.full((world,), np.inf)
+    if caps is not None:
+        c = np.asarray(caps, np.float64)
+        cap_arr = np.where(c > 0, c, np.inf)
+    if np.isfinite(cap_arr).all() and cap_arr.sum() < total:
+        # infeasible caps: overflow proportionally to weight (loudly)
+        cap_arr = np.full((world,), np.inf)
+        over = True
+    else:
+        over = False
+    shares = np.zeros((world,), np.float64)
+    remaining = float(total)
+    free = np.ones((world,), bool)
+    while remaining > 1e-9 and free.any():
+        # spread what's left over the unsaturated ranks by weight; any
+        # rank this pushes past its cap saturates there and the loop
+        # redistributes its excess (terminates: each round saturates at
+        # least one rank or distributes everything)
+        add = remaining * (w * free) / float((w * free).sum())
+        trial = shares + np.where(free, add, 0.0)
+        hit = free & (trial >= cap_arr)
+        if not hit.any():
+            shares = trial
+            break
+        shares[hit] = cap_arr[hit]
+        free &= ~hit
+        remaining = max(0.0, float(total - shares.sum()))
+    units = np.floor(shares).astype(np.int64)
+    # largest remainder, bounded by caps, ties to the lower rank
+    frac = shares - units
+    order = np.argsort(-frac, kind="stable")
+    leftover = int(total - units.sum())
+    for r in order:
+        if leftover <= 0:
+            break
+        if units[r] + 1 <= cap_arr[r]:
+            units[r] += 1
+            leftover -= 1
+    i = 0
+    while leftover > 0 and i < world:  # caps all saturated: spill in order
+        units[order[i % world]] += 1
+        leftover -= 1
+        i += 1
+    return units, over
+
+
+def plan_extents(
+    n_rows: int, chunk_rows: int, weights: Sequence[float],
+    caps_rows: Optional[Sequence[int]] = None,
+) -> Tuple[List[Tuple[int, int]], bool]:
+    """Weight-proportional per-rank row ranges, quantized to whole
+    chunks: rank r gets rows ``[start, start + rows)`` where every
+    boundary except the global tail is a ``chunk_rows`` multiple — so
+    each rank's pass is the same bucketed per-chunk program, just a
+    different chunk COUNT.  Returns ``(extents, over_cap)``; extents
+    always cover exactly ``[0, n_rows)`` (sum of rows == n_rows).
+    World size 1 degenerates to the identity extent."""
+    n = int(n_rows)
+    if n < 1:
+        raise ValueError(f"n_rows must be >= 1, got {n}")
+    if chunk_rows < 1:
+        raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+    world = len(weights)
+    if world == 1:
+        # nowhere else to put rows: the identity extent, loudly over-cap
+        # when the one rank's budget cannot hold them (advisory)
+        over1 = bool(
+            caps_rows is not None and len(caps_rows) == 1
+            and caps_rows[0] and 0 < caps_rows[0] < n
+        )
+        return [(0, n)], over1
+    n_chunks = -(-n // chunk_rows)
+    caps_c = None
+    if caps_rows is not None:
+        # a participating rank stages at least ONE chunk (a sub-chunk
+        # budget floors there rather than silently uncapping)
+        caps_c = np.asarray(
+            [max(1, int(c) // chunk_rows) if c and c > 0 else 0
+             for c in caps_rows],
+            np.float64,
+        )
+    w = np.maximum(
+        np.asarray(weights, np.float64), _WEIGHT_FLOOR
+        * max(float(np.mean(weights)), 1e-12),
+    )
+    chunks, over = _apportion(n_chunks, w, caps_c)
+    extents: List[Tuple[int, int]] = []
+    start = 0
+    for r in range(world):
+        rows = min(int(chunks[r]) * chunk_rows, n - start)
+        rows = max(rows, 0)
+        extents.append((start, rows))
+        start += rows
+    # rounding can leave a sub-chunk tail uncovered when a capped rank
+    # absorbed the last whole chunk: hand the tail to the last rank
+    # with any rows (the global tail is the one sub-chunk boundary)
+    if start < n:
+        for r in range(world - 1, -1, -1):
+            s, rows = extents[r]
+            if rows > 0 or r == 0:
+                extents[r] = (s, rows + (n - start))
+                break
+    if sum(rows for _, rows in extents) != n:
+        raise BalanceError(
+            f"planner bug: extents {extents} do not cover {n} rows"
+        )
+    return extents, over
+
+
+def host_caps_rows(capworld: CapabilityWorld, row_bytes: int,
+                   backing: str) -> Optional[List[int]]:
+    """Per-rank row caps from the gathered host budgets: a
+    memory-backed shard must fit ``_HOST_FRACTION`` of its rank's host
+    budget (disk/spill-backed sources stream O(chunk) host and are
+    uncapped).  0 entries = uncapped."""
+    if backing in ("disk", "spill") or row_bytes <= 0:
+        return None
+    caps = []
+    for b in capworld.host:
+        caps.append(
+            int(b * _HOST_FRACTION / row_bytes) if b > 0 else 0
+        )
+    if all(c == 0 for c in caps):
+        return None
+    return caps
+
+
+def plan_block_offsets(
+    n_keys: int, weights: Sequence[float],
+    caps_keys: Optional[Sequence[int]] = None,
+    deadband: float = DEADBAND,
+) -> Optional[np.ndarray]:
+    """Capability-weighted block boundaries for the block-ALS user axis:
+    ``(world + 1,)`` key offsets proportional to weight, each block
+    non-empty when ``n_keys >= world``.  Returns None when the weights
+    sit within ``deadband`` of equal — the caller keeps the exact
+    uniform ``ceil(n/world)`` layout, so homogeneous worlds (and the 2-D
+    sharded-item layout, whose identity mapping REQUIRES uniform blocks
+    — see ops/als_block.prepare_block_inputs) are untouched."""
+    world = len(weights)
+    if world <= 1:
+        return None
+    w = np.asarray(weights, np.float64)
+    w = w / max(float(w.mean()), 1e-12)
+    if float(np.max(np.abs(w - 1.0))) <= deadband:
+        return None
+    n = int(n_keys)
+    caps = None
+    if caps_keys is not None:
+        caps = np.asarray(
+            [int(c) if c and c > 0 else 0 for c in caps_keys], np.float64
+        )
+    keys, _ = _apportion(n, w, caps)
+    if n >= world:
+        # every block must own at least one key (block runners assume a
+        # non-degenerate local row range); steal from the largest
+        for r in range(world):
+            while keys[r] < 1:
+                donor = int(np.argmax(keys))
+                if keys[donor] <= 1:
+                    break
+                keys[donor] -= 1
+                keys[r] += 1
+    offsets = np.zeros((world + 1,), np.int64)
+    offsets[1:] = np.cumsum(keys)
+    offsets[-1] = n
+    return offsets
+
+
+# fraction of a rank's HBM budget its block-ALS key share may imply in
+# resident factor/moment state (the rest is the edge tables, the
+# replicated other side, and XLA temporaries)
+_HBM_BLOCK_FRACTION = 0.25
+
+
+def block_offsets(
+    n_keys: int, mesh_world: int, bytes_per_key: int = 0,
+    capworld: Optional[CapabilityWorld] = None,
+) -> Optional[np.ndarray]:
+    """Capability-weighted user-block offsets for the REPLICATED-item
+    block-ALS layout, or None to keep the uniform split (disarmed,
+    deadband, or an irregular mesh/process ratio).  Each process's
+    capability weight spreads over its mesh slots (blocks are per
+    device, capabilities per host); ``bytes_per_key`` prices a block's
+    resident factor+moment state against the rank's HBM budget so a
+    fast-but-small-HBM rank is not handed more keys than it can hold
+    (the membudget pricing).  The 2-D sharded-item layout must NOT use
+    this — its identity mapping requires uniform blocks
+    (ops/als_block.prepare_block_inputs); the models/als dispatch only
+    consults it on the replicated layout."""
+    cfg = get_config()
+    nproc = _world()
+    if capworld is None:
+        if not armed(nproc, cfg):
+            return None
+        capworld = world_capabilities(nproc)
+    slots = max(1, int(mesh_world) // capworld.world)
+    if capworld.world * slots != int(mesh_world):
+        return None  # irregular slot layout: keep the uniform split
+    w = np.repeat(capworld.weights, slots)
+    caps = None
+    if bytes_per_key > 0:
+        caps = []
+        for b in capworld.hbm:
+            per_slot = (
+                int(b * _HBM_BLOCK_FRACTION / (slots * bytes_per_key))
+                if b > 0 else 0
+            )
+            caps.extend([per_slot] * slots)
+    offsets = plan_block_offsets(n_keys, w, caps_keys=caps)
+    if offsets is not None:
+        log.info(
+            "balance: capability-weighted block offsets (%s): %s",
+            capworld.origin, [int(o) for o in offsets],
+        )
+        if _rank() == 0:
+            _tm.counter(
+                "oap_balance_block_plans_total",
+                help="Capability-weighted block-ALS layouts planned",
+            ).inc()
+    return offsets
+
+
+# ---------------------------------------------------------------------------
+# the shard plan + balanced source views
+# ---------------------------------------------------------------------------
+
+
+class ShardPlan:
+    """One world's live extent assignment.  Extents are read at each
+    pass's iteration start and may be re-planned by the controller
+    BETWEEN passes (the consumer thread owns both sides: streamed
+    passes fully close their prefetcher before the reduction that
+    precedes :func:`observe_pass`, so no producer thread is alive
+    during a swap)."""
+
+    def __init__(self, n_rows: int, chunk_rows: int,
+                 capworld: CapabilityWorld, origin: str,
+                 extents: List[Tuple[int, int]], over_cap: bool,
+                 caps_rows: Optional[List[int]] = None):
+        self.n_rows = int(n_rows)
+        self.chunk_rows = int(chunk_rows)
+        self.world = capworld.world
+        self.origin = origin
+        self.over_cap = bool(over_cap)
+        self.caps_rows = caps_rows
+        self._capworld = capworld
+        self._lock = threading.Lock()
+        self._extents = list(extents)
+        self._weights = np.array(capworld.weights, np.float64)
+
+    def extents(self) -> List[Tuple[int, int]]:
+        with self._lock:
+            return list(self._extents)
+
+    def local_extent(self, rank: int) -> Tuple[int, int]:
+        with self._lock:
+            return self._extents[rank]
+
+    def weights(self) -> np.ndarray:
+        with self._lock:
+            return np.array(self._weights)
+
+    def set_extents(self, extents: List[Tuple[int, int]],
+                    weights: np.ndarray) -> None:
+        with self._lock:
+            self._extents = list(extents)
+            self._weights = np.array(weights, np.float64)
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            extents = list(self._extents)
+            weights = [round(float(w), 4) for w in self._weights]
+        out: Dict[str, Any] = {
+            "world": self.world,
+            "origin": self.origin,
+            "chunk_rows": self.chunk_rows,
+            "n_rows": self.n_rows,
+            "weights": weights,
+            "extents": [[int(s), int(r)] for s, r in extents],
+        }
+        if self.over_cap:
+            out["over_cap"] = True
+        if self.caps_rows is not None:
+            out["caps_rows"] = [int(c) for c in self.caps_rows]
+        return out
+
+
+def make_plan(
+    n_rows: int, chunk_rows: int, *, row_bytes: int = 0,
+    backing: str = "memory", world: Optional[int] = None,
+    capworld: Optional[CapabilityWorld] = None,
+) -> ShardPlan:
+    """Build (and activate) the shard plan for one global table: armed,
+    weights come from the gathered capability world (probe/pinned) and
+    host-budget caps price the extents; disarmed, the plan is the equal
+    layout (origin ``"equal"``) — same machinery, so equal-vs-weighted
+    comparisons run the identical code path."""
+    cfg = get_config()
+    world = _world() if world is None else int(world)
+    caps_rows = None
+    if armed(world, cfg):
+        if capworld is None and world != _world():
+            raise BalanceError(
+                f"cannot plan a {world}-rank world from a "
+                f"{_world()}-process one without an explicit capworld "
+                "(the capability gather only covers live ranks)"
+            )
+        cw = capworld or world_capabilities(world)
+        origin = cw.origin
+        caps_rows = host_caps_rows(cw, row_bytes, backing)
+    else:
+        cw = CapabilityWorld(
+            world=world, weights=np.ones((world,)),
+            raw=np.ones((world,)),
+            origins=tuple([ORIGIN_EQUAL] * world),
+            hbm=np.zeros((world,)), host=np.zeros((world,)),
+        )
+        origin = ORIGIN_EQUAL
+    extents, over = plan_extents(
+        n_rows, chunk_rows, cw.weights, caps_rows=caps_rows
+    )
+    plan = ShardPlan(
+        n_rows, chunk_rows, cw, origin, extents, over, caps_rows
+    )
+    if over:
+        log.warning(
+            "balance: per-rank host caps infeasible for %d rows — "
+            "extents overflow the budget proportionally (advisory)",
+            n_rows,
+        )
+    if _rank() == 0:
+        _tm.counter(
+            "oap_balance_plans_total",
+            help="Shard plans built by the balance planner",
+        ).inc()
+        for r, (_, rows) in enumerate(extents):
+            _tm.gauge(
+                "oap_balance_extent_rows", {"rank": str(r)},
+                help="Rows assigned to each rank by the current plan",
+            ).set(float(rows))
+    activate(plan)
+    return plan
+
+
+class BalancedView(ChunkSource):
+    """A rank's live view of one globally-shared table: a real
+    :class:`~oap_mllib_tpu.data.stream.ChunkSource` (so the models'
+    streamed routing, weight lockstep validation, and the resilience
+    ladder treat it like any other source) whose row range is the
+    plan's CURRENT extent, read at each pass's iteration start — a
+    re-plan between passes moves rows across ranks with no source
+    rebuild.  ``data`` is anything row-sliceable with ``.shape``
+    (ndarray, memmap, an ``np.load(mmap_mode="r")`` array)."""
+
+    def __init__(self, data, plan: ShardPlan, chunk_rows: int,
+                 rank: Optional[int] = None):
+        if getattr(data, "ndim", len(getattr(data, "shape", ()))) != 2:
+            raise ValueError("BalancedView needs 2-D row-sliceable data")
+        self._data = data
+        self._plan = plan
+        self._rank = _rank() if rank is None else int(rank)
+        if not (0 <= self._rank < plan.world):
+            raise ValueError(
+                f"rank {self._rank} outside plan world {plan.world}"
+            )
+        super().__init__(
+            self._pieces, int(data.shape[1]), chunk_rows,
+            n_rows=plan.local_extent(self._rank)[1],
+            dtype=np.dtype(getattr(data, "dtype", np.float32)),
+            backing="memory",
+        )
+        if plan.chunk_rows % self.chunk_rows and \
+                self.chunk_rows % plan.chunk_rows:
+            raise ValueError(
+                f"view chunk_rows {self.chunk_rows} must divide (or be a "
+                f"multiple of) the plan's {plan.chunk_rows} — extents are "
+                "quantized to the plan's chunk width"
+            )
+
+    @property
+    def plan(self) -> ShardPlan:
+        return self._plan
+
+    def _pieces(self):
+        start, rows = self._plan.local_extent(self._rank)
+        cr = self.chunk_rows
+        for lo in range(0, rows, cr):
+            take = min(cr, rows - lo)
+            yield np.asarray(
+                self._data[start + lo: start + lo + take], self.dtype
+            )
+
+    def with_chunk_rows(self, chunk_rows: int) -> "BalancedView":
+        """Resilience-ladder re-chunk (geometric OOM rung): same plan,
+        same extent, narrower chunks — extents stay aligned because the
+        halved width divides the plan's quantum."""
+        return BalancedView(
+            self._data, self._plan, chunk_rows, rank=self._rank
+        )
+
+    def __iter__(self):
+        # refresh the expected row count from the LIVE extent before
+        # delegating to the base walk (its cross-pass determinism check
+        # would otherwise reject the first pass after a re-plan)
+        self._n_rows = self._plan.local_extent(self._rank)[1]
+        return super().__iter__()
+
+
+def local_sources(
+    x, sample_weight=None, chunk_rows: Optional[int] = None,
+    plan: Optional[ShardPlan] = None, rank: Optional[int] = None,
+):
+    """Build this rank's balanced source view(s) over one GLOBAL table
+    (the capability-weighted replacement for hand-slicing equal shards):
+    every rank calls this with the SAME ``x`` (and optional per-row
+    ``sample_weight`` vector) and receives a ChunkSource-compatible view
+    of its planned extent; the weight view shares the data view's plan,
+    so the two stay in lockstep across re-plans.  Returns ``source`` or
+    ``(source, weight_source)``."""
+    from oap_mllib_tpu.data.stream import DEFAULT_CHUNK_ROWS
+
+    if getattr(x, "ndim", 0) != 2:
+        x = np.asarray(x)
+        if x.ndim != 2:
+            raise ValueError(f"expected 2-D data, got shape {x.shape}")
+    chunk_rows = DEFAULT_CHUNK_ROWS if chunk_rows is None else int(
+        chunk_rows)
+    from oap_mllib_tpu.data.bucketing import bucket_rows
+
+    cr = bucket_rows(chunk_rows)
+    if plan is None:
+        plan = make_plan(
+            int(x.shape[0]), cr,
+            row_bytes=int(x.shape[1]) * np.dtype(
+                getattr(x, "dtype", np.float32)).itemsize,
+            backing="memory",
+        )
+    src = BalancedView(x, plan, cr, rank=rank)
+    if sample_weight is None:
+        return src
+    w = np.asarray(sample_weight, np.float64).reshape(-1, 1)
+    if w.shape[0] != x.shape[0]:
+        raise ValueError(
+            f"sample_weight rows {w.shape[0]} != data rows {x.shape[0]}"
+        )
+    return src, BalancedView(w, plan, cr, rank=rank)
+
+
+# ---------------------------------------------------------------------------
+# the live straggler controller (module state, reset per fit)
+# ---------------------------------------------------------------------------
+
+# tracked (utils/locktrace.py): the /healthz handler thread reads the
+# active plan + decisions while fit passes write them
+_state_lock = locktrace.TrackedLock("balance.state", threading.Lock())
+_active: Optional[ShardPlan] = None
+_skews: List[float] = []
+_over_count = 0
+_streak_rank: Optional[int] = None
+_streak = 0
+_decisions: List[Dict[str, Any]] = []
+_hint: Optional[Dict[str, Any]] = None
+
+
+def activate(plan: ShardPlan) -> None:
+    """Register ``plan`` as the fit's live plan (the controller's
+    re-plan target and the summary's decision trail)."""
+    global _active
+    with _state_lock:
+        _active = plan
+
+
+def active_plan() -> Optional[ShardPlan]:
+    with _state_lock:
+        return _active
+
+
+def deactivate() -> None:
+    global _active
+    with _state_lock:
+        _active = None
+
+
+def observe_pass(phase: str, frames) -> Optional[Dict[str, Any]]:
+    """Controller seam, called from ops/stream_ops._fleet_pass with the
+    SAME gathered per-rank frames every rank holds (identical data →
+    identical decision → rank-uniform extents, no extra collective).
+    Returns the decision record when a re-plan fired (tests/gate)."""
+    frames = np.asarray(frames, np.float64)
+    if frames.ndim != 2 or frames.shape[0] < 1:
+        return None
+    world = frames.shape[0]
+    cfg = get_config()
+    if not armed(world, cfg):
+        return None
+    with _state_lock:
+        plan = _active
+    if plan is None or plan.world != world:
+        return None
+    thr = rebalance_threshold_cfg(cfg)
+    pat = rebalance_patience_cfg(cfg)
+    walls = frames[:, 0]
+    mean = float(walls.mean())
+    skew = float(walls.max() / mean) if mean > 0 else 1.0
+    slowest = int(np.argmax(walls))
+    global _over_count, _streak_rank, _streak
+    with _state_lock:
+        _skews.append(skew)
+        over = skew > thr
+        _over_count = _over_count + 1 if over else 0
+        if over and slowest == _streak_rank:
+            _streak += 1
+        elif over:
+            _streak_rank, _streak = slowest, 1
+        else:
+            _streak_rank, _streak = None, 0
+        over_count = _over_count
+        streak = _streak
+        skews = list(_skews)
+        n_replans = len(_decisions)
+    if not over or over_count < pat:
+        return None
+    from oap_mllib_tpu.telemetry.fleet import _trend
+
+    trend = _trend(skews[-max(2 * pat, 4):])
+    if trend == "falling":
+        return None  # a warming-up relaunch is healing itself
+    if phase not in _REPLAN_PHASES:
+        return None
+    if n_replans >= _MAX_REPLANS or streak >= 2 * pat and n_replans > 0:
+        _maybe_hint(plan, slowest, skew, streak, cfg)
+        if n_replans >= _MAX_REPLANS:
+            return None
+    return _replan(plan, frames, skew, slowest, trend)
+
+
+def _replan(plan: ShardPlan, frames: np.ndarray, skew: float,
+            slowest: int, trend: str) -> Optional[Dict[str, Any]]:
+    walls = frames[:, 0]
+    old_extents = plan.extents()
+    rows = np.asarray([r for _, r in old_extents], np.float64)
+    # measured effective throughput = rows this rank processed / its
+    # wall; a zero-extent rank measures nothing and keeps its weight
+    with np.errstate(divide="ignore", invalid="ignore"):
+        meas = np.where(
+            (walls > 0) & (rows > 0),
+            rows / np.maximum(walls, 1e-9), 0.0,
+        )
+    cur = plan.weights()
+    active_sel = meas > 0
+    if not active_sel.any():
+        return None
+    meas_n = np.array(cur)
+    meas_norm = meas[active_sel] / meas[active_sel].mean()
+    meas_n[active_sel] = meas_norm
+    new_w = _EMA * cur + (1.0 - _EMA) * meas_n
+    new_w = np.maximum(new_w / new_w.mean(), _WEIGHT_FLOOR)
+    new_extents, _ = plan_extents(
+        plan.n_rows, plan.chunk_rows, new_w, caps_rows=plan.caps_rows
+    )
+    decision = {
+        "pass": len(_skews),
+        "skew_ratio": round(skew, 4),
+        "slowest_rank": slowest,
+        "trend": trend,
+        "weights": [round(float(w), 4) for w in new_w],
+        "old_extents": [[int(s), int(r)] for s, r in old_extents],
+        "new_extents": [[int(s), int(r)] for s, r in new_extents],
+    }
+    global _over_count
+    if new_extents == old_extents:
+        decision["noop"] = True
+        with _state_lock:
+            _over_count = 0  # nothing to move; stop re-deciding each pass
+            _decisions.append(decision)
+        return decision
+    plan.set_extents(new_extents, new_w)
+    with _state_lock:
+        _over_count = 0
+        _decisions.append(decision)
+    if _rank() == 0:
+        _tm.counter(
+            "oap_balance_replans_total",
+            help="Live extent re-plans by the straggler controller",
+        ).inc()
+        for r, (_, rws) in enumerate(new_extents):
+            _tm.gauge(
+                "oap_balance_extent_rows", {"rank": str(r)},
+                help="Rows assigned to each rank by the current plan",
+            ).set(float(rws))
+    from oap_mllib_tpu.telemetry import flightrec
+
+    if flightrec.enabled():
+        flightrec.record(
+            "balance", "replan",
+            f"skew={skew:.2f} slowest=r{slowest}",
+        )
+    log.warning(
+        "balance: re-planned extents (skew %.2f, slowest rank %d, "
+        "trend %s): %s -> %s", skew, slowest, trend,
+        [r for _, r in old_extents], [r for _, r in new_extents],
+    )
+    return decision
+
+
+def _maybe_hint(plan: ShardPlan, rank: int, skew: float, streak: int,
+                cfg) -> None:
+    """Persistent-offender escalation: record (and, with the recovery
+    sideband armed, write) a supervisor hint naming the rank that stayed
+    slowest through the controller's attempts — the shrink/evict path is
+    the supervisor's, not ours (utils/supervisor.py reads the hint)."""
+    global _hint
+    with _state_lock:
+        if _hint is not None:
+            return
+        _hint = {
+            "schema": 1,
+            "rank": int(rank),
+            "skew_ratio": round(float(skew), 4),
+            "streak_passes": int(streak),
+            "replans": len(_decisions),
+            "reason": "persistent straggler despite re-planning",
+        }
+        hint = dict(_hint)
+    _tm.counter(
+        "oap_balance_supervisor_hints_total",
+        help="Persistent-straggler hints handed to the supervisor",
+    ).inc()
+    log.warning(
+        "balance: rank %d is a persistent straggler (skew %.2f for %d "
+        "passes despite re-planning) — handing to the supervisor's "
+        "shrink/evict path", rank, skew, streak,
+    )
+    if cfg.crash_dir and _rank() == 0:
+        import os
+
+        from oap_mllib_tpu.data import io as _io
+
+        try:
+            os.makedirs(cfg.crash_dir, exist_ok=True)
+            _io.atomic_write_json(
+                os.path.join(cfg.crash_dir, HINT_FILENAME), hint
+            )
+        except OSError as e:  # noqa: PERF203 — hint is advisory
+            log.warning("balance: hint write failed: %s", e)
+
+
+def decisions() -> List[Dict[str, Any]]:
+    with _state_lock:
+        return list(_decisions)
+
+
+def summary_block(world: int) -> Optional[Dict[str, Any]]:
+    """The per-fit ``balance`` block, or None when no plan is active."""
+    with _state_lock:
+        plan = _active
+        dec = list(_decisions)
+        hint = dict(_hint) if _hint is not None else None
+        passes = len(_skews)
+    if plan is None:
+        return None
+    block = dict(plan.as_dict())
+    block["enabled"] = armed(world)
+    block["passes_observed"] = passes
+    block["replans"] = dec
+    if hint is not None:
+        block["supervisor_hint"] = hint
+    return block
+
+
+def finalize_fit(summary, root) -> None:
+    """Fit-boundary hook (telemetry/export.finalize_fit): land the
+    ``balance`` block + a ``balance`` child span, then reset the per-fit
+    controller state.  The plan itself stays active (its adapted extents
+    warm-start the next fit over the same sources); one config-read +
+    None-check when the plane never planned anything."""
+    with _state_lock:
+        plan = _active
+    if plan is None:
+        return
+    try:
+        world = _world()
+    except Exception:  # noqa: BLE001 — exposition must not kill a fit
+        world = plan.world
+    block = summary_block(world)
+    _reset_fit_state()
+    if summary is None or block is None:
+        return
+    if isinstance(summary, dict):
+        summary["balance"] = block
+    else:
+        summary.balance = block
+    if root is not None:
+        root.node("balance").attrs.update({
+            "origin": block["origin"],
+            "world": block["world"],
+            "replans": len(block["replans"]),
+            "weights": block["weights"],
+        })
+
+
+def _reset_fit_state() -> None:
+    global _over_count, _streak_rank, _streak, _hint
+    with _state_lock:
+        _skews.clear()
+        _decisions.clear()
+        _over_count = 0
+        _streak_rank, _streak = None, 0
+        _hint = None
+
+
+def cached_capability() -> float:
+    """This rank's capability as already gathered/pinned, or 0.0 when
+    nothing has been probed yet (the fleet frame's 'unknown' marker —
+    reading it must never trigger a probe or a collective)."""
+    with _sync_lock:
+        for cw in _sync_cache.values():
+            r = _rank()
+            if r < cw.world:
+                return float(cw.weights[r])
+    return 0.0
+
+
+def _reset_for_tests() -> None:
+    global _active
+    with _sync_lock:
+        _sync_cache.clear()
+    with _state_lock:
+        _active = None
+    _reset_fit_state()

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -54,7 +54,8 @@ def _pad_bucket(arr: np.ndarray, size: int, fill) -> np.ndarray:
 _EDGE_MULTIPLE = 2048  # compacted per-rank edges pad to this (chunk factors)
 
 
-def _local_bucket_build(users, items, ratings, kpb, world, local_sources):
+def _local_bucket_build(users, items, ratings, kpb, world, local_sources,
+                        offsets=None):
     """Bucket this process's edges by destination block and balance each
     bucket round-robin across the process's local source shards.
 
@@ -62,13 +63,22 @@ def _local_bucket_build(users, items, ratings, kpb, world, local_sources):
     which sets the all_to_all pad size — becomes ~avg over local sources
     instead of whatever the arrival-order split produced.
 
+    ``offsets``: explicit (uneven) block boundaries — the capability-
+    weighted layout (parallel/balance.py) buckets by searchsorted
+    instead of the uniform kpb division.
+
     Returns (buckets[s][b] -> (u, i, r), counts (local_sources, world)).
     """
     from oap_mllib_tpu import native
 
-    us, it, rs, counts, _ = native.shuffle_prep(
-        users, items, ratings, kpb, world
-    )
+    if offsets is not None:
+        us, it, rs, counts, _ = native.shuffle_prep_offsets(
+            users, items, ratings, offsets
+        )
+    else:
+        us, it, rs, counts, _ = native.shuffle_prep(
+            users, items, ratings, kpb, world
+        )
     buckets = [[None] * world for _ in range(local_sources)]
     out_counts = np.zeros((local_sources, world), np.int64)
     pos = 0
@@ -100,6 +110,7 @@ def exchange_ratings(
     ratings: np.ndarray,
     mesh: Mesh,
     n_users: int,
+    offsets: Optional[np.ndarray] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, np.ndarray]:
     """Run the block shuffle through a compiled all_to_all on the mesh.
 
@@ -117,6 +128,12 @@ def exchange_ratings(
     Returns (users, items, ratings, valid) block-sharded device arrays +
     block offsets.  Ratings travel as exact f32 bit patterns (int32
     bitcast), ids as int32 — nothing is rounded through a float payload.
+
+    ``offsets``: explicit ``(world + 1,)`` block boundaries — the
+    capability-weighted uneven layout (parallel/balance
+    .plan_block_offsets); every rank must pass the SAME offsets (they
+    are a pure function of the gathered capability world).  None keeps
+    the uniform ``ceil(n_users / world)`` split.
     """
     if n_users >= 2**31 or (len(items) and int(np.max(items)) >= 2**31):
         raise ValueError(
@@ -129,10 +146,21 @@ def exchange_ratings(
     nproc = jax.process_count()
     local_sources = max(1, world // nproc)
     kpb = max(1, math.ceil(n_users / world))
-    offsets = np.minimum(np.arange(world + 1) * kpb, n_users)
+    if offsets is None:
+        offsets = np.minimum(np.arange(world + 1) * kpb, n_users)
+        bucket_offsets = None
+    else:
+        offsets = np.asarray(offsets, np.int64)
+        if len(offsets) != world + 1 or int(offsets[-1]) != n_users:
+            raise ValueError(
+                f"offsets must be (world+1,)={world + 1} entries ending "
+                f"at n_users={n_users}, got {offsets!r}"
+            )
+        bucket_offsets = offsets
 
     buckets, counts_local = _local_bucket_build(
-        users, items, ratings, kpb, world, local_sources
+        users, items, ratings, kpb, world, local_sources,
+        offsets=bucket_offsets,
     )
 
     # exchange bucket sizes (host metadata, ~ the reference's

@@ -229,6 +229,14 @@ def finalize_fit(summary) -> None:
     from oap_mllib_tpu.telemetry import fleet as _fleet
 
     _fleet.finalize_fit(summary, root)
+    # balance fit-boundary hook (parallel/balance.py, ISSUE 15): land
+    # the ``balance`` block (plan origin/weights/extents + the re-plan
+    # decision trail + any supervisor hint) and a ``balance`` child
+    # span, then reset the controller's per-fit state.  One None-check
+    # when no plan is active.
+    from oap_mllib_tpu.parallel import balance as _balance
+
+    _balance.finalize_fit(summary, root)
     _metrics.counter(
         "oap_fit_total", {"fit": root.name},
         help="Completed fits by root span name",

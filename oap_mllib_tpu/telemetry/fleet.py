@@ -64,6 +64,11 @@ FRAME_FIELDS = (
     "bytes_staged",
     "retries",
     "kernel_dispatch_s",
+    # ISSUE 15 (capability-weighted sharding): rows this rank actually
+    # processed in the pass, and its capability weight (0 = not probed)
+    # — assignment vs achievement, side by side
+    "rows",
+    "capability",
 )
 
 # metric family per frame field (Prometheus naming: unit suffixes)
@@ -75,6 +80,8 @@ _FIELD_METRICS = {
     "bytes_staged": "oap_fleet_bytes_staged",
     "retries": "oap_fleet_retries",
     "kernel_dispatch_s": "oap_fleet_kernel_dispatch_seconds",
+    "rows": "oap_fleet_rows",
+    "capability": "oap_fleet_capability",
 }
 
 _STATS = ("min", "max", "mean", "p99")
@@ -130,6 +137,8 @@ def local_frame(stats, pass_wall_s: float) -> np.ndarray:
     """This rank's stat frame for one finished pass, from the pass's
     PrefetchStats + the process registry — shape ``(len(FRAME_FIELDS),)``
     float64, identical on every rank by construction."""
+    from oap_mllib_tpu.parallel import balance
+
     reg = _tm.registry()
     return np.asarray(
         [
@@ -140,6 +149,10 @@ def local_frame(stats, pass_wall_s: float) -> np.ndarray:
             float(stats.bytes_staged),
             reg.family_total("oap_resilience_retries_total"),
             reg.family_total("oap_kernel_dispatch_seconds"),
+            float(stats.rows),
+            # already-gathered/pinned capability only: building a frame
+            # must never trigger a probe or a collective (0 = unknown)
+            balance.cached_capability(),
         ],
         np.float64,
     )
@@ -154,6 +167,8 @@ _state_lock = locktrace.TrackedLock("fleet.state", threading.Lock())
 _window: List[Dict[str, Any]] = []  # per-pass {phase, frames(list), skew}
 _passes = 0
 _rank_wall_totals: Optional[np.ndarray] = None  # per-rank summed pass walls
+_rank_row_totals: Optional[np.ndarray] = None  # per-rank summed rows
+_rank_capability: Optional[np.ndarray] = None  # per-rank weight (last pass)
 _health: Dict[str, Any] = {"fit": "", "step": 0, "ladder": "", "phase": ""}
 
 
@@ -196,12 +211,17 @@ def fold_pass(phase: str, frames: np.ndarray) -> Dict[str, Any]:
         "frames": frames.tolist(),
         "fields": per_field,
     }
-    global _passes, _rank_wall_totals
+    rows = frames[:, FRAME_FIELDS.index("rows")]
+    caps = frames[:, FRAME_FIELDS.index("capability")]
+    global _passes, _rank_wall_totals, _rank_row_totals, _rank_capability
     with _state_lock:
         _passes += 1
         if _rank_wall_totals is None or len(_rank_wall_totals) != world:
             _rank_wall_totals = np.zeros((world,), np.float64)
+            _rank_row_totals = np.zeros((world,), np.float64)
         _rank_wall_totals += walls
+        _rank_row_totals += rows
+        _rank_capability = caps.copy()
         if len(_window) < _WINDOW_CAP:
             _window.append(rec)
         _health["step"] = _passes
@@ -268,6 +288,14 @@ def summary_block() -> Optional[Dict[str, Any]]:
             None if _rank_wall_totals is None
             else np.array(_rank_wall_totals)
         )
+        row_totals = (
+            None if _rank_row_totals is None
+            else np.array(_rank_row_totals)
+        )
+        caps = (
+            None if _rank_capability is None
+            else np.array(_rank_capability)
+        )
     world = window[-1]["world"] if window else 1
     skews = [w["skew_ratio"] for w in window]
     block: Dict[str, Any] = {
@@ -284,6 +312,12 @@ def summary_block() -> Optional[Dict[str, Any]]:
         block["fit_skew_ratio"] = (
             float(totals.max() / mean) if mean > 0 else 1.0
         )
+    # assignment vs achievement (ISSUE 15): what each rank was handed
+    # (capability weight) next to what it actually pushed through
+    if row_totals is not None and len(row_totals) == world:
+        block["per_rank_rows"] = [int(r) for r in row_totals]
+    if caps is not None and len(caps) == world:
+        block["per_rank_capability"] = [round(float(c), 4) for c in caps]
     return block
 
 
@@ -342,11 +376,13 @@ def finalize_fit(summary, root) -> None:
 
 
 def _reset_fit_window() -> None:
-    global _passes, _rank_wall_totals
+    global _passes, _rank_wall_totals, _rank_row_totals, _rank_capability
     with _state_lock:
         _window.clear()
         _passes = 0
         _rank_wall_totals = None
+        _rank_row_totals = None
+        _rank_capability = None
 
 
 # -- live exposition (stdlib http.server, one daemon thread per rank) ---------
@@ -361,12 +397,20 @@ def _healthz_payload() -> Dict[str, Any]:
     from oap_mllib_tpu.telemetry import flightrec
     from oap_mllib_tpu.utils import recovery
 
+    from oap_mllib_tpu.parallel import balance
+
     cfg = get_config()
+    rank = int(cfg.process_id)
     with _state_lock:
         health = dict(_health)
+        rows_done = (
+            int(_rank_row_totals[rank])
+            if _rank_row_totals is not None
+            and rank < len(_rank_row_totals) else 0
+        )
     return {
         "ok": True,
-        "rank": int(cfg.process_id),
+        "rank": rank,
         "world": int(cfg.num_processes),
         "fit": health.get("fit", ""),
         "phase": health.get("phase", ""),
@@ -375,6 +419,10 @@ def _healthz_payload() -> Dict[str, Any]:
         "last_collective": recovery.last_completed(),
         "flight_recorder_seq": flightrec.last_seq(),
         "fleet_passes": health.get("step", 0),
+        # assignment vs achievement (ISSUE 15): this rank's capability
+        # weight next to the rows it has pushed through this fit
+        "capability": balance.cached_capability(),
+        "rows_processed": rows_done,
     }
 
 

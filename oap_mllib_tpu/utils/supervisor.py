@@ -205,6 +205,7 @@ class Supervisor:
         self.attempts: List[Attempt] = []
         self.relaunches = 0
         self.shrinks = 0
+        self.balance_hints: List[Dict[str, Any]] = []
         self._blame_rank: Optional[int] = None
         self._blame_count = 0
 
@@ -307,6 +308,28 @@ class Supervisor:
             ))
         return att
 
+    def _read_balance_hint(self) -> Optional[Dict[str, Any]]:
+        """Consume the straggler controller's persistent-offender hint
+        (parallel/balance.HINT_FILENAME — written by rank 0 when a rank
+        stayed slowest despite re-planning).  Read-and-remove: a hint
+        names ONE world's offender and must not carry into the next
+        attempt's bookkeeping."""
+        path = os.path.join(self.crash_dir, "balance.hint.json")
+        if not os.path.exists(path):
+            return None
+        try:
+            import json
+
+            with open(path) as f:
+                hint = json.load(f)
+        except Exception:  # noqa: BLE001 — a torn hint is no hint
+            hint = None
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return hint if isinstance(hint, dict) else None
+
     # -- the supervision loop ------------------------------------------------
 
     def run(self) -> Dict[str, Any]:
@@ -331,6 +354,15 @@ class Supervisor:
             outs = self._reap(procs)
             att = self._classify(attempt, world, procs, outs)
             self.attempts.append(att)
+            hint = self._read_balance_hint()
+            if hint is not None:
+                self.balance_hints.append(hint)
+                log.warning(
+                    "supervisor: balance hint — rank %s was a persistent "
+                    "straggler (skew %s over %s passes)",
+                    hint.get("rank"), hint.get("skew_ratio"),
+                    hint.get("streak_passes"),
+                )
             if att.ok and clean:
                 return self._summary(True, world, outs)
             culprit = att.culprit()
@@ -350,6 +382,18 @@ class Supervisor:
                 self._blame_count += 1
             else:
                 self._blame_rank, self._blame_count = culprit, 1
+            # a balance hint naming the culprit counts as one more vote
+            # toward the shrink threshold: the controller already proved
+            # the rank was dragging the world BEFORE it died, so the
+            # supervisor stops giving it relaunch benefit-of-the-doubt
+            if (hint is not None and culprit is not None
+                    and int(hint.get("rank", -1)) == culprit):
+                self._blame_count += 1
+                log.warning(
+                    "supervisor: culprit rank %d matches the balance "
+                    "hint — blame count now %d/%d",
+                    culprit, self._blame_count, self.shrink_after,
+                )
             if (self._blame_count >= self.shrink_after and world > 1
                     and culprit is not None):
                 world -= 1
@@ -392,6 +436,7 @@ class Supervisor:
             "relaunches": self.relaunches,
             "restart_budget": self.restart_budget,
             "shrinks": self.shrinks,
+            "balance_hints": list(self.balance_hints),
             "attempts": [a.as_dict() for a in self.attempts],
             "outputs": list(outs),
         }

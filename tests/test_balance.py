@@ -1,0 +1,466 @@
+"""Capability-weighted sharding units (ISSUE 15): probe determinism +
+pin grammar, planner properties, balanced source views (live re-plan,
+weight lockstep, resilience re-chunk), the straggler controller, block
+offsets, and the summary/fleet exposure."""
+
+import json
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.data.stream import ChunkSource
+from oap_mllib_tpu.models.kmeans import KMeans
+from oap_mllib_tpu.parallel import balance
+from oap_mllib_tpu.telemetry import fleet
+from oap_mllib_tpu.utils import dispatch
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    balance._reset_for_tests()
+    fleet._reset_for_tests()
+    yield
+    balance._reset_for_tests()
+    fleet._reset_for_tests()
+
+
+def _capworld(*caps, hbm=0, host=0):
+    return balance.fold_world(np.asarray(
+        [[c, 1.0, hbm, host] for c in caps], np.float64
+    ))
+
+
+F = len(fleet.FRAME_FIELDS)
+
+
+def _frames(walls, rows=None):
+    out = np.ones((len(walls), F), np.float64)
+    out[:, 0] = walls
+    if rows is not None:
+        out[:, fleet.FRAME_FIELDS.index("rows")] = rows
+    return out
+
+
+class TestKnobs:
+    def test_capability_sharding_modes(self):
+        assert balance.armed(1) is False  # auto, single process
+        assert balance.armed(2) is True
+        set_config(capability_sharding="on")
+        assert balance.armed(1) is True
+        set_config(capability_sharding="off")
+        assert balance.armed(8) is False
+
+    def test_capability_sharding_typo_raises(self):
+        set_config(capability_sharding="onn")
+        with pytest.raises(ValueError, match="capability_sharding"):
+            balance.armed(2)
+
+    def test_rebalance_threshold_validates(self):
+        set_config(rebalance_threshold=1.0)
+        with pytest.raises(ValueError, match="rebalance_threshold"):
+            balance.rebalance_threshold_cfg()
+
+    def test_rebalance_patience_validates(self):
+        set_config(rebalance_patience=0)
+        with pytest.raises(ValueError, match="rebalance_patience"):
+            balance.rebalance_patience_cfg()
+
+
+class TestProbe:
+    def test_probe_deterministic_cached(self):
+        a = dispatch.throughput_probe(0)
+        b = dispatch.throughput_probe(0)
+        assert a == b  # cached per process
+        assert a > 0
+
+    def test_pinned_bare_float(self):
+        set_config(rank_capability="0.25")
+        assert dispatch.pinned_capability() == 0.25
+
+    def test_pinned_map_covers_this_rank(self):
+        set_config(rank_capability="0:0.75,1:0.25")
+        # the suite runs as process_index 0
+        assert dispatch.pinned_capability() == 0.75
+
+    def test_pinned_map_missing_rank_falls_back_to_probe(self):
+        set_config(rank_capability="7:0.25")
+        assert dispatch.pinned_capability() is None
+        cap, origin = dispatch.rank_capability()
+        assert origin == "probe" and cap > 0
+
+    def test_pinned_typo_raises(self):
+        set_config(rank_capability="fast")
+        with pytest.raises(ValueError, match="rank_capability"):
+            dispatch.pinned_capability()
+
+    def test_pinned_nonpositive_raises(self):
+        set_config(rank_capability="0")
+        with pytest.raises(ValueError, match="> 0"):
+            dispatch.pinned_capability()
+
+    def test_rank_capability_origin_pinned(self):
+        set_config(rank_capability="2.0")
+        assert dispatch.rank_capability() == (2.0, "pinned")
+
+
+class TestFoldWorld:
+    def test_normalizes_to_mean_one(self):
+        cw = _capworld(2.0, 1.0, 1.0)
+        assert cw.weights.mean() == pytest.approx(1.0)
+        assert cw.weights[0] == pytest.approx(1.5)
+        assert cw.origin == "pinned"
+
+    def test_rejects_wrong_shape(self):
+        with pytest.raises(ValueError, match="capability frame"):
+            balance.fold_world(np.zeros((2, 3)))
+
+    def test_mixed_origins(self):
+        cw = balance.fold_world(
+            np.asarray([[1.0, 1, 0, 0], [1.0, 0, 0, 0]])
+        )
+        assert cw.origin == "mixed"
+
+
+class TestPlanExtents:
+    def test_sum_to_n_and_quantized(self):
+        ext, over = balance.plan_extents(1000, 100, [1.0, 0.25])
+        assert not over
+        assert sum(r for _, r in ext) == 1000
+        assert ext[0] == (0, 800) and ext[1] == (800, 200)
+
+    def test_world_one_degenerates_to_equal(self):
+        ext, over = balance.plan_extents(12345, 256, [3.7])
+        assert ext == [(0, 12345)] and not over
+
+    def test_equal_weights_equal_chunks(self):
+        ext, _ = balance.plan_extents(4096, 256, [1.0, 1.0])
+        assert ext[0][1] == ext[1][1] == 2048
+
+    def test_caps_respected_with_redistribution(self):
+        ext, over = balance.plan_extents(
+            1000, 100, [1.0, 1.0, 1.0], caps_rows=[200, 0, 0]
+        )
+        assert not over
+        assert ext[0][1] == 200  # capped rank saturates
+        assert sum(r for _, r in ext) == 1000
+
+    def test_infeasible_caps_overflow_loudly(self):
+        ext, over = balance.plan_extents(
+            1000, 100, [1.0, 1.0], caps_rows=[100, 100]
+        )
+        assert over
+        assert sum(r for _, r in ext) == 1000
+
+    def test_world_one_over_cap_flag(self):
+        _, over = balance.plan_extents(1000, 100, [1.0], caps_rows=[500])
+        assert over
+
+    def test_zero_weight_rank_floored_not_starved(self):
+        ext, _ = balance.plan_extents(10000, 100, [1.0, 1e-12])
+        assert ext[1][1] >= 0  # floor keeps the plan valid
+        assert sum(r for _, r in ext) == 10000
+
+    def test_invalid_inputs_raise(self):
+        with pytest.raises(ValueError):
+            balance.plan_extents(0, 100, [1.0])
+        with pytest.raises(ValueError):
+            balance.plan_extents(100, 0, [1.0])
+
+
+class TestBlockOffsets:
+    def test_deadband_keeps_uniform(self):
+        assert balance.plan_block_offsets(1000, [1.0, 1.02]) is None
+        assert balance.plan_block_offsets(1000, [1.0]) is None
+
+    def test_weighted_offsets_monotone_nonempty(self):
+        off = balance.plan_block_offsets(1000, [1.0, 0.25, 0.25])
+        assert off is not None
+        assert off[0] == 0 and off[-1] == 1000
+        assert all(np.diff(off) >= 1)
+        assert off[1] - off[0] > off[2] - off[1]  # fast rank, bigger block
+
+    def test_block_offsets_disarmed_returns_none(self):
+        set_config(capability_sharding="off")
+        assert balance.block_offsets(1000, 4) is None
+
+    def test_block_offsets_with_injected_capworld(self):
+        cw = _capworld(1.0, 0.25)
+        off = balance.block_offsets(1000, 2, capworld=cw)
+        assert off is not None and off[1] == 800
+
+    def test_block_offsets_irregular_slots_keep_uniform(self):
+        cw = _capworld(1.0, 0.25)
+        assert balance.block_offsets(1000, 3, capworld=cw) is None
+
+    def test_block_offsets_hbm_priced(self):
+        # fast rank with tiny HBM: its key share caps at the budget
+        frames = np.asarray([
+            [4.0, 1.0, 10_000, 0],  # fast, 10 KB HBM
+            [1.0, 1.0, 0, 0],  # slow, unbounded
+        ])
+        cw = balance.fold_world(frames)
+        off = balance.block_offsets(10000, 2, bytes_per_key=100,
+                                    capworld=cw)
+        assert off is not None
+        # cap = 10_000 * fraction / 100 = 25 keys for rank 0
+        assert off[1] - off[0] <= 25 + 1
+
+
+class TestHostCaps:
+    def test_disk_backed_uncapped(self):
+        cw = _capworld(1.0, 1.0, host=1 << 20)
+        assert balance.host_caps_rows(cw, 100, "disk") is None
+        assert balance.host_caps_rows(cw, 0, "memory") is None
+
+    def test_memory_backed_capped_by_host_budget(self):
+        cw = _capworld(1.0, 1.0, host=1 << 20)
+        caps = balance.host_caps_rows(cw, 1024, "memory")
+        assert caps is not None
+        assert caps[0] == int((1 << 20) * balance._HOST_FRACTION / 1024)
+
+
+class TestBalancedView:
+    def test_identity_plan_matches_plain_source(self):
+        x = np.arange(1000 * 3, dtype=np.float32).reshape(1000, 3)
+        set_config(capability_sharding="off")
+        src = balance.local_sources(x, chunk_rows=128)
+        plain = ChunkSource.from_array(x, chunk_rows=128)
+        got = [(c.copy(), v) for c, v in src]
+        want = [(c.copy(), v) for c, v in plain]
+        assert len(got) == len(want)
+        for (cg, vg), (cw_, vw) in zip(got, want):
+            assert vg == vw
+            np.testing.assert_array_equal(cg, cw_)
+        assert isinstance(src, ChunkSource)  # models route it streamed
+
+    def test_extents_partition_rows_across_ranks(self):
+        x = np.arange(1000 * 2, dtype=np.float32).reshape(1000, 2)
+        cw = _capworld(1.0, 0.25)
+        set_config(capability_sharding="on")
+        plan = balance.make_plan(1000, 128, world=2, capworld=cw)
+        v0 = balance.BalancedView(x, plan, 128, rank=0)
+        v1 = balance.BalancedView(x, plan, 128, rank=1)
+        rows = np.concatenate([v0.to_array(), v1.to_array()])
+        np.testing.assert_array_equal(rows, x)
+        assert v0.n_rows > v1.n_rows
+
+    def test_replan_takes_effect_next_pass(self):
+        x = np.zeros((1024, 2), np.float32)
+        cw = _capworld(1.0, 1.0)
+        set_config(capability_sharding="on")
+        plan = balance.make_plan(1024, 128, world=2, capworld=cw)
+        v1 = balance.BalancedView(x, plan, 128, rank=1)
+        assert sum(1 for _ in v1) == 4  # 512 rows / 128
+        new_ext, _ = balance.plan_extents(1024, 128, [3.0, 1.0])
+        plan.set_extents(new_ext, np.asarray([1.5, 0.5]))
+        assert sum(1 for _ in v1) == 2  # 256 rows after the re-plan
+        assert v1.n_rows == 256
+
+    def test_weight_view_lockstep(self):
+        x = np.random.default_rng(0).normal(size=(700, 4)).astype(
+            np.float32)
+        w = np.ones(700)
+        set_config(capability_sharding="off")
+        src, wsrc = balance.local_sources(x, w, chunk_rows=128)
+        assert isinstance(wsrc, ChunkSource)
+        assert wsrc.n_features == 1
+        assert wsrc.chunk_rows == src.chunk_rows
+        assert wsrc.n_rows == src.n_rows
+
+    def test_with_chunk_rows_stays_aligned(self):
+        x = np.zeros((1024, 2), np.float32)
+        set_config(capability_sharding="off")
+        src = balance.local_sources(x, chunk_rows=256)
+        halved = src.with_chunk_rows(128)
+        assert isinstance(halved, balance.BalancedView)
+        assert halved.chunk_rows == 128
+        assert halved.to_array().shape == (1024, 2)
+
+    def test_mismatched_weight_length_raises(self):
+        with pytest.raises(ValueError, match="sample_weight rows"):
+            balance.local_sources(
+                np.zeros((10, 2)), np.ones(5), chunk_rows=4
+            )
+
+
+class TestController:
+    def _plan(self, world=2, n=30000, chunk=512):
+        cw = _capworld(*([1.0] * world))
+        set_config(capability_sharding="on")
+        return balance.make_plan(n, chunk, world=world, capworld=cw)
+
+    def test_replan_after_patience(self):
+        set_config(rebalance_threshold=1.4, rebalance_patience=2)
+        plan = self._plan()
+        rows = [e[1] for e in plan.extents()]
+        fr = _frames([1.0, 4.0], rows=rows)
+        assert balance.observe_pass("lloyd_loop", fr) is None  # pass 1
+        dec = balance.observe_pass("lloyd_loop", fr)  # pass 2 = patience
+        assert dec is not None
+        assert dec["slowest_rank"] == 1
+        assert dec["new_extents"][1][1] < dec["old_extents"][1][1]
+        assert sum(r for _, r in plan.extents()) == 30000
+
+    def test_below_threshold_never_replans(self):
+        set_config(rebalance_threshold=1.5, rebalance_patience=1)
+        plan = self._plan()
+        fr = _frames([1.0, 1.2], rows=[e[1] for e in plan.extents()])
+        for _ in range(6):
+            assert balance.observe_pass("lloyd_loop", fr) is None
+
+    def test_falling_trend_suppresses(self):
+        # patience 4 so the trend window (4 passes) is computable at
+        # the would-be trigger: a steadily-shrinking skew (a cold-cache
+        # relaunch warming up) must NOT trigger a re-plan
+        set_config(rebalance_threshold=1.4, rebalance_patience=4)
+        plan = self._plan()
+        rows = [e[1] for e in plan.extents()]
+        for wall in (64.0, 24.0, 10.0, 5.0, 3.5, 3.0):
+            dec = balance.observe_pass(
+                "lloyd_loop", _frames([1.0, wall], rows=rows)
+            )
+            assert dec is None
+
+    def test_init_phase_never_replans(self):
+        set_config(rebalance_threshold=1.2, rebalance_patience=1)
+        plan = self._plan()
+        fr = _frames([1.0, 5.0], rows=[e[1] for e in plan.extents()])
+        for _ in range(4):
+            assert balance.observe_pass("init_centers", fr) is None
+
+    def test_disarmed_ignores_frames(self):
+        set_config(capability_sharding="off")
+        assert balance.observe_pass("lloyd_loop", _frames([1, 9])) is None
+
+    def test_decisions_deterministic(self):
+        def run():
+            balance._reset_for_tests()
+            set_config(rebalance_threshold=1.4, rebalance_patience=2)
+            plan = self._plan()
+            fr = _frames([1.0, 4.0],
+                         rows=[e[1] for e in plan.extents()])
+            decs = []
+            for _ in range(6):
+                d = balance.observe_pass("lloyd_loop", fr)
+                if d:
+                    decs.append(d)
+            return plan.extents(), decs
+
+        a = run()
+        b = run()
+        assert a == b
+
+    def test_persistent_straggler_writes_hint(self, tmp_path):
+        set_config(rebalance_threshold=1.4, rebalance_patience=1,
+                   crash_dir=str(tmp_path))
+        plan = self._plan()
+        rows = [e[1] for e in plan.extents()]
+        for _ in range(6):  # streak >= 2*patience after a replan
+            balance.observe_pass(
+                "lloyd_loop", _frames([1.0, 4.0], rows=rows)
+            )
+        hint_path = tmp_path / balance.HINT_FILENAME
+        assert hint_path.exists()
+        hint = json.loads(hint_path.read_text())
+        assert hint["rank"] == 1
+        assert hint["schema"] == 1
+
+    def test_replan_capped_at_max(self):
+        set_config(rebalance_threshold=1.1, rebalance_patience=1)
+        plan = self._plan()
+        for _ in range(balance._MAX_REPLANS + 10):
+            balance.observe_pass(
+                "lloyd_loop",
+                _frames([1.0, 4.0],
+                        rows=[e[1] for e in plan.extents()]),
+            )
+        assert len(balance.decisions()) <= balance._MAX_REPLANS
+
+
+class TestSupervisorHint:
+    def test_supervisor_consumes_hint(self, tmp_path):
+        from oap_mllib_tpu.utils.supervisor import Supervisor
+
+        (tmp_path / balance.HINT_FILENAME).write_text(
+            json.dumps({"schema": 1, "rank": 0, "skew_ratio": 3.0,
+                        "streak_passes": 4})
+        )
+        sup = Supervisor(
+            lambda r, w, c, a: ["true"], world=1,
+            crash_dir=str(tmp_path), restart_budget=0,
+        )
+        hint = sup._read_balance_hint()
+        assert hint is not None and hint["rank"] == 0
+        assert not (tmp_path / balance.HINT_FILENAME).exists()  # consumed
+        assert sup._read_balance_hint() is None
+
+
+class TestFitIntegration:
+    def _x(self, rows=3000, d=8):
+        return np.random.default_rng(0).normal(size=(rows, d)).astype(
+            np.float32)
+
+    def test_balanced_fit_lands_summary_and_span(self):
+        set_config(capability_sharding="on", fleet_stats="on")
+        src = balance.local_sources(self._x(), chunk_rows=300)
+        m = KMeans(k=3, seed=0, init_mode="random", max_iter=3,
+                   tol=0.0).fit(src)
+        blk = m.summary.balance
+        assert blk["enabled"] is True
+        assert blk["world"] == 1
+        assert blk["extents"] == [[0, 3000]]
+        assert blk["origin"] in ("probe", "pinned")
+        assert blk["replans"] == []
+        names = [c["name"] for c in m.summary.telemetry["spans"]["children"]]
+        assert "balance" in names
+        # fleet exposure: assignment vs achievement
+        assert m.summary.fleet["per_rank_rows"] is not None
+        assert m.summary.fleet["per_rank_capability"][0] > 0
+
+    def test_controller_state_resets_between_fits(self):
+        set_config(capability_sharding="on", fleet_stats="on")
+        src = balance.local_sources(self._x(), chunk_rows=300)
+        KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(src)
+        assert balance.decisions() == []  # finalize drained it
+        m = KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(src)
+        assert m.summary.balance["passes_observed"] >= 2
+
+    def test_disarmed_fit_has_no_balance_block(self):
+        set_config(capability_sharding="off")
+        src = ChunkSource.from_array(self._x(), chunk_rows=300)
+        m = KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(src)
+        assert not hasattr(m.summary, "balance")
+
+    def test_balanced_pca_fit(self):
+        from oap_mllib_tpu.models.pca import PCA
+
+        set_config(capability_sharding="on", fleet_stats="on")
+        src = balance.local_sources(self._x(rows=2000), chunk_rows=500)
+        model = PCA(k=2).fit(src)
+        s = model.summary
+        blk = s.get("balance") if isinstance(s, dict) else s.balance
+        assert blk["extents"] == [[0, 2000]]
+
+    def test_healthz_carries_capability_and_rows(self):
+        from oap_mllib_tpu.telemetry.fleet import _healthz_payload
+
+        set_config(capability_sharding="on", fleet_stats="on")
+        src = balance.local_sources(self._x(), chunk_rows=300)
+        KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(src)
+        hz = _healthz_payload()
+        assert "capability" in hz
+        assert "rows_processed" in hz
+        assert hz["capability"] > 0
+
+
+class TestFrameExposure:
+    def test_local_frame_carries_rows_and_capability(self):
+        from oap_mllib_tpu.data.prefetch import PrefetchStats
+
+        stats = PrefetchStats()
+        stats.rows = 777
+        frame = fleet.local_frame(stats, 1.0)
+        named = dict(zip(fleet.FRAME_FIELDS, frame))
+        assert named["rows"] == 777
+        assert "capability" in named  # 0.0 when nothing probed yet

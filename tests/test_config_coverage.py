@@ -271,6 +271,36 @@ class TestConfigCoverage:
         with pytest.raises(ValueError, match="flight_recorder"):
             flightrec.record("span_open", "x")
 
+    def test_capability_sharding_typo_raises(self):
+        """The kmeans_kernel contract for the balance plane (ISSUE 15):
+        a typo'd mode raises at the armed() check, not silently keeping
+        equal shards."""
+        from oap_mllib_tpu.parallel import balance
+
+        set_config(capability_sharding="weighted")
+        with pytest.raises(ValueError, match="capability_sharding"):
+            balance.armed(2)
+
+    def test_rank_capability_typo_raises(self):
+        from oap_mllib_tpu.utils import dispatch
+
+        set_config(rank_capability="slow")
+        with pytest.raises(ValueError, match="rank_capability"):
+            dispatch.pinned_capability()
+        set_config(rank_capability="-1.0")
+        with pytest.raises(ValueError, match="> 0"):
+            dispatch.pinned_capability()
+
+    def test_rebalance_knobs_validate(self):
+        from oap_mllib_tpu.parallel import balance
+
+        set_config(rebalance_threshold=0.9)
+        with pytest.raises(ValueError, match="rebalance_threshold"):
+            balance.rebalance_threshold_cfg()
+        set_config(rebalance_threshold=1.5, rebalance_patience=0)
+        with pytest.raises(ValueError, match="rebalance_patience"):
+            balance.rebalance_patience_cfg()
+
     def test_supervisor_knobs_reach_supervisor(self, tmp_path):
         """restart_budget / restart_backoff / shrink_after flow into
         Supervisor defaults (utils/supervisor.py)."""

@@ -569,6 +569,71 @@ def test_r17_names_the_unbound_literal_and_its_origin():
     assert "'rows'" in f.detail and f.line == 5
 
 
+BAL = "oap_mllib_tpu/parallel/fake_balance.py"
+
+
+def test_r16_balance_scope_rank_gated_capability_sync():
+    """ISSUE 15: the capability allgather must be rank-uniform — a
+    planner-shaped module gating ops/stream_ops.capability_sync (which
+    transitively reaches the host allgather) on process_index is
+    exactly the hang R16 exists to catch, and parallel/ is in scope."""
+    text = (
+        "import jax\n"
+        "from oap_mllib_tpu.ops import stream_ops\n\n\n"
+        "def world_capabilities(frame):\n"
+        "    if jax.process_index() == 0:\n"
+        "        return stream_ops.capability_sync(frame)\n"
+        "    return None\n"
+    )
+    found = lint(BAL, text, rules=["collective-divergence"])
+    assert [f.line for f in found] == [7]
+    assert "capability_sync" in found[0].detail
+    assert "process_index" in found[0].detail
+
+
+def test_r16_balance_rank_derived_extent_loop_flagged():
+    """A planner iterating rank-derived extents around a collective
+    diverges trip counts — same hang, more steps."""
+    text = (
+        "import jax\n"
+        "from oap_mllib_tpu.ops import stream_ops\n\n\n"
+        "def replan(arrays, extents):\n"
+        "    mine = extents[jax.process_index()]\n"
+        "    for _ in range(mine):\n"
+        "        arrays = stream_ops._psum_host(arrays)\n"
+        "    return arrays\n"
+    )
+    found = lint(BAL, text, rules=["collective-divergence"])
+    assert [f.line for f in found] == [8]
+
+
+def test_r16_balance_gathered_decision_is_clean():
+    """The live controller's shape: branching on GATHERED (therefore
+    rank-identical) frames before a collective is world-uniform."""
+    text = (
+        "import numpy as np\n"
+        "from oap_mllib_tpu.ops import stream_ops\n\n\n"
+        "def observe(frame, arrays):\n"
+        "    gathered = stream_ops.capability_sync(frame)\n"
+        "    if float(np.asarray(gathered).max()) > 1.5:\n"
+        "        return stream_ops._psum_host(arrays)\n"
+        "    return arrays\n"
+    )
+    assert lint(BAL, text, rules=["collective-divergence"]) == []
+
+
+def test_r17_balance_scope_unbound_axis():
+    """R17 covers parallel/balance-shaped modules: a collective whose
+    axis resolves to no mesh binding is flagged there too."""
+    text = (
+        "from oap_mllib_tpu.parallel import collective\n\n\n"
+        "def fold(x):\n"
+        "    return collective.psum(x, 'balance_axis')\n"
+    )
+    (f,) = lint(BAL, text, rules=["unbound-collective-axis"])
+    assert "'balance_axis'" in f.detail and f.line == 5
+
+
 def test_r18_upcast_and_matmul_consumers_are_clean():
     text = (
         "import jax.numpy as jnp\n"

@@ -939,3 +939,108 @@ class TestServingPlane:
         assert "SERVE_OK rank=0 legs=6" in outs2[0], outs2[0]
         relaunched = _answer_digests(outs2[0])
         assert relaunched == survivor, (relaunched, survivor)
+
+
+_BALANCE_WORKER = os.path.join(
+    os.path.dirname(__file__), "pseudo_cluster_worker_balance.py"
+)
+
+
+class TestHeteroFleet:
+    """ISSUE 15 acceptance: capability-weighted sharding across a REAL
+    2-process world with one deliberately slowed rank — the weighted
+    layout beats the equal layout end-to-end, results stay within 1e-5,
+    the decision trail lands in summary.balance, and the live straggler
+    controller re-plans an initially-equal world mid-fit."""
+
+    # per-chunk sleep on rank 1: equal layout pays ~12 chunks x sleep
+    # per pass, the 1:0.25-weighted layout ~5 — a wide, scheduler-noise
+    # -proof gap across the fit's 9 rollup passes
+    _SLEEP = "0.05"
+
+    def _launch_balance_world(self, mode, timeout=120):
+        procs, outs, elapsed = _launch_world(
+            nproc=2, local_dev=1, timeout=timeout, worker=_BALANCE_WORKER,
+            env_extra={
+                "BALANCE_WORKER_MODE": mode,
+                "BALANCE_CHUNK_SLEEP": self._SLEEP,
+            },
+        )
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"worker failed:\n{out}"
+        return outs
+
+    @staticmethod
+    def _tagged_json(out, tag, rank):
+        line = [
+            ln for ln in out.splitlines()
+            if ln.startswith(f"{tag} rank={rank} ")
+        ]
+        assert line, f"no {tag} line for rank {rank}:\n{out}"
+        return json.loads(line[0].split(" ", 2)[2])
+
+    def test_weighted_layout_beats_equal_with_parity(self):
+        """The capability-weighted world must finish measurably faster
+        than the equal-shard world on the same slowed rank, with
+        centers within 1e-5 and the plan visible in summary.balance."""
+        eq = self._launch_balance_world("equal")
+        wt = self._launch_balance_world("weighted")
+
+        eq_res = [self._tagged_json(eq[r], "RESULT", r) for r in range(2)]
+        wt_res = [self._tagged_json(wt[r], "RESULT", r) for r in range(2)]
+        # world wall = the slowest rank's wall (the pass barrier)
+        eq_wall = max(r["wall_s"] for r in eq_res)
+        wt_wall = max(r["wall_s"] for r in wt_res)
+        assert wt_wall < 0.75 * eq_wall, (
+            f"weighted layout ({wt_wall:.2f}s) did not beat equal "
+            f"({eq_wall:.2f}s) by the required margin"
+        )
+        # parity: same optimization, different reduction grouping
+        c_eq = np.asarray(eq_res[0]["centers"])
+        c_wt = np.asarray(wt_res[0]["centers"])
+        assert np.max(np.abs(c_eq - c_wt)) <= 1e-5
+        assert abs(eq_res[0]["cost"] - wt_res[0]["cost"]) <= 1e-3 * max(
+            abs(eq_res[0]["cost"]), 1.0
+        )
+        # every rank computed the identical plan (rank-uniform contract)
+        blocks = [self._tagged_json(wt[r], "BALANCE", r) for r in range(2)]
+        assert blocks[0] == blocks[1]
+        block = blocks[0]
+        assert block["origin"] == "pinned"
+        assert block["enabled"] is True
+        extents = block["extents"]
+        assert sum(r for _, r in extents) == 6000
+        # rank 1 (capability 0.25) must hold the smaller extent
+        assert extents[1][1] < extents[0][1]
+        # fleet block shows assignment vs achievement side by side
+        rows = self._tagged_json(wt[0], "FLEETROWS", 0)
+        assert rows["per_rank_capability"] is not None
+        assert rows["per_rank_rows"] is not None
+        assert rows["per_rank_rows"][0] > rows["per_rank_rows"][1]
+
+    def test_live_rebalance_shrinks_straggler_extent(self):
+        """An initially-equal world (equal pinned capabilities) must
+        detect the slowed rank from the fleet rollups and re-plan its
+        extents mid-fit — the decision trail in summary.balance."""
+        outs = self._launch_balance_world("rebalance")
+        blocks = [self._tagged_json(outs[r], "BALANCE", r)
+                  for r in range(2)]
+        assert blocks[0] == blocks[1]  # identical decisions on every rank
+        block = blocks[0]
+        replans = block["replans"]
+        assert replans, f"no replan recorded: {json.dumps(block)[:500]}"
+        first = replans[0]
+        assert first["slowest_rank"] == 1
+        assert first["skew_ratio"] > 1.3
+        # the re-planned extent moved rows OFF the straggler
+        assert first["new_extents"][1][1] < first["old_extents"][1][1]
+        final = block["extents"]
+        assert final[1][1] < final[0][1]
+        assert sum(r for _, r in final) == 6000
+        # parity against the equal-shard oracle survives the re-plans
+        eq = self._launch_balance_world("equal")
+        c_eq = np.asarray(
+            self._tagged_json(eq[0], "RESULT", 0)["centers"])
+        c_rb = np.asarray(
+            self._tagged_json(outs[0], "RESULT", 0)["centers"])
+        assert np.max(np.abs(c_eq - c_rb)) <= 1e-5
