@@ -1281,7 +1281,15 @@ def bench_serving(requests: int = 200, sweep_users: int = 1_000_000,
     Leg 2 — full-sweep top-k: ``recommend_for_all_users`` over a
     ``sweep_users``-row synthetic factor table through the streamed,
     prefetch-pipelined sweep (serving/sweep.py) — users/sec with the
-    quadratic score matrix never materialized."""
+    quadratic score matrix never materialized.
+
+    Leg 3 — multi-process fleet storm (ISSUE 16): a REAL 2-replica
+    world (tests/pseudo_cluster_worker_traffic.py, bench mode) drives
+    sustained jittered storms through each replica's async
+    TrafficQueue; the ``serving_kmeans_qps_mp`` headline is the
+    fleet-aggregate QPS.  Hosts that cannot spawn a multiprocess jax
+    world WARN and skip the leg (bench_regress is name-keyed and
+    warn-skips absent metrics)."""
     import numpy as np
 
     from oap_mllib_tpu import serving
@@ -1338,11 +1346,107 @@ def bench_serving(requests: int = 200, sweep_users: int = 1_000_000,
             sweep_users=nu, n_items=ni, rank=r, top_k=topk,
             sweep_wall_sec=round(sweep_wall, 2),
         )
+    # the fleet leg only prices into emitting runs — in-process callers
+    # (dev/serve_gate.py leg 5) measure the single-process storm only
+    mp = bench_serving_mp(emit=True) if emit else None
     return {
         "qps": qps, "p50_s": p50, "p99_s": p99,
         "steady_compiles": steady_compiles,
         "users_per_sec": users_per_sec,
+        "qps_mp": None if mp is None else mp["qps_mp"],
     }
+
+
+# environment-incapability signatures (mirrors tests/test_pseudo_cluster
+# .py): a worker that died on one of these means this HOST cannot form
+# a multiprocess jax world — warn + skip, not a bench failure
+_MP_ENV_FAILURE_MARKERS = (
+    "Multiprocess computations aren't implemented",
+    "UNIMPLEMENTED",
+    "Unable to initialize backend",
+    "failed to join world",
+    "DEADLINE_EXCEEDED",
+    "Failed to connect to coordinator",
+)
+
+
+def bench_serving_mp(nproc: int = 2, requests: int = 200,
+                     emit: bool = True):
+    """Fleet-QPS headline: spawn ``nproc`` bench-mode traffic workers
+    as a real multi-process world, parse each replica's ``BENCH_QPS``
+    line, and emit the aggregate as ``serving_kmeans_qps_mp``.
+    Returns None (after a WARN) when this host cannot spawn the world
+    — the regression harness warn-skips metrics absent from a run."""
+    import subprocess
+    import tempfile
+
+    from oap_mllib_tpu.parallel.bootstrap import free_port
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "pseudo_cluster_worker_traffic.py")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["TRAFFIC_WORKER_MODE"] = "bench"
+    env["TRAFFIC_BENCH_REQUESTS"] = str(requests)
+    with tempfile.TemporaryDirectory() as crash_dir:
+        env["TRAFFIC_CRASH_DIR"] = crash_dir
+        coord = f"127.0.0.1:{free_port('127.0.0.1', 4000)}"
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, str(r), str(nproc), coord, "1"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=repo,
+            )
+            for r in range(nproc)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=300)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+    per_rank = []
+    for p, out in zip(procs, outs):
+        if any(m in out for m in _MP_ENV_FAILURE_MARKERS):
+            print("WARN: serving_kmeans_qps_mp skipped — this host "
+                  "cannot form a multiprocess jax world",
+                  file=sys.stderr)
+            return None
+        if p.returncode != 0:
+            print("WARN: serving_kmeans_qps_mp skipped — bench worker "
+                  f"exited {p.returncode}:\n{out[-1500:]}",
+                  file=sys.stderr)
+            return None
+        line = [ln for ln in out.splitlines()
+                if ln.startswith("BENCH_QPS ")]
+        if not line:
+            print("WARN: serving_kmeans_qps_mp skipped — no BENCH_QPS "
+                  f"line:\n{out[-1500:]}", file=sys.stderr)
+            return None
+        per_rank.append(
+            dict(kv.split("=", 1) for kv in line[-1].split()[1:])
+        )
+    # every replica stormed concurrently: the fleet answers the SUM of
+    # the per-replica rates; the tail is the worst replica's tail
+    qps_mp = sum(float(r["qps"]) for r in per_rank)
+    p50_ms = max(float(r["p50_ms"]) for r in per_rank)
+    p99_ms = max(float(r["p99_ms"]) for r in per_rank)
+    if emit:
+        _emit(
+            "serving_kmeans_qps_mp", qps_mp, "req/sec", 0.0,
+            nproc=nproc, requests_per_replica=requests,
+            per_replica_qps=[round(float(r["qps"]), 1) for r in per_rank],
+            p50_ms=round(p50_ms, 3), p99_ms=round(p99_ms, 3),
+        )
+    return {"qps_mp": qps_mp, "p50_ms": p50_ms, "p99_ms": p99_ms}
 
 
 def main():

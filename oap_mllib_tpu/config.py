@@ -350,6 +350,38 @@ class Config:
     # models' chunked top-k uses), so the (chunk, n_items) score block
     # stays bounded whatever the table sizes.  Negative raises.
     sweep_chunk_rows: int = 0
+    # -- traffic plane (serving/traffic.py): async ingestion, admission,
+    #    replica scaling ------------------------------------------------------
+    # Max pending async requests a TrafficQueue holds before submit
+    # sheds with ShedError(reason="queue_full") + oap_serve_shed_total.
+    # The bound is requests (not rows): it caps dispatcher latency per
+    # pump cycle.  Must be >= 1; a typo raises at submit time.
+    serve_queue_depth: int = 256
+    # Default per-request deadline in milliseconds for submits that
+    # don't pass deadline_ms explicitly.  Requests still pending past
+    # their deadline are shed before dispatch (their future raises
+    # ShedError(reason="deadline")) — never scored dead.  0 (default) =
+    # no deadline; negative raises.
+    serve_deadline_ms: float = 0.0
+    # Fraction of the resolved HBM budget (utils/membudget.Budgets —
+    # memory_budget_hbm or auto-detect) the traffic queue's staged
+    # working set may claim before submit sheds with
+    # ShedError(reason="budget"): pending + incoming request bytes x
+    # the planner's overhead fudge > hbm_budget x this headroom =>
+    # shed instead of OOM.  Only armed when the budget resolves > 0
+    # (an unbounded budget prices nothing).  Must be in (0, 1]; a typo
+    # raises at submit time.
+    serve_shed_headroom: float = 0.5
+    # Scale-out trigger for the serving replica controller
+    # (serving/traffic.ScaleController): windowed mean queue depth PER
+    # REPLICA above this (with a non-falling depth trend) votes one
+    # replica out, booked in oap_serve_scale_out_total and the
+    # supervisor sideband hint.  Must be > 0.
+    serve_scale_high: float = 32.0
+    # Scale-in trigger: a fleet idle (zero queue depth, no new
+    # requests) for this many seconds sheds one replica down to the
+    # controller's floor.  Must be > 0.
+    serve_scale_idle_s: float = 30.0
     # -- telemetry layer (oap_mllib_tpu/telemetry/) --------------------------
     # jax.profiler trace directory: non-empty wraps every estimator fit
     # in a profiler trace written there (utils/profiling.maybe_trace),
@@ -432,6 +464,15 @@ class Config:
     # deployments — ranks absent from the map fall back to the probe).
     # Values must be > 0; a typo raises.
     rank_capability: str = ""
+    # Capability-probe generation.  The probe cache
+    # (utils/dispatch.throughput_probe, parallel/balance
+    # .world_capabilities) is keyed by this epoch: bumping it
+    # invalidates every cached measurement so the next consult
+    # re-probes.  The supervisor (utils/supervisor.py) sets
+    # OAP_MLLIB_TPU_PROBE_EPOCH to the attempt number on every
+    # (re)launch, so a relaunched rank measures its CURRENT capability
+    # instead of trusting its pre-preemption value.  Default 0.
+    probe_epoch: int = 0
     # Live straggler rebalancing trigger (parallel/balance.py, riding
     # the fleet rollups): when a pass's skew ratio (max/mean per-rank
     # pass wall) exceeds this for rebalance_patience consecutive passes

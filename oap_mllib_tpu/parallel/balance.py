@@ -244,20 +244,22 @@ def fold_world(gathered) -> CapabilityWorld:
 
 
 _sync_lock = locktrace.TrackedLock("balance.sync", threading.Lock())
-_sync_cache: Dict[int, CapabilityWorld] = {}
+_sync_cache: Dict[tuple, CapabilityWorld] = {}
 
 
 def world_capabilities(world: Optional[int] = None) -> CapabilityWorld:
-    """The gathered capability world, allgathered once per world size
-    and cached (the "once at fit start" contract: the first armed plan
-    of a process pays one probe + one tiny fixed-shape allgather; every
-    later plan reads the cache).  Fits are serialized per process, so
-    the gather itself runs outside the cache lock (no collective under
-    a lock — the R21 contract) without risking a divergent double
-    gather."""
+    """The gathered capability world, allgathered once per (world size,
+    ``Config.probe_epoch``) and cached (the "once at fit start"
+    contract: the first armed plan of a process pays one probe + one
+    tiny fixed-shape allgather; every later plan reads the cache, and a
+    supervisor-bumped epoch invalidates it so relaunched ranks
+    re-measure).  Fits are serialized per process, so the gather itself
+    runs outside the cache lock (no collective under a lock — the R21
+    contract) without risking a divergent double gather."""
     world = _world() if world is None else int(world)
+    key = (world, int(get_config().probe_epoch))
     with _sync_lock:
-        cached = _sync_cache.get(world)
+        cached = _sync_cache.get(key)
     if cached is not None:
         return cached
     frame = local_capability_frame()
@@ -269,7 +271,7 @@ def world_capabilities(world: Optional[int] = None) -> CapabilityWorld:
         gathered = capability_sync(frame)
     cw = fold_world(gathered)
     with _sync_lock:
-        _sync_cache[world] = cw
+        _sync_cache[key] = cw
     if _rank() == 0:
         for r in range(cw.world):
             _tm.gauge(

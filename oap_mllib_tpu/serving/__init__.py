@@ -32,12 +32,25 @@ existing subsystems instead of a per-call eager afterthought:
   (utils/recovery.py); a replica that misses its deadline is EVICTED —
   survivors keep answering in local mode and the supervisor
   (utils/supervisor.py) relaunches the lost replica.
+- :mod:`~oap_mllib_tpu.serving.traffic` — the production front:
+  ``TrafficQueue.submit`` returns a future while a dispatcher thread
+  forms flushes by DEADLINE (not arrival order) over ``predict_many``;
+  admission control prices the staged working set against the
+  ``utils/membudget.py`` planner and sheds LOUDLY (:class:`ShedError`
+  + ``oap_serve_shed_total``) instead of letting a storm OOM a
+  replica; :class:`ScaleController` turns replica count into a
+  controlled variable (queue-depth/p99 trends -> ``oap_serve_scale_*``
+  + the supervisor's ``serve.scale.hint.json`` sideband).
 
 Usage (docs/user-guide.md "Serving")::
 
     handle = serving.serve(model)        # pins weights on-device once
     handle.warmup(4096)                  # pre-compile the bucket family
     ids = handle.predict(batch)          # zero steady-state compiles
+
+    with serving.TrafficQueue(handle) as q:          # async front
+        futs = [q.submit(b, deadline_ms=50) for b in storm]
+        ids = [f.result() for f in futs]             # or ShedError
 """
 
 from oap_mllib_tpu.serving.registry import (  # noqa: F401
@@ -51,3 +64,9 @@ from oap_mllib_tpu.serving.registry import (  # noqa: F401
     unserve,
 )
 from oap_mllib_tpu.serving.ha import ReplicaGuard, heartbeat  # noqa: F401
+from oap_mllib_tpu.serving.traffic import (  # noqa: F401
+    ScaleController,
+    ShedError,
+    TrafficQueue,
+    write_scale_hint,
+)

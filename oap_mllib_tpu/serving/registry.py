@@ -41,6 +41,31 @@ from oap_mllib_tpu.utils import locktrace
 _LOCK = locktrace.TrackedLock("serving.registry", threading.RLock())
 _SERVED: Dict[tuple, "ServedModel"] = {}
 
+# oap_serve_queue_depth is written from BOTH sides of the traffic
+# plane — submitters increment, the dispatcher thread and coalesced
+# flushes decrement — so the gauge is maintained as a delta-summed
+# counter under its own tracked lock: concurrent set() calls from two
+# threads would clobber each other (the race the "locks" sanitizer
+# watches this seam for); delta folding under the lock cannot.
+_DEPTH_LOCK = locktrace.TrackedLock("serving.queue_depth")
+_queue_depth = 0
+
+
+def note_queue_depth(delta: int) -> int:
+    """Fold ``delta`` into the live queue-depth gauge (pending async
+    requests + requests coalesced into an in-flight flush), race-safe
+    under the dispatcher thread.  Returns the new depth."""
+    global _queue_depth
+    with _DEPTH_LOCK:
+        _queue_depth = max(0, _queue_depth + int(delta))
+        depth = _queue_depth
+        _tm.gauge(
+            "oap_serve_queue_depth",
+            help="Serving requests pending in the traffic queue or "
+                 "coalesced into the in-flight batch",
+        ).set(depth)
+    return depth
+
 
 def pin(cache: dict, name: str, host_array) -> Any:
     """Device copy of ``host_array`` cached in ``cache[name]``, keyed by
@@ -107,15 +132,13 @@ class ServedModel:
         batches = [np.atleast_2d(np.asarray(b)) for b in batches]
         if not batches:
             return []
-        g = _tm.gauge(
-            "oap_serve_queue_depth",
-            help="Requests coalesced into the in-flight serving batch",
-        )
-        g.set(len(batches))
+        # delta-folded, not set(): the dispatcher thread and concurrent
+        # flushes all move the same gauge (see note_queue_depth)
+        note_queue_depth(len(batches))
         try:
             out = score_rows(np.concatenate(batches, axis=0))
         finally:
-            g.set(0)
+            note_queue_depth(-len(batches))
         parts = []
         lo = 0
         for b in batches:
@@ -355,9 +378,12 @@ def served_models() -> Dict[tuple, ServedModel]:
 
 def clear() -> None:
     """Tests: drop every handle (per-model pins die with them)."""
+    global _queue_depth
     with _LOCK:
         _SERVED.clear()
         _tm.gauge("oap_serve_models_pinned").set(0)
+    with _DEPTH_LOCK:
+        _queue_depth = 0
 
 
 def serving_summary() -> Dict[str, Any]:
@@ -377,6 +403,11 @@ def serving_summary() -> Dict[str, Any]:
         p50, p99 = _latency_quantiles()
         block["latency_p50_s"] = p50
         block["latency_p99_s"] = p99
+    with _DEPTH_LOCK:
+        block["queue_depth"] = _queue_depth
+    from oap_mllib_tpu.serving import traffic
+
+    block.update(traffic.summary_block())
     return block
 
 

@@ -1,0 +1,588 @@
+"""Traffic plane: async ingestion, deadline-aware admission, scaling.
+
+The registry/batcher machinery (ISSUE 13) answers requests fast — but
+synchronously: ``predict``/``predict_many`` block their caller, batches
+form in arrival order, and nothing stands between a request storm and
+the replica's memory.  This module is the production front the serving
+plane dispatches through (the map-reduce request-path decomposition of
+PAPERS.md arXiv:2403.07128 applied to serving):
+
+- :class:`TrafficQueue` — ``submit`` returns a ``concurrent.futures
+  .Future`` immediately; a dispatcher thread coalesces pending requests
+  into flushes ordered by **deadline** (not arrival order) on the
+  existing geometric buckets (``ServedModel.predict_many`` →
+  ``_flush_many`` → one bucketed launch per flush), so the request
+  nearest its deadline is always scored first whatever order the storm
+  arrived in.  Requests whose deadline expires before dispatch are shed
+  (their future raises), never scored dead.
+- **Admission control** — ``submit`` bounds the queue
+  (``Config.serve_queue_depth``) and prices the projected staged
+  working set against the memory-budget planner
+  (``utils/membudget.Budgets`` × ``Config.serve_shed_headroom``), so a
+  request storm can never OOM a replica.  Shedding is LOUD, the
+  ``scale_policy`` contract: a :class:`ShedError` naming queue depth /
+  deadline / priced bytes / budget, ``oap_serve_shed_total{reason=}``
+  booked — never silent.
+- :class:`ScaleController` — replica count as a controlled variable:
+  consumes queue-depth/p99 samples (fleet heartbeat views /
+  ``telemetry/fleet`` rollups), votes scale-out on sustained
+  queue-depth-per-replica over ``Config.serve_scale_high`` with a
+  non-falling trend, scale-in after ``Config.serve_scale_idle_s`` of
+  idleness.  Decisions land in ``summary.serving`` +
+  ``oap_serve_scale_*`` metrics, and :func:`write_scale_hint` posts
+  them on the supervisor's sideband (``serve.scale.hint.json`` — the
+  ``balance.hint.json`` pattern) so ``utils/supervisor.Supervisor``
+  sizes the next relaunch from live traffic instead of a static world.
+
+Concurrency contract (oaplint R19-R22 / the ``locks`` sanitizer): the
+queue lock is a :class:`~oap_mllib_tpu.utils.locktrace.TrackedLock`
+held only around list surgery — scoring, future resolution, and event
+waits all run OUTSIDE it (detach-then-act); the dispatcher thread is
+daemonized AND joined by :meth:`TrafficQueue.close`.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.utils import locktrace
+
+# the supervisor sideband file the scale controller posts decisions to
+# (crash_dir/<SCALE_HINT_FILENAME>; read-and-removed per attempt like
+# parallel/balance.py's balance.hint.json)
+SCALE_HINT_FILENAME = "serve.scale.hint.json"
+
+# module scale-decision state for serving_summary (written under the
+# tracked lock below — the dispatcher thread and fit threads both read)
+_STATE_LOCK = locktrace.TrackedLock("serving.scale")
+_scale_state: Dict[str, Any] = {}
+
+
+class ShedError(RuntimeError):
+    """A request the traffic plane refused (admission) or dropped
+    (deadline expiry) — LOUDLY, the ``scale_policy`` contract: the
+    message names the queue depth, the deadline, and the priced
+    bytes-vs-budget so the operator sees exactly why, and every shed
+    counts ``oap_serve_shed_total{reason=}``.  ``reason`` is one of
+    ``"queue_full"`` / ``"budget"`` / ``"deadline"``."""
+
+    def __init__(self, reason: str, msg: str, *,
+                 queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 priced_bytes: Optional[int] = None,
+                 budget_bytes: Optional[int] = None):
+        self.reason = reason
+        self.queue_depth = queue_depth
+        self.deadline_ms = deadline_ms
+        self.priced_bytes = priced_bytes
+        self.budget_bytes = budget_bytes
+        parts = []
+        if queue_depth is not None:
+            parts.append(f"queue depth {queue_depth}")
+        if deadline_ms is not None and math.isfinite(deadline_ms):
+            parts.append(f"deadline {deadline_ms:.1f} ms")
+        if priced_bytes is not None:
+            parts.append(
+                f"priced ~{_fmt_bytes(priced_bytes)} vs budget "
+                f"{_fmt_bytes(budget_bytes or 0)}"
+            )
+        detail = ", ".join(parts)
+        super().__init__(
+            f"serving traffic: request shed ({reason}) — {msg}"
+            + (f" [{detail}]" if detail else "")
+        )
+
+
+def _fmt_bytes(n: int) -> str:
+    from oap_mllib_tpu.utils.membudget import _fmt_bytes as fmt
+
+    return fmt(int(n))
+
+
+def _shed(reason: str, msg: str, **ctx) -> ShedError:
+    """Build a ShedError and book the shed counter — every shed is
+    visible on the metrics plane whether it raises at submit or lands
+    on a future at dispatch."""
+    _tm.counter(
+        "oap_serve_shed_total", {"reason": reason},
+        help="Requests shed by traffic-plane admission control / "
+             "deadline expiry, by reason",
+    ).inc()
+    return ShedError(reason, msg, **ctx)
+
+
+# -- validated traffic knobs --------------------------------------------------
+
+
+def traffic_cfg() -> Dict[str, float]:
+    """Validated traffic-plane knobs.  A typo raises at submit time,
+    not after a storm already queued (the kmeans_kernel/fault_spec
+    contract)."""
+    cfg = get_config()
+    depth = int(cfg.serve_queue_depth)
+    if depth < 1:
+        raise ValueError(
+            f"serve_queue_depth must be >= 1, got {depth}"
+        )
+    deadline_ms = float(cfg.serve_deadline_ms)
+    if deadline_ms < 0:
+        raise ValueError(
+            f"serve_deadline_ms must be >= 0 (0 = no deadline), got "
+            f"{deadline_ms}"
+        )
+    headroom = float(cfg.serve_shed_headroom)
+    if not 0.0 < headroom <= 1.0:
+        raise ValueError(
+            f"serve_shed_headroom must be in (0, 1], got {headroom}"
+        )
+    return {
+        "queue_depth": depth,
+        "deadline_ms": deadline_ms,
+        "headroom": headroom,
+    }
+
+
+class _Request:
+    __slots__ = ("x", "rows", "deadline", "deadline_ms", "seq", "future",
+                 "submitted")
+
+    def __init__(self, x: np.ndarray, deadline: float, deadline_ms: float,
+                 seq: int, submitted: float):
+        self.x = x
+        self.rows = int(x.shape[0])
+        self.deadline = deadline  # absolute clock seconds; inf = none
+        self.deadline_ms = deadline_ms
+        self.seq = seq
+        self.submitted = submitted
+        self.future: Future = Future()
+
+
+class TrafficQueue:
+    """Async request front for one serving handle.
+
+    ::
+
+        q = serving.TrafficQueue(handle)
+        futs = [q.submit(batch, deadline_ms=50.0) for batch in storm]
+        ids = [f.result() for f in futs]
+        q.close()
+
+    ``submit`` admits (or sheds) under the queue lock and returns a
+    future; the dispatcher thread pops the whole pending set, sheds
+    expired requests, sorts the rest by absolute deadline, slices them
+    into flushes of at most ``max_batch_rows`` request rows, and
+    answers each flush through ``handle.predict_many`` (one coalesced
+    bucketed launch per flush — zero steady-state compiles after
+    warmup).  Futures resolve (or raise) exactly once.
+
+    ``clock`` is injectable (tests drive deadline logic with a fake
+    monotonic clock + :meth:`pump`, no thread, fully deterministic);
+    ``start=False`` skips the dispatcher thread so :meth:`pump` is the
+    only dispatch path."""
+
+    def __init__(self, handle, *, max_batch_rows: int = 1024,
+                 poll_s: float = 0.01,
+                 clock: Callable[[], float] = time.monotonic,
+                 start: bool = True):
+        if not callable(getattr(handle, "predict_many", None)):
+            raise TypeError(
+                f"TrafficQueue needs a handle with predict_many (got "
+                f"{type(handle).__name__}); serve() the model first"
+            )
+        if max_batch_rows < 1:
+            raise ValueError(
+                f"max_batch_rows must be >= 1, got {max_batch_rows}"
+            )
+        self._handle = handle
+        self._max_batch_rows = int(max_batch_rows)
+        self._poll_s = float(poll_s)
+        self._clock = clock
+        self._lock = locktrace.TrackedLock("serving.traffic")
+        self._pending: List[_Request] = []
+        self._seq = 0
+        self._closed = False
+        self._budget_cache: Optional[tuple] = None
+        self.submitted = 0
+        self.answered = 0
+        self.shed = 0
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            t = threading.Thread(
+                target=self._run, name="oap-serve-dispatch", daemon=True
+            )
+            self._thread = t
+            t.start()
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, x, deadline_ms: Optional[float] = None) -> Future:
+        """Admit one request; returns its future.  Sheds (raising
+        :class:`ShedError`) when the queue is at ``serve_queue_depth``
+        or the projected staged bytes would breach the serving memory
+        allowance — the storm backs off HERE, not in the allocator."""
+        knobs = traffic_cfg()
+        if deadline_ms is None:
+            deadline_ms = knobs["deadline_ms"]
+        deadline_ms = float(deadline_ms)
+        if deadline_ms < 0:
+            raise ValueError(
+                f"deadline_ms must be >= 0 (0 = no deadline), got "
+                f"{deadline_ms}"
+            )
+        x = np.atleast_2d(np.asarray(x))
+        allowance = self._allowance(knobs["headroom"])
+        req_bytes = int(x.size * x.itemsize)
+        now = self._clock()
+        deadline = (
+            now + deadline_ms / 1e3 if deadline_ms > 0 else math.inf
+        )
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(
+                    "TrafficQueue is closed; no further submissions"
+                )
+            depth = len(self._pending)
+            if depth >= knobs["queue_depth"]:
+                raise _shed(
+                    "queue_full",
+                    f"pending queue at serve_queue_depth="
+                    f"{knobs['queue_depth']}; retry after the dispatcher "
+                    "drains or scale out",
+                    queue_depth=depth, deadline_ms=deadline_ms,
+                )
+            if allowance > 0:
+                from oap_mllib_tpu.utils.membudget import _OVERHEAD
+
+                pending_bytes = sum(
+                    int(r.x.size * r.x.itemsize) for r in self._pending
+                )
+                priced = int((pending_bytes + req_bytes) * _OVERHEAD)
+                if priced > allowance:
+                    raise _shed(
+                        "budget",
+                        "projected staged working set exceeds the "
+                        "serving allowance (hbm budget x "
+                        "serve_shed_headroom); shed instead of OOM",
+                        queue_depth=depth, deadline_ms=deadline_ms,
+                        priced_bytes=priced, budget_bytes=allowance,
+                    )
+            req = _Request(x, deadline, deadline_ms, self._seq, now)
+            self._seq += 1
+            self._pending.append(req)
+            self.submitted += 1
+        from oap_mllib_tpu.serving import registry
+
+        registry.note_queue_depth(1)
+        self._wake.set()
+        return req.future
+
+    def _allowance(self, headroom: float) -> int:
+        """The serving working-set allowance in bytes (0 = unbounded):
+        the resolved HBM budget scaled by ``serve_shed_headroom``.
+        Resolution is cached per budget-knob value — admission must not
+        pay a device query per request."""
+        cfg = get_config()
+        key = (cfg.memory_budget_hbm, cfg.memory_budget_host)
+        cached = self._budget_cache
+        if cached is None or cached[0] != key:
+            from oap_mllib_tpu.utils.membudget import Budgets
+
+            cached = (key, Budgets.resolve())
+            self._budget_cache = cached
+        hbm = cached[1].hbm
+        return int(hbm * headroom) if hbm > 0 else 0
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self._poll_s)
+            self._wake.clear()
+            self.pump()
+
+    def pump(self) -> int:
+        """One dispatch cycle: pop everything pending, shed the
+        expired, deadline-order the rest, flush in row-bounded groups.
+        Returns the number of requests resolved (answered + shed).
+        Safe to call concurrently with the dispatcher thread — the pop
+        is atomic and each request belongs to exactly one cycle."""
+        with self._lock:
+            batch = self._pending
+            self._pending = []
+        if not batch:
+            return 0
+        from oap_mllib_tpu.serving import registry
+
+        registry.note_queue_depth(-len(batch))
+        now = self._clock()
+        live: List[_Request] = []
+        resolved = 0
+        for r in batch:
+            if not r.future.set_running_or_notify_cancel():
+                resolved += 1  # caller cancelled before dispatch
+                continue
+            if r.deadline <= now:
+                late_ms = (now - r.deadline) * 1e3
+                r.future.set_exception(_shed(
+                    "deadline",
+                    f"request expired {late_ms:.1f} ms past its "
+                    "deadline before dispatch (queue wait exceeded the "
+                    "budget); shed un-scored",
+                    queue_depth=len(batch),
+                    deadline_ms=r.deadline_ms,
+                ))
+                with self._lock:
+                    self.shed += 1
+                resolved += 1
+                continue
+            live.append(r)
+        live.sort(key=lambda r: (r.deadline, r.seq))
+        group: List[_Request] = []
+        rows = 0
+        groups: List[List[_Request]] = []
+        for r in live:
+            if group and rows + r.rows > self._max_batch_rows:
+                groups.append(group)
+                group, rows = [], 0
+            group.append(r)
+            rows += r.rows
+        if group:
+            groups.append(group)
+        for g in groups:
+            try:
+                parts = self._handle.predict_many([r.x for r in g])
+            except Exception as exc:  # noqa: BLE001 — lands on futures
+                for r in g:
+                    r.future.set_exception(exc)
+            else:
+                for r, out in zip(g, parts):
+                    r.future.set_result(out)
+                with self._lock:
+                    self.answered += len(g)
+            resolved += len(g)
+        return resolved
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop admissions, join the dispatcher (R22), drain leftovers
+        through one final :meth:`pump` so every future resolves."""
+        with self._lock:
+            self._closed = True
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        self.pump()
+
+    def __enter__(self) -> "TrafficQueue":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- replica-count control ----------------------------------------------------
+
+
+class ScaleController:
+    """Replica count as a controlled variable.
+
+    Feed it queue-depth/p99 samples — from :func:`serving.heartbeat`
+    fleet views (:meth:`observe_view`) or straight numbers
+    (:meth:`observe`) — and it votes: **out** when the windowed mean
+    queue depth per replica exceeds ``Config.serve_scale_high`` and the
+    depth trend (``telemetry/fleet._trend``) is not falling; **in**
+    when the fleet sat idle (zero depth, no new requests) for
+    ``Config.serve_scale_idle_s``; **hold** otherwise.  Decisions book
+    ``oap_serve_scale_out_total`` / ``oap_serve_scale_in_total`` / the
+    ``oap_serve_scale_replicas`` gauge, surface in
+    ``serving_summary()['scale']``, and :func:`write_scale_hint` posts
+    them to the supervisor sideband so the next relaunch is sized by
+    live traffic."""
+
+    WINDOW = 4  # samples per decision window (fleet._trend's minimum)
+
+    def __init__(self, replicas: int, *, min_replicas: int = 1,
+                 max_replicas: int = 0,
+                 high: Optional[float] = None,
+                 idle_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        cfg = get_config()
+        self.high = float(cfg.serve_scale_high if high is None else high)
+        self.idle_s = float(
+            cfg.serve_scale_idle_s if idle_s is None else idle_s
+        )
+        if self.high <= 0:
+            raise ValueError(
+                f"serve_scale_high must be > 0, got {self.high}"
+            )
+        if self.idle_s <= 0:
+            raise ValueError(
+                f"serve_scale_idle_s must be > 0, got {self.idle_s}"
+            )
+        if replicas < 1 or min_replicas < 1:
+            raise ValueError(
+                f"replicas/min_replicas must be >= 1, got "
+                f"{replicas}/{min_replicas}"
+            )
+        self.replicas = int(replicas)
+        self.min_replicas = int(min_replicas)
+        # 0 = unbounded growth is never sane for a supervisor-run
+        # fleet: default cap is the starting size x2
+        self.max_replicas = int(max_replicas) or 2 * int(replicas)
+        self._clock = clock
+        self._depths: deque = deque(maxlen=self.WINDOW)
+        self._p99s: deque = deque(maxlen=self.WINDOW)
+        self._last_busy = clock()
+        self._last_requests: Optional[int] = None
+        self.decisions: List[Dict[str, Any]] = []
+
+    def observe_view(self, view: Dict[str, Any],
+                     p99_s: float = 0.0) -> Dict[str, Any]:
+        """One observation from a :func:`serving.heartbeat` fleet view
+        (fleet-wide queue depth = sum across replicas; replica count
+        tracks the view's world)."""
+        self.replicas = max(self.min_replicas, int(view.get("world", 1)))
+        return self.observe(
+            queue_depth=int(sum(view.get("queue_depth", []) or [0])),
+            p99_s=p99_s,
+            requests=int(sum(view.get("requests", []) or [0])),
+        )
+
+    def observe(self, queue_depth: int, p99_s: float = 0.0,
+                requests: Optional[int] = None) -> Dict[str, Any]:
+        """Fold one sample, return the decision dict (action out/in/
+        hold, replicas, reason, the sample, the trends)."""
+        from oap_mllib_tpu.telemetry.fleet import _trend
+
+        now = self._clock()
+        self._depths.append(float(queue_depth))
+        self._p99s.append(float(p99_s))
+        busy = queue_depth > 0 or (
+            requests is not None and requests != self._last_requests
+        )
+        if requests is not None:
+            self._last_requests = requests
+        if busy:
+            self._last_busy = now
+        depth_trend = _trend(list(self._depths))
+        p99_trend = _trend(list(self._p99s))
+        per_replica = (
+            float(np.mean(self._depths)) / max(1, self.replicas)
+        )
+        action, reason = "hold", ""
+        if (len(self._depths) == self.WINDOW
+                and per_replica > self.high
+                and depth_trend != "falling"
+                and self.replicas < self.max_replicas):
+            action = "out"
+            self.replicas += 1
+            reason = (
+                f"queue depth/replica {per_replica:.1f} > "
+                f"serve_scale_high={self.high:g} (depth {depth_trend}, "
+                f"p99 {p99_trend})"
+            )
+            self._depths.clear()
+            self._p99s.clear()
+            _tm.counter(
+                "oap_serve_scale_out_total",
+                help="Scale-out decisions by the serving replica "
+                     "controller",
+            ).inc()
+        elif (now - self._last_busy >= self.idle_s
+                and self.replicas > self.min_replicas):
+            action = "in"
+            self.replicas -= 1
+            reason = (
+                f"idle {now - self._last_busy:.1f}s >= "
+                f"serve_scale_idle_s={self.idle_s:g}"
+            )
+            self._last_busy = now
+            _tm.counter(
+                "oap_serve_scale_in_total",
+                help="Scale-in decisions by the serving replica "
+                     "controller",
+            ).inc()
+        _tm.gauge(
+            "oap_serve_scale_replicas",
+            help="Replica count the serving scale controller currently "
+                 "wants",
+        ).set(self.replicas)
+        decision = {
+            "action": action,
+            "replicas": self.replicas,
+            "reason": reason,
+            "queue_depth": int(queue_depth),
+            "queue_depth_per_replica": round(per_replica, 3),
+            "p99_s": float(p99_s),
+            "depth_trend": depth_trend,
+            "p99_trend": p99_trend,
+        }
+        self.decisions.append(decision)
+        with _STATE_LOCK:
+            _scale_state.clear()
+            _scale_state.update(decision)
+        return decision
+
+
+def write_scale_hint(crash_dir: str,
+                     decision: Dict[str, Any]) -> Optional[str]:
+    """Post a non-hold scale decision on the supervisor sideband
+    (``crash_dir/serve.scale.hint.json``, atomic tmp+rename — the
+    balance.hint.json pattern).  The supervisor consumes it
+    read-and-remove when sizing the next relaunch.  Returns the path
+    (None for hold decisions or an unarmed sideband)."""
+    import json
+    import os
+
+    if not crash_dir or decision.get("action") not in ("out", "in"):
+        return None
+    os.makedirs(crash_dir, exist_ok=True)
+    path = os.path.join(crash_dir, SCALE_HINT_FILENAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(decision, f)
+    os.replace(tmp, path)
+    return path
+
+
+def summary_block() -> Dict[str, Any]:
+    """The traffic-plane additions to ``serving_summary()``: shed
+    totals by reason, plus the scale controller's last decision."""
+    out: Dict[str, Any] = {}
+    reg = _tm.registry()
+    with _tm._LOCK:
+        sheds = {
+            dict(labels).get("reason", ""): int(m.value)
+            for (name, labels), m in reg._metrics.items()
+            if name == "oap_serve_shed_total"
+        }
+    if sheds:
+        out["shed"] = {"total": sum(sheds.values()), **sheds}
+    with _STATE_LOCK:
+        if _scale_state:
+            out["scale"] = dict(_scale_state)
+    return out
+
+
+def _reset_for_tests() -> None:
+    with _STATE_LOCK:
+        _scale_state.clear()

@@ -108,13 +108,24 @@ def histogram_quantile(h: Histogram, q: float) -> float:
     reaches ``q * count`` (the +Inf overflow returns the largest finite
     bound).  With the factor-4 log buckets the estimate is within one
     bucket factor of the true quantile — the resolution the serving
-    p50/p99 summary block and bench tail-latency lines report at."""
-    if not 0.0 < q <= 1.0:
-        raise ValueError(f"quantile must be in (0, 1], got {q}")
+    p50/p99 summary block and bench tail-latency lines report at.
+
+    Edges: an empty histogram returns 0.0 for any ``q``; ``q=0``
+    returns the lowest non-empty bucket's bound (the min estimate);
+    ``q=1`` the highest non-empty finite bound; mass in the +Inf
+    overflow clamps to the largest finite bound (the storage has no
+    upper witness).  Out-of-range ``q`` raises."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
     with _LOCK:
         total = h.count
         if total == 0:
             return 0.0
+        if q == 0.0:
+            for bound, c in zip(h.bounds, h.counts):
+                if c > 0:
+                    return float(bound)
+            return float(h.bounds[-1])  # all mass in the overflow
         target = q * total
         cum = 0
         for bound, c in zip(h.bounds, h.counts):

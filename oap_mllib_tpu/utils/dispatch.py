@@ -117,12 +117,15 @@ def throughput_probe(seed: int = 0) -> float:
     best-of-``_PROBE_REPS`` each, combined harmonically — a rank slow at
     EITHER leg is a slow rank (streamed passes pay both).  The input is
     deterministic-seeded so every rank times the same program on the
-    same bits; the result is cached per process (the once-per-fit-start
-    allgather in ops/stream_ops.capability_sync reads the cache).
-    ``Config.rank_capability`` pins the value instead (tests, known
-    deployments) — see :func:`pinned_capability`.
+    same bits; the result is cached per process per
+    ``Config.probe_epoch`` (the once-per-fit-start allgather in
+    ops/stream_ops.capability_sync reads the cache).  The supervisor
+    bumps the epoch on every relaunch attempt, so a relaunched rank
+    re-measures its CURRENT capability instead of trusting its
+    pre-preemption value.  ``Config.rank_capability`` pins the value
+    instead (tests, known deployments) — see :func:`pinned_capability`.
     """
-    key = int(seed)
+    key = (int(seed), int(get_config().probe_epoch))
     if key in _probe_cache:
         return _probe_cache[key]
     import numpy as np

@@ -153,6 +153,14 @@ class ProgramCache:
 
 _CACHE = ProgramCache()
 
+# the registry is reachable from the serving dispatcher thread as well
+# as fit flows; entry mutation is guarded inside ProgramCache._lock,
+# and the module-level clear (a cross-thread registry reset) takes this
+# tracked seam so the "locks" sanitizer can witness it
+from oap_mllib_tpu.utils import locktrace as _locktrace  # noqa: E402
+
+_CLEAR_LOCK = _locktrace.TrackedLock("progcache.clear")
+
 
 def get_or_build(algo: str, key: tuple, build: Callable[[], Any]):
     return _CACHE.get_or_build(algo, key, build)
@@ -167,7 +175,8 @@ def stats() -> Dict[str, Any]:
 
 
 def clear() -> None:
-    _CACHE.clear()
+    with _CLEAR_LOCK:
+        _CACHE.clear()
 
 
 def delta(before: Dict[str, Any]) -> Dict[str, Any]:

@@ -149,7 +149,13 @@ class Supervisor:
       seed is re-seeded ``+attempt`` so a deterministic kill schedule
       does not re-kill the resumed world at the same point;
     - ``SUPERVISE_ATTEMPT`` — the attempt index (drill workers key
-      one-shot faults off it).
+      one-shot faults off it);
+    - ``OAP_MLLIB_TPU_PROBE_EPOCH`` — the attempt index as the
+      capability-probe generation: every relaunch invalidates the
+      probe caches (utils/dispatch.throughput_probe, parallel/balance
+      .world_capabilities), so a relaunched rank re-measures its
+      CURRENT capability instead of shard-planning from its
+      pre-preemption value.
 
     Restart policy: at most ``restart_budget`` relaunches (Config
     default), backoff ``restart_backoff * 2^(n-1)`` seconds before
@@ -206,6 +212,7 @@ class Supervisor:
         self.relaunches = 0
         self.shrinks = 0
         self.balance_hints: List[Dict[str, Any]] = []
+        self.scale_hints: List[Dict[str, Any]] = []
         self._blame_rank: Optional[int] = None
         self._blame_count = 0
 
@@ -221,6 +228,9 @@ class Supervisor:
         env["OAP_MLLIB_TPU_CRASH_DIR"] = self.crash_dir
         env["OAP_MLLIB_TPU_RESUME"] = "auto"
         env["SUPERVISE_ATTEMPT"] = str(attempt)
+        # fresh capability generation per attempt: a relaunched rank
+        # must re-probe, not trust its pre-preemption measurement
+        env["OAP_MLLIB_TPU_PROBE_EPOCH"] = str(attempt)
         if self.chaos:
             from oap_mllib_tpu.utils.faults import parse_chaos
 
@@ -330,6 +340,30 @@ class Supervisor:
             pass
         return hint if isinstance(hint, dict) else None
 
+    def _read_scale_hint(self) -> Optional[Dict[str, Any]]:
+        """Consume the serving scale controller's replica decision
+        (serving/traffic.SCALE_HINT_FILENAME — the fleet's queue-depth/
+        p99 trends voting the world out or in).  Read-and-remove, like
+        the balance hint: one decision sizes ONE relaunch."""
+        path = os.path.join(self.crash_dir, "serve.scale.hint.json")
+        if not os.path.exists(path):
+            return None
+        try:
+            import json
+
+            with open(path) as f:
+                hint = json.load(f)
+        except Exception:  # noqa: BLE001 — a torn hint is no hint
+            hint = None
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        if not isinstance(hint, dict) \
+                or hint.get("action") not in ("out", "in"):
+            return None
+        return hint
+
     # -- the supervision loop ------------------------------------------------
 
     def run(self) -> Dict[str, Any]:
@@ -362,6 +396,13 @@ class Supervisor:
                     "straggler (skew %s over %s passes)",
                     hint.get("rank"), hint.get("skew_ratio"),
                     hint.get("streak_passes"),
+                )
+            scale_hint = self._read_scale_hint()
+            if scale_hint is not None:
+                self.scale_hints.append(scale_hint)
+                log.warning(
+                    "supervisor: serving scale hint — %s (%s)",
+                    scale_hint.get("action"), scale_hint.get("reason"),
                 )
             if att.ok and clean:
                 return self._summary(True, world, outs)
@@ -409,6 +450,20 @@ class Supervisor:
                     "shrinking world to %d (resume=auto reshards state)",
                     culprit, self.shrink_after, world,
                 )
+            if scale_hint is not None:
+                want = world + (1 if scale_hint["action"] == "out" else -1)
+                # replica count is the controlled variable, but the
+                # supervisor bounds it: never above the initially
+                # provisioned world (host resources were sized for it),
+                # never below 1
+                sized = max(1, min(want, self.world))
+                if sized != world:
+                    log.warning(
+                        "supervisor: sizing next world %d -> %d per "
+                        "serving scale hint (%s)",
+                        world, sized, scale_hint["action"],
+                    )
+                    world = sized
             self.relaunches += 1
             _tm.counter(
                 "oap_recovery_relaunches_total",
@@ -437,6 +492,7 @@ class Supervisor:
             "restart_budget": self.restart_budget,
             "shrinks": self.shrinks,
             "balance_hints": list(self.balance_hints),
+            "scale_hints": list(self.scale_hints),
             "attempts": [a.as_dict() for a in self.attempts],
             "outputs": list(outs),
         }

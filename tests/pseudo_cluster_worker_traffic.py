@@ -1,0 +1,251 @@
+"""Traffic-plane pseudo-cluster worker (ISSUE 16).
+
+One replica of a REAL ``jax.distributed`` serving fleet driving the
+async traffic plane end to end:
+
+1. **Sharded-sweep parity** — shard deterministic ALS factor tables
+   onto the live multi-process mesh (``sweep.shard_factors`` — the
+   elastic redistribution pass), run the ring-rotated factor-sharded
+   full sweep, and assert IN-PROCESS that ids AND score bits match the
+   single-process reference (``ALSModel._top_k_scores``).  Prints
+   ``PARITY_OK`` + a digest the parent cross-checks across ranks.
+2. **Jittered storm** — waves of jittered-size requests through a
+   :class:`serving.TrafficQueue` (submit -> future -> result walls),
+   fleet heartbeats between waves over the deadline-watchdogged host
+   collective plane, and a zero-steady-state-compile assertion from the
+   XLA ground truth.  Prints ``STORM_OK rank= reqs= p50_ms= p99_ms=
+   compiles=``.
+3. **Loud shedding** (rank 0) — synthetic tight knobs drive one shed of
+   each reason (queue_full / budget / deadline) with zero OOM.  Prints
+   ``SHED_OK sheds=3``.
+
+Modes (env ``TRAFFIC_WORKER_MODE``):
+
+- ``healthy`` — every rank runs all legs and exits 0.
+- ``evict`` — rank 1 SIGKILLs itself at the start of storm wave 1 (a
+  preempted replica); rank 0's next heartbeat converts into a
+  ``CollectiveTimeoutError`` which the :class:`ReplicaGuard` absorbs:
+  the survivor prints ``EVICTED``, keeps answering the remaining waves
+  in local-only mode, and still holds the p99 and zero-compile
+  contracts.
+- ``bench`` — the ``serving_kmeans_qps_mp`` headline: a sustained
+  storm through the async queue, printing ``BENCH_QPS rank=0 qps=
+  p50_ms= p99_ms=`` for bench.py to parse.
+
+Invoked as:  python pseudo_cluster_worker_traffic.py RANK NPROC COORD LOCAL_DEV
+(the standard worker argv — the shared _launch_world plumbing spawns it).
+"""
+
+import hashlib
+import os
+import sys
+import time
+
+rank, nproc = int(sys.argv[1]), int(sys.argv[2])
+coord, local_dev = sys.argv[3], int(sys.argv[4])
+mode = os.environ["TRAFFIC_WORKER_MODE"]
+crash_dir = os.environ["TRAFFIC_CRASH_DIR"]
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={local_dev}"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+if hasattr(jax.config, "jax_num_cpu_devices"):
+    jax.config.update("jax_num_cpu_devices", local_dev)
+
+import numpy as np
+
+if nproc > 1:
+    from oap_mllib_tpu.parallel import bootstrap
+
+    ran = bootstrap.initialize_distributed(coord, nproc, rank)
+    assert ran, "initialize_distributed returned False"
+
+from oap_mllib_tpu import serving
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.models.kmeans import KMeans
+from oap_mllib_tpu.utils import progcache
+
+# the heartbeat deadline is the eviction mechanism under test: well
+# under the parent's watchdog, well over a healthy heartbeat
+set_config(collective_timeout=10.0, crash_dir=crash_dir)
+
+# -- leg 1: multi-process sharded sweep, bit-identical to the reference
+if mode != "bench":
+    from oap_mllib_tpu.models.als import ALSModel
+    from oap_mllib_tpu.parallel.mesh import get_mesh
+    from oap_mllib_tpu.serving import sweep
+
+    prng = np.random.default_rng(123)
+    uf = prng.normal(size=(96, 5)).astype(np.float32)
+    itf = prng.normal(size=(64, 5)).astype(np.float32)
+    mesh = get_mesh()
+    ub, uoff, upp = sweep.shard_factors(uf, mesh)
+    ib, ioff, ipp = sweep.shard_factors(itf, mesh)
+    sharded = ALSModel(
+        None, None,
+        sharded_user=(ub, uoff, upp), sharded_item=(ib, ioff, ipp),
+    )
+    ids, scores = sweep.recommend_for_all_users(sharded, 8, with_scores=True)
+    ref = ALSModel(uf, itf)
+    ids_ref, s_ref = ref._top_k_scores(uf, itf, 8)
+    assert np.array_equal(ids, ids_ref), "sharded sweep ids diverge"
+    assert np.array_equal(scores, s_ref), "sharded sweep score bits diverge"
+    digest = hashlib.sha256(ids.tobytes() + scores.tobytes()).hexdigest()[:16]
+    print(f"PARITY_OK rank={rank} digest={digest}", flush=True)
+
+# -- serve one replicated model per replica (the fleet contract)
+rng = np.random.default_rng(77)
+if mode == "bench":
+    # the QPS headline prices SERVING, not fitting: identical synthetic
+    # centers on every replica (no collective — the leg runs even on
+    # hosts whose jax build cannot fit across processes)
+    from oap_mllib_tpu.models.kmeans import KMeansModel
+
+    model = KMeansModel(rng.normal(size=(4, 8)).astype(np.float32))
+else:
+    x = rng.normal(size=(600, 8)).astype(np.float32)
+    model = KMeans(k=4, seed=5, init_mode="random", max_iter=4).fit(x)
+handle = serving.serve(model)
+handle.warmup(128)
+
+if mode == "bench":
+    n_req = int(os.environ.get("TRAFFIC_BENCH_REQUESTS", "200"))
+    reqs = [
+        rng.normal(size=(int(s), 8)).astype(np.float32)
+        for s in rng.integers(5, 128, size=n_req)
+    ]
+    with serving.TrafficQueue(handle) as q:
+        for b in reqs[:16]:  # warm wave: async path + buckets hot
+            q.submit(b, deadline_ms=60_000).result(timeout=60)
+        t0 = time.perf_counter()
+        subs = [
+            (time.perf_counter(), q.submit(b, deadline_ms=120_000))
+            for b in reqs
+        ]
+        walls = []
+        for ts, f in subs:
+            f.result(timeout=120)
+            walls.append(time.perf_counter() - ts)
+        total = time.perf_counter() - t0
+    walls.sort()
+    p50 = walls[len(walls) // 2]
+    p99 = walls[min(len(walls) - 1, int(len(walls) * 0.99))]
+    print(
+        f"BENCH_QPS rank={rank} qps={n_req / total:.1f} "
+        f"p50_ms={p50 * 1e3:.3f} p99_ms={p99 * 1e3:.3f}",
+        flush=True,
+    )
+    # collective-free exit barrier: the first replica to _exit would
+    # tear down the coordination service under its still-storming
+    # peers — wait until every rank has filed its done marker
+    open(os.path.join(crash_dir, f"bench.done.rank{rank}"), "w").close()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and not all(
+        os.path.exists(os.path.join(crash_dir, f"bench.done.rank{r}"))
+        for r in range(nproc)
+    ):
+        time.sleep(0.05)
+    os._exit(0)
+
+# -- leg 2: jittered storm, heartbeats between waves, zero steady compiles
+waves = [
+    [
+        rng.normal(size=(int(s), 8)).astype(np.float32)
+        for s in rng.integers(5, 128, size=12)
+    ]
+    for _ in range(3)
+]
+guard = serving.ReplicaGuard()
+walls = []
+announced = False
+compile_snap = None
+q = serving.TrafficQueue(handle)
+for w, wave in enumerate(waves):
+    if mode == "evict" and rank == 1 and nproc > 1 and w == 1:
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)  # a preempted replica
+    with guard.leg():
+        futs = [
+            (time.perf_counter(), q.submit(b, deadline_ms=120_000))
+            for b in wave
+        ]
+        for ts, f in futs:
+            f.result(timeout=120)
+            walls.append(time.perf_counter() - ts)
+        if not guard.local_only and nproc > 1:
+            view = serving.heartbeat(
+                requests=handle.requests, queue_depth=q.depth()
+            )
+            if w == 0:
+                print(f"FLEET rank={rank} world={view['world']}", flush=True)
+    if guard.local_only and not announced:
+        announced = True
+        err = type(guard.last_error).__name__
+        print(f"EVICTED rank={rank} wave={w} err={err}", flush=True)
+    if w == 0:
+        # wave 0 is the warm wave (first heartbeat shapes included);
+        # everything after must compile NOTHING, and the latency
+        # contract (p99 vs p50) is judged on steady-state waves only
+        compile_snap = progcache.xla_compile_count()
+        walls = []
+q.close()
+steady_compiles = progcache.xla_compile_count() - compile_snap
+walls.sort()
+p50 = walls[len(walls) // 2]
+p99 = walls[min(len(walls) - 1, int(len(walls) * 0.99))]
+print(
+    f"STORM_OK rank={rank} reqs={len(walls)} p50_ms={p50 * 1e3:.3f} "
+    f"p99_ms={p99 * 1e3:.3f} compiles={steady_compiles} "
+    f"local_only={guard.local_only}",
+    flush=True,
+)
+
+# -- leg 3 (rank 0): one loud shed of each reason, zero OOM
+if rank == 0:
+    sheds = []
+    set_config(serve_queue_depth=1)
+    q2 = serving.TrafficQueue(handle, start=False)
+    held = q2.submit(waves[0][0])
+    try:
+        q2.submit(waves[0][1])
+    except serving.ShedError as e:
+        sheds.append(e.reason)
+    set_config(serve_queue_depth=256, memory_budget_hbm="2K",
+               serve_shed_headroom=0.5)
+    try:
+        q2.submit(np.zeros((512, 8), np.float32))
+    except serving.ShedError as e:
+        sheds.append(e.reason)
+    set_config(memory_budget_hbm="")
+    late = q2.submit(waves[0][2], deadline_ms=1.0)
+    time.sleep(0.05)
+    q2.pump()
+    if isinstance(late.exception(), serving.ShedError):
+        sheds.append(late.exception().reason)
+    assert held.result(timeout=30) is not None  # admitted work still answers
+    q2.close()
+    assert sheds == ["queue_full", "budget", "deadline"], sheds
+    print(f"SHED_OK rank={rank} sheds={len(sheds)}", flush=True)
+
+print(
+    f"TRAFFIC_OK rank={rank} reqs={len(walls)} local_only={guard.local_only}",
+    flush=True,
+)
+# collective-free exit barrier (see bench mode): skipped once the
+# fleet is evicted — the dead peer will never file its marker
+open(os.path.join(crash_dir, f"traffic.done.rank{rank}"), "w").close()
+if not guard.local_only:
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and not all(
+        os.path.exists(os.path.join(crash_dir, f"traffic.done.rank{r}"))
+        for r in range(nproc)
+    ):
+        time.sleep(0.05)
+os._exit(0)

@@ -482,8 +482,40 @@ class TestMetricsQuantile:
         assert tm.histogram_quantile(h, 0.5) == 1.0
         assert tm.histogram_quantile(h, 0.99) == 16.0
         with pytest.raises(ValueError):
-            tm.histogram_quantile(h, 0.0)
+            tm.histogram_quantile(h, -0.1)
+        with pytest.raises(ValueError):
+            tm.histogram_quantile(h, 1.01)
 
     def test_quantile_empty_histogram(self):
         h = tm.Histogram(bounds=(1.0, 2.0))
-        assert tm.histogram_quantile(h, 0.5) == 0.0
+        for q in (0.0, 0.5, 1.0):
+            assert tm.histogram_quantile(h, q) == 0.0
+
+    def test_quantile_q0_is_min_estimate(self):
+        # q=0 names the lowest NON-EMPTY bucket, not bounds[0]
+        h = tm.Histogram(bounds=(1.0, 4.0, 16.0))
+        h.observe(3.0)
+        h.observe(10.0)
+        assert tm.histogram_quantile(h, 0.0) == 4.0
+
+    def test_quantile_single_bucket_mass(self):
+        # all mass in one bucket: every quantile names that bucket
+        h = tm.Histogram(bounds=(1.0, 4.0, 16.0))
+        for _ in range(7):
+            h.observe(2.0)
+        for q in (0.0, 0.25, 0.5, 1.0):
+            assert tm.histogram_quantile(h, q) == 4.0
+
+    def test_quantile_overflow_clamps_to_last_finite_bound(self):
+        # mass past the largest finite bound has no upper witness:
+        # q=0, q=1, and everything between clamp to bounds[-1]
+        h = tm.Histogram(bounds=(1.0, 4.0))
+        h.observe(100.0)
+        for q in (0.0, 0.5, 1.0):
+            assert tm.histogram_quantile(h, q) == 4.0
+
+    def test_quantile_q1_is_max_bucket(self):
+        h = tm.Histogram(bounds=(1.0, 4.0, 16.0))
+        h.observe(0.5)
+        h.observe(12.0)
+        assert tm.histogram_quantile(h, 1.0) == 16.0

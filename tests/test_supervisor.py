@@ -234,3 +234,116 @@ print("RESULT ok")
         assert tm.counter("oap_recovery_relaunches_total"
                           ).value == before + 1
         assert hist.count == count_before + 1
+
+
+class TestCapabilityReprobe:
+    """PR 15 follow-on (ISSUE 16): a relaunched rank must re-measure its
+    capability — the supervisor bumps Config.probe_epoch per attempt and
+    every probe cache is keyed by it."""
+
+    def test_worker_env_carries_probe_epoch(self, tmp_path):
+        sup = _mk(tmp_path, "pass")
+        assert sup._worker_env(0)["OAP_MLLIB_TPU_PROBE_EPOCH"] == "0"
+        assert sup._worker_env(3)["OAP_MLLIB_TPU_PROBE_EPOCH"] == "3"
+
+    def test_epoch_bump_invalidates_pinned_then_cleared_probe(self):
+        """The regression: a capability cached before preemption (here a
+        pinned sentinel) must NOT survive into the relaunched attempt's
+        epoch — the next consult re-probes."""
+        from oap_mllib_tpu.utils import dispatch
+
+        dispatch._reset_probe_for_tests()
+        try:
+            # pre-preemption: a pinned capability, measured+cached at
+            # epoch 0
+            set_config(rank_capability="0.25")
+            cap, origin = dispatch.rank_capability()
+            assert (cap, origin) == (0.25, "pinned")
+            dispatch._probe_cache[(0, 0)] = 0.25
+            # the pin is cleared (relaunched host, fresh config) but the
+            # stale measurement still answers at epoch 0
+            set_config(rank_capability="")
+            assert dispatch.throughput_probe() == 0.25
+            # the supervisor's epoch bump invalidates it: fresh probe
+            set_config(probe_epoch=1)
+            fresh = dispatch.throughput_probe()
+            assert fresh != 0.25
+            assert (0, 1) in dispatch._probe_cache
+        finally:
+            dispatch._reset_probe_for_tests()
+
+    def test_epoch_bump_invalidates_world_capability_cache(self):
+        from oap_mllib_tpu.parallel import balance
+
+        balance._reset_for_tests()
+        try:
+            set_config(rank_capability="0.5")
+            cw0 = balance.world_capabilities(1)
+            assert balance.world_capabilities(1) is cw0  # cached
+            set_config(probe_epoch=2)
+            cw1 = balance.world_capabilities(1)
+            assert cw1 is not cw0  # fresh gather under the new epoch
+        finally:
+            balance._reset_for_tests()
+
+
+# worker: every rank fails on attempt 0, succeeds after — so the
+# supervisor reads the scale hint at the attempt boundary and sizes the
+# relaunch from it
+_FAIL_ONCE = """
+import sys
+rank, world, coord, attempt = sys.argv[1:5]
+if attempt == "0":
+    sys.exit(1)
+print("RESULT world=" + world)
+"""
+
+
+class TestScaleHint:
+    def _write_hint(self, sup, action):
+        import json
+
+        os.makedirs(sup.crash_dir, exist_ok=True)
+        with open(os.path.join(sup.crash_dir,
+                               "serve.scale.hint.json"), "w") as f:
+            json.dump({"action": action, "replicas": 1,
+                       "reason": "test"}, f)
+
+    def test_scale_in_hint_sizes_next_world(self, tmp_path):
+        sup = _mk(tmp_path, _FAIL_ONCE, world=2, restart_budget=2)
+        self._write_hint(sup, "in")
+        s = sup.run()
+        assert s["ok"]
+        assert s["final_world"] == 1
+        assert [a["world"] for a in s["attempts"]] == [2, 1]
+        assert [h["action"] for h in s["scale_hints"]] == ["in"]
+        assert any("world=1" in o for o in s["outputs"])
+        # read-and-remove: the hint sized ONE relaunch
+        assert not os.path.exists(
+            os.path.join(sup.crash_dir, "serve.scale.hint.json")
+        )
+
+    def test_scale_out_hint_capped_at_initial_world(self, tmp_path):
+        sup = _mk(tmp_path, _FAIL_ONCE, world=2, restart_budget=2)
+        self._write_hint(sup, "out")
+        s = sup.run()
+        assert s["ok"]
+        # out from the provisioned size holds (host resources were
+        # sized for the initial world) — but the hint is recorded
+        assert s["final_world"] == 2
+        assert [h["action"] for h in s["scale_hints"]] == ["out"]
+
+    def test_hold_or_torn_hint_ignored(self, tmp_path):
+        import json
+
+        sup = _mk(tmp_path, "pass", world=1)
+        os.makedirs(sup.crash_dir, exist_ok=True)
+        path = os.path.join(sup.crash_dir, "serve.scale.hint.json")
+        with open(path, "w") as f:
+            json.dump({"action": "hold"}, f)
+        assert sup._read_scale_hint() is None
+        assert not os.path.exists(path)  # consumed either way
+        with open(path, "w") as f:
+            f.write("{torn")
+        assert sup._read_scale_hint() is None
+        assert not os.path.exists(path)

@@ -1,0 +1,478 @@
+"""Traffic-plane tests (ISSUE 16): async ingestion, deadline-aware
+batch formation, admission control, and the replica scale controller.
+
+Contracts under test:
+
+- deadline-ordered dispatch: out-of-order arrivals flush in deadline
+  order (deterministic injected clock — no wall-clock in the loop);
+- expired requests are shed BEFORE dispatch (their future raises a
+  ``ShedError`` naming the deadline) and never reach the handle;
+- every future resolves or raises EXACTLY once, including under a
+  racing dispatcher thread and at close();
+- admission sheds loudly — queue-full and budget sheds raise at
+  ``submit`` with queue depth / priced-bytes detail and book
+  ``oap_serve_shed_total{reason=}``;
+- async answers are bit-identical to direct ``handle.predict`` calls;
+- ``oap_serve_queue_depth`` is delta-folded under a tracked lock —
+  race-safe under the dispatcher thread and clean with
+  ``sanitizers="locks"`` armed;
+- the scale controller votes out on sustained per-replica depth,
+  in on idleness, books ``oap_serve_scale_*``, lands its decision in
+  ``serving_summary()``, and posts the supervisor sideband hint.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu import serving
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.models.kmeans import KMeans
+from oap_mllib_tpu.serving import registry, traffic
+from oap_mllib_tpu.telemetry import metrics as tm
+
+
+@pytest.fixture(autouse=True)
+def _clear_serving():
+    registry.clear()
+    traffic._reset_for_tests()
+    yield
+    registry.clear()
+    traffic._reset_for_tests()
+
+
+class FakeClock:
+    """Injected monotonic clock: deadline logic is tested without a
+    single wall-clock read."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class SpyHandle:
+    """Records each flush's per-request row counts and tags results so
+    tests can match answers back to requests."""
+
+    def __init__(self, fail: Exception | None = None):
+        self.flushes: list[list[int]] = []
+        self.fail = fail
+
+    def predict_many(self, batches):
+        self.flushes.append([b.shape[0] for b in batches])
+        if self.fail is not None:
+            raise self.fail
+        return [np.full(b.shape[0], b.shape[0], np.int32) for b in batches]
+
+
+def _kmeans_handle(rng, n=300, d=8, k=4):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    model = KMeans(k=k, seed=3, max_iter=3).fit(x)
+    return serving.serve(model), x
+
+
+def _shed_total(reason: str) -> int:
+    reg = tm.registry()
+    with tm._LOCK:
+        return int(sum(
+            m.value for (name, labels), m in reg._metrics.items()
+            if name == "oap_serve_shed_total"
+            and dict(labels).get("reason") == reason
+        ))
+
+
+class TestAdmission:
+    def test_needs_predict_many(self):
+        with pytest.raises(TypeError, match="predict_many"):
+            serving.TrafficQueue(object(), start=False)
+
+    def test_knob_typos_raise_at_submit(self):
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        set_config(serve_queue_depth=0)
+        with pytest.raises(ValueError, match="serve_queue_depth"):
+            q.submit(np.zeros((1, 2)))
+        set_config(serve_queue_depth=4, serve_shed_headroom=1.5)
+        with pytest.raises(ValueError, match="serve_shed_headroom"):
+            q.submit(np.zeros((1, 2)))
+        set_config(serve_shed_headroom=0.5, serve_deadline_ms=-1.0)
+        with pytest.raises(ValueError, match="serve_deadline_ms"):
+            q.submit(np.zeros((1, 2)))
+        set_config(serve_deadline_ms=0.0)
+        with pytest.raises(ValueError, match="deadline_ms"):
+            q.submit(np.zeros((1, 2)), deadline_ms=-5)
+
+    def test_queue_full_sheds_loudly_at_submit(self):
+        set_config(serve_queue_depth=2)
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        q.submit(np.zeros((1, 2)))
+        q.submit(np.zeros((1, 2)))
+        before = _shed_total("queue_full")
+        with pytest.raises(serving.ShedError) as ei:
+            q.submit(np.zeros((1, 2)), deadline_ms=25.0)
+        e = ei.value
+        assert e.reason == "queue_full"
+        assert e.queue_depth == 2
+        msg = str(e)
+        # loud like scale_policy: the message names depth and deadline
+        assert "serve_queue_depth=2" in msg
+        assert "queue depth 2" in msg and "25.0 ms" in msg
+        assert _shed_total("queue_full") == before + 1
+        q.pump()
+        q.close()
+
+    def test_budget_shed_prices_against_membudget(self):
+        # 4 KiB budget x 0.5 headroom = 2048 B allowance; one 100x8 f32
+        # request (3200 B) x the planner fudge prices over it
+        set_config(memory_budget_hbm="4K", serve_shed_headroom=0.5)
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        before = _shed_total("budget")
+        with pytest.raises(serving.ShedError) as ei:
+            q.submit(np.zeros((100, 8), np.float32))
+        e = ei.value
+        assert e.reason == "budget"
+        assert e.budget_bytes == 2048
+        assert e.priced_bytes > e.budget_bytes
+        assert "budget" in str(e) and "OOM" in str(e)
+        assert _shed_total("budget") == before + 1
+        # under the allowance is admitted: pending bytes accumulate
+        f = q.submit(np.zeros((10, 8), np.float32))  # 320 B * 1.25
+        with pytest.raises(serving.ShedError):
+            # (320 + 1600) * 1.25 = 2400 B > the 2048 B allowance
+            q.submit(np.zeros((50, 8), np.float32))
+        q.pump()
+        assert f.result(timeout=5) is not None
+        q.close()
+
+    def test_unbounded_budget_prices_nothing(self):
+        set_config(memory_budget_hbm="0")  # explicit unlimited
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        q.submit(np.zeros((5000, 8), np.float32))
+        q.pump()
+        q.close()
+
+    def test_submit_after_close_raises(self):
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        q.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            q.submit(np.zeros((1, 2)))
+
+
+class TestDeadlineBatching:
+    def test_out_of_order_arrivals_flush_in_deadline_order(self):
+        clock = FakeClock()
+        spy = SpyHandle()
+        q = serving.TrafficQueue(spy, start=False, clock=clock)
+        # arrival order: loose, tight, middle — dispatch must invert it
+        q.submit(np.zeros((3, 2)), deadline_ms=5000)
+        q.submit(np.zeros((7, 2)), deadline_ms=100)
+        q.submit(np.zeros((5, 2)), deadline_ms=1000)
+        q.pump()
+        assert spy.flushes == [[7, 5, 3]]
+        q.close()
+
+    def test_no_deadline_sorts_last_by_arrival(self):
+        clock = FakeClock()
+        spy = SpyHandle()
+        q = serving.TrafficQueue(spy, start=False, clock=clock)
+        q.submit(np.zeros((2, 2)))  # inf deadline
+        q.submit(np.zeros((9, 2)), deadline_ms=50)
+        q.submit(np.zeros((4, 2)))  # inf deadline, later arrival
+        q.pump()
+        assert spy.flushes == [[9, 2, 4]]
+        q.close()
+
+    def test_default_deadline_comes_from_config(self):
+        set_config(serve_deadline_ms=10.0)
+        clock = FakeClock()
+        spy = SpyHandle()
+        q = serving.TrafficQueue(spy, start=False, clock=clock)
+        f = q.submit(np.zeros((1, 2)))  # inherits the 10 ms default
+        clock.advance(1.0)
+        q.pump()
+        assert isinstance(f.exception(), serving.ShedError)
+        assert f.exception().reason == "deadline"
+        assert spy.flushes == []  # never dispatched
+        q.close()
+
+    def test_expired_shed_before_dispatch_live_still_answered(self):
+        clock = FakeClock()
+        spy = SpyHandle()
+        q = serving.TrafficQueue(spy, start=False, clock=clock)
+        dead = q.submit(np.zeros((6, 2)), deadline_ms=10)
+        live = q.submit(np.zeros((4, 2)), deadline_ms=60_000)
+        before = _shed_total("deadline")
+        clock.advance(0.5)  # past 10 ms, well under 60 s
+        n = q.pump()
+        assert n == 2
+        exc = dead.exception()
+        assert isinstance(exc, serving.ShedError)
+        assert exc.reason == "deadline"
+        assert "expired" in str(exc) and "10.0 ms" in str(exc)
+        assert _shed_total("deadline") == before + 1
+        assert live.result(timeout=5)[0] == 4
+        assert spy.flushes == [[4]]  # the dead request never dispatched
+        q.close()
+
+    def test_max_batch_rows_splits_flushes_in_deadline_order(self):
+        clock = FakeClock()
+        spy = SpyHandle()
+        q = serving.TrafficQueue(
+            spy, start=False, clock=clock, max_batch_rows=10
+        )
+        q.submit(np.zeros((6, 2)), deadline_ms=300)
+        q.submit(np.zeros((6, 2)), deadline_ms=100)
+        q.submit(np.zeros((6, 2)), deadline_ms=200)
+        q.pump()
+        # tightest-deadline pair would overflow 10 rows: greedy split,
+        # still deadline-ordered across flushes
+        assert spy.flushes == [[6], [6], [6]] or spy.flushes == [[6, 6], [6]]
+        q.close()
+
+    def test_futures_resolve_exactly_once(self):
+        clock = FakeClock()
+        q = serving.TrafficQueue(SpyHandle(), start=False, clock=clock)
+        f = q.submit(np.zeros((2, 2)))
+        assert q.pump() == 1
+        first = f.result(timeout=5)
+        # a second cycle has nothing to do and cannot re-resolve
+        assert q.pump() == 0
+        assert f.result() is first
+        q.close()
+
+    def test_cancelled_future_dropped_without_dispatch(self):
+        clock = FakeClock()
+        spy = SpyHandle()
+        q = serving.TrafficQueue(spy, start=False, clock=clock)
+        f = q.submit(np.zeros((2, 2)))
+        assert f.cancel()
+        q.pump()
+        assert spy.flushes == []
+        assert f.cancelled()
+        q.close()
+
+    def test_handle_exception_lands_on_every_future_of_the_flush(self):
+        clock = FakeClock()
+        boom = RuntimeError("scoring failed")
+        q = serving.TrafficQueue(
+            SpyHandle(fail=boom), start=False, clock=clock
+        )
+        f1 = q.submit(np.zeros((2, 2)))
+        f2 = q.submit(np.zeros((3, 2)))
+        q.pump()
+        assert f1.exception() is boom and f2.exception() is boom
+        q.close()
+
+
+class TestAsyncDispatch:
+    def test_storm_answers_match_direct_predict(self, rng):
+        handle, _ = _kmeans_handle(rng)
+        handle.warmup(64)
+        batches = [
+            rng.normal(size=(int(s), 8)).astype(np.float32)
+            for s in rng.integers(3, 60, size=40)
+        ]
+        with serving.TrafficQueue(handle) as q:
+            futs = [q.submit(b, deadline_ms=60_000) for b in batches]
+            got = [f.result(timeout=60) for f in futs]
+        for b, ids in zip(batches, got):
+            np.testing.assert_array_equal(ids, handle.predict(b))
+
+    def test_close_drains_pending(self):
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        futs = [q.submit(np.zeros((2, 2))) for _ in range(5)]
+        q.close()  # final inline pump resolves everything
+        assert all(f.done() for f in futs)
+        assert all(f.exception() is None for f in futs)
+
+    def test_dispatcher_thread_is_daemon_and_joined(self):
+        q = serving.TrafficQueue(SpyHandle())
+        t = q._thread
+        assert t is not None and t.daemon
+        q.close()
+        assert not t.is_alive()
+        assert q._thread is None
+
+
+class TestQueueDepthGauge:
+    def _gauge(self):
+        reg = tm.registry()
+        with tm._LOCK:
+            for (name, _), m in reg._metrics.items():
+                if name == "oap_serve_queue_depth":
+                    return m.value
+        return None
+
+    def test_gauge_tracks_pending_and_returns_to_zero(self):
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        for _ in range(3):
+            q.submit(np.zeros((2, 2)))
+        assert self._gauge() == 3
+        assert q.depth() == 3
+        q.pump()
+        assert self._gauge() == 0
+        assert q.depth() == 0
+        q.close()
+
+    def test_delta_folding_is_race_safe(self):
+        # the bug the seam fixes: concurrent set() calls clobber each
+        # other; delta folding under the tracked lock cannot
+        n, per = 8, 200
+        start = threading.Barrier(n)
+
+        def hammer():
+            start.wait()
+            for _ in range(per):
+                registry.note_queue_depth(1)
+                registry.note_queue_depth(-1)
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert self._gauge() == 0
+
+    def test_clean_under_locks_sanitizer(self, rng):
+        from oap_mllib_tpu.utils import locktrace
+
+        locktrace._reset_for_tests()
+        set_config(sanitizers="locks")
+        handle, _ = _kmeans_handle(rng)
+        handle.warmup(64)
+        # armed tracked locks raise LockOrderError on any live
+        # inversion across submit / dispatcher / flush seams
+        with serving.TrafficQueue(handle) as q:
+            futs = [
+                q.submit(
+                    rng.normal(size=(5, 8)).astype(np.float32),
+                    deadline_ms=60_000,
+                )
+                for _ in range(30)
+            ]
+            for f in futs:
+                assert f.result(timeout=60) is not None
+        set_config(sanitizers="")
+        locktrace._reset_for_tests()
+
+
+class TestScaleController:
+    def test_validates_knobs(self):
+        with pytest.raises(ValueError, match="replicas"):
+            serving.ScaleController(0)
+        set_config(serve_scale_high=0.0)
+        with pytest.raises(ValueError, match="serve_scale_high"):
+            serving.ScaleController(1)
+        set_config(serve_scale_high=32.0, serve_scale_idle_s=-1.0)
+        with pytest.raises(ValueError, match="serve_scale_idle_s"):
+            serving.ScaleController(1)
+
+    def test_scales_out_on_sustained_depth(self):
+        set_config(serve_scale_high=4.0)
+        clock = FakeClock()
+        sc = serving.ScaleController(2, clock=clock)
+        before = int(tm.family_total("oap_serve_scale_out_total"))
+        decisions = [
+            sc.observe(queue_depth=40, p99_s=0.2) for _ in range(4)
+        ]
+        assert [d["action"] for d in decisions[:-1]] == ["hold"] * 3
+        last = decisions[-1]
+        assert last["action"] == "out"
+        assert last["replicas"] == 3
+        assert "serve_scale_high=4" in last["reason"]
+        assert int(tm.family_total("oap_serve_scale_out_total")) \
+            == before + 1
+        summary = registry.serving_summary()
+        assert summary["scale"]["action"] == "out"
+
+    def test_holds_while_depth_trend_falls(self):
+        set_config(serve_scale_high=4.0)
+        sc = serving.ScaleController(1, clock=FakeClock())
+        # mean depth/replica is over the bar, but falling fast: a
+        # draining queue must not trigger growth
+        for depth in (100, 90, 10, 5):
+            d = sc.observe(queue_depth=depth)
+        assert d["action"] == "hold"
+        assert d["depth_trend"] == "falling"
+
+    def test_scales_in_on_idleness(self):
+        set_config(serve_scale_idle_s=5.0)
+        clock = FakeClock()
+        sc = serving.ScaleController(3, min_replicas=1, clock=clock)
+        before = int(tm.family_total("oap_serve_scale_in_total"))
+        d = sc.observe(queue_depth=0)
+        assert d["action"] == "hold"
+        clock.advance(6.0)
+        d = sc.observe(queue_depth=0)
+        assert d["action"] == "in" and d["replicas"] == 2
+        # traffic resets the idle clock
+        clock.advance(6.0)
+        d = sc.observe(queue_depth=3)
+        assert d["action"] == "hold"
+        assert int(tm.family_total("oap_serve_scale_in_total")) \
+            == before + 1
+
+    def test_growth_caps_and_floor(self):
+        set_config(serve_scale_high=1.0, serve_scale_idle_s=1.0)
+        clock = FakeClock()
+        sc = serving.ScaleController(1, max_replicas=2, clock=clock)
+        for _ in range(4):
+            d = sc.observe(queue_depth=50)
+        assert d["replicas"] == 2
+        for _ in range(4):
+            d = sc.observe(queue_depth=50)
+        assert d["action"] == "hold" and d["replicas"] == 2  # capped
+        clock.advance(10.0)
+        d = sc.observe(queue_depth=0)
+        assert d["action"] == "in" and d["replicas"] == 1
+        clock.advance(10.0)
+        d = sc.observe(queue_depth=0)
+        assert d["action"] == "hold" and d["replicas"] == 1  # floored
+
+    def test_observe_view_folds_fleet_heartbeat(self):
+        set_config(serve_scale_high=4.0)
+        sc = serving.ScaleController(1, clock=FakeClock())
+        view = {"world": 2, "queue_depth": [30.0, 20.0],
+                "requests": [100.0, 90.0]}
+        d = sc.observe_view(view, p99_s=0.1)
+        assert sc.replicas == 2
+        assert d["queue_depth"] == 50
+
+    def test_write_scale_hint_roundtrip(self, tmp_path):
+        set_config(serve_scale_high=1.0)
+        sc = serving.ScaleController(1, clock=FakeClock())
+        for _ in range(4):
+            d = sc.observe(queue_depth=50)
+        assert d["action"] == "out"
+        path = serving.write_scale_hint(str(tmp_path), d)
+        assert path is not None
+        import json
+
+        with open(path) as f:
+            assert json.load(f)["action"] == "out"
+        # hold decisions post nothing
+        hold = dict(d, action="hold")
+        assert serving.write_scale_hint(str(tmp_path / "x"), hold) is None
+
+
+class TestSummary:
+    def test_serving_summary_grows_traffic_blocks(self):
+        set_config(serve_queue_depth=1)
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        q.submit(np.zeros((1, 2)))
+        with pytest.raises(serving.ShedError):
+            q.submit(np.zeros((1, 2)))
+        q.pump()
+        q.close()
+        s = registry.serving_summary()
+        assert s["queue_depth"] == 0
+        assert s["shed"]["total"] >= 1
+        assert s["shed"]["queue_full"] >= 1
