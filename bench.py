@@ -120,6 +120,13 @@ def _emit(metric, value, unit, vs_baseline, **extra):
 
         line["lock_hold_p99_ms"] = round(
             locktrace.hold_quantile(0.99) * 1e3, 4)
+    if "kernel" in extra:
+        # every kernel-bearing line names the autotune policy it ran
+        # under — numbers from a swept/pinned run must never be diffed
+        # silently against hand-picked-default numbers
+        from oap_mllib_tpu.config import get_config
+
+        line["tuning"] = get_config().tuning.split(":", 1)[0]
     line.update(extra)
     print(json.dumps(line), flush=True)
 
@@ -1142,6 +1149,64 @@ def bench_compile_sweep(n_sizes: int = 10, d: int = 16, k: int = 8,
     )
     ratio = out["steady_compiles_off"] / max(out["steady_compiles_on"], 1)
     out["steady_compile_ratio"] = round(ratio, 2)
+
+    # tuned leg: a pinned non-default walk geometry must ride the SAME
+    # compile-amortization planes — the bucketed program cache within
+    # the process (second same-bucket fit adds ZERO XLA compiles) and
+    # the persistent XLA cache across processes (its executables land
+    # on disk, so a warm restart skips backend compilation for tuned
+    # programs exactly as it does for default-geometry ones)
+    import shutil
+    import tempfile
+
+    prior_tuning = get_config().tuning
+    xdir = tempfile.mkdtemp(prefix="oap-bench-xla-cache-")
+    try:
+        set_config(
+            shape_bucketing="on",
+            tuning='pin:{"kmeans": {"tile_rows": 256, "depth": 3}}',
+            compilation_cache_dir=xdir,
+        )
+        c0 = progcache.xla_compile_count()
+        KMeans(k=k, seed=5, init_mode="random", max_iter=max_iter).fit(
+            x[: sizes[0]]
+        )
+        out["tuned_warm_compiles"] = progcache.xla_compile_count() - c0
+        c1 = progcache.xla_compile_count()
+        KMeans(k=k, seed=5, init_mode="random", max_iter=max_iter).fit(
+            x[: sizes[1]]  # distinct exact shape, same x2 bucket
+        )
+        out["tuned_steady_compiles"] = progcache.xla_compile_count() - c1
+        out["tuned_cache_entries"] = sum(
+            len(fs) for _, _, fs in os.walk(xdir)
+        )
+        assert out["tuned_steady_compiles"] == 0, (
+            "pinned tuned geometry broke bucketed program reuse: "
+            f"{out['tuned_steady_compiles']} new XLA compiles on the "
+            "second same-bucket fit"
+        )
+        assert out["tuned_cache_entries"] > 0, (
+            "tuned programs did not land in the persistent XLA "
+            f"compilation cache at {xdir}"
+        )
+    finally:
+        set_config(shape_bucketing=prior, tuning=prior_tuning,
+                   compilation_cache_dir="")
+        # un-wire jax's persistent cache before deleting its dir, so
+        # later bench legs neither write into a dead path nor report
+        # cache-hit-deflated compile counts
+        try:
+            import jax
+
+            from jax._src import compilation_cache as _cc
+
+            jax.config.update("jax_compilation_cache_dir", None)
+            _cc.reset_cache()
+            progcache._persist_applied = None
+        except Exception:
+            pass
+        shutil.rmtree(xdir, ignore_errors=True)
+
     if emit:
         _emit(
             "kmeans_compile_sweep_10sizes", ratio, "x fewer XLA compiles",

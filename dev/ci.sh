@@ -166,6 +166,14 @@ echo "   -reduction parity vs psum at 1e-5 with zero standalone centroid"
 echo "   allreduces in the ring-fused Lloyd build (dev/kernel_gate.py) =="
 python dev/kernel_gate.py
 
+echo "== tuning gate: autotune cache round-trip (sweep-once, zero-sweep"
+echo "   re-resolve after a memory wipe AND in a fresh interpreter),"
+echo "   double-buffered walk parity (bit-identical across depth/route at"
+echo "   a fixed partition, 1e-6 across partitions), segmented-ring Lloyd"
+echo "   census at 3 psums with 1e-5 parity, and a microsecond-bounded"
+echo "   resolve seam in the no-sweep modes (dev/tuning_gate.py) =="
+python dev/tuning_gate.py
+
 echo "== compiled-mode TPU suite (skipped unless a TPU backend is present) =="
 if python -c "import jax, sys; sys.exit(0 if jax.default_backend() == 'tpu' else 1)" 2>/dev/null; then
   python -m pytest tests_tpu/ -q

@@ -364,6 +364,105 @@ def profile_overlap():
     return rows
 
 
+SWEEP_BUCKETS = {
+    # representative bucket dims per kernel family: (k, d) for kmeans,
+    # (d,) for pca, (r,) for the ALS kernels — buckets are n-independent
+    # (ops/pallas/autotune.shape_bucket), so one bucket per family shows
+    # the whole geometry response
+    "kmeans": (128, 256),
+    "pca": (256,),
+    "als_gram": (16,),
+    "als_solve": (16,),
+}
+
+
+def profile_sweep():
+    """Autotuner candidate-grid shoot-out (ops/pallas/autotune.py): time
+    EVERY candidate geometry per kernel family at a representative shape
+    bucket through the tuner's own measurement harness — the long-form
+    evidence behind each cached winner.  Off-TPU the kernels run in
+    interpret mode (structure-only; regenerate on hardware like the
+    other tables)."""
+    import jax
+
+    from oap_mllib_tpu.ops.pallas import autotune
+
+    interp = jax.default_backend() != "tpu"
+    if interp:
+        print("# non-TPU backend: candidates run interpret mode (relative "
+              "timings not meaningful — regenerate on TPU)", flush=True)
+    rows = []
+    for kernel, dims in SWEEP_BUCKETS.items():
+        bucket = autotune.shape_bucket(*dims)
+        rng = np.random.default_rng(0)
+        operands = autotune._bench_operands(kernel, bucket, rng)
+        best = None
+        for cand in autotune.CANDIDATES[kernel]:
+            dt = autotune._measure(kernel, operands, cand, "highest", interp)
+            row = {
+                "op": "tuning_sweep", "kernel": kernel,
+                "bucket": list(bucket), **cand,
+                "ms": round(dt * 1e3, 3),
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+            if best is None or dt < best[1]:
+                best = (cand, dt)
+        print(f"# winner {kernel}: {best[0]} ({best[1] * 1e3:.3f} ms)",
+              flush=True)
+    return rows
+
+
+def profile_tuned_vs_default():
+    """Tuned-vs-default contract check: resolve each kernel family's
+    geometry through a fresh sweep (``tuning="on"``, throwaway cache
+    dir), then time the winner against the shipped DEFAULTS on the
+    tuner's own operands.  The tuned pick must never lose — the default
+    is IN the candidate grid, so a loss indicts the measurement
+    harness, not the search; __main__ exits nonzero on one."""
+    import tempfile
+
+    import jax
+
+    from oap_mllib_tpu.config import set_config
+    from oap_mllib_tpu.ops.pallas import autotune
+
+    interp = jax.default_backend() != "tpu"
+    if interp:
+        print("# non-TPU backend: interpret-mode walls (contract still "
+              "checked — both legs share the harness)", flush=True)
+    rows = []
+    with tempfile.TemporaryDirectory() as tmp:
+        set_config(tuning="on", tuning_cache_dir=tmp)
+        autotune.clear()
+        try:
+            for kernel, dims in SWEEP_BUCKETS.items():
+                bucket = autotune.shape_bucket(*dims)
+                tuned = autotune.resolve(kernel, bucket, interpret=interp)
+                rng = np.random.default_rng(0)
+                operands = autotune._bench_operands(kernel, bucket, rng)
+                t_tuned = autotune._measure(
+                    kernel, operands, tuned, "highest", interp
+                )
+                t_def = autotune._measure(
+                    kernel, operands, autotune.DEFAULTS[kernel], "highest",
+                    interp,
+                )
+                row = {
+                    "op": "tuned_vs_default", "kernel": kernel,
+                    "tuned": tuned, "default": autotune.DEFAULTS[kernel],
+                    "tuned_ms": round(t_tuned * 1e3, 3),
+                    "default_ms": round(t_def * 1e3, 3),
+                    "speedup": round(t_def / max(t_tuned, 1e-9), 3),
+                }
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+        finally:
+            set_config(tuning="auto", tuning_cache_dir="")
+            autotune.clear()
+    return rows
+
+
 def _print_progcache_stats() -> None:
     """Program-cache hit/miss report for the profiled run: the ops
     entries register every launch with utils/progcache, so after a
@@ -396,6 +495,21 @@ if __name__ == "__main__":
         profile_fused()
     elif "--overlap" in sys.argv:
         profile_overlap()
+    elif "--sweep" in sys.argv:
+        profile_sweep()
+    elif "--tuned-vs-default" in sys.argv:
+        tvd = profile_tuned_vs_default()
+        # re-measurement noise headroom: the sweep already took min-of-N
+        # per candidate, so a real loss shows up far beyond 10%
+        bad = [r for r in tvd
+               if r["tuned_ms"] > r["default_ms"] * 1.10]
+        if bad:
+            print(f"# FAIL: tuned geometry slower than defaults: {bad}",
+                  flush=True)
+            _print_progcache_stats()
+            sys.exit(1)
+        print("# tuned geometry >= defaults on every kernel family",
+              flush=True)
     else:
         rows = profile()
         print()

@@ -177,6 +177,24 @@ class Config:
     # compilation entirely — the cross-process half of compile
     # amortization.  Empty (default) = no persistence.
     compilation_cache_dir: str = ""
+    # Kernel-geometry autotuner (ops/pallas/autotune.py): tile rows,
+    # VMEM rotation depth, solve batch, ring segment counts per
+    # (backend, shape-bucket, dtype-tier).  "auto" = launch cached or
+    # pinned tuned geometry when available, otherwise the hand-picked
+    # defaults — never sweeps, zero overhead.  "on" = sweep the
+    # candidate grid on a cache miss (deterministic measured best-of-N;
+    # winners persist) so the SECOND fit on the same backend/bucket
+    # launches pre-tuned with zero sweep overhead.  "off" = defaults
+    # always, cache ignored.  "pin:<json>" = per-kernel geometry pinned
+    # verbatim (e.g. 'pin:{"kmeans": {"tile_rows": 1024}}'); unknown
+    # kernels/fields raise, like every typo here.
+    tuning: str = "auto"
+    # Persistent tuning-cache directory: swept winners serialize here
+    # (one JSON file per (backend, kernel, shape-bucket, dtype-tier)
+    # key) so a FRESH process — or a second fit anywhere on the same
+    # backend — launches pre-tuned without re-sweeping.  Empty
+    # (default) = in-process memory only.
+    tuning_cache_dir: str = ""
     # Streamed-path prefetch depth: how many chunks the background staging
     # thread may hold ahead of the consumer (data/prefetch.py).  2 =
     # double buffering — chunk N+1 is padded/converted/device_put while

@@ -28,6 +28,7 @@ import numpy as np
 from oap_mllib_tpu import telemetry
 from oap_mllib_tpu.fallback import als_np
 from oap_mllib_tpu.ops import als_ops
+from oap_mllib_tpu.ops.pallas import autotune
 from oap_mllib_tpu.utils import checkpoint as ckpt_mod
 from oap_mllib_tpu.utils import precision as psn
 from oap_mllib_tpu.utils import progcache
@@ -513,11 +514,13 @@ class ALS:
             def attempt(degraded):
                 timings = Timings("als.fit")
                 cache_before = progcache.stats()
+                tune_before = autotune.mark()
                 model = self._fit_block_parallel(
                     users, items, ratings, n_users, n_items, x0, y0, mesh,
                     timings,
                 )
                 model.summary["progcache"] = progcache.delta(cache_before)
+                model.summary["tuning"] = autotune.delta(tune_before)
                 return model
 
             model = resilience.resilient_fit(
@@ -627,6 +630,7 @@ class ALS:
         )
         timings = Timings("als.fit")
         cache_before = progcache.stats()
+        tune_before = autotune.mark()
         # compute-precision policy (utils/precision.py), resolved per
         # attempt so the ladder's f32-degradation scope applies on retry
         pol = psn.resolve("als")
@@ -750,6 +754,7 @@ class ALS:
             "als_kernel": "grouped" if grouped_ok else "coo",
             "item_layout": "replicated",
             "progcache": progcache.delta(cache_before),
+            "tuning": autotune.delta(tune_before),
             **self._block_summary(1),
         }
         if stream_route and grouped_ok:
@@ -951,6 +956,7 @@ class ALS:
         def attempt(degraded):
             timings = Timings("als.fit")
             cache_before = progcache.stats()
+            tune_before = autotune.mark()
             pol = psn.resolve("als")
             with phase_timer(timings, "table_convert"):
                 by_user = als_ops.build_grouped_edges(
@@ -976,6 +982,7 @@ class ALS:
                 "timings": timings, "accelerated": True, "streamed": True,
                 "als_kernel": "grouped", "item_layout": "replicated",
                 "progcache": progcache.delta(cache_before),
+                "tuning": autotune.delta(tune_before),
                 **self._block_summary(1),
             }
             psn.record(summary, timings, pol)
@@ -1085,13 +1092,30 @@ class ALS:
             )
         timings = Timings("als.fit")
         cache_before = progcache.stats()
+        tune_before = autotune.mark()
         pol = psn.resolve("als")
         x0 = None if init is None else np.array(init[0], np.float32)
         y0 = None if init is None else np.array(init[1], np.float32)
+        # capability-weighted user blocks for the STREAMED layout too
+        # (same planner + deadband as the in-memory fit below): a slow
+        # rank streams and solves a smaller user block.  The 2-D
+        # sharded-item layout keeps the uniform split — its identity
+        # mapping requires it — and None (homogeneous worlds) keeps the
+        # layout bit-identical.
+        bal_offsets = None
+        if not item_sharded:
+            from oap_mllib_tpu.parallel import balance
+
+            bal_offsets = balance.block_offsets(
+                n_users, world,
+                bytes_per_key=4 * (self.rank
+                                   + (self.rank + 1) * (self.rank + 2)),
+            )
         with phase_timer(timings, "table_convert"):
             lay = als_block_stream.prepare_streamed_block_layouts(
                 users, items, ratings, n_users, n_items, mesh, self.rank,
                 item_sharded=item_sharded, sizes=sizes,
+                offsets=bal_offsets,
             )
             x0_dev = self._place_block_factors(
                 mesh, lay.offsets_u, lay.upb, x0, self.seed
@@ -1131,6 +1155,7 @@ class ALS:
             "als_kernel": "grouped",
             "item_layout": "sharded" if item_sharded else "replicated",
             "progcache": progcache.delta(cache_before),
+            "tuning": autotune.delta(tune_before),
             **self._block_summary(world),
         }
         psn.record(summary, timings, pol)

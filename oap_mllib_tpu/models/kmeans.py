@@ -28,6 +28,7 @@ from oap_mllib_tpu import telemetry
 from oap_mllib_tpu.data.table import DenseTable
 from oap_mllib_tpu.fallback.kmeans_np import lloyd_np, predict_np
 from oap_mllib_tpu.ops import kmeans_ops
+from oap_mllib_tpu.ops.pallas import autotune
 from oap_mllib_tpu.parallel.mesh import get_mesh
 from oap_mllib_tpu.utils import checkpoint as ckpt_mod
 from oap_mllib_tpu.utils import precision as psn
@@ -508,6 +509,7 @@ class KMeans:
         kmeans_ops.ring_mode_cfg(cfg)
         timings = Timings("kmeans.fit")
         cache_before = progcache.stats()
+        tune_before = autotune.mark()
         ckpt = ckpt_mod.maybe_open(
             "kmeans", self._ckpt_signature(source.n_features, cfg),
             timings=timings,
@@ -542,6 +544,7 @@ class KMeans:
         )
         summary.streamed = True
         summary.progcache = progcache.delta(cache_before)
+        summary.tuning = autotune.delta(tune_before)
         psn.record(summary, timings, pol)
         if ckpt is not None:
             ckpt.record(summary)
@@ -565,6 +568,7 @@ class KMeans:
         pol = psn.resolve("kmeans")
         timings = Timings("kmeans.fit")
         cache_before = progcache.stats()
+        tune_before = autotune.mark()
         mesh = get_mesh()
         mp = mesh.shape[cfg.model_axis]
         d_orig = x.shape[1]
@@ -640,6 +644,7 @@ class KMeans:
             cluster_sizes=np.asarray(counts),
         )
         summary.progcache = progcache.delta(cache_before)
+        summary.tuning = autotune.delta(tune_before)
         psn.record(summary, timings, pol)
         if ckpt is not None:
             ckpt.record(summary)
@@ -694,6 +699,15 @@ class KMeans:
             # (docs/distributed.md "Elastic worlds")
             use_pallas = False
         if mesh.shape[cfg.model_axis] > 1 and cfg.kmeans_kernel != "xla":
+            # segmented-start ring epilogue geometry: pure function of
+            # (config, cache, bucket) so every rank resolves identically
+            ring_segments = autotune.resolve(
+                "ring",
+                autotune.shape_bucket(
+                    mesh.shape[cfg.data_axis], table.data.shape[1]
+                ),
+            )["segments"]
+
             def run_iters(c0, iters):
                 return kmeans_ops.lloyd_run_model_sharded(
                     table.data,
@@ -707,6 +721,7 @@ class KMeans:
                     precision=tier,
                     timings=timings,
                     policy=pol.name,
+                    ring_segments=ring_segments,
                 )
 
             if ckpt is None:
@@ -715,6 +730,14 @@ class KMeans:
                 run_iters, centers0, ckpt, resume, d_orig
             )
         single_device = len(jax.devices()) == 1 and jax.process_count() == 1
+        # tuned tile geometry for the hot loop, resolved for BOTH kernel
+        # routes (the XLA Lloyd derives its chunking from the same tile
+        # rows, so a tuned bucket steers either program)
+        geo = autotune.resolve(
+            "kmeans",
+            autotune.shape_bucket(self.k, table.data.shape[1]),
+            tier,
+        )
         if use_pallas:
             from oap_mllib_tpu.ops.pallas.kmeans_kernel import lloyd_run_pallas
 
@@ -722,6 +745,7 @@ class KMeans:
                 progcache.backend_fingerprint(),
                 progcache.array_key(table.data, weights),
                 np.asarray(centers0).shape, self.max_iter, tier,
+                geo["tile_rows"], geo["depth"],
             )
             with progcache.launch(
                 "kmeans.lloyd_pallas", key, timings, "lloyd_loop"
@@ -733,12 +757,22 @@ class KMeans:
                     self.max_iter,
                     self.tol,
                     mode=tier,
+                    tile_rows=geo["tile_rows"],
+                    depth=geo["depth"],
                 )
-        row_chunks = (
-            kmeans_ops.auto_row_chunks(table.n_padded, self.k)
-            if single_device
-            else 1
-        )
+        if single_device and geo != autotune.DEFAULTS["kmeans"]:
+            # tuned bucket: chunk the scan at the tuned tile rows (the
+            # default geometry keeps auto_row_chunks' occupancy rule
+            # bit-for-bit, so untuned fits are unchanged)
+            row_chunks = max(
+                1, -(-table.n_padded // max(geo["tile_rows"], 1))
+            )
+        else:
+            row_chunks = (
+                kmeans_ops.auto_row_chunks(table.n_padded, self.k)
+                if single_device
+                else 1
+            )
         if degraded and single_device:
             # auto_row_chunks returns a chunk COUNT — each geometric
             # rung doubles it again, halving the rows (and the live

@@ -26,6 +26,7 @@ from oap_mllib_tpu import telemetry
 from oap_mllib_tpu.data.table import DenseTable
 from oap_mllib_tpu.fallback.pca_np import pca_np
 from oap_mllib_tpu.ops import pca_ops
+from oap_mllib_tpu.ops.pallas import autotune
 from oap_mllib_tpu.parallel.mesh import get_mesh
 from oap_mllib_tpu.utils import checkpoint as ckpt_mod
 from oap_mllib_tpu.utils import precision as psn
@@ -349,6 +350,7 @@ class PCA:
         pol = psn.resolve("pca")
         timings = Timings("pca.fit")
         cache_before = progcache.stats()
+        tune_before = autotune.mark()
         d = source.n_features
         ckpt = ckpt_mod.maybe_open(
             "pca", self._ckpt_signature(d, cfg, "colsum"), timings=timings
@@ -372,6 +374,7 @@ class PCA:
             "n_rows": n,
             "pca_solver": solver,
             "progcache": progcache.delta(cache_before),
+            "tuning": autotune.delta(tune_before),
         }
         psn.record(summary, timings, pol)
         if ckpt is not None:
@@ -392,6 +395,7 @@ class PCA:
     def _fit_tpu_inner(self, x, dtype, jax) -> PCAModel:
         timings = Timings("pca.fit")
         cache_before = progcache.stats()
+        tune_before = autotune.mark()
         cfg = get_config()
         pol = psn.resolve("pca")
         mesh = get_mesh()
@@ -470,6 +474,7 @@ class PCA:
             "mesh_shape": dict(mesh.shape),
             "pca_solver": solver,
             "progcache": progcache.delta(cache_before),
+            "tuning": autotune.delta(tune_before),
         }
         psn.record(summary, timings, pol)
         if ckpt is not None:

@@ -133,6 +133,22 @@ def _own_mask(world: int, owned: List[int]) -> np.ndarray:
     return own
 
 
+def _block_of(k: np.ndarray, kpb: int, world: int,
+              offsets: "Optional[np.ndarray]" = None) -> np.ndarray:
+    """Block id of each key: the uniform ``k // kpb`` division, or the
+    boundary lookup when capability-weighted offsets are in play
+    (parallel/balance.plan_block_offsets).  ``offsets=None`` keeps the
+    exact integer-division mapping so homogeneous fits stay
+    bit-identical to the pre-offsets layout."""
+    if offsets is None:
+        return np.minimum(k // kpb, world - 1)
+    # offsets[b] <= k < offsets[b+1] selects block b; the clip guards
+    # stray out-of-range ids the same way the uniform min() does
+    return np.minimum(
+        np.searchsorted(offsets[1:], k, side="right"), world - 1
+    )
+
+
 def _cat(parts, dtype):
     return np.concatenate(parts) if parts else np.zeros((0,), dtype)
 
@@ -144,11 +160,13 @@ def _redistribute_triples(
     kpb: int,
     world: int,
     owned: List[int],
+    offsets: "Optional[np.ndarray]" = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Multi-process edge redistribution by block of ``keys``: returns
     the (keys, other, ratings) triples belonging to THIS process's
     blocks.  Identity when single-process (the caller's triples are
-    already the whole dataset)."""
+    already the whole dataset).  ``offsets`` switches the uniform block
+    mapping to capability-weighted boundaries (see _block_of)."""
     if jax.process_count() == 1:
         return (
             np.asarray(keys, np.int64),
@@ -158,7 +176,7 @@ def _redistribute_triples(
     own = _own_mask(world, owned)
     ku, ko, kr = [], [], []
     for k, o, r in _gathered_triple_chunks(keys, other, ratings):
-        mine = own[np.minimum(k // kpb, world - 1)]
+        mine = own[_block_of(k, kpb, world, offsets)]
         ku.append(k[mine])
         ko.append(o[mine])
         kr.append(r[mine])
@@ -231,6 +249,7 @@ def prepare_streamed_block_layouts(
     *,
     item_sharded: bool,
     sizes=None,
+    offsets=None,
 ) -> StreamedBlockLayouts:
     """Build the host-side grouped layouts for the streamed block fit.
 
@@ -242,16 +261,37 @@ def prepare_streamed_block_layouts(
     guard ran (models/als._block_dispatch) — threaded through so the
     build uses exactly the layout the guard priced, like the in-memory
     preps; otherwise group sizes derive from global stats here.  Either
-    way every process compiles identical static shapes."""
+    way every process compiles identical static shapes.
+
+    ``offsets`` is the capability-weighted user-block layout
+    (parallel/balance.block_offsets): ``(world + 1,)`` boundaries that
+    replace the uniform ``ceil(n/world)`` split, mirroring the
+    in-memory path's als_block.prepare_block_inputs.  ``upb`` becomes
+    the widest block and every consumer downstream is boundary-generic
+    (block-local rebasing, factor placement, checkpoint resharding).
+    Only valid on the replicated-item layout — the 2-D sharded layout's
+    identity mapping requires uniform blocks — and ``None`` keeps the
+    uniform arithmetic bit-identical."""
     cfg = get_config()
     axis = cfg.data_axis
     world = mesh.shape[axis]
     owned = owned_blocks(mesh, axis)
+    if offsets is not None and item_sharded:
+        raise ValueError(
+            "weighted block offsets require the replicated-item layout "
+            "(the 2-D identity mapping needs uniform blocks)"
+        )
     # integer ceil, matching the guards' kpb (a float ceil could differ
     # at large n and desynchronize the priced vs built layout)
     kpb_u = max(1, -(-n_users // world))
-    upb = kpb_u
-    offsets_u = np.minimum(np.arange(world + 1) * kpb_u, n_users)
+    if offsets is not None:
+        offsets_u = np.asarray(offsets, np.int64)
+        upb = max(1, int(np.max(np.diff(offsets_u))))
+        off_w = offsets_u
+    else:
+        upb = kpb_u
+        offsets_u = np.minimum(np.arange(world + 1) * kpb_u, n_users)
+        off_w = None
     if item_sharded:
         kpb_i = max(1, -(-n_items // world))
         ipb = kpb_i
@@ -280,24 +320,28 @@ def prepare_streamed_block_layouts(
         )
     else:
         uu, ui, ur = _redistribute_triples(
-            users, items, ratings, kpb_u, world, owned
+            users, items, ratings, kpb_u, world, owned, off_w
         )
-    ublock = np.minimum(uu // kpb_u, world - 1)
+    ublock = _block_of(uu, kpb_u, world, off_w)
     for b in owned:
         sel = ublock == b
+        # block-local rebase: the weighted layout subtracts the block's
+        # planned boundary, the uniform layout the exact b*kpb product
+        # (bit-identical to the pre-offsets arithmetic)
+        lo = int(offsets_u[b]) if off_w is not None else b * kpb_u
         # user side: dst = block-local user, src = global item id (the
         # padded-Y row under the identity mapping — als_block
         # prepare_block_inputs note — so the SAME layout serves both
         # item layouts' user updates)
         by_user[b] = build_grouped_edges(
-            uu[sel] - b * kpb_u, ui[sel], ur[sel], upb, p_u
+            uu[sel] - lo, ui[sel], ur[sel], upb, p_u
         )
         if not item_sharded:
             # replicated item side: dst = global item, src = LOCAL user
             # (indexes this rank's x block), exactly like
             # als_block.prepare_grouped_inputs
             by_item[b] = build_grouped_edges(
-                ui[sel], uu[sel] - b * kpb_u, ur[sel], n_items, p_i
+                ui[sel], uu[sel] - lo, ur[sel], n_items, p_i
             )
     if item_sharded:
         iblock = np.minimum(iu // kpb_i, world - 1)

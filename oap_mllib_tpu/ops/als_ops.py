@@ -234,7 +234,8 @@ def unpack_flat_moments(m_flat: jax.Array, r: int):
 
 
 def regularized_solve(a, b, n_reg, reg, eye, gram=None,
-                      kernel: str = "xla") -> jax.Array:
+                      kernel: str = "xla",
+                      geometry=None) -> jax.Array:
     """THE half-update solve every ALS path consumes moments through
     (single-device grouped/COO, streamed, block-parallel, streamed
     block): ALS-WR lambda scaling (reg x per-row rating count — Spark
@@ -247,12 +248,18 @@ def regularized_solve(a, b, n_reg, reg, eye, gram=None,
     assembly+solve kernel (ops/pallas/als_kernel.solve_traced — same
     elimination sequence, one HBM read of the moments, resolved by
     :func:`resolve_solve_kernel`); "pallas_interpret" is the CPU
-    interpret-mode leg tier-1 exercises the full runners through."""
+    interpret-mode leg tier-1 exercises the full runners through.
+    ``geometry``: tuned ``(batch, depth)`` for the pallas consumer
+    (ops/pallas/autotune, resolved eagerly by the runner wrappers and
+    threaded here as jit statics; None keeps the hand-picked
+    constants)."""
     if kernel.startswith("pallas"):
         from oap_mllib_tpu.ops.pallas.als_kernel import solve_traced
 
+        batch, depth = geometry if geometry else (None, None)
         return solve_traced(
-            a, b, n_reg, reg, gram, interpret=kernel == "pallas_interpret"
+            a, b, n_reg, reg, gram, interpret=kernel == "pallas_interpret",
+            batch=batch, depth=depth,
         )
     a = a + reg * n_reg[:, None, None] * eye[None]
     if gram is not None:
@@ -260,16 +267,20 @@ def regularized_solve(a, b, n_reg, reg, eye, gram=None,
     return masked_solve(a, b, n_reg)
 
 
-def _factor_gram(factors, kernel: str = "xla"):
+def _factor_gram(factors, kernel: str = "xla", geometry=None):
     """The implicit-feedback Gram ``F^T F`` feeding regularized_solve —
     psn.pdot on the XLA route, the streamed Pallas factor-Gram kernel on
     the pallas routes.  Pinned mode="highest" either way: Grams condition
-    the solve and never run reduced (utils/precision.py contract)."""
+    the solve and never run reduced (utils/precision.py contract).
+    ``geometry``: tuned ``(tile_rows, depth)`` statics, like
+    :func:`regularized_solve`."""
     if kernel.startswith("pallas"):
         from oap_mllib_tpu.ops.pallas.als_kernel import factor_gram_traced
 
+        tile_rows, depth = geometry if geometry else (None, None)
         return factor_gram_traced(
-            factors, "highest", interpret=kernel == "pallas_interpret"
+            factors, "highest", interpret=kernel == "pallas_interpret",
+            tile_rows=tile_rows, depth=depth,
         )
     return psn.pdot(factors.T, factors)
 
@@ -550,7 +561,7 @@ def normal_eq_partials_grouped(
     jax.jit,
     static_argnames=(
         "n_users", "n_items", "max_iter", "implicit", "policy",
-        "solve_kernel",
+        "solve_kernel", "solve_geo", "gram_geo",
     ),
 )
 def _als_run_grouped_jit(
@@ -566,6 +577,8 @@ def _als_run_grouped_jit(
     implicit: bool,
     policy: str = "f32",
     solve_kernel: str = "xla",
+    solve_geo=None,
+    gram_geo=None,
 ) -> Tuple[jax.Array, jax.Array]:
     r = x0.shape[1]
     eye = jnp.eye(r, dtype=x0.dtype)
@@ -575,9 +588,12 @@ def _als_run_grouped_jit(
             src_g, conf_g, valid_g, group_dst, factors, n_dst, alpha,
             implicit, policy,
         )
-        gram = _factor_gram(factors, solve_kernel) if implicit else None
+        gram = (
+            _factor_gram(factors, solve_kernel, gram_geo)
+            if implicit else None
+        )
         return regularized_solve(
-            a, b, n_reg, reg, eye, gram, solve_kernel
+            a, b, n_reg, reg, eye, gram, solve_kernel, solve_geo
         ).astype(factors.dtype)
 
     def body(carry, _):
@@ -619,20 +635,44 @@ def als_run_grouped(
     solve_kernel = solve_kernel or resolve_solve_kernel(
         x0.shape[1], x0.dtype
     )
+    solve_geo, gram_geo = _tuned_geometry(
+        x0.shape[1], solve_kernel, implicit
+    )
     # reg/alpha are traced scalars, not statics — they do not key a new
     # program and so stay out of the cache key
     key = (
         progcache.backend_fingerprint(),
         progcache.array_key(u_src_g, i_src_g, x0, y0),
         n_users, n_items, max_iter, implicit, policy, solve_kernel,
+        solve_geo, gram_geo,
     )
     with progcache.launch("als.run_grouped", key, timings, phase):
         return _als_run_grouped_jit(
             u_src_g, u_conf_g, u_valid_g, u_group_dst,
             i_src_g, i_conf_g, i_valid_g, i_group_dst,
             x0, y0, n_users, n_items, max_iter, reg, alpha, implicit,
-            policy, solve_kernel,
+            policy, solve_kernel, solve_geo, gram_geo,
         )
+
+
+def _tuned_geometry(r: int, solve_kernel: str, implicit: bool):
+    """Tuned ALS kernel geometry for the pallas consumers (ops/pallas/
+    autotune): ``(solve_geo, gram_geo)`` as hashable static tuples —
+    ``(batch, depth)`` and ``(tile_rows, depth)`` — or ``(None, None)``
+    on the XLA route.  Resolved EAGERLY by the runner wrappers (never
+    inside a traced body) so the cache/sweep machinery runs exactly once
+    per program build."""
+    if not solve_kernel.startswith("pallas"):
+        return None, None
+    from oap_mllib_tpu.ops.pallas import autotune
+
+    g = autotune.resolve("als_solve", autotune.shape_bucket(r))
+    solve_geo = (g["batch"], g["depth"])
+    gram_geo = None
+    if implicit:
+        gg = autotune.resolve("als_gram", autotune.shape_bucket(r))
+        gram_geo = (gg["tile_rows"], gg["depth"])
+    return solve_geo, gram_geo
 
 
 def _half_update(
@@ -646,26 +686,29 @@ def _half_update(
     alpha: float,
     policy: str = "f32",
     solve_kernel: str = "xla",
+    solve_geo=None,
+    gram_geo=None,
 ) -> jax.Array:
     """Solve one side's factors given the other side's. Returns (n_dst, r)."""
     r = src_factors.shape[1]
     # (r, r) <- MXU, psum over mesh — stays full f32 under every policy
     # (the Gram conditions the solve; its cost is O(n*r^2), not the hot path)
-    gram = _factor_gram(src_factors, solve_kernel)
+    gram = _factor_gram(src_factors, solve_kernel, gram_geo)
     a_part, b, n_reg = normal_eq_partials(
         dst_idx, src_idx, conf, valid, src_factors, n_dst, alpha, True,
         policy,
     )
     eye = jnp.eye(r, dtype=src_factors.dtype)
     return regularized_solve(
-        a_part, b, n_reg, reg, eye, gram, solve_kernel
+        a_part, b, n_reg, reg, eye, gram, solve_kernel, solve_geo
     ).astype(src_factors.dtype)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "n_users", "n_items", "max_iter", "policy", "solve_kernel"
+        "n_users", "n_items", "max_iter", "policy", "solve_kernel",
+        "solve_geo", "gram_geo",
     ),
 )
 def _als_implicit_run_jit(
@@ -682,17 +725,19 @@ def _als_implicit_run_jit(
     alpha: float,
     policy: str = "f32",
     solve_kernel: str = "xla",
+    solve_geo=None,
+    gram_geo=None,
 ) -> Tuple[jax.Array, jax.Array]:
 
     def body(carry, _):
         x, y = carry
         x = _half_update(
             u_idx, i_idx, conf, valid, y, n_users, reg, alpha, policy,
-            solve_kernel,
+            solve_kernel, solve_geo, gram_geo,
         )
         y = _half_update(
             i_idx, u_idx, conf, valid, x, n_items, reg, alpha, policy,
-            solve_kernel,
+            solve_kernel, solve_geo, gram_geo,
         )
         return (x, y), None
 
@@ -712,22 +757,26 @@ def als_implicit_run(
     solve_kernel = solve_kernel or resolve_solve_kernel(
         x0.shape[1], x0.dtype
     )
+    solve_geo, gram_geo = _tuned_geometry(x0.shape[1], solve_kernel, True)
     key = (
         progcache.backend_fingerprint(),
         progcache.array_key(u_idx, x0, y0),
-        n_users, n_items, max_iter, policy, solve_kernel,
+        n_users, n_items, max_iter, policy, solve_kernel, solve_geo,
+        gram_geo,
     )
     with progcache.launch("als.implicit_coo", key, timings, phase):
         return _als_implicit_run_jit(
             u_idx, i_idx, conf, valid, x0, y0,
             n_users, n_items, max_iter, reg, alpha, policy, solve_kernel,
+            solve_geo, gram_geo,
         )
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "n_users", "n_items", "max_iter", "policy", "solve_kernel"
+        "n_users", "n_items", "max_iter", "policy", "solve_kernel",
+        "solve_geo",
     ),
 )
 def _als_explicit_run_jit(
@@ -743,6 +792,7 @@ def _als_explicit_run_jit(
     reg: float,
     policy: str = "f32",
     solve_kernel: str = "xla",
+    solve_geo=None,
 ) -> Tuple[jax.Array, jax.Array]:
 
     def half(dst_idx, src_idx, src_factors, n_dst):
@@ -753,7 +803,7 @@ def _als_explicit_run_jit(
         )
         eye = jnp.eye(r, dtype=src_factors.dtype)
         return regularized_solve(
-            a_part, b, n_reg, reg, eye, None, solve_kernel
+            a_part, b, n_reg, reg, eye, None, solve_kernel, solve_geo
         ).astype(src_factors.dtype)
 
     def body(carry, _):
@@ -778,15 +828,17 @@ def als_explicit_run(
     solve_kernel = solve_kernel or resolve_solve_kernel(
         x0.shape[1], x0.dtype
     )
+    solve_geo, _ = _tuned_geometry(x0.shape[1], solve_kernel, False)
     key = (
         progcache.backend_fingerprint(),
         progcache.array_key(u_idx, x0, y0),
-        n_users, n_items, max_iter, policy, solve_kernel,
+        n_users, n_items, max_iter, policy, solve_kernel, solve_geo,
     )
     with progcache.launch("als.explicit_coo", key, timings, phase):
         return _als_explicit_run_jit(
             u_idx, i_idx, rating, valid, x0, y0,
             n_users, n_items, max_iter, reg, policy, solve_kernel,
+            solve_geo,
         )
 
 

@@ -409,7 +409,7 @@ def lloyd_run(
 
 def _lloyd_model_sharded_fn(mesh, dax: str, max_: str, max_iter: int,
                             precision: str, policy: str = "f32",
-                            ring: bool = False):
+                            ring: bool = False, ring_segments: int = 1):
     """Compiled model-sharded Lloyd program, cached in the process-wide
     program registry (utils/progcache — this function's old private
     functools.lru_cache is the pattern the registry generalizes) per
@@ -417,18 +417,19 @@ def _lloyd_model_sharded_fn(mesh, dax: str, max_: str, max_iter: int,
     closure per fit would recompile."""
     key = (
         progcache.mesh_fingerprint(mesh), dax, max_, max_iter, precision,
-        policy, ring,
+        policy, ring, ring_segments,
     )
     return progcache.get_or_build(
         "kmeans.lloyd_model_sharded", key,
         lambda: _build_lloyd_model_sharded(mesh, dax, max_, max_iter,
-                                           precision, policy, ring),
+                                           precision, policy, ring,
+                                           ring_segments),
     )
 
 
 def _build_lloyd_model_sharded(mesh, dax: str, max_: str, max_iter: int,
                                precision: str, policy: str = "f32",
-                               ring: bool = False):
+                               ring: bool = False, ring_segments: int = 1):
     """Build the jitted model-sharded Lloyd program (cached above).
 
     Mesh-sharded linalg (survey §5): on a (data, model) mesh each device
@@ -449,6 +450,9 @@ def _build_lloyd_model_sharded(mesh, dax: str, max_: str, max_iter: int,
     (ops/pallas/ring_reduce.ring_allreduce — remote-DMA kernel on TPU,
     the identical-schedule ppermute program elsewhere); the model-axis
     assignment psum and the convergence-move psum are untouched.
+    ``ring_segments`` > 1 splits the packed buffer into that many
+    independently-fenced ring reductions (segmented-start epilogue, a
+    tuned knob — see ring_allreduce's docstring).
     """
     world = mesh.shape[dax]
 
@@ -491,7 +495,8 @@ def _build_lloyd_model_sharded(mesh, dax: str, max_: str, max_iter: int,
 
             d_loc = sums_part.shape[1]
             red = ring_allreduce(
-                jnp.concatenate([sums_part, extra], axis=1), dax, world
+                jnp.concatenate([sums_part, extra], axis=1), dax, world,
+                segments=ring_segments,
             )
             sums_blk = red[:, :d_loc]
             counts = red[:, d_loc]
@@ -554,6 +559,7 @@ def lloyd_run_model_sharded(
     timings=None,
     phase: str = "lloyd_loop",
     policy: str = "f32",
+    ring_segments: int = 1,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Lloyd loop with centroids feature-sharded over the MODEL axis.
 
@@ -568,12 +574,14 @@ def lloyd_run_model_sharded(
     parity lane keeps the psum path).
     """
     ring = ring_enabled(mesh, data_axis) and np.dtype(x.dtype) == np.float32
+    ring_segments = max(1, int(ring_segments)) if ring else 1
     fn = _lloyd_model_sharded_fn(mesh, data_axis, model_axis, max_iter,
-                                 precision, policy, ring)
+                                 precision, policy, ring, ring_segments)
     key = (
         progcache.mesh_fingerprint(mesh),
         progcache.array_key(x, weights),
         np.asarray(init_centers).shape, max_iter, precision, policy, ring,
+        ring_segments,
     )
     with progcache.launch("kmeans.lloyd_model_sharded.run", key, timings,
                           phase):

@@ -1,0 +1,113 @@
+"""Shared double-buffered tile-walk plumbing for the Pallas kernel plane.
+
+The grid-pipelined kernels (kmeans/pca/als ``pallas_call`` grids) lean on
+the Mosaic pipeline to stage the next block while the current one
+computes.  The communication-avoiding restructure (ROADMAP item 4, the
+rank-k-update formulation of arXiv:2601.17136) makes that overlap
+explicit instead: inputs stay in HBM (``memory_space=ANY``), each kernel
+walks its tiles with a *rotating* VMEM buffer of static ``depth``, and
+the DMA for tile ``t + depth - 1`` is in flight while tile ``t``
+computes — the SNIPPETS [1] async-copy pattern applied within a rank.
+Accumulators live in VMEM for the whole walk, so intermediates (the
+K-Means one-hot, the centered PCA tile) never round-trip HBM.
+
+This module owns the two pieces every kernel shares, so the rotation
+arithmetic cannot drift between them:
+
+- :func:`rotation_scratch` — the ``scratch_shapes`` entries for one
+  walk: a ``(depth, *tile)`` VMEM buffer plus a ``(depth,)`` DMA
+  semaphore per input.
+- :func:`tile_walk` — the in-kernel driver: warm-up starts for the
+  first ``depth - 1`` tiles, then a ``fori_loop`` that prefetches tile
+  ``t + depth - 1`` into its rotation slot, waits tile ``t``'s DMA, and
+  hands the resident views to the kernel's tile body.  Tiles are
+  visited strictly in order, so the accumulation order — and therefore
+  every result bit — matches the grid-pipelined kernels and the
+  schedule-identical XLA fallbacks (``lax.scan`` over the same tiles in
+  the same order; see each kernel's ``_xla_walk``).
+
+Depth is a tuned knob (ops/pallas/autotune.py): 2 = classic double
+buffering, 3+ trades VMEM for slack against DMA-latency jitter.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEPTHS = (2, 3, 4)  # supported rotation depths (1 means "use the grid kernel")
+
+
+def check_depth(depth: int) -> int:
+    depth = int(depth)
+    if depth not in DEPTHS:
+        raise ValueError(
+            f"rotation depth must be one of {DEPTHS}, got {depth!r}"
+        )
+    return depth
+
+
+def rotation_scratch(depth: int, tile_shapes):
+    """``scratch_shapes`` for one rotating walk over ``len(tile_shapes)``
+    inputs: the VMEM rotation buffers first, then one (depth,) DMA
+    semaphore array per input (kernel scratch refs arrive in this
+    order)."""
+    shapes = [
+        pltpu.VMEM((depth,) + tuple(ts), jnp.float32) for ts in tile_shapes
+    ]
+    shapes += [pltpu.SemaphoreType.DMA((depth,)) for _ in tile_shapes]
+    return shapes
+
+
+def tile_walk(inputs, bufs, sems, tile, num_tiles, depth, body, axes=None):
+    """Drive one double-buffered walk inside a kernel body.
+
+    ``inputs`` are HBM (``ANY``) refs, ``bufs``/``sems`` the matching
+    rotation scratch from :func:`rotation_scratch`, ``tile`` the static
+    tile extent along each input's walk axis (``axes``, default 0 —
+    the ALS solve walks axis 1), ``num_tiles`` the static tile count.
+    ``body(t, views)`` receives the tile index and the resident
+    ``(tile, ...)`` views; it mutates the kernel's accumulator refs.
+
+    The start/wait pair rebuilds the same copy descriptor (the async
+    copy contract), keyed by rotation slot ``t % depth``.
+    """
+    if axes is None:
+        axes = (0,) * len(inputs)
+
+    def _dma(ref, buf, sem, ax, slot, t):
+        if ax == 0:
+            src = ref.at[pl.ds(t * tile, tile)]
+        else:
+            src = ref.at[:, pl.ds(t * tile, tile)]
+        return pltpu.make_async_copy(src, buf.at[slot], sem.at[slot])
+
+    def _start(t):
+        slot = lax.rem(t, depth)
+        for ref, buf, sem, ax in zip(inputs, bufs, sems, axes):
+            _dma(ref, buf, sem, ax, slot, t).start()
+
+    def _wait(t):
+        slot = lax.rem(t, depth)
+        for ref, buf, sem, ax in zip(inputs, bufs, sems, axes):
+            _dma(ref, buf, sem, ax, slot, t).wait()
+
+    # warm-up: fill the pipeline with the first depth-1 tiles
+    for t in range(min(depth - 1, num_tiles)):
+        _start(jnp.int32(t))
+
+    def _step(t, carry):
+        nxt = t + depth - 1
+
+        @pl.when(nxt < num_tiles)
+        def _prefetch():
+            _start(nxt)
+
+        _wait(t)
+        slot = lax.rem(t, depth)
+        body(t, [buf[slot] for buf in bufs])
+        return carry
+
+    lax.fori_loop(0, num_tiles, _step, jnp.int32(0))

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from oap_mllib_tpu.ops.pallas import _dbuf
 from oap_mllib_tpu.ops.pallas._tiers import (
     LANE,
     check_mode,
@@ -86,6 +87,60 @@ def _cluster_sums(one_hot01, wx, mode):
     return dot_bf16(oh, wx_hi, dn) + dot_bf16(oh, wx_lo, dn)
 
 
+def _tile_update(x, w, c, mode, need_cost):
+    """One resident tile's full fused update: assignment + moment
+    accumulation with the one-hot/centered intermediates living and
+    dying in VMEM (never HBM).  Shared by the grid kernel, the
+    double-buffered walk kernel, and the schedule-identical XLA
+    fallback, so the three cannot drift a bit.  Returns
+    ``(sums_inc (k, d), counts_inc (1, k), cost_inc | None)``."""
+    k = c.shape[0]
+    c_sq = jnp.sum(c * c, axis=1)[None, :]  # (1, k)
+    cross = _cross_term(x, c, mode)  # (bn, k)  <- MXU
+
+    if need_cost:
+        # squared distances via the matmul identity (MXU)
+        x_sq = jnp.sum(x * x, axis=1, keepdims=True)  # (bn, 1)
+        d2 = jnp.maximum(x_sq + c_sq - 2.0 * cross, 0.0)
+        assign = jnp.argmin(d2, axis=1)  # (bn,)
+        min_d2 = jnp.min(d2, axis=1, keepdims=True)  # (bn, 1)
+    else:
+        # loop mode: argmin is invariant to the per-row |x|^2 term, so
+        # rank on the half-score x.c - c_sq/2 (argMAX) — no d2 assembly,
+        # no maximum, no min pass (cost is dead inside the Lloyd loop:
+        # the caller recomputes it at "highest" after convergence).
+        # NB keep the (bn, k) term on the LEFT of the subtract: with the
+        # broadcast (1, k) operand first, Mosaic's lowering allocates a
+        # ~32 MB scoped-vmem temp and fails to compile (argmax of
+        # cross - c_sq/2 selects the same center, same first-index
+        # tie-break as argmin of the negation)
+        assign = jnp.argmax(cross - 0.5 * c_sq, axis=1)  # (bn,)
+
+    # unweighted 0/1 one-hot (VPU compare against 2-D iota); weights fold
+    # into w*x so the one-hot stays exactly representable in bf16
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
+    one_hot = jnp.where(col_ids == assign[:, None], 1.0, 0.0)  # (bn, k)
+
+    sums_inc = _cluster_sums(one_hot, w * x, mode)
+    if mode == "highest":
+        # strict-parity tier: exact f32 VPU reduction
+        counts_inc = jnp.sum(one_hot * w, axis=0, keepdims=True)
+    else:
+        # fast tiers: counts as (1, bn) @ (bn, k) bf16 matmuls with
+        # f32 accumulation — the one-hot is exact 0/1 and w rides a
+        # hi/lo split, so counts stay ~f32-exact for ANY weights
+        # while the two VPU passes over (bn, k) disappear (measured
+        # -1.1 ms/iter at 1M x 256 k=1000).  NB bf16 single-pass at
+        # this shape compiles where the f32-HIGHEST variant blew
+        # Mosaic's scoped vmem (see the assignment note above).
+        oh = one_hot.astype(jnp.bfloat16)
+        w_hi, w_lo = split_bf16(w)
+        dn = (((1,), (0,)), ((), ()))
+        counts_inc = dot_bf16(w_hi.T, oh, dn) + dot_bf16(w_lo.T, oh, dn)
+    cost_inc = jnp.sum(min_d2 * w) if need_cost else None
+    return sums_inc, counts_inc, cost_inc
+
+
 def _make_kernel(mode, need_cost=True):
     def _kernel(x_ref, w_ref, c_ref, sums_ref, counts_ref, cost_ref):
         """One grid step: process a (bn, d) row block against all k centers."""
@@ -96,72 +151,30 @@ def _make_kernel(mode, need_cost=True):
             counts_ref[:] = jnp.zeros_like(counts_ref)
             cost_ref[0, 0] = jnp.float32(0.0)
 
-        x = x_ref[:]  # (bn, d)
-        w = w_ref[:]  # (bn, 1)
-        c = c_ref[:]  # (k, d)
-        k = c.shape[0]
-
-        c_sq = jnp.sum(c * c, axis=1)[None, :]  # (1, k)
-        cross = _cross_term(x, c, mode)  # (bn, k)  <- MXU
-
+        sums_inc, counts_inc, cost_inc = _tile_update(
+            x_ref[:], w_ref[:], c_ref[:], mode, need_cost
+        )
+        sums_ref[:] += sums_inc
+        counts_ref[:] += counts_inc
         if need_cost:
-            # squared distances via the matmul identity (MXU)
-            x_sq = jnp.sum(x * x, axis=1, keepdims=True)  # (bn, 1)
-            d2 = jnp.maximum(x_sq + c_sq - 2.0 * cross, 0.0)
-            assign = jnp.argmin(d2, axis=1)  # (bn,)
-            min_d2 = jnp.min(d2, axis=1, keepdims=True)  # (bn, 1)
-        else:
-            # loop mode: argmin is invariant to the per-row |x|^2 term, so
-            # rank on the half-score x.c - c_sq/2 (argMAX) — no d2 assembly,
-            # no maximum, no min pass (cost is dead inside the Lloyd loop:
-            # the caller recomputes it at "highest" after convergence).
-            # NB keep the (bn, k) term on the LEFT of the subtract: with the
-            # broadcast (1, k) operand first, Mosaic's lowering allocates a
-            # ~32 MB scoped-vmem temp and fails to compile (argmax of
-            # cross - c_sq/2 selects the same center, same first-index
-            # tie-break as argmin of the negation)
-            assign = jnp.argmax(cross - 0.5 * c_sq, axis=1)  # (bn,)
-
-        # unweighted 0/1 one-hot (VPU compare against 2-D iota); weights fold
-        # into w*x so the one-hot stays exactly representable in bf16
-        col_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
-        one_hot = jnp.where(col_ids == assign[:, None], 1.0, 0.0)  # (bn, k)
-
-        sums_ref[:] += _cluster_sums(one_hot, w * x, mode)
-        if mode == "highest":
-            # strict-parity tier: exact f32 VPU reduction
-            counts_ref[:] += jnp.sum(one_hot * w, axis=0, keepdims=True)
-        else:
-            # fast tiers: counts as (1, bn) @ (bn, k) bf16 matmuls with
-            # f32 accumulation — the one-hot is exact 0/1 and w rides a
-            # hi/lo split, so counts stay ~f32-exact for ANY weights
-            # while the two VPU passes over (bn, k) disappear (measured
-            # -1.1 ms/iter at 1M x 256 k=1000).  NB bf16 single-pass at
-            # this shape compiles where the f32-HIGHEST variant blew
-            # Mosaic's scoped vmem (see the assignment note above).
-            oh = one_hot.astype(jnp.bfloat16)
-            w_hi, w_lo = split_bf16(w)
-            dn = (((1,), (0,)), ((), ()))
-            counts_ref[:] += dot_bf16(w_hi.T, oh, dn) + dot_bf16(w_lo.T, oh, dn)
-        if need_cost:
-            cost_ref[0, 0] += jnp.sum(min_d2 * w)
+            cost_ref[0, 0] += cost_inc
 
     return _kernel
 
 
 def _pallas_accumulate(x, w, centers, mode="highest", interpret=False,
-                       need_cost=True):
+                       need_cost=True, block_rows=_BLOCK_ROWS):
     """Raw pallas_call on pre-padded operands (traced inside the jitted
     wrappers below — no jit of its own)."""
     n, d = x.shape
     k = centers.shape[0]
-    grid = (n // _BLOCK_ROWS,)
+    grid = (n // block_rows,)
     sums, counts, cost = pl.pallas_call(
         _make_kernel(mode, need_cost),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((k, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -179,19 +192,174 @@ def _pallas_accumulate(x, w, centers, mode="highest", interpret=False,
     return sums, counts, cost
 
 
+# -- double-buffered walk (explicit DMA overlap; ROADMAP item 4) -------------
+
+
+def _make_dbuf_kernel(mode, need_cost, tile_rows, depth, num_tiles):
+    def _kernel(x_hbm, w_hbm, c_ref, sums_ref, counts_ref, cost_ref,
+                xbuf, wbuf, xsem, wsem):
+        """Single-invocation walk: x/w stay in HBM, each (tile_rows, d)
+        tile streams into the rotation buffer while the previous tile's
+        fused update runs — the accumulators are VMEM-resident for the
+        whole walk."""
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        cost_ref[0, 0] = jnp.float32(0.0)
+        c = c_ref[:]
+
+        def body(t, views):
+            x, w = views
+            sums_inc, counts_inc, cost_inc = _tile_update(
+                x, w, c, mode, need_cost
+            )
+            sums_ref[:] += sums_inc
+            counts_ref[:] += counts_inc
+            if need_cost:
+                cost_ref[0, 0] += cost_inc
+
+        _dbuf.tile_walk(
+            [x_hbm, w_hbm], [xbuf, wbuf], [xsem, wsem],
+            tile_rows, num_tiles, depth, body,
+        )
+
+    return _kernel
+
+
+def _pallas_accumulate_dbuf(x, w, centers, mode, interpret, need_cost,
+                            tile_rows, depth):
+    """Raw double-buffered pallas_call on pre-padded operands (rows a
+    multiple of ``tile_rows``)."""
+    n, d = x.shape
+    k = centers.shape[0]
+    num_tiles = n // tile_rows
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.TPUCompilerParams(
+            has_side_effects=True
+        )
+    sums, counts, cost = pl.pallas_call(
+        _make_dbuf_kernel(mode, need_cost, tile_rows, depth, num_tiles),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        scratch_shapes=_dbuf.rotation_scratch(
+            depth, [(tile_rows, d), (tile_rows, 1)]
+        ),
+        interpret=interpret,
+        **kwargs,
+    )(x, w, centers)
+    return sums, counts, cost
+
+
+def _xla_walk(x_p, w_p, c_p, mode, need_cost, tile_rows):
+    """Schedule-identical XLA fallback for the double-buffered walk: a
+    ``lax.scan`` over the SAME (tile_rows, d) tiles in the SAME order
+    through the SAME ``_tile_update``, so the CPU tier-1 suite exercises
+    the exact program structure (and bits) the DMA kernel produces."""
+    n, d = x_p.shape
+    k = c_p.shape[0]
+    num_tiles = n // tile_rows
+    xt = x_p.reshape(num_tiles, tile_rows, d)
+    wt = w_p.reshape(num_tiles, tile_rows, 1)
+
+    def step(carry, tile):
+        sums, counts, cost = carry
+        xi, wi = tile
+        sums_inc, counts_inc, cost_inc = _tile_update(
+            xi, wi, c_p, mode, need_cost
+        )
+        cost = cost + cost_inc if need_cost else cost
+        return (sums + sums_inc, counts + counts_inc, cost), None
+
+    init = (
+        jnp.zeros((k, d), jnp.float32),
+        jnp.zeros((1, k), jnp.float32),
+        jnp.float32(0.0),
+    )
+    (sums, counts, cost), _ = jax.lax.scan(step, init, (xt, wt))
+    return sums, counts, cost.reshape(1, 1)
+
+
+def _accumulate_walk_any(x_p, w_p, c_p, mode, interpret, need_cost,
+                         tile_rows, depth):
+    """Backend dispatch for the walk on pre-padded operands: the DMA
+    kernel on TPU (or under interpret), the schedule-identical XLA scan
+    elsewhere."""
+    if interpret or jax.default_backend() == "tpu":
+        return _pallas_accumulate_dbuf(
+            x_p, w_p, c_p, mode, interpret, need_cost, tile_rows, depth
+        )
+    return _xla_walk(x_p, w_p, c_p, mode, need_cost, tile_rows)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mode", "interpret", "need_cost", "tile_rows", "depth"),
+)
+def _walk_jit(x, weights, centers, mode, interpret, need_cost, tile_rows,
+              depth):
+    k, d = centers.shape[0], x.shape[1]
+    x_p, w_p, c_p = _pad_operands_traced(
+        x, weights, centers, block_rows=tile_rows
+    )
+    sums, counts, cost = _accumulate_walk_any(
+        x_p, w_p, c_p, mode, interpret, need_cost, tile_rows, depth
+    )
+    return sums[:k, :d], counts[0, :k], cost[0, 0]
+
+
+def lloyd_accumulate_walk(
+    x: jax.Array,
+    weights: jax.Array,
+    centers: jax.Array,
+    mode: str = "highest",
+    interpret: bool = False,
+    tile_rows: int = _BLOCK_ROWS,
+    depth: int = 2,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Double-buffered fused accumulate: same contract (and bits) as
+    :func:`lloyd_accumulate_pallas`, with explicit DMA/compute overlap
+    and tunable geometry (ops/pallas/autotune.py)."""
+    mode = check_mode(mode)
+    _dbuf.check_depth(depth)
+    progcache.note(
+        "kmeans.pallas_walk",
+        (progcache.backend_fingerprint(),
+         progcache.array_key(x, weights, centers), mode, interpret,
+         tile_rows, depth),
+    )
+    with kernel_launch("kmeans.accumulate_walk"):
+        return _walk_jit(
+            x, weights, centers, mode, interpret, True, int(tile_rows),
+            int(depth),
+        )
+
+
 @functools.partial(jax.jit, static_argnames=("mode", "interpret", "need_cost"))
 def _call(x, w, centers, mode="highest", interpret=False, need_cost=True):
     return _pallas_accumulate(x, w, centers, mode, interpret, need_cost)
 
 
-def _pad_operands_traced(x, weights, centers):
+def _pad_operands_traced(x, weights, centers, block_rows=_BLOCK_ROWS):
     """Padding math shared by the jitted wrappers (traced, never eager):
-    rows to the 512-row block, k and d to lane multiples.  Dummy centers
-    sit at 1e15 so no real row selects them; dummy feature columns of
-    real centers are 0 (matching padded x columns)."""
+    rows to the row-block multiple, k and d to lane multiples.  Dummy
+    centers sit at 1e15 so no real row selects them; dummy feature
+    columns of real centers are 0 (matching padded x columns)."""
     n, d = x.shape
     k = centers.shape[0]
-    n_pad = pad_to(max(n, _BLOCK_ROWS), _BLOCK_ROWS)
+    n_pad = pad_to(max(n, block_rows), block_rows)
     d_pad = pad_to(d, LANE)
     k_pad = pad_to(k, LANE)
     x_p = jnp.zeros((n_pad, d_pad), jnp.float32).at[:n, :d].set(x.astype(jnp.float32))
@@ -203,30 +371,65 @@ def _pad_operands_traced(x, weights, centers):
     return x_p, w_p, c_p
 
 
-def _pad_operands(x, weights, centers):
+def _pad_operands(x, weights, centers, block_rows=_BLOCK_ROWS):
     """One compiled program per shape signature for the loop entry's pad
     step — previously ~6 eager dispatches per call.  Built through the
     program-cache registry (R1: jit lives in a get_or_build builder)."""
     fn = progcache.get_or_build(
-        "kmeans.pallas_pad", (),
-        lambda: jax.jit(_pad_operands_traced),
+        "kmeans.pallas_pad", (block_rows,),
+        lambda: jax.jit(
+            functools.partial(_pad_operands_traced, block_rows=block_rows)
+        ),
     )
     return fn(x, weights, centers)
 
 
-@functools.partial(jax.jit, static_argnames=("mode", "interpret", "need_cost"))
-def _accumulate_jit(x, weights, centers, mode, interpret, need_cost):
+def _accum_any(x_p, w_p, centers, mode, interpret, need_cost, tile_rows,
+               depth):
+    """Kernel-variant dispatch on pre-padded operands: the grid-pipelined
+    kernel at depth < 2, the explicit double-buffered walk (DMA kernel on
+    TPU/interpret, schedule-identical XLA scan elsewhere) at depth >= 2.
+    All variants share ``_tile_update``, so this choice never moves a
+    result bit — only the overlap."""
+    if depth >= 2:
+        return _accumulate_walk_any(
+            x_p, w_p, centers, mode, interpret, need_cost, tile_rows, depth
+        )
+    return _pallas_accumulate(
+        x_p, w_p, centers, mode, interpret, need_cost, tile_rows
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mode", "interpret", "need_cost", "tile_rows", "depth"),
+)
+def _accumulate_jit(x, weights, centers, mode, interpret, need_cost,
+                    tile_rows=_BLOCK_ROWS, depth=0):
     """Single-shot fused accumulate: pad + kernel + slice in ONE jitted
     program.  The old path ran ``_pad_operands`` eagerly before a jitted
     kernel call — roughly six XLA dispatches of padding scatter/concat per
     invocation that the program cache could not see (``lloyd_run_pallas``
     pads once outside its loop and never had the problem)."""
     k, d = centers.shape[0], x.shape[1]
-    x_p, w_p, c_p = _pad_operands_traced(x, weights, centers)
-    sums, counts, cost = _pallas_accumulate(
-        x_p, w_p, c_p, mode, interpret, need_cost
+    x_p, w_p, c_p = _pad_operands_traced(
+        x, weights, centers, block_rows=tile_rows
+    )
+    sums, counts, cost = _accum_any(
+        x_p, w_p, c_p, mode, interpret, need_cost, tile_rows, depth
     )
     return sums[:k, :d], counts[0, :k], cost[0, 0]
+
+
+def _norm_geometry(tile_rows, depth):
+    """Normalize optional tuned geometry to the static (tile_rows, depth)
+    pair the jitted entries key on: None -> the hand-picked defaults
+    (grid kernel at the 512-row block)."""
+    tile_rows = _BLOCK_ROWS if tile_rows is None else int(tile_rows)
+    depth = 0 if depth is None else int(depth)
+    if depth >= 2:
+        _dbuf.check_depth(depth)
+    return tile_rows, depth
 
 
 def lloyd_accumulate_pallas(
@@ -235,24 +438,36 @@ def lloyd_accumulate_pallas(
     centers: jax.Array,
     mode: str = "highest",
     interpret: bool = False,
+    tile_rows: int = None,
+    depth: int = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Drop-in replacement for ops.kmeans_ops._accumulate (f32 only).
 
     One registry-tracked jitted program per input signature (padding
-    included — see ``_accumulate_jit``).
+    included — see ``_accumulate_jit``).  ``tile_rows``/``depth`` carry
+    tuned geometry (ops/pallas/autotune.py); depth >= 2 routes to the
+    double-buffered walk, bit-identical by construction.
     """
     mode = check_mode(mode)
+    tile_rows, depth = _norm_geometry(tile_rows, depth)
     progcache.note(
         "kmeans.pallas_accumulate",
         (progcache.backend_fingerprint(),
-         progcache.array_key(x, weights, centers), mode, interpret),
+         progcache.array_key(x, weights, centers), mode, interpret,
+         tile_rows, depth),
     )
     with kernel_launch("kmeans.accumulate"):
-        return _accumulate_jit(x, weights, centers, mode, interpret, True)
+        return _accumulate_jit(
+            x, weights, centers, mode, interpret, True, tile_rows, depth
+        )
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "mode", "interpret"))
-def _lloyd_loop_padded(x_p, w_p, c_p, max_iter, tol, mode="highest", interpret=False):
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_iter", "mode", "interpret", "tile_rows", "depth"),
+)
+def _lloyd_loop_padded(x_p, w_p, c_p, max_iter, tol, mode="highest",
+                       interpret=False, tile_rows=_BLOCK_ROWS, depth=0):
     """while_loop over the fused kernel on pre-padded operands."""
     tol_sq = tol * tol
 
@@ -262,8 +477,8 @@ def _lloyd_loop_padded(x_p, w_p, c_p, max_iter, tol, mode="highest", interpret=F
 
     def body(state):
         centers, it, _ = state
-        sums, counts, _ = _pallas_accumulate(
-            x_p, w_p, centers, mode, interpret, need_cost=False
+        sums, counts, _ = _accum_any(
+            x_p, w_p, centers, mode, interpret, False, tile_rows, depth
         )
         counts_col = counts[0][:, None]  # (k_pad, 1)
         new_centers = jnp.where(
@@ -278,24 +493,29 @@ def _lloyd_loop_padded(x_p, w_p, c_p, max_iter, tol, mode="highest", interpret=F
     # final cost + counts w.r.t. the returned centers, always at full
     # precision — the user-facing objective should not carry the fast
     # tiers' distance error
-    _, counts, cost = _pallas_accumulate(
-        x_p, w_p, centers, "highest", interpret, need_cost=True
+    _, counts, cost = _accum_any(
+        x_p, w_p, centers, "highest", interpret, True, tile_rows, depth
     )
     return centers, n_iter, cost[0, 0], counts[0]
 
 
 def lloyd_run_pallas(x, weights, init_centers, max_iter, tol,
-                     mode: str = "highest", interpret: bool = False):
+                     mode: str = "highest", interpret: bool = False,
+                     tile_rows: int = None, depth: int = None):
     """Fused-kernel Lloyd loop; same contract as ops.kmeans_ops.lloyd_run
     (f32, adds per-cluster counts). Pads once outside the loop (one
-    compiled pad program), slices the result back."""
+    compiled pad program), slices the result back.  Tuned geometry rides
+    ``tile_rows``/``depth`` (depth >= 2 = the double-buffered walk)."""
     mode = check_mode(mode)
+    tile_rows, depth = _norm_geometry(tile_rows, depth)
     d = x.shape[1]
     k = init_centers.shape[0]
     with kernel_launch("kmeans.lloyd_loop"):
-        x_p, w_p, c_p = _pad_operands(x, weights, init_centers)
+        x_p, w_p, c_p = _pad_operands(
+            x, weights, init_centers, block_rows=tile_rows
+        )
         centers, n_iter, cost, counts = _lloyd_loop_padded(
             x_p, w_p, c_p, max_iter, jnp.asarray(tol, jnp.float32), mode,
-            interpret,
+            interpret, tile_rows, depth,
         )
     return centers[:k, :d], n_iter, cost, counts[:k]

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from oap_mllib_tpu.ops.pallas import _dbuf
 from oap_mllib_tpu.ops.pallas._tiers import (
     LANE,
     check_mode,
@@ -54,6 +55,25 @@ from oap_mllib_tpu.utils import progcache
 _BLOCK_ROWS = 512
 
 
+def _tile_moments(x, m, mean, mode, need_gram):
+    """One resident tile's moment update — center + mask + Gram with the
+    centered intermediate living and dying in VMEM.  Shared by the grid
+    kernel, the double-buffered walk, and the schedule-identical XLA
+    fallback.  Returns (gram_inc | None, colsum_inc (1, d), count_inc)."""
+    xm = x * m
+    # raw masked column sums + weighted row count: always exact f32
+    # VPU reductions (the mean numerator must not carry tier rounding)
+    colsum_inc = jnp.sum(xm, axis=0, keepdims=True)
+    count_inc = jnp.sum(m)
+    gram_inc = None
+    if need_gram:
+        xc = (x - mean) * m  # centered in f32, masked
+        # (d, d) += xc^T @ xc — contract the row axis on the MXU at
+        # the requested tier (hi/lo splits round xc ONCE per operand)
+        gram_inc = tiered_dot(xc, xc, (((0,), (0,)), ((), ())), mode)
+    return gram_inc, colsum_inc, count_inc
+
+
 def _make_kernel(mode, need_gram):
     def _kernel(x_ref, m_ref, mean_ref, gram_ref, colsum_ref, count_ref):
         """One grid step: fold a (bn, d) row block into the moments."""
@@ -63,35 +83,29 @@ def _make_kernel(mode, need_gram):
             colsum_ref[:] = jnp.zeros_like(colsum_ref)
             count_ref[0, 0] = jnp.float32(0.0)
 
-        x = x_ref[:]  # (bn, d)
-        m = m_ref[:]  # (bn, 1)
-        xm = x * m
-        # raw masked column sums + weighted row count: always exact f32
-        # VPU reductions (the mean numerator must not carry tier rounding)
-        colsum_ref[:] += jnp.sum(xm, axis=0, keepdims=True)
-        count_ref[0, 0] += jnp.sum(m)
+        gram_inc, colsum_inc, count_inc = _tile_moments(
+            x_ref[:], m_ref[:], mean_ref[:], mode, need_gram
+        )
+        colsum_ref[:] += colsum_inc
+        count_ref[0, 0] += count_inc
         if need_gram:
-            xc = (x - mean_ref[:]) * m  # centered in f32, masked
-            # (d, d) += xc^T @ xc — contract the row axis on the MXU at
-            # the requested tier (hi/lo splits round xc ONCE per operand)
-            gram_ref[:] += tiered_dot(
-                xc, xc, (((0,), (0,)), ((), ())), mode
-            )
+            gram_ref[:] += gram_inc
 
     return _kernel
 
 
-def _pallas_moments(x, m, mean, mode, interpret, need_gram):
+def _pallas_moments(x, m, mean, mode, interpret, need_gram,
+                    block_rows=_BLOCK_ROWS):
     """Raw pallas_call on pre-padded operands (traced inside the jitted
     wrappers — no jit of its own)."""
     n, d = x.shape
-    grid = (n // _BLOCK_ROWS,)
+    grid = (n // block_rows,)
     gram, colsum, count = pl.pallas_call(
         _make_kernel(mode, need_gram),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -109,12 +123,120 @@ def _pallas_moments(x, m, mean, mode, interpret, need_gram):
     return gram, colsum, count
 
 
-def _pad_rows_cols(x, mask, mean):
+# -- double-buffered walk (explicit DMA overlap; ROADMAP item 4) -------------
+
+
+def _make_dbuf_kernel(mode, need_gram, tile_rows, depth, num_tiles):
+    def _kernel(x_hbm, m_hbm, mean_ref, gram_ref, colsum_ref, count_ref,
+                xbuf, mbuf, xsem, msem):
+        gram_ref[:] = jnp.zeros_like(gram_ref)
+        colsum_ref[:] = jnp.zeros_like(colsum_ref)
+        count_ref[0, 0] = jnp.float32(0.0)
+        mean = mean_ref[:]
+
+        def body(t, views):
+            x, m = views
+            gram_inc, colsum_inc, count_inc = _tile_moments(
+                x, m, mean, mode, need_gram
+            )
+            colsum_ref[:] += colsum_inc
+            count_ref[0, 0] += count_inc
+            if need_gram:
+                gram_ref[:] += gram_inc
+
+        _dbuf.tile_walk(
+            [x_hbm, m_hbm], [xbuf, mbuf], [xsem, msem],
+            tile_rows, num_tiles, depth, body,
+        )
+
+    return _kernel
+
+
+def _pallas_moments_dbuf(x, m, mean, mode, interpret, need_gram,
+                         tile_rows, depth):
+    n, d = x.shape
+    num_tiles = n // tile_rows
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.TPUCompilerParams(
+            has_side_effects=True
+        )
+    gram, colsum, count = pl.pallas_call(
+        _make_dbuf_kernel(mode, need_gram, tile_rows, depth, num_tiles),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((d, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        scratch_shapes=_dbuf.rotation_scratch(
+            depth, [(tile_rows, d), (tile_rows, 1)]
+        ),
+        interpret=interpret,
+        **kwargs,
+    )(x, m, mean)
+    return gram, colsum, count
+
+
+def _xla_walk(x_p, m_p, mean_p, mode, need_gram, tile_rows):
+    """Schedule-identical XLA fallback: ``lax.scan`` over the same tiles
+    in the same order through the same ``_tile_moments``."""
+    n, d = x_p.shape
+    num_tiles = n // tile_rows
+    xt = x_p.reshape(num_tiles, tile_rows, d)
+    mt = m_p.reshape(num_tiles, tile_rows, 1)
+
+    def step(carry, tile):
+        gram, colsum, count = carry
+        xi, mi = tile
+        gram_inc, colsum_inc, count_inc = _tile_moments(
+            xi, mi, mean_p, mode, need_gram
+        )
+        gram = gram + gram_inc if need_gram else gram
+        return (gram, colsum + colsum_inc, count + count_inc), None
+
+    init = (
+        jnp.zeros((d, d), jnp.float32),
+        jnp.zeros((1, d), jnp.float32),
+        jnp.float32(0.0),
+    )
+    (gram, colsum, count), _ = jax.lax.scan(step, init, (xt, mt))
+    return gram, colsum, count.reshape(1, 1)
+
+
+def _moments_any(x_p, m_p, mean_p, mode, interpret, need_gram, tile_rows,
+                 depth):
+    """Kernel-variant dispatch on pre-padded operands (the kmeans_kernel
+    ``_accum_any`` pattern): grid pipeline at depth < 2, double-buffered
+    walk at depth >= 2 (DMA kernel on TPU/interpret, XLA scan
+    elsewhere)."""
+    if depth >= 2:
+        if interpret or jax.default_backend() == "tpu":
+            return _pallas_moments_dbuf(
+                x_p, m_p, mean_p, mode, interpret, need_gram, tile_rows,
+                depth,
+            )
+        return _xla_walk(x_p, m_p, mean_p, mode, need_gram, tile_rows)
+    return _pallas_moments(
+        x_p, m_p, mean_p, mode, interpret, need_gram, tile_rows
+    )
+
+
+def _pad_rows_cols(x, mask, mean, block_rows=_BLOCK_ROWS):
     """Pad rows to the block multiple (mask 0) and d to the lane multiple
     (zero columns — zero in x AND mean, so they vanish from every
     output).  Traced only (inside the jitted wrappers)."""
     n, d = x.shape
-    n_pad = pad_to(max(n, _BLOCK_ROWS), _BLOCK_ROWS)
+    n_pad = pad_to(max(n, block_rows), block_rows)
     d_pad = pad_to(d, LANE)
     x_p = jnp.zeros((n_pad, d_pad), jnp.float32).at[:n, :d].set(
         x.astype(jnp.float32)
@@ -128,25 +250,40 @@ def _pad_rows_cols(x, mask, mean):
     return x_p, m_p, mean_p
 
 
-def moments_traced(x, mask, mean, mode, interpret, need_gram):
+def _norm_geometry(tile_rows, depth):
+    """None -> the hand-picked defaults (grid kernel, 512-row block)."""
+    tile_rows = _BLOCK_ROWS if tile_rows is None else int(tile_rows)
+    depth = 0 if depth is None else int(depth)
+    if depth >= 2:
+        _dbuf.check_depth(depth)
+    return tile_rows, depth
+
+
+def moments_traced(x, mask, mean, mode, interpret, need_gram,
+                   tile_rows=None, depth=None):
     """Traced pad + kernel + slice (no jit of its own) — the seam the
     streamed per-chunk accumulators jit around (ops/stream_ops)."""
+    tile_rows, depth = _norm_geometry(tile_rows, depth)
     d = x.shape[1]
-    x_p, m_p, mean_p = _pad_rows_cols(x, mask, mean)
-    gram, colsum, count = _pallas_moments(
-        x_p, m_p, mean_p, mode, interpret, need_gram
+    x_p, m_p, mean_p = _pad_rows_cols(x, mask, mean, block_rows=tile_rows)
+    gram, colsum, count = _moments_any(
+        x_p, m_p, mean_p, mode, interpret, need_gram, tile_rows, depth
     )
     return gram[:d, :d], colsum[0, :d], count[0, 0]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mode", "interpret", "need_gram")
+    jax.jit,
+    static_argnames=("mode", "interpret", "need_gram", "tile_rows", "depth"),
 )
-def _moments_jit(x, mask, mean, mode, interpret, need_gram):
+def _moments_jit(x, mask, mean, mode, interpret, need_gram,
+                 tile_rows=_BLOCK_ROWS, depth=0):
     """Pad + kernel + slice in ONE jitted program (the
     kmeans_kernel._accumulate_jit pattern — progcache sees one program
     per input signature, never eager padding dispatches)."""
-    return moments_traced(x, mask, mean, mode, interpret, need_gram)
+    return moments_traced(
+        x, mask, mean, mode, interpret, need_gram, tile_rows, depth
+    )
 
 
 def pca_moments_pallas(
@@ -156,6 +293,8 @@ def pca_moments_pallas(
     mode: str = "highest",
     interpret: bool = False,
     need_gram: bool = True,
+    tile_rows: int = None,
+    depth: int = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Fused PCA moments over one table/chunk: returns (gram (d, d),
     colsum (d,), wcount scalar), all f32.
@@ -167,33 +306,40 @@ def pca_moments_pallas(
     zero vector (pass-1 usage).
     """
     mode = check_mode(mode)
+    tile_rows, depth = _norm_geometry(tile_rows, depth)
     if mean is None:
         mean = jnp.zeros((x.shape[1],), jnp.float32)
     progcache.note(
         "pca.pallas_moments",
         (progcache.backend_fingerprint(),
-         progcache.array_key(x, mask), mode, interpret, need_gram),
+         progcache.array_key(x, mask), mode, interpret, need_gram,
+         tile_rows, depth),
     )
     with kernel_launch("pca.moments"):
-        return _moments_jit(x, mask, mean, mode, interpret, need_gram)
+        return _moments_jit(
+            x, mask, mean, mode, interpret, need_gram, tile_rows, depth
+        )
 
 
-@functools.partial(jax.jit, static_argnames=("mode", "interpret"))
-def _covariance_pallas_jit(x, mask, n_rows, mode, interpret):
+@functools.partial(
+    jax.jit, static_argnames=("mode", "interpret", "tile_rows", "depth")
+)
+def _covariance_pallas_jit(x, mask, n_rows, mode, interpret,
+                           tile_rows=_BLOCK_ROWS, depth=0):
     """Both covariance passes — colsum/mean then centered Gram — over ONE
     padded copy of the table, in one jitted program.  Numerics match
     pca_ops._covariance_jit's two-pass mean-centered form (the raw-moment
     form stays banned; see that docstring)."""
     d = x.shape[1]
     x_p, m_p, zero_mean = _pad_rows_cols(
-        x, mask, jnp.zeros((d,), jnp.float32)
+        x, mask, jnp.zeros((d,), jnp.float32), block_rows=tile_rows
     )
-    _, colsum, _ = _pallas_moments(
-        x_p, m_p, zero_mean, mode, interpret, need_gram=False
+    _, colsum, _ = _moments_any(
+        x_p, m_p, zero_mean, mode, interpret, False, tile_rows, depth
     )
     mean_p = colsum / n_rows  # (1, d_pad); padded columns stay 0
-    gram, _, _ = _pallas_moments(
-        x_p, m_p, mean_p, mode, interpret, need_gram=True
+    gram, _, _ = _moments_any(
+        x_p, m_p, mean_p, mode, interpret, True, tile_rows, depth
     )
     cov = gram[:d, :d] / jnp.maximum(n_rows - 1.0, 1.0)
     # numerical symmetry guard before eigh (same as the XLA pass)
@@ -203,18 +349,23 @@ def _covariance_pallas_jit(x, mask, n_rows, mode, interpret):
 def covariance_pallas(
     x: jax.Array, mask: jax.Array, n_rows: jax.Array,
     mode: str = "highest", interpret: bool = False,
+    tile_rows: int = None, depth: int = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fused-kernel replacement for pca_ops._covariance_jit: (cov (d, d),
     mean (d,)) — same two-pass centered numerics, one padded table copy,
-    no HBM-materialized centered temp."""
+    no HBM-materialized centered temp.  ``tile_rows``/``depth`` carry
+    tuned geometry (depth >= 2 = the double-buffered walk)."""
     mode = check_mode(mode)
+    tile_rows, depth = _norm_geometry(tile_rows, depth)
     progcache.note(
         "pca.pallas_covariance",
         (progcache.backend_fingerprint(),
-         progcache.array_key(x, mask), mode, interpret),
+         progcache.array_key(x, mask), mode, interpret, tile_rows, depth),
     )
     with kernel_launch("pca.covariance"):
-        return _covariance_pallas_jit(x, mask, n_rows, mode, interpret)
+        return _covariance_pallas_jit(
+            x, mask, n_rows, mode, interpret, tile_rows, depth
+        )
 
 
 def pallas_gram_preferred(d: int, precision: str) -> bool:

@@ -241,19 +241,35 @@ def _ring_pallas(x, axis_name: str, world: int):
 # -- dispatch ----------------------------------------------------------------
 
 
-def ring_allreduce(x, axis_name: str, world: int, interpret: bool = False):
+def ring_allreduce(x, axis_name: str, world: int, interpret: bool = False,
+                   segments: int = 1):
     """Sum an identically-shaped per-device 2-D f32 buffer across
     ``axis_name`` with the ring schedule; call INSIDE shard_map/jit
     bodies (the collective.psum seam's in-jit contract).  ``world`` is
     the static axis size.  ``world < 2`` falls back to the psum path —
     the clean degradation the acceptance contract requires.  The
     ``interpret`` static forces the ppermute schedule (tier-1's CPU leg
-    runs it regardless, by backend)."""
+    runs it regardless, by backend).
+
+    ``segments`` > 1 is the segmented-start epilogue (ROADMAP item 4,
+    tuned by ops/pallas/autotune.py): the rows split into ``segments``
+    INDEPENDENT ring reductions, each fenced on only its own row slice.
+    A segment's reduce-scatter may therefore dispatch while the local
+    walk is still accumulating later rows, and a consumer of an early
+    segment's output may start before the last segment's all-gather
+    completes — the data dependence is per segment, which is exactly
+    what lets the XLA scheduler overlap the ring with the surrounding
+    pass.  Row-disjoint segments mean the set of additions per row is
+    unchanged; only the rotation's starting owner moves, so results
+    stay within the ring parity envelope (<= 1e-5) and the trace-time
+    census is unchanged (one ``ring.allreduce`` per call, zero
+    standalone psums)."""
     note_emitted("ring.allreduce")
     if world < 2:
         return collective.psum(x, axis_name)
+    segments = max(1, int(segments))
     rows, cols = x.shape
-    rows_pad = pad_to(max(rows, world), world)
+    rows_pad = pad_to(max(rows, world * segments), world * segments)
     use_pallas = jax.default_backend() == "tpu" and not interpret
     # even lane-multiple columns on BOTH paths so the bi-directional
     # halves split at the same column — cross-backend bit identity
@@ -263,32 +279,46 @@ def ring_allreduce(x, axis_name: str, world: int, interpret: bool = False):
         xp = jnp.zeros((rows_pad, cols_pad), jnp.float32).at[
             :rows, :cols
         ].set(xp)
-    out = (
-        _ring_pallas(xp, axis_name, world)
-        if use_pallas
-        else _ring_ppermute(xp, axis_name, world)
-    )
+    ring_one = _ring_pallas if use_pallas else _ring_ppermute
+    if segments == 1:
+        out = ring_one(xp, axis_name, world)
+    else:
+        seg_rows = rows_pad // segments
+        out = jnp.concatenate(
+            [
+                ring_one(
+                    xp[g * seg_rows : (g + 1) * seg_rows], axis_name, world
+                )
+                for g in range(segments)
+            ],
+            axis=0,
+        )
     return out[:rows, :cols]
 
 
 # -- eager/hosted entry for the streamed multi-host reductions ---------------
 
 
-def stacked_ring_fn(mesh, axis_name: str, interpret: bool = False):
+def stacked_ring_fn(mesh, axis_name: str, interpret: bool = False,
+                    segments: int = 1):
     """Registry-cached jitted ring program for host-driven paths
     (ops/stream_ops): takes a (world, rows, cols) f32 array sharded one
     slot per device over ``axis_name`` (each process contributes its
     per-pass moments in its first local slot, zeros elsewhere) and
-    returns it with every slot holding the full sum."""
+    returns it with every slot holding the full sum.  ``segments`` is
+    the segmented-start epilogue knob (see :func:`ring_allreduce`)."""
     from oap_mllib_tpu.utils import progcache
     from oap_mllib_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     world = mesh.shape[axis_name]
+    segments = max(1, int(segments))
 
     def build():
         def body(blk):  # (1, rows, cols) per device slot
-            return ring_allreduce(blk[0], axis_name, world, interpret)[None]
+            return ring_allreduce(
+                blk[0], axis_name, world, interpret, segments=segments
+            )[None]
 
         return jax.jit(
             shard_map(
@@ -299,5 +329,8 @@ def stacked_ring_fn(mesh, axis_name: str, interpret: bool = False):
             )
         )
 
-    key = (progcache.mesh_fingerprint(mesh), axis_name, world, interpret)
+    key = (
+        progcache.mesh_fingerprint(mesh), axis_name, world, interpret,
+        segments,
+    )
     return progcache.get_or_build("ring.stacked", key, build)

@@ -128,14 +128,22 @@ def covariance(
     if use_pallas_gram(
         get_config().pca_kernel, x.shape[1], precision, x.dtype
     ):
+        from oap_mllib_tpu.ops.pallas import autotune
         from oap_mllib_tpu.ops.pallas.pca_kernel import covariance_pallas
 
+        geo = autotune.resolve(
+            "pca", autotune.shape_bucket(x.shape[1]), precision
+        )
         key = (
             progcache.backend_fingerprint(),
             progcache.array_key(x, mask), precision, "pallas",
+            geo["tile_rows"], geo["depth"],
         )
         with progcache.launch("pca.covariance_pallas", key, timings, phase):
-            return covariance_pallas(x, mask, n_rows, mode=precision)
+            return covariance_pallas(
+                x, mask, n_rows, mode=precision,
+                tile_rows=geo["tile_rows"], depth=geo["depth"],
+            )
     key = (
         progcache.backend_fingerprint(),
         progcache.array_key(x, mask),

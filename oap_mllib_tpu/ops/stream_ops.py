@@ -303,7 +303,17 @@ def _ring_reduce_f32(arrays, mesh, axis: str):
     local[0] = buf  # this process's payload in its first device slot
     sharding = NamedSharding(mesh, P(axis, None, None))
     stacked = jax.make_array_from_process_local_data(sharding, local)
-    out = stacked_ring_fn(mesh, axis)(stacked)
+    # segmented-start epilogue geometry (ops/pallas/autotune): resolved
+    # from config + cache only — a pure function of (config, bucket) on
+    # every rank, so the ring program stays rank-uniform (R16; mode "on"
+    # under a multi-process world resolves "default-multiproc" for the
+    # same reason)
+    from oap_mllib_tpu.ops.pallas import autotune
+
+    segments = autotune.resolve(
+        "ring", autotune.shape_bucket(d_ax, cols)
+    )["segments"]
+    out = stacked_ring_fn(mesh, axis, segments=segments)(stacked)
     summed = np.asarray(out.addressable_shards[0].data)[0].ravel()[:total]
     res, off = [], 0
     for a in arrays:
@@ -917,27 +927,31 @@ def _gram_chunk_comp(gram, comp, chunk, w, mean, precision, policy):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("interpret",), donate_argnums=(0,)
+    jax.jit, static_argnames=("interpret", "tile_rows", "depth"),
+    donate_argnums=(0,),
 )
-def _colsum_chunk_pallas(total, chunk, w, interpret=False):
+def _colsum_chunk_pallas(total, chunk, w, interpret=False, tile_rows=None,
+                         depth=None):
     from oap_mllib_tpu.ops.pallas import pca_kernel as _pk
 
     _, cs, _ = _pk.moments_traced(
         chunk, w, jnp.zeros((chunk.shape[1],), jnp.float32),
-        "highest", interpret, False,
+        "highest", interpret, False, tile_rows, depth,
     )
     return total + cs
 
 
 @functools.partial(
-    jax.jit, static_argnames=("interpret",), donate_argnums=(0, 1)
+    jax.jit, static_argnames=("interpret", "tile_rows", "depth"),
+    donate_argnums=(0, 1),
 )
-def _colsum_chunk_pallas_comp(total, comp, chunk, w, interpret=False):
+def _colsum_chunk_pallas_comp(total, comp, chunk, w, interpret=False,
+                              tile_rows=None, depth=None):
     from oap_mllib_tpu.ops.pallas import pca_kernel as _pk
 
     _, s, _ = _pk.moments_traced(
         chunk, w, jnp.zeros((chunk.shape[1],), jnp.float32),
-        "highest", interpret, False,
+        "highest", interpret, False, tile_rows, depth,
     )
     y = s - comp
     t = total + y
@@ -946,23 +960,30 @@ def _colsum_chunk_pallas_comp(total, comp, chunk, w, interpret=False):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mode", "interpret"), donate_argnums=(0,)
+    jax.jit, static_argnames=("mode", "interpret", "tile_rows", "depth"),
+    donate_argnums=(0,),
 )
-def _gram_chunk_pallas(gram, chunk, w, mean, mode, interpret=False):
+def _gram_chunk_pallas(gram, chunk, w, mean, mode, interpret=False,
+                       tile_rows=None, depth=None):
     from oap_mllib_tpu.ops.pallas import pca_kernel as _pk
 
-    g, _, _ = _pk.moments_traced(chunk, w, mean, mode, interpret, True)
+    g, _, _ = _pk.moments_traced(
+        chunk, w, mean, mode, interpret, True, tile_rows, depth
+    )
     return gram + g
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mode", "interpret"), donate_argnums=(0, 1)
+    jax.jit, static_argnames=("mode", "interpret", "tile_rows", "depth"),
+    donate_argnums=(0, 1),
 )
 def _gram_chunk_pallas_comp(gram, comp, chunk, w, mean, mode,
-                            interpret=False):
+                            interpret=False, tile_rows=None, depth=None):
     from oap_mllib_tpu.ops.pallas import pca_kernel as _pk
 
-    g, _, _ = _pk.moments_traced(chunk, w, mean, mode, interpret, True)
+    g, _, _ = _pk.moments_traced(
+        chunk, w, mean, mode, interpret, True, tile_rows, depth
+    )
     y = g - comp
     t = gram + y
     comp = (t - gram) - y
@@ -1008,12 +1029,21 @@ def covariance_streamed(
     use_pk = pca_ops.use_pallas_gram(
         get_config().pca_kernel, d, precision, dtype
     )
+    # tuned kernel geometry, resolved ONCE per pass pair outside the
+    # chunk loop (the chunk fns take it as jit statics); default path
+    # keeps (None, None) = the hand-picked constants
+    pk_rows = pk_depth = None
+    if use_pk:
+        from oap_mllib_tpu.ops.pallas import autotune
+
+        geo = autotune.resolve("pca", autotune.shape_bucket(d), precision)
+        pk_rows, pk_depth = geo["tile_rows"], geo["depth"]
 
     resume = checkpoint.restore() if checkpoint is not None else None
     base_key = (
         progcache.backend_fingerprint(),
         (source.chunk_rows, d), str(np.dtype(dtype)), str(stage_dtype),
-        precision, policy,
+        precision, policy, pk_rows, pk_depth,
     )
     if resume is not None and resume.found and (
             resume.extra.get("stage") == "colsum"):
@@ -1036,10 +1066,13 @@ def covariance_streamed(
                 ):
                     if use_pk and compensated:
                         total, comp = _colsum_chunk_pallas_comp(
-                            total, comp, cj, wj
+                            total, comp, cj, wj,
+                            tile_rows=pk_rows, depth=pk_depth,
                         )
                     elif use_pk:
-                        total = _colsum_chunk_pallas(total, cj, wj)
+                        total = _colsum_chunk_pallas(
+                            total, cj, wj, tile_rows=pk_rows, depth=pk_depth
+                        )
                     elif compensated:
                         total, comp = _colsum_chunk_comp(total, comp, cj, wj)
                     else:
@@ -1077,10 +1110,14 @@ def covariance_streamed(
             ):
                 if use_pk and compensated:
                     gram, gcomp = _gram_chunk_pallas_comp(
-                        gram, gcomp, cj, wj, mean, precision
+                        gram, gcomp, cj, wj, mean, precision,
+                        tile_rows=pk_rows, depth=pk_depth,
                     )
                 elif use_pk:
-                    gram = _gram_chunk_pallas(gram, cj, wj, mean, precision)
+                    gram = _gram_chunk_pallas(
+                        gram, cj, wj, mean, precision,
+                        tile_rows=pk_rows, depth=pk_depth,
+                    )
                 elif compensated:
                     gram, gcomp = _gram_chunk_comp(
                         gram, gcomp, cj, wj, mean, precision, policy

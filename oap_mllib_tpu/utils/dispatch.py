@@ -66,12 +66,18 @@ def should_accelerate(algo: str, guard_ok: bool, reason: str = "") -> bool:
     Every estimator fit funnels through here, so this is also where the
     persistent XLA compilation cache is wired (Config
     .compilation_cache_dir -> jax compilation_cache_dir, idempotent) —
-    before the first program of the fit traces."""
+    before the first program of the fit traces — and where the kernel
+    autotuner's mode string is validated (ops/pallas/autotune.parse_mode:
+    a Config.tuning typo raises HERE, at fit entry, not deep inside a
+    kernel launch)."""
     cfg = get_config()
     if cfg.compilation_cache_dir:
         from oap_mllib_tpu.utils.progcache import ensure_persistent_cache
 
         ensure_persistent_cache(cfg.compilation_cache_dir)
+    from oap_mllib_tpu.ops.pallas.autotune import parse_mode
+
+    parse_mode(cfg.tuning)
     ok = platform_compatible() and guard_ok
     if ok:
         return True

@@ -269,6 +269,17 @@ def backend_fingerprint() -> tuple:
     return (jax.default_backend(), len(jax.devices()), jax.process_count())
 
 
+def key_digest(key) -> str:
+    """Short stable hex digest of a hashable-repr key tuple, for layers
+    that file registry-style keys on DISK (the tuning cache names its
+    JSON entries with this; a raw repr would produce filesystem-hostile
+    names).  repr-based, so only use with keys built from primitives —
+    exactly what the registry key conventions already require."""
+    import hashlib
+
+    return hashlib.sha1(repr(key).encode()).hexdigest()[:16]
+
+
 # -- XLA compile ground truth ----------------------------------------------
 
 _XLA_EVENTS = {"count": 0, "secs": 0.0}

@@ -834,6 +834,50 @@ class TestStreamedALS:
             m1.item_factors_, m2.item_factors_, atol=1e-4, rtol=1e-4
         )
 
+    def test_streamed_weighted_block_offsets_parity(self, rng):
+        """Capability-weighted user blocks on the STREAMED block path
+        (ISSUE 15 carry-over): injected uneven offsets — monkeypatched
+        ``balance.block_offsets``, the same planner seam the in-memory
+        fit consults — must reproduce the uniform streamed fit's factors
+        on the 8-device mesh.  The weighted layout only moves rows
+        between blocks; searchsorted block mapping, block-local
+        rebasing, factor placement and the gather-back are all
+        boundary-generic."""
+        from oap_mllib_tpu.parallel import balance
+
+        u, i, r, nu, ni = _ratings(rng, n_users=53, n_items=24)
+        x0 = init_factors(nu, 3, 1)
+        y0 = init_factors(ni, 3, 2)
+        kw = dict(rank=3, max_iter=2, reg_param=0.1, alpha=0.8)
+        set_config(als_kernel="grouped")
+        orig = balance.block_offsets
+        try:
+            m1 = ALS(**kw).fit(
+                self._triples_source(u, i, r, 64), n_users=nu,
+                n_items=ni, init=(x0, y0),
+            )
+            off = balance.plan_block_offsets(
+                nu, [4.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 2.0]
+            )
+            assert off is not None and len(off) == 9
+            assert len(set(np.diff(off))) > 1  # genuinely uneven blocks
+            balance.block_offsets = lambda *a, **k: off
+            m2 = ALS(**kw).fit(
+                self._triples_source(u, i, r, 64), n_users=nu,
+                n_items=ni, init=(x0, y0),
+            )
+        finally:
+            balance.block_offsets = orig
+            set_config(als_kernel="auto")
+        assert m2.summary.get("streamed")
+        assert m2.summary["item_layout"] == "replicated"
+        np.testing.assert_allclose(
+            m1.user_factors_, m2.user_factors_, atol=1e-5, rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            m1.item_factors_, m2.item_factors_, atol=1e-5, rtol=1e-5
+        )
+
     @pytest.mark.parametrize("implicit", [True, False])
     def test_streamed_mesh_parity_item_sharded(self, rng, implicit):
         """Streamed-vs-in-memory parity on the mesh with the 2-D
