@@ -1411,15 +1411,84 @@ def bench_serving(requests: int = 200, sweep_users: int = 1_000_000,
             sweep_users=nu, n_items=ni, rank=r, top_k=topk,
             sweep_wall_sec=round(sweep_wall, 2),
         )
-    # the fleet leg only prices into emitting runs — in-process callers
-    # (dev/serve_gate.py leg 5) measure the single-process storm only
+    # the brownout + fleet legs only price into emitting runs —
+    # in-process callers (dev/serve_gate.py leg 5) measure the
+    # single-process storm only
+    bo = _bench_serving_brownout(handle, x, sizes, emit) if emit else None
     mp = bench_serving_mp(emit=True) if emit else None
     return {
         "qps": qps, "p50_s": p50, "p99_s": p99,
         "steady_compiles": steady_compiles,
         "users_per_sec": users_per_sec,
+        "qps_brownout": None if bo is None else bo["qps"],
         "qps_mp": None if mp is None else mp["qps_mp"],
     }
+
+
+def _bench_serving_brownout(handle, x, sizes, emit: bool) -> dict:
+    """Degraded-mode headline (ISSUE 18): the same jittered storm
+    through the async TrafficQueue with the brownout ladder pinned at
+    its top rung (reduced top-k + bf16 + stale pins all active), two
+    transient dispatcher faults armed (the retry envelope), and two
+    NaN-payload requests (poison bisection) — ``serving_kmeans_qps_
+    brownout`` is the throughput a browned-out replica still sustains,
+    with the retry/poison counters it booked along the way."""
+    import numpy as np
+
+    from oap_mllib_tpu import serving
+    from oap_mllib_tpu.config import set_config
+    from oap_mllib_tpu.serving import traffic as traffic_mod
+    from oap_mllib_tpu.telemetry import metrics as tm
+
+    requests = len(sizes)
+    retries0 = int(tm.family_total("oap_serve_retries_total"))
+    poison0 = int(tm.family_total("oap_serve_poison_total"))
+    try:
+        set_config(serve_brownout="pin:stale",
+                   fault_spec="serve.dispatch:fail=2")
+        traffic_mod._reset_for_tests()
+        # the degraded precision policy (bf16 rung) compiles its own
+        # bucket family — warm it so the storm stays compile-free
+        handle.warmup(2048)
+        nan_at = {3, requests // 2}
+        reqs = []
+        for i, s in enumerate(sizes):
+            b = x[: int(s)]
+            if i in nan_at:
+                b = b.copy()
+                b[0, 0] = np.nan
+            reqs.append(b)
+        walls = []
+        t0 = time.perf_counter()
+        with serving.TrafficQueue(handle) as q:
+            futs = [
+                (time.perf_counter(), q.submit(b, deadline_ms=120_000))
+                for b in reqs
+            ]
+            for ts, f in futs:
+                try:
+                    f.result(timeout=120)
+                except serving.ServeError:
+                    pass  # the quarantined poison payloads
+                walls.append(time.perf_counter() - ts)
+        storm_wall = time.perf_counter() - t0
+    finally:
+        set_config(serve_brownout="auto", fault_spec="")
+        traffic_mod._reset_for_tests()
+    walls.sort()
+    p50 = walls[len(walls) // 2]
+    p99 = walls[min(len(walls) - 1, int(len(walls) * 0.99))]
+    qps = requests / storm_wall
+    retried = int(tm.family_total("oap_serve_retries_total")) - retries0
+    poison = int(tm.family_total("oap_serve_poison_total")) - poison0
+    if emit:
+        _emit(
+            "serving_kmeans_qps_brownout", qps, "req/sec", 0.0,
+            p50_ms=round(p50 * 1e3, 3), p99_ms=round(p99 * 1e3, 3),
+            rung="stale", requests=requests,
+            retried=retried, poison=poison,
+        )
+    return {"qps": qps, "retried": retried, "poison": poison}
 
 
 # environment-incapability signatures (mirrors tests/test_pseudo_cluster

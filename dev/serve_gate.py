@@ -28,6 +28,28 @@ Legs (ISSUE 13 acceptance):
    and shed loudly (one shed of each reason).  Hosts that cannot form
    a multiprocess jax world at all (the tests' _ENV_FAILURE_MARKERS
    signatures) WARN and skip the leg instead of failing the gate.
+8. **Poison bisection** (ISSUE 18) — a NaN-payload request coalesced
+   with innocents is isolated by log2 bisection: exactly one
+   quarantine (``oap_serve_poison_total``), every innocent answered
+   bit-identically, and ZERO new XLA compiles (the halves re-coalesce
+   on the warmed bucket family).
+9. **Graceful drain** (ISSUE 18) — ``TrafficQueue.drain`` answers
+   every pending future, books ``oap_serve_drains_total`` exactly
+   once, and the drained queue sheds new admissions with
+   ``reason="draining"``.
+10. **Brownout ladder** (ISSUE 18) — sustained 2x over-budget pressure
+    walks the auto ladder exactly topk -> bf16 -> stale (3 steps
+    booked), absorbing breaches at active rungs; the bf16 rung flips
+    the serving precision policy only where a parity bound exists, and
+    a pinned rung halves top-k depth.
+11. **Request-lifecycle chaos drill** (ISSUE 18) — a REAL 2-replica
+    fleet under a 220-request storm with armed ``serve.dispatch``
+    transients, an injected ``serve.batch`` poison, real NaN-payload
+    requests, and rank 1 SIGKILLed mid-storm: the survivor resolves
+    EVERY accepted future (answered bit-identically or classified),
+    quarantines exactly the poison payloads, retries the transients,
+    compiles nothing in steady state, then re-forms the sharded sweep
+    on its local layout with bit-identical answers.
 
 Exit 1 with the offending numbers on any violation.
 """
@@ -225,6 +247,102 @@ def main() -> int:
           "(2-process fleet) ==")
     _traffic_eviction_leg()
 
+    # -- leg 8: poison-batch bisection, zero compiles ------------------------
+    print("== serve gate: poison-batch bisection (quarantine + "
+          "innocents + zero compiles) ==")
+    from oap_mllib_tpu.serving import traffic as traffic_mod
+    from oap_mllib_tpu.telemetry import metrics as tm
+
+    traffic_mod._reset_for_tests()
+    poison0 = int(tm.family_total("oap_serve_poison_total"))
+    bisect0 = int(tm.family_total("oap_serve_bisect_total"))
+    compiles0 = progcache.xla_compile_count()
+    q8 = serving.TrafficQueue(hk, start=False)
+    innocents = [storm_x[:5], storm_x[5:17], storm_x[17:47]]
+    bad = np.full((7, 16), np.nan, np.float32)
+    futs8 = [q8.submit(b) for b in innocents]
+    fp8 = q8.submit(bad)
+    q8.pump()
+    q8.close()
+    check(progcache.xla_compile_count() - compiles0 == 0,
+          "bisection halves compiled new programs (bucket family "
+          "must stay warm)")
+    poison_n = int(tm.family_total("oap_serve_poison_total")) - poison0
+    check(poison_n == 1, f"expected exactly 1 quarantine, got {poison_n}")
+    check(int(tm.family_total("oap_serve_bisect_total")) - bisect0 >= 1,
+          "poison batch was never bisected")
+    exc8 = fp8.exception()
+    check(isinstance(exc8, serving.ServeError)
+          and exc8.reason == "poison",
+          f"poison request not quarantined: {exc8!r}")
+    for b, f in zip(innocents, futs8):
+        if not np.array_equal(f.result(), hk.predict(b)):
+            check(False, "innocent sharing the poisoned flush diverged")
+            break
+    print(f"  quarantined 1 of {len(innocents) + 1} coalesced requests, "
+          f"0 compiles")
+
+    # -- leg 9: graceful drain -----------------------------------------------
+    print("== serve gate: graceful drain flushes every future, then "
+          "sheds admissions ==")
+    drains0 = int(tm.family_total("oap_serve_drains_total"))
+    q9 = serving.TrafficQueue(hk, start=False)
+    futs9 = [q9.submit(storm_x[:9]) for _ in range(5)]
+    stats9 = q9.drain(timeout_s=5.0)
+    check(stats9["drained"] and stats9["failed"] == 0,
+          f"drain left failures: {stats9}")
+    check(stats9["answered"] == 5,
+          f"drain answered {stats9['answered']}/5 pending futures")
+    check(all(f.exception() is None for f in futs9),
+          "drained futures did not all answer")
+    check(int(tm.family_total("oap_serve_drains_total")) - drains0 == 1,
+          "oap_serve_drains_total not booked exactly once")
+    try:
+        q9.submit(storm_x[:3])
+        check(False, "drained queue admitted a new request")
+    except serving.ShedError as e:
+        check(e.reason == "draining",
+              f"post-drain shed reason {e.reason!r} != 'draining'")
+    q9.close()
+    print(f"  drained {stats9['answered']} futures, admissions shed")
+
+    # -- leg 10: brownout ladder ---------------------------------------------
+    print("== serve gate: brownout ladder steps topk -> bf16 -> stale "
+          "under sustained pressure ==")
+    from oap_mllib_tpu.serving import batcher as batcher_mod
+
+    steps0 = int(tm.family_total("oap_serve_brownout_steps_total"))
+    absorbed0 = int(tm.family_total("oap_serve_brownout_absorbed_total"))
+    b10 = serving.BrownoutController("auto")
+    for _ in range(12):
+        b10.observe(200, 100)  # sustained 2x over-budget
+    check(b10.rung == 3,
+          f"ladder stopped at rung {b10.rung} (expected 3/stale)")
+    check([s["to"] for s in b10.steps] == ["topk", "bf16", "stale"],
+          f"ladder walked {[s['to'] for s in b10.steps]}")
+    check(int(tm.family_total("oap_serve_brownout_steps_total"))
+          - steps0 == 3, "expected exactly 3 brownout steps booked")
+    check(int(tm.family_total("oap_serve_brownout_absorbed_total"))
+          - absorbed0 >= 1, "no breach was absorbed at an active rung")
+    set_config(serve_brownout="pin:bf16")
+    traffic_mod._reset_for_tests()
+    pol10 = batcher_mod.resolve_policy("kmeans").name
+    check(pol10 == "bf16",
+          f"bf16 rung did not flip serving precision (got {pol10!r})")
+    set_config(serve_brownout="pin:topk")
+    traffic_mod._reset_for_tests()
+    check(serving.brownout_topk(8) == 4,
+          "topk rung did not halve the sweep depth")
+    set_config(serve_brownout="auto")
+    traffic_mod._reset_for_tests()
+    print("  ladder: topk -> bf16 -> stale, precision + depth rungs "
+          "verified")
+
+    # -- leg 11: request-lifecycle chaos drill (2-process fleet) -------------
+    print("== serve gate: request-lifecycle chaos drill (retries + "
+          "poison + SIGKILL on a 2-process fleet) ==")
+    _traffic_drill_leg()
+
     if failures:
         print(f"\nserve gate: {len(failures)} failure(s)")
         return 1
@@ -334,6 +452,53 @@ def _traffic_eviction_leg():
         check("SHED_OK rank=0 sheds=3" in outs[0],
               "survivor's shed legs incomplete (expected one shed of "
               "each reason: queue_full, budget, deadline)")
+
+
+def _traffic_drill_leg():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as crash_dir:
+        spawned = _spawn_traffic_world("drill", 2, crash_dir, timeout=300)
+        if spawned is None:
+            return
+        procs, outs = spawned
+        check(procs[1].returncode == -9,
+              f"victim replica was not SIGKILLed:\n{outs[1][-1500:]}")
+        check(procs[0].returncode == 0,
+              f"survivor replica failed the drill:\n{outs[0][-1500:]}")
+        check("EVICTED rank=0" in outs[0],
+              "survivor never evicted the dead replica")
+        drill = _traffic_fields(outs[0], "DRILL_OK rank=0")
+        check(drill is not None,
+              f"survivor never finished the drill:\n{outs[0][-1500:]}")
+        if drill is not None:
+            print(f"  drill: submitted {drill['submitted']}, answered "
+                  f"{drill['answered']}, poison {drill['poison']}, "
+                  f"retried {drill['retried']}, bisects "
+                  f"{drill['bisects']}, compiles {drill['compiles']}")
+            check(int(drill["submitted"]) >= 200,
+                  f"drill storm too small: {drill['submitted']} < 200")
+            check(drill["unresolved"] == "0",
+                  f"{drill['unresolved']} accepted futures never "
+                  "resolved (silent loss)")
+            check(drill["poison"] == "3",
+                  f"expected exactly 3 quarantines, got {drill['poison']}")
+            check(int(drill["retried"]) >= 1,
+                  "dispatcher transients were never retried")
+            check(int(drill["bisects"]) >= 1,
+                  "poison batches were never bisected")
+            check(drill["compiles"] == "0",
+                  f"drill compiled {drill['compiles']} programs in "
+                  "steady state (must be 0)")
+        reform = _traffic_fields(outs[0], "REFORM_OK rank=0")
+        check(reform is not None,
+              "survivor never re-formed the sharded sweep on its "
+              "local layout")
+        if reform is not None:
+            check(int(reform["reforms"]) >= 1,
+                  "oap_serve_sweep_reforms_total was never booked")
+            print(f"  re-formed sweep: {reform['reforms']} reform(s), "
+                  f"digest {reform['digest']}")
 
 
 if __name__ == "__main__":

@@ -400,6 +400,34 @@ class Config:
     # requests) for this many seconds sheds one replica down to the
     # controller's floor.  Must be > 0.
     serve_scale_idle_s: float = 30.0
+    # Durable-future retry envelope (serving/traffic.py): how many times
+    # an ADMITTED request may be re-enqueued after a transient scoring
+    # fault before its future fails with a classified ServeError
+    # (reason="retries-exhausted").  Re-enqueued requests keep their
+    # original deadline and arrival order, so retries never jump the
+    # deadline priority.  0 = fail on the first transient fault; must
+    # be >= 0 (a typo raises at submit time).
+    serve_retry_limit: int = 2
+    # Backoff base in seconds for re-enqueued requests: retry n waits
+    # ~ serve_retry_backoff * 2^n before redispatch, jittered
+    # deterministically per (site, attempt) like
+    # utils/resilience.RetryPolicy.  Must be >= 0; a typo raises at
+    # submit time.
+    serve_retry_backoff: float = 0.01
+    # Brownout degradation ladder (serving/traffic.BrownoutController):
+    # what the traffic plane does under SUSTAINED over-budget pressure
+    # (membudget-priced admission, fleet-trend-gated like the scale
+    # controller) before it sheds.  "auto" (default) steps through the
+    # recorded rungs — "topk" (halved top-k depth), "bf16" (serving
+    # precision drops to bf16 where a parity bound is registered),
+    # "stale" (stale-pin answering during model re-pin) — absorbing
+    # over-budget requests while rungs remain; each step is LOUD
+    # (serving_summary()["brownout"], span attrs,
+    # oap_serve_brownout_rung, the flight recorder).  "off" disarms the
+    # ladder (over-budget requests shed immediately, today's
+    # behavior); "pin:<rung>" holds a fixed rung (off|topk|bf16|stale)
+    # without automatic stepping.  A typo raises at submit time.
+    serve_brownout: str = "auto"
     # -- telemetry layer (oap_mllib_tpu/telemetry/) --------------------------
     # jax.profiler trace directory: non-empty wraps every estimator fit
     # in a profiler trace written there (utils/profiling.maybe_trace),

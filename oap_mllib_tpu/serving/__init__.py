@@ -40,7 +40,15 @@ existing subsystems instead of a per-call eager afterthought:
   + ``oap_serve_shed_total``) instead of letting a storm OOM a
   replica; :class:`ScaleController` turns replica count into a
   controlled variable (queue-depth/p99 trends -> ``oap_serve_scale_*``
-  + the supervisor's ``serve.scale.hint.json`` sideband).
+  + the supervisor's ``serve.scale.hint.json`` sideband).  Accepted
+  requests are DURABLE (ISSUE 18): a retry envelope re-enqueues
+  transient scoring faults at original deadline priority, poison
+  batches bisect on the warm bucket family until the poison request is
+  quarantined (:class:`ServeError` + ``oap_serve_poison_total``),
+  ``TrafficQueue.drain`` / ``ReplicaGuard.release`` flush every future
+  before a replica lets go, and the :class:`BrownoutController` ladder
+  (``Config.serve_brownout``) degrades top-k depth / precision /
+  pin freshness under sustained pressure before anything sheds.
 
 Usage (docs/user-guide.md "Serving")::
 
@@ -63,10 +71,19 @@ from oap_mllib_tpu.serving.registry import (  # noqa: F401
     serving_summary,
     unserve,
 )
-from oap_mllib_tpu.serving.ha import ReplicaGuard, heartbeat  # noqa: F401
+from oap_mllib_tpu.serving.ha import (  # noqa: F401
+    ReplicaGuard,
+    fleet_evicted,
+    heartbeat,
+)
 from oap_mllib_tpu.serving.traffic import (  # noqa: F401
+    BrownoutController,
     ScaleController,
+    ServeError,
     ShedError,
     TrafficQueue,
+    brownout,
+    brownout_stale_ok,
+    brownout_topk,
     write_scale_hint,
 )

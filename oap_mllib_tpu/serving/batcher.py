@@ -54,7 +54,13 @@ def resolve_policy(algo: str) -> psn.PrecisionPolicy:
     bf16 without a second knob); a non-empty value overrides it with the
     same vocabulary, re-using resolve's auto/x64 pins by resolving
     against a config copy whose global policy is the override.  A typo
-    raises at request time (the kmeans_kernel contract)."""
+    raises at request time (the kmeans_kernel contract).
+
+    The brownout ladder's ``bf16`` rung
+    (``traffic.brownout_precision_override``) folds in HERE — but only
+    when no explicit ``serving_precision`` pin exists and the algorithm
+    has a recorded parity bound: an operator pin always beats a
+    degradation rung."""
     cfg = get_config()
     raw = cfg.serving_precision
     if raw not in _SERVING_CHOICES:
@@ -64,6 +70,18 @@ def resolve_policy(algo: str) -> psn.PrecisionPolicy:
             f"got {raw!r}"
         )
     if not raw:
+        from oap_mllib_tpu.serving import traffic
+
+        browned = traffic.brownout_precision_override(algo)
+        if browned:
+            return psn.resolve(
+                algo,
+                dataclasses.replace(
+                    cfg, compute_precision=browned,
+                    kmeans_precision="", pca_precision="",
+                    als_precision="",
+                ),
+            )
         return psn.resolve(algo)
     return psn.resolve(
         algo,

@@ -33,6 +33,24 @@ import numpy as np
 from oap_mllib_tpu.telemetry import metrics as _tm
 from oap_mllib_tpu.utils import recovery
 
+# process-wide eviction flag: once ANY ReplicaGuard evicts, sharded
+# collectives spanning the old mesh are doomed (a dead peer never
+# arrives) — serving/sweep.py checks this BEFORE launching so the
+# failure becomes a classified re-form instead of a watchdog-less hang
+_EVICTED = False
+
+
+def fleet_evicted() -> bool:
+    """True once a replica has been evicted in this process — the
+    signal that the pre-eviction multi-process mesh must not be
+    dispatched onto again (re-form on the survivors' layout instead)."""
+    return _EVICTED
+
+
+def _reset_for_tests() -> None:
+    global _EVICTED
+    _EVICTED = False
+
 
 def heartbeat(requests: Optional[int] = None,
               queue_depth: Optional[int] = None) -> Dict[str, Any]:
@@ -88,20 +106,46 @@ class ReplicaGuard:
     is armed — the supervisor's classification input), the guard flips
     to ``local_only``, and the leg RETURNS instead of raising — the
     survivor keeps answering requests with identical results (the
-    weights are local; only the fleet view shrank)."""
+    weights are local; only the fleet view shrank).
 
-    def __init__(self):
+    ``queue``: attach the replica's ``traffic.TrafficQueue`` and
+    :meth:`release` gracefully drains it (stop admission, flush every
+    accepted future, fail leftovers loudly) before the replica lets go
+    — the scale-in/shutdown half of the request-lifecycle contract."""
+
+    def __init__(self, queue=None):
         self.local_only = False
         self.evictions = 0
         self.last_error: Optional[BaseException] = None
+        self.queue = queue
 
     def leg(self):
         return _Leg(self)
 
+    def release(self, timeout_s: float = 5.0) -> Optional[Dict[str, Any]]:
+        """Graceful replica release: drain + close the attached traffic
+        queue so no accepted future dies with the replica; returns the
+        drain stats (None when no queue is attached)."""
+        stats = None
+        q = self.queue
+        if q is not None:
+            stats = q.drain(timeout_s)
+            q.close()
+            from oap_mllib_tpu.telemetry import flightrec
+
+            flightrec.record(
+                "serve", "release",
+                f"replica released: answered={stats['answered']} "
+                f"failed={stats['failed']}",
+            )
+        return stats
+
     def _evict(self, exc: BaseException) -> None:
+        global _EVICTED
         self.local_only = True
         self.evictions += 1
         self.last_error = exc
+        _EVICTED = True
         _tm.counter(
             "oap_serve_evictions_total",
             help="Serving replicas evicted after recovery-plane errors",

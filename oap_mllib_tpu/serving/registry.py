@@ -67,17 +67,35 @@ def note_queue_depth(delta: int) -> int:
     return depth
 
 
-def pin(cache: dict, name: str, host_array) -> Any:
+def pin(cache: dict, name: str, host_array, *,
+        allow_stale: bool = False) -> Any:
     """Device copy of ``host_array`` cached in ``cache[name]``, keyed by
     the host array's IDENTITY: the same object returns the same device
     buffer (zero re-uploads), a replaced array (a refit, a mutated
     model) re-stages exactly once.  Staging is an explicit
-    ``jax.device_put`` (transfer-sanitizer clean)."""
+    ``jax.device_put`` (transfer-sanitizer clean).
+
+    ``allow_stale``: at the brownout ladder's ``stale`` rung
+    (``traffic.brownout_stale_ok``), a re-pin in flight (the identity
+    key changed — a refit replaced the host table) answers from the
+    PREVIOUS device pin instead of blocking the request on the fresh
+    transfer — LOUD via ``oap_serve_stale_pins_total``; the fresh table
+    pins on the next un-browned-out call."""
     import jax
 
     ent = cache.get(name)
     if ent is not None and ent[0] is host_array:
         return ent[1]
+    if ent is not None and allow_stale:
+        from oap_mllib_tpu.serving import traffic
+
+        if traffic.brownout_stale_ok():
+            _tm.counter(
+                "oap_serve_stale_pins_total",
+                help="Requests answered from a stale device pin under "
+                     "the brownout ladder's stale rung",
+            ).inc()
+            return ent[1]
     dev = jax.device_put(np.asarray(host_array))
     cache[name] = (host_array, dev)
     return dev
@@ -132,11 +150,29 @@ class ServedModel:
         batches = [np.atleast_2d(np.asarray(b)) for b in batches]
         if not batches:
             return []
+        from oap_mllib_tpu.utils import faults
+
+        # the coalesced-flush fault site: drives the traffic plane's
+        # poison-batch bisection (a classified fault here splits the
+        # group, never fails innocents)
+        faults.maybe_fault("serve.batch")
         # delta-folded, not set(): the dispatcher thread and concurrent
         # flushes all move the same gauge (see note_queue_depth)
         note_queue_depth(len(batches))
         try:
-            out = score_rows(np.concatenate(batches, axis=0))
+            joined = np.concatenate(batches, axis=0)
+            if (np.issubdtype(joined.dtype, np.floating)
+                    and not np.isfinite(joined).all()):
+                # a poison payload faults DETERMINISTICALLY in
+                # whichever bisection half contains it — that's what
+                # lets the traffic plane isolate the request
+                from oap_mllib_tpu.utils.resilience import NonFiniteError
+
+                raise NonFiniteError(
+                    "coalesced serving flush contains nonfinite input "
+                    "rows (poison request in the batch)"
+                )
+            out = score_rows(joined)
         finally:
             note_queue_depth(-len(batches))
         parts = []

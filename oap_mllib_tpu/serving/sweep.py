@@ -64,15 +64,56 @@ def _sweep_chunk_rows(n_targets: int, r: int) -> int:
 
 def recommend_for_all_users(model, num_items: int, *,
                             with_scores: bool = False, chunk_rows: int = 0,
-                            handle=None):
+                            handle=None, reform=None):
     """Top-``num_items`` item ids (and optionally scores) for EVERY
     user — the serving-plane sweep.  Sharded fits sweep their live
     factor layout; host-factor models run the streamed chunked sweep.
-    Results match ``model.recommend_for_all_users`` exactly."""
+    Results match ``model.recommend_for_all_users`` exactly.
+
+    ``reform`` is the eviction-failover hook (the durable-future
+    contract for in-flight sharded work): when a replica dies
+    mid-sweep — a recovery-plane error (``CollectiveTimeoutError`` /
+    ``PeerAbortError``), or the pre-launch eviction check refusing a
+    mesh that spans a dead peer — ``reform(exc)`` must return a
+    REPLACEMENT model on the survivors' live layout (e.g. re-shard the
+    host factor tables across local devices with
+    :func:`shard_factors_local`); the sweep then re-runs ONCE on it
+    (``oap_serve_sweep_reforms_total`` booked).  Without a hook the
+    sweep fails loudly: ``traffic.ServeError(reason="eviction")``
+    naming the culprit crash record(s) on the sideband."""
+    from oap_mllib_tpu.utils import recovery
+
     if num_items < 0:
         raise ValueError(f"top-k count must be >= 0, got {num_items}")
     if getattr(model, "_sharded_user", None) is not None:
-        ids, scores = _sweep_sharded(model, int(num_items), with_scores)
+        try:
+            ids, scores = _sweep_sharded(
+                model, int(num_items), with_scores
+            )
+        except recovery.RecoveryError as exc:
+            if reform is None:
+                from oap_mllib_tpu.serving import traffic
+
+                raise traffic.ServeError(
+                    "eviction",
+                    "sharded sweep lost a replica mid-flight and no "
+                    "reform hook was provided; the mesh spans a dead "
+                    "peer",
+                    crash_records=recovery.list_crash_records(
+                        str(get_config().crash_dir or "")
+                    ),
+                    cause=exc,
+                ) from exc
+            _tm.counter(
+                "oap_serve_sweep_reforms_total",
+                help="Sharded sweeps re-formed on the survivors' "
+                     "layout after a replica eviction",
+            ).inc()
+            new_model = reform(exc)
+            return recommend_for_all_users(
+                new_model, num_items, with_scores=with_scores,
+                chunk_rows=chunk_rows, handle=handle, reform=None,
+            )
     else:
         ids, scores = sweep_streamed(
             model.user_factors_, _pinned_targets(model, handle),
@@ -93,7 +134,10 @@ def _pinned_targets(model, handle):
     cache = getattr(model, "_dev_cache", None)
     if cache is None:
         cache = model._dev_cache = {}
-    return pin(cache, "targets:item", model.item_factors_)
+    # allow_stale: at the brownout ladder's stale rung a refit-in-
+    # flight answers from the previous pin instead of blocking
+    return pin(cache, "targets:item", model.item_factors_,
+               allow_stale=True)
 
 
 # -- streamed (host-factor) sweep --------------------------------------------
@@ -269,6 +313,19 @@ def _sweep_sharded(model, n: int, with_scores: bool):
     mesh = xb.sharding.mesh
     cfg = get_config()
     axis = cfg.data_axis
+    if not xb.is_fully_addressable:
+        from oap_mllib_tpu.serving import ha
+        from oap_mllib_tpu.utils import recovery as _rec
+
+        if ha.fleet_evicted():
+            # the mesh spans an evicted peer: an XLA collective on it
+            # would hang with no watchdog — refuse BEFORE launch so the
+            # caller's reform hook re-plans on the survivors' layout
+            raise _rec.PeerAbortError(
+                "sharded sweep refused: the factor mesh spans an "
+                "evicted replica (fleet is local-only); re-form the "
+                "shards on the survivors before sweeping"
+            )
     world = mesh.shape[axis]
     pol = _serving_policy_als()
     item_sharded = model._sharded_item is not None
@@ -384,5 +441,42 @@ def shard_factors(factors: np.ndarray, mesh) -> tuple:
     ids = np.arange(lo, hi, dtype=np.int64)
     blocks = reshard_factor_rows(
         ids, np.asarray(factors[lo:hi], np.float32), mesh, offsets, per
+    )
+    return blocks, offsets, per
+
+
+def shard_factors_local(factors: np.ndarray) -> tuple:
+    """Block a HOST factor table across THIS process's devices only —
+    the eviction-failover layout.  :func:`shard_factors` routes rows
+    through the cross-process exchange sized by ``jax.process_count``,
+    which is exactly what a survivor must NOT do after a peer died (the
+    dead rank never arrives).  This variant builds a fresh local mesh
+    over ``jax.local_devices()`` and places even row blocks with a
+    plain ``device_put`` — no collective, usable the instant the fleet
+    flips local-only.  Returns the same ``(blocks, offsets, per_block)``
+    triple, so a re-formed model drops straight into the ring sweep
+    (which now rotates over the local mesh)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    cfg = get_config()
+    axis = cfg.data_axis
+    devs = jax.local_devices()
+    world = len(devs)
+    mesh = Mesh(np.asarray(devs), (axis,))
+    factors = np.asarray(factors, np.float32)
+    n = int(factors.shape[0])
+    per = -(-n // world)
+    offsets = np.minimum(
+        np.arange(world + 1, dtype=np.int64) * per, n
+    )
+    padded = factors
+    if world * per != n:
+        padded = np.concatenate([
+            factors,
+            np.zeros((world * per - n, factors.shape[1]), np.float32),
+        ])
+    blocks = jax.device_put(
+        padded, NamedSharding(mesh, P(axis, None))
     )
     return blocks, offsets, per

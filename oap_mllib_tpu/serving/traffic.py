@@ -34,6 +34,37 @@ PAPERS.md arXiv:2403.07128 applied to serving):
   ``balance.hint.json`` pattern) so ``utils/supervisor.Supervisor``
   sizes the next relaunch from live traffic instead of a static world.
 
+Request-lifecycle fault tolerance (ISSUE 18) — the *degrade, never
+fail* ladder the fits got, applied to every ACCEPTED request:
+
+- **Durable futures** — each admitted request carries a retry envelope
+  (``Config.serve_retry_limit`` / ``serve_retry_backoff``, the
+  site-hashed deterministic jitter of ``utils/resilience.RetryPolicy``).
+  A transient scoring fault re-enqueues the request at its ORIGINAL
+  deadline priority instead of failing the future; a dispatcher-thread
+  crash (fault site ``serve.dispatch``) fails the in-cycle futures with
+  a classified :class:`ServeError` and restarts the dispatch loop — the
+  queue never wedges, and no admitted future is ever silently dropped.
+- **Poison-batch bisection** — a classified fault inside a coalesced
+  flush triggers log₂ bisection of the group: halves re-coalesce onto
+  the same geometric bucket family (zero new XLA compiles) until the
+  poison request(s) are isolated, quarantined
+  (``oap_serve_poison_total`` + a payload digest in the flight
+  recorder), and every innocent request is answered.
+- **Graceful drain** — :meth:`TrafficQueue.drain` stops admission
+  (``ShedError(reason="draining")``), flushes pending + retrying
+  futures until a wall deadline, fails leftovers loudly
+  (``reason="drain-deadline"``), and posts a
+  ``serve.drain.done.rank<r>.json`` report on the supervisor sideband;
+  wired into ``ScaleController`` scale-in and ``ReplicaGuard.release``.
+- **Brownout ladder** — :class:`BrownoutController`
+  (``Config.serve_brownout`` = auto|off|pin:<rung>) steps recorded
+  degradation rungs (reduced top-k depth → bf16 serving precision where
+  a parity bound exists → stale-pin answering) under sustained
+  over-budget pressure, fleet-trend-gated like the scale controller —
+  each rung LOUD in ``serving_summary()["brownout"]``, span attrs, and
+  ``oap_serve_brownout_rung``, absorbing pressure before requests shed.
+
 Concurrency contract (oaplint R19-R22 / the ``locks`` sanitizer): the
 queue lock is a :class:`~oap_mllib_tpu.utils.locktrace.TrackedLock`
 held only around list surgery — scoring, future resolution, and event
@@ -43,9 +74,13 @@ daemonized AND joined by :meth:`TrafficQueue.close`.
 
 from __future__ import annotations
 
+import json
 import math
+import os
 import threading
 import time
+import warnings
+import zlib
 from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional
@@ -54,7 +89,7 @@ import numpy as np
 
 from oap_mllib_tpu.config import get_config
 from oap_mllib_tpu.telemetry import metrics as _tm
-from oap_mllib_tpu.utils import locktrace
+from oap_mllib_tpu.utils import faults, locktrace
 
 # the supervisor sideband file the scale controller posts decisions to
 # (crash_dir/<SCALE_HINT_FILENAME>; read-and-removed per attempt like
@@ -73,7 +108,9 @@ class ShedError(RuntimeError):
     message names the queue depth, the deadline, and the priced
     bytes-vs-budget so the operator sees exactly why, and every shed
     counts ``oap_serve_shed_total{reason=}``.  ``reason`` is one of
-    ``"queue_full"`` / ``"budget"`` / ``"deadline"``."""
+    ``"queue_full"`` / ``"budget"`` / ``"deadline"`` / ``"draining"``
+    (the queue is flushing for scale-in/shutdown — resubmit to a live
+    replica)."""
 
     def __init__(self, reason: str, msg: str, *,
                  queue_depth: Optional[int] = None,
@@ -100,6 +137,64 @@ class ShedError(RuntimeError):
             f"serving traffic: request shed ({reason}) — {msg}"
             + (f" [{detail}]" if detail else "")
         )
+
+
+class ServeError(RuntimeError):
+    """A request the traffic plane ACCEPTED but could not answer — the
+    loud half of the durable-future contract: accepted work completes
+    exactly-once or fails naming exactly why.  ``reason`` is one of
+
+    - ``"retries-exhausted"`` — transient scoring faults outlasted the
+      ``serve_retry_limit`` envelope (``retries`` says how many ran);
+    - ``"poison"`` — bisection isolated this request as the poison in
+      its coalesced batch (``oap_serve_poison_total`` booked, payload
+      digest in the flight recorder); innocents were answered;
+    - ``"fault"`` — a classified non-retriable fault (``fault_class``
+      names the kind, e.g. oom);
+    - ``"dispatcher-crash"`` — the dispatch cycle scoring this request
+      crashed; the dispatcher restarted but this future fails loudly
+      rather than hang;
+    - ``"drain-deadline"`` — unresolved when a graceful drain's wall
+      deadline expired;
+    - ``"shutdown"`` — the queue closed with the request unresolved
+      (close() fail-or-flushes every future; nothing leaks);
+    - ``"eviction"`` — a replica died mid-flight and the work could not
+      re-form on the survivors; ``crash_records`` names the culprit
+      crash record path(s) on the sideband.
+
+    Every construction books
+    ``oap_serve_request_failures_total{reason=}`` so classified
+    failures are visible on the metrics plane wherever they land."""
+
+    def __init__(self, reason: str, msg: str, *,
+                 fault_class: Optional[str] = None,
+                 retries: int = 0,
+                 cause: Optional[BaseException] = None,
+                 crash_records=()):
+        self.reason = reason
+        self.fault_class = fault_class
+        self.retries = int(retries)
+        self.crash_records = tuple(crash_records)
+        _tm.counter(
+            "oap_serve_request_failures_total", {"reason": reason},
+            help="Accepted requests the traffic plane failed loudly, "
+                 "by classified reason",
+        ).inc()
+        parts = []
+        if fault_class:
+            parts.append(f"class={fault_class}")
+        if retries:
+            parts.append(f"retries={retries}")
+        if self.crash_records:
+            parts.append("crash records: "
+                         + ", ".join(self.crash_records))
+        detail = ", ".join(parts)
+        super().__init__(
+            f"serving traffic: request failed ({reason}) — {msg}"
+            + (f" [{detail}]" if detail else "")
+        )
+        if cause is not None:
+            self.__cause__ = cause
 
 
 def _fmt_bytes(n: int) -> str:
@@ -144,16 +239,53 @@ def traffic_cfg() -> Dict[str, float]:
         raise ValueError(
             f"serve_shed_headroom must be in (0, 1], got {headroom}"
         )
+    retry_limit = int(cfg.serve_retry_limit)
+    if retry_limit < 0:
+        raise ValueError(
+            f"serve_retry_limit must be >= 0, got {retry_limit}"
+        )
+    retry_backoff = float(cfg.serve_retry_backoff)
+    if retry_backoff < 0:
+        raise ValueError(
+            f"serve_retry_backoff must be >= 0, got {retry_backoff}"
+        )
+    brownout = str(cfg.serve_brownout).strip().lower()
+    _parse_brownout(brownout)  # a typo raises here, at submit time
     return {
         "queue_depth": depth,
         "deadline_ms": deadline_ms,
         "headroom": headroom,
+        "retry_limit": retry_limit,
+        "retry_backoff": retry_backoff,
+        "brownout": brownout,
     }
+
+
+# ordered degradation rungs the brownout ladder steps through: each is
+# cheaper than the last, every step is recorded/LOUD (see
+# BrownoutController)
+BROWNOUT_RUNGS = ("off", "topk", "bf16", "stale")
+
+
+def _parse_brownout(raw: str) -> Optional[int]:
+    """Parse ``Config.serve_brownout`` (auto|off|pin:<rung>): the
+    pinned rung index for ``pin:``, None for auto/off; a typo raises
+    ValueError (the kmeans_kernel/fault_spec contract)."""
+    if raw in ("auto", "off"):
+        return None
+    if raw.startswith("pin:"):
+        rung = raw[len("pin:"):]
+        if rung in BROWNOUT_RUNGS:
+            return BROWNOUT_RUNGS.index(rung)
+    raise ValueError(
+        f"serve_brownout must be auto, off, or pin:<rung> with rung in "
+        f"{'|'.join(BROWNOUT_RUNGS)}; got {raw!r}"
+    )
 
 
 class _Request:
     __slots__ = ("x", "rows", "deadline", "deadline_ms", "seq", "future",
-                 "submitted")
+                 "submitted", "retries", "not_before", "running")
 
     def __init__(self, x: np.ndarray, deadline: float, deadline_ms: float,
                  seq: int, submitted: float):
@@ -164,6 +296,14 @@ class _Request:
         self.seq = seq
         self.submitted = submitted
         self.future: Future = Future()
+        # durable-future envelope: retries spent so far, the earliest
+        # clock second the next attempt may dispatch (backoff), and
+        # whether set_running_or_notify_cancel already ran (a future
+        # transitions PENDING->RUNNING exactly once; a requeued request
+        # is already RUNNING)
+        self.retries = 0
+        self.not_before = 0.0
+        self.running = False
 
 
 class TrafficQueue:
@@ -208,8 +348,10 @@ class TrafficQueue:
         self._clock = clock
         self._lock = locktrace.TrackedLock("serving.traffic")
         self._pending: List[_Request] = []
+        self._inflight: Dict[int, _Request] = {}
         self._seq = 0
         self._closed = False
+        self._draining = False
         self._budget_cache: Optional[tuple] = None
         self.submitted = 0
         self.answered = 0
@@ -253,6 +395,13 @@ class TrafficQueue:
                     "TrafficQueue is closed; no further submissions"
                 )
             depth = len(self._pending)
+            if self._draining:
+                raise _shed(
+                    "draining",
+                    "queue is draining for scale-in/shutdown; resubmit "
+                    "to a live replica",
+                    queue_depth=depth, deadline_ms=deadline_ms,
+                )
             if depth >= knobs["queue_depth"]:
                 raise _shed(
                     "queue_full",
@@ -268,7 +417,11 @@ class TrafficQueue:
                     int(r.x.size * r.x.itemsize) for r in self._pending
                 )
                 priced = int((pending_bytes + req_bytes) * _OVERHEAD)
-                if priced > allowance:
+                # the brownout ladder sees every priced admission: over
+                # budget it may step a rung and ABSORB the breach
+                # (degrade before shed); under budget it steps back down
+                bo = brownout().observe_admission(priced, allowance)
+                if priced > allowance and not bo["absorb"]:
                     raise _shed(
                         "budget",
                         "projected staged working set exceeds the "
@@ -313,41 +466,94 @@ class TrafficQueue:
         while not self._stop.is_set():
             self._wake.wait(self._poll_s)
             self._wake.clear()
-            self.pump()
+            try:
+                self.pump()
+            except Exception as exc:  # noqa: BLE001 — crash survived
+                # the never-wedge contract: pump already failed or
+                # requeued every in-cycle future (see
+                # _dispatcher_crash); the loop restarts and keeps
+                # draining — LOUD, never silent
+                warnings.warn(
+                    "serving traffic: dispatcher crashed and restarted "
+                    f"— in-cycle futures were failed/requeued ({exc!r})",
+                    RuntimeWarning, stacklevel=2,
+                )
+
+    # -- future resolution (exactly-once, close/drain-race safe) -------------
+
+    def _land(self, r: _Request, out) -> bool:
+        self._inflight.pop(id(r), None)
+        try:
+            r.future.set_result(out)
+            return True
+        except Exception:  # InvalidStateError: close()/drain() beat us
+            return False
+
+    def _land_exc(self, r: _Request, exc: BaseException) -> bool:
+        self._inflight.pop(id(r), None)
+        try:
+            r.future.set_exception(exc)
+            return True
+        except Exception:  # InvalidStateError: close()/drain() beat us
+            return False
 
     def pump(self) -> int:
-        """One dispatch cycle: pop everything pending, shed the
-        expired, deadline-order the rest, flush in row-bounded groups.
-        Returns the number of requests resolved (answered + shed).
-        Safe to call concurrently with the dispatcher thread — the pop
-        is atomic and each request belongs to exactly one cycle."""
+        """One dispatch cycle: pop every pending request whose retry
+        backoff has elapsed, shed the expired, deadline-order the rest,
+        flush in row-bounded groups.  Returns the number of requests
+        resolved (answered + shed + failed).  Safe to call concurrently
+        with the dispatcher thread — the pop is atomic and each request
+        belongs to exactly one cycle.  A crash in the cycle itself
+        (fault site ``serve.dispatch``) fails or requeues every
+        unresolved in-cycle future before re-raising — accepted work is
+        never silently dropped."""
+        now = self._clock()
         with self._lock:
-            batch = self._pending
-            self._pending = []
-        if not batch:
-            return 0
+            if not self._pending:
+                return 0
+            ready = [r for r in self._pending if r.not_before <= now]
+            if not ready:
+                return 0
+            if len(ready) == len(self._pending):
+                self._pending = []
+            else:
+                self._pending = [
+                    r for r in self._pending if r.not_before > now
+                ]
+            for r in ready:
+                self._inflight[id(r)] = r
         from oap_mllib_tpu.serving import registry
 
-        registry.note_queue_depth(-len(batch))
-        now = self._clock()
-        live: List[_Request] = []
+        registry.note_queue_depth(-len(ready))
+        try:
+            faults.maybe_fault("serve.dispatch")
+            return self._dispatch(ready, now)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            self._dispatcher_crash(ready, exc)
+            raise
+
+    def _dispatch(self, ready: List[_Request], now: float) -> int:
         resolved = 0
-        for r in batch:
-            if not r.future.set_running_or_notify_cancel():
-                resolved += 1  # caller cancelled before dispatch
-                continue
+        live: List[_Request] = []
+        for r in ready:
+            if not r.running:
+                if not r.future.set_running_or_notify_cancel():
+                    self._inflight.pop(id(r), None)
+                    resolved += 1  # caller cancelled before dispatch
+                    continue
+                r.running = True
             if r.deadline <= now:
                 late_ms = (now - r.deadline) * 1e3
-                r.future.set_exception(_shed(
+                if self._land_exc(r, _shed(
                     "deadline",
                     f"request expired {late_ms:.1f} ms past its "
                     "deadline before dispatch (queue wait exceeded the "
                     "budget); shed un-scored",
-                    queue_depth=len(batch),
+                    queue_depth=len(ready),
                     deadline_ms=r.deadline_ms,
-                ))
-                with self._lock:
-                    self.shed += 1
+                )):
+                    with self._lock:
+                        self.shed += 1
                 resolved += 1
                 continue
             live.append(r)
@@ -364,39 +570,545 @@ class TrafficQueue:
         if group:
             groups.append(group)
         for g in groups:
-            try:
-                parts = self._handle.predict_many([r.x for r in g])
-            except Exception as exc:  # noqa: BLE001 — lands on futures
-                for r in g:
-                    r.future.set_exception(exc)
-            else:
-                for r, out in zip(g, parts):
-                    r.future.set_result(out)
-                with self._lock:
-                    self.answered += len(g)
-            resolved += len(g)
+            resolved += self._dispatch_group(g, now)
         return resolved
+
+    def _dispatch_group(self, g: List[_Request], now: float) -> int:
+        """Score one deadline-ordered group; on a fault, classify and
+        either retry (transient), bisect (classified fault in a
+        coalesced group — halves re-coalesce on the same geometric
+        bucket family, no new compiles), quarantine (isolated poison),
+        or land the raw exception (unclassified: a programming error
+        must propagate unchanged, never masked)."""
+        try:
+            parts = self._handle.predict_many([r.x for r in g])
+        except Exception as exc:  # noqa: BLE001 — classified below
+            return self._group_fault(g, exc, now)
+        resolved = 0
+        for r, out in zip(g, parts):
+            if self._land(r, out):
+                resolved += 1
+        with self._lock:
+            self.answered += resolved
+        return resolved
+
+    def _group_fault(self, g: List[_Request], exc: BaseException,
+                     now: float) -> int:
+        from oap_mllib_tpu.utils import resilience
+
+        kind = resilience.classify_fault(exc)
+        if kind == resilience.TRANSIENT:
+            policy = resilience.RetryPolicy.for_serving()
+            retriable = [r for r in g if r.retries < policy.max_retries]
+            spent = [r for r in g if r.retries >= policy.max_retries]
+            if retriable:
+                self._requeue(retriable, now, policy)
+            n = 0
+            for r in spent:
+                if self._land_exc(r, ServeError(
+                    "retries-exhausted",
+                    f"request seq={r.seq} kept hitting transient "
+                    f"scoring faults past serve_retry_limit="
+                    f"{policy.max_retries}",
+                    fault_class=kind, retries=r.retries, cause=exc,
+                )):
+                    n += 1
+            return n
+        if len(g) > 1 and kind is not None:
+            # poison-batch bisection: a CLASSIFIED fault in a coalesced
+            # group — split and rescore; each half re-buckets onto the
+            # already-warm geometric family, so isolation costs zero
+            # new XLA compiles
+            _tm.counter(
+                "oap_serve_bisect_total",
+                help="Coalesced-batch bisection rounds triggered by a "
+                     "classified scoring fault",
+            ).inc()
+            mid = len(g) // 2
+            return (self._dispatch_group(g[:mid], now)
+                    + self._dispatch_group(g[mid:], now))
+        if len(g) > 1:
+            # unclassified: land the RAW exception on every future of
+            # the flush (identity preserved — never masked, never
+            # rescored: a programming error is deterministic)
+            return sum(1 for r in g if self._land_exc(r, exc))
+        return self._quarantine(g[0], exc, kind)
+
+    def _quarantine(self, r: _Request, exc: BaseException,
+                    kind: Optional[str]) -> int:
+        from oap_mllib_tpu.utils import resilience
+
+        if kind is None:
+            # raw identity preserved for unclassified singletons too
+            return 1 if self._land_exc(r, exc) else 0
+        if kind == resilience.NONFINITE:
+            from oap_mllib_tpu.telemetry import flightrec
+
+            digest = zlib.crc32(
+                np.ascontiguousarray(r.x).tobytes()
+            ) & 0xFFFFFFFF
+            _tm.counter(
+                "oap_serve_poison_total",
+                help="Requests quarantined as poison by coalesced-"
+                     "batch bisection",
+            ).inc()
+            flightrec.record(
+                "serve", "poison",
+                f"seq={r.seq} rows={r.rows} digest={digest:08x}: {exc}",
+            )
+            err = ServeError(
+                "poison",
+                f"request seq={r.seq} quarantined: scoring it produces "
+                f"a nonfinite outcome (payload digest {digest:08x}); "
+                "innocents in its batch were answered",
+                fault_class=kind, retries=r.retries, cause=exc,
+            )
+        else:
+            err = ServeError(
+                "fault",
+                f"request seq={r.seq} failed a non-retriable {kind} "
+                "scoring fault",
+                fault_class=kind, retries=r.retries, cause=exc,
+            )
+        return 1 if self._land_exc(r, err) else 0
+
+    def _requeue(self, rs: List[_Request], now: float, policy) -> None:
+        """Re-enqueue transiently-faulted requests: seq and deadline
+        are PRESERVED, so the retry dispatches at its original deadline
+        priority; ``not_before`` applies the policy's jittered
+        backoff."""
+        for r in rs:
+            r.not_before = now + policy.delay_s(r.retries,
+                                                site="serve.batch")
+            r.retries += 1
+        _tm.counter(
+            "oap_serve_retries_total",
+            help="Transient scoring faults re-enqueued by the durable-"
+                 "future retry envelope",
+        ).inc(len(rs))
+        with self._lock:
+            closed = self._closed
+            if not closed:
+                self._pending.extend(rs)
+                for r in rs:
+                    self._inflight.pop(id(r), None)
+        if closed:
+            for r in rs:
+                self._land_exc(r, ServeError(
+                    "shutdown",
+                    f"request seq={r.seq} had retries left but the "
+                    "queue is closing; resubmit to a live replica",
+                    retries=r.retries,
+                ))
+            return
+        from oap_mllib_tpu.serving import registry
+
+        registry.note_queue_depth(len(rs))
+        self._wake.set()
+
+    def _dispatcher_crash(self, ready: List[_Request],
+                          exc: BaseException) -> None:
+        """A crash in the dispatch cycle OUTSIDE the scoring call
+        (fault site ``serve.dispatch`` or a bug): classify it, requeue
+        transient survivors with retries left, fail everything else
+        with ``ServeError(reason="dispatcher-crash")`` — the loop
+        restarts (see ``_run``) and the queue never wedges."""
+        from oap_mllib_tpu.utils import resilience
+
+        _tm.counter(
+            "oap_serve_dispatch_crashes_total",
+            help="Dispatcher-thread crashes survived by the traffic "
+                 "plane (futures failed/requeued, dispatch restarted)",
+        ).inc()
+        with self._lock:
+            pending_ids = {id(r) for r in self._pending}
+        leftover = [
+            r for r in ready
+            if not r.future.done() and id(r) not in pending_ids
+        ]
+        kind = resilience.classify_fault(exc)
+        if kind == resilience.TRANSIENT:
+            policy = resilience.RetryPolicy.for_serving()
+            retriable = [
+                r for r in leftover if r.retries < policy.max_retries
+            ]
+            leftover = [
+                r for r in leftover if r.retries >= policy.max_retries
+            ]
+            if retriable:
+                self._requeue(retriable, self._clock(), policy)
+        for r in leftover:
+            self._land_exc(r, ServeError(
+                "dispatcher-crash",
+                f"the dispatch cycle scoring request seq={r.seq} "
+                "crashed; the dispatcher restarts but this future "
+                "fails loudly rather than hang",
+                fault_class=kind, retries=r.retries, cause=exc,
+            ))
 
     # -- lifecycle -----------------------------------------------------------
 
-    def close(self) -> None:
+    def drain(self, timeout_s: float = 5.0) -> Dict[str, Any]:
+        """Graceful release of this replica's queue: stop admission
+        (subsequent submits shed with ``reason="draining"``), flush
+        pending + retrying futures until the queue is empty or the
+        WALL deadline (``timeout_s``) expires, then fail leftovers
+        loudly with ``ServeError(reason="drain-deadline")`` — every
+        accepted future resolves before the replica releases.  Books
+        ``oap_serve_drains_total``, posts a
+        ``serve.drain.done.rank<r>.json`` report on the crash sideband
+        when armed, and returns the stats dict.  Wired into
+        ``ScaleController`` scale-in decisions and
+        ``ha.ReplicaGuard.release``."""
+        faults.maybe_fault("serve.drain")
+        with self._lock:
+            self._draining = True
+            start_pending = len(self._pending) + len(self._inflight)
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        answered0 = self.answered
+        while True:
+            try:
+                self.pump()
+            except Exception:  # noqa: BLE001 — crash path already
+                pass           # failed/requeued the cycle's futures
+            with self._lock:
+                left = len(self._pending) + len(self._inflight)
+            if left == 0 or time.monotonic() >= deadline:
+                break
+            self._wake.set()
+            time.sleep(min(self._poll_s, 0.005))
+        with self._lock:
+            leftovers = list(self._pending)
+            self._pending = []
+            stuck = [
+                r for r in self._inflight.values()
+                if not r.future.done()
+            ]
+        if leftovers:
+            from oap_mllib_tpu.serving import registry
+
+            registry.note_queue_depth(-len(leftovers))
+        failed = 0
+        for r in leftovers + stuck:
+            if self._land_exc(r, ServeError(
+                "drain-deadline",
+                f"request seq={r.seq} unresolved when the drain "
+                f"deadline ({timeout_s:g}s) expired; resubmit to a "
+                "live replica",
+                retries=r.retries,
+            )):
+                failed += 1
+        stats = {
+            "pending_at_drain": start_pending,
+            "answered": self.answered - answered0,
+            "failed": failed,
+            "drained": failed == 0,
+            "timeout_s": float(timeout_s),
+        }
+        _tm.counter(
+            "oap_serve_drains_total",
+            help="Graceful drains of the traffic queue (scale-in / "
+                 "shutdown)",
+        ).inc()
+        self._write_drain_report(stats)
+        return stats
+
+    def _write_drain_report(self, stats: Dict[str, Any]) -> Optional[str]:
+        """Post the drain outcome on the supervisor sideband (atomic
+        tmp+rename, the scale-hint pattern) so the supervisor's shrink
+        path can confirm the released replica flushed its futures."""
+        crash_dir = str(get_config().crash_dir or "")
+        if not crash_dir:
+            return None
+        try:
+            import jax
+
+            rank = int(jax.process_index())
+        except Exception:  # noqa: BLE001 — sidebandless single host
+            rank = 0
+        os.makedirs(crash_dir, exist_ok=True)
+        path = os.path.join(crash_dir, f"serve.drain.done.rank{rank}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"rank": rank, **stats}, f)
+        os.replace(tmp, path)
+        return path
+
+    def close(self, timeout_s: Optional[float] = None) -> None:
         """Stop admissions, join the dispatcher (R22), drain leftovers
-        through one final :meth:`pump` so every future resolves."""
+        through one final :meth:`pump`, then FAIL-or-flush: any future
+        still unresolved (a retry whose backoff never elapsed, a
+        scoring callable that wedged the dispatcher past ``timeout_s``)
+        raises ``ServeError(reason="shutdown")`` — close never leaks a
+        pending future, wedged or not."""
         with self._lock:
             self._closed = True
+            for r in self._pending:
+                # final pump dispatches retries immediately: their
+                # backoff is moot once the queue is closing
+                r.not_before = 0.0
         self._stop.set()
         self._wake.set()
         t = self._thread
+        wedged = False
         if t is not None:
-            t.join()
-            self._thread = None
-        self.pump()
+            t.join(timeout_s)
+            if t.is_alive():
+                # the scoring callable wedged the dispatcher: the
+                # daemon flag alone would silently strand every pending
+                # future — fail them explicitly instead
+                wedged = True
+                _tm.counter(
+                    "oap_serve_close_wedged_total",
+                    help="close() calls that found the dispatcher "
+                         "wedged in a scoring call past the join "
+                         "timeout (pending futures failed explicitly)",
+                ).inc()
+                warnings.warn(
+                    "serving traffic: dispatcher did not join within "
+                    f"{timeout_s}s at close (scoring callable wedged); "
+                    "failing every unresolved future loudly",
+                    RuntimeWarning, stacklevel=2,
+                )
+            else:
+                self._thread = None
+        if not wedged:
+            try:
+                self.pump()
+            except Exception:  # noqa: BLE001 — crash path already
+                pass           # failed/requeued the cycle's futures
+        with self._lock:
+            leftovers = list(self._pending)
+            self._pending = []
+            stuck = [
+                r for r in self._inflight.values()
+                if not r.future.done()
+            ]
+        if leftovers:
+            from oap_mllib_tpu.serving import registry
+
+            registry.note_queue_depth(-len(leftovers))
+        for r in leftovers + stuck:
+            self._land_exc(r, ServeError(
+                "shutdown",
+                f"request seq={r.seq} unresolved at TrafficQueue "
+                "close; resubmit to a live replica",
+                retries=r.retries,
+            ))
 
     def __enter__(self) -> "TrafficQueue":
         return self
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+# -- brownout degradation ladder ----------------------------------------------
+
+
+class BrownoutController:
+    """Degrade before you shed: under sustained over-budget admission
+    pressure (priced bytes vs the serving allowance — the same pricing
+    the budget shed uses), step through recorded degradation rungs
+    instead of immediately refusing work.  Rungs, in order:
+
+    0. ``off``   — no degradation (the steady state);
+    1. ``topk``  — recommendation top-k depth halves
+       (:func:`brownout_topk`); score work shrinks, answers shorten;
+    2. ``bf16``  — serving precision drops to bf16 for algorithms with
+       a recorded parity bound (:func:`brownout_precision_override`,
+       consumed by ``batcher.resolve_policy``; an explicit
+       ``serving_precision`` pin always wins);
+    3. ``stale`` — model re-pins may answer from the previous (stale)
+       device pin instead of blocking (:func:`brownout_stale_ok`,
+       consumed by ``registry.pin``).
+
+    Stepping is gated like ``ScaleController``: a FULL window of
+    samples whose mean pressure ratio exceeds 1.0 with a non-falling
+    trend (``telemetry/fleet._trend``) steps up; mean below 0.5 with a
+    non-rising trend steps down.  ``pin:<rung>`` holds a rung
+    unconditionally; ``off`` disables the ladder.  Every step is LOUD:
+    ``oap_serve_brownout_rung`` gauge, ``oap_serve_brownout_steps_
+    total{direction=}``, a flight-recorder entry, the enclosing span's
+    ``brownout`` attr, and ``serving_summary()["brownout"]``.
+
+    A breach is ABSORBED (admitted over budget) when the ladder just
+    stepped or holds an intermediate rung — the degradation buys back
+    the working set.  At the top rung with pressure still sustained,
+    the budget shed resumes as the backstop: brownout delays shedding,
+    it never disables the OOM guard."""
+
+    RUNGS = BROWNOUT_RUNGS
+    WINDOW = 4  # samples per step decision (fleet._trend's minimum)
+
+    def __init__(self, policy: Optional[str] = None):
+        raw = str(
+            get_config().serve_brownout if policy is None else policy
+        ).strip().lower()
+        self.policy = raw
+        self.pinned = _parse_brownout(raw)
+        self.rung = self.pinned or 0
+        self.absorbed = 0
+        self._ratios: deque = deque(maxlen=self.WINDOW)
+        self.steps: List[Dict[str, Any]] = []
+        self._gauge()
+
+    def _gauge(self) -> None:
+        _tm.gauge(
+            "oap_serve_brownout_rung",
+            help="Current brownout degradation rung (0=off, 1=topk, "
+                 "2=bf16, 3=stale)",
+        ).set(self.rung)
+
+    def _step(self, direction: int, ratio: float, trend: str) -> None:
+        old = self.rung
+        self.rung += direction
+        step = {
+            "from": self.RUNGS[old],
+            "to": self.RUNGS[self.rung],
+            "ratio": round(float(ratio), 3),
+            "trend": trend,
+        }
+        self.steps.append(step)
+        self._ratios.clear()  # each rung needs fresh sustained samples
+        self._gauge()
+        _tm.counter(
+            "oap_serve_brownout_steps_total",
+            {"direction": "up" if direction > 0 else "down"},
+            help="Brownout ladder rung steps, by direction",
+        ).inc()
+        from oap_mllib_tpu.telemetry import flightrec
+
+        flightrec.record(
+            "serve", "brownout",
+            f"rung {self.RUNGS[old]}->{self.RUNGS[self.rung]} "
+            f"(pressure {ratio:.2f}, {trend})",
+        )
+        from oap_mllib_tpu.telemetry.spans import current_span
+
+        sp = current_span()
+        if sp is not None:
+            sp.attrs["brownout"] = self.RUNGS[self.rung]
+
+    def observe_admission(self, priced: int, budget: int) -> Dict[str, Any]:
+        """Fold one priced admission; returns the decision dict (rung,
+        whether THIS breach is absorbed, the pressure ratio/trend).
+        Never blocks: called under the admission lock in ``submit``."""
+        ratio = float(priced) / float(budget) if budget > 0 else 0.0
+        self._ratios.append(ratio)
+        if self.policy == "off" or self.pinned is not None:
+            # pinned rungs degrade but never absorb a breach silently:
+            # the operator pinned quality, not the admission contract
+            return {
+                "rung": self.rung, "rung_name": self.RUNGS[self.rung],
+                "absorb": False, "ratio": ratio, "stepped": 0,
+            }
+        from oap_mllib_tpu.telemetry.fleet import _trend
+
+        trend = _trend(list(self._ratios))
+        mean = float(np.mean(self._ratios))
+        stepped = 0
+        if (len(self._ratios) == self.WINDOW
+                and mean > 1.0
+                and trend != "falling"
+                and self.rung < len(self.RUNGS) - 1):
+            self._step(+1, ratio, trend)
+            stepped = 1
+        elif (len(self._ratios) == self.WINDOW
+                and mean < 0.5
+                and trend != "rising"
+                and self.rung > 0):
+            self._step(-1, ratio, trend)
+            stepped = -1
+        absorb = ratio > 1.0 and (
+            stepped > 0 or 0 < self.rung < len(self.RUNGS) - 1
+        )
+        if absorb:
+            self.absorbed += 1
+            _tm.counter(
+                "oap_serve_brownout_absorbed_total",
+                help="Over-budget admissions absorbed by an active "
+                     "brownout rung instead of shed",
+            ).inc()
+        return {
+            "rung": self.rung, "rung_name": self.RUNGS[self.rung],
+            "absorb": absorb, "ratio": ratio, "stepped": stepped,
+        }
+
+    # public spelling; the ladder "observes" pressure like the
+    # ScaleController observes the queue
+    observe = observe_admission
+
+    def summary(self) -> Dict[str, Any]:
+        out = {
+            "policy": self.policy,
+            "rung": self.RUNGS[self.rung],
+            "rung_index": self.rung,
+            "steps": len(self.steps),
+            "absorbed": self.absorbed,
+        }
+        if self.steps:
+            out["last_step"] = dict(self.steps[-1])
+        return out
+
+
+_BROWNOUT: Optional[BrownoutController] = None
+
+
+def brownout() -> BrownoutController:
+    """The process-wide brownout ladder, lazily (re)built whenever
+    ``Config.serve_brownout`` changes — the progcache/registry
+    singleton pattern."""
+    global _BROWNOUT
+    raw = str(get_config().serve_brownout).strip().lower()
+    with _STATE_LOCK:
+        b = _BROWNOUT
+        if b is None or b.policy != raw:
+            b = BrownoutController(raw)
+            _BROWNOUT = b
+    return b
+
+
+def brownout_rung() -> int:
+    """Current rung index (0 = off) without forcing a rebuild cycle —
+    cross-module consumers (batcher, registry, sweep) key off this."""
+    return brownout().rung
+
+
+def brownout_topk(k: int) -> int:
+    """Rung >= topk: halve the requested recommendation depth (floor
+    1).  NOTE the reduced-k program is a new static shape — warm it
+    (``warmup``) before relying on the zero-compile steady state at
+    this rung."""
+    if brownout_rung() >= BROWNOUT_RUNGS.index("topk"):
+        reduced = max(1, int(k) // 2)
+        if reduced < int(k):
+            _tm.counter(
+                "oap_serve_brownout_topk_reduced_total",
+                help="Recommendation requests answered at reduced "
+                     "top-k depth under brownout",
+            ).inc()
+        return reduced
+    return int(k)
+
+
+def brownout_precision_override(algo: str) -> str:
+    """Rung >= bf16 AND the algorithm has a recorded parity bound:
+    return "bf16" for ``batcher.resolve_policy`` to fold in (an
+    explicit ``serving_precision`` pin always wins); else ""."""
+    if brownout_rung() >= BROWNOUT_RUNGS.index("bf16"):
+        from oap_mllib_tpu.utils.precision import PARITY_BOUNDS
+
+        if algo in PARITY_BOUNDS:
+            return "bf16"
+    return ""
+
+
+def brownout_stale_ok() -> bool:
+    """Rung >= stale: ``registry.pin`` may answer from the previous
+    (stale) device pin during a model re-pin instead of blocking on
+    the fresh transfer."""
+    return brownout_rung() >= BROWNOUT_RUNGS.index("stale")
 
 
 # -- replica-count control ----------------------------------------------------
@@ -424,7 +1136,8 @@ class ScaleController:
                  max_replicas: int = 0,
                  high: Optional[float] = None,
                  idle_s: Optional[float] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 queue: Optional[TrafficQueue] = None):
         cfg = get_config()
         self.high = float(cfg.serve_scale_high if high is None else high)
         self.idle_s = float(
@@ -449,6 +1162,10 @@ class ScaleController:
         # fleet: default cap is the starting size x2
         self.max_replicas = int(max_replicas) or 2 * int(replicas)
         self._clock = clock
+        # the local replica's queue, when attached: a scale-IN decision
+        # gracefully drains it (stop admission, flush futures) before
+        # the replica releases — no future dies with the shrink
+        self._queue = queue
         self._depths: deque = deque(maxlen=self.WINDOW)
         self._p99s: deque = deque(maxlen=self.WINDOW)
         self._last_busy = clock()
@@ -536,6 +1253,10 @@ class ScaleController:
             "depth_trend": depth_trend,
             "p99_trend": p99_trend,
         }
+        if action == "in" and self._queue is not None:
+            # graceful shrink: the released replica stops admission and
+            # flushes every accepted future BEFORE the world resizes
+            decision["drained"] = self._queue.drain()
         self.decisions.append(decision)
         with _STATE_LOCK:
             _scale_state.clear()
@@ -565,8 +1286,10 @@ def write_scale_hint(crash_dir: str,
 
 
 def summary_block() -> Dict[str, Any]:
-    """The traffic-plane additions to ``serving_summary()``: shed
-    totals by reason, plus the scale controller's last decision."""
+    """The traffic-plane additions to ``serving_summary()``: shed and
+    request-failure totals by reason, durable-future counters, the
+    brownout ladder state, plus the scale controller's last
+    decision."""
     out: Dict[str, Any] = {}
     reg = _tm.registry()
     with _tm._LOCK:
@@ -575,8 +1298,32 @@ def summary_block() -> Dict[str, Any]:
             for (name, labels), m in reg._metrics.items()
             if name == "oap_serve_shed_total"
         }
+        fails = {
+            dict(labels).get("reason", ""): int(m.value)
+            for (name, labels), m in reg._metrics.items()
+            if name == "oap_serve_request_failures_total"
+        }
     if sheds:
         out["shed"] = {"total": sum(sheds.values()), **sheds}
+    futures = {
+        "retries": int(_tm.family_total("oap_serve_retries_total")),
+        "poison": int(_tm.family_total("oap_serve_poison_total")),
+        "bisections": int(_tm.family_total("oap_serve_bisect_total")),
+        "dispatcher_crashes": int(
+            _tm.family_total("oap_serve_dispatch_crashes_total")
+        ),
+        "drains": int(_tm.family_total("oap_serve_drains_total")),
+    }
+    if fails:
+        futures["failed"] = {"total": sum(fails.values()), **fails}
+    if fails or any(futures[k] for k in
+                    ("retries", "poison", "bisections",
+                     "dispatcher_crashes", "drains")):
+        out["futures"] = futures
+    b = _BROWNOUT
+    if b is not None and (b.rung or b.steps or b.policy != "auto"
+                          or b.absorbed):
+        out["brownout"] = b.summary()
     with _STATE_LOCK:
         if _scale_state:
             out["scale"] = dict(_scale_state)
@@ -584,5 +1331,7 @@ def summary_block() -> Dict[str, Any]:
 
 
 def _reset_for_tests() -> None:
+    global _BROWNOUT
     with _STATE_LOCK:
         _scale_state.clear()
+        _BROWNOUT = None

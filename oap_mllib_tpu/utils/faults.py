@@ -48,6 +48,21 @@ site                  fires at
 ``spill.read``        every piece pulled from a SPILL-backed
                       ``ChunkSource`` (a table the host-OOM rung staged
                       to disk) — drives the spilled-route read tiers
+``serve.request``     every scoring batch booked by the serving
+                      micro-batcher (serving/batcher.py ``_book``) —
+                      request-path faults; a transient here drives the
+                      traffic plane's durable-future retry envelope
+``serve.dispatch``    every dispatch cycle of the async traffic queue
+                      (serving/traffic.TrafficQueue.pump) — a
+                      dispatcher-thread crash; the queue must fail
+                      in-flight futures loudly and restart, never wedge
+``serve.batch``       every coalesced flush of the serving registry
+                      (serving/registry.ServedModel._flush_many) — a
+                      poison batch; drives the log2-bisection isolation
+                      path of the traffic plane
+``serve.drain``       every graceful-drain entry
+                      (serving/traffic.TrafficQueue.drain) — drain-path
+                      faults during scale-in / shutdown
 ====================  =====================================================
 
 Arming: ``Config.fault_spec`` / env ``OAP_MLLIB_TPU_FAULT_SPEC``, a
@@ -96,6 +111,7 @@ SITES = (
     "stream.read", "prefetch.stage", "bootstrap.connect", "fit.execute",
     "ckpt.write", "ckpt.restore", "collective.dispatch",
     "disk.read", "spill.write", "spill.read", "serve.request",
+    "serve.dispatch", "serve.batch", "serve.drain",
 )
 
 KIND_FAIL = "fail"

@@ -262,6 +262,23 @@ def clear_crash_records(crash_dir: str) -> int:
     return removed
 
 
+def list_crash_records(crash_dir: str) -> list:
+    """Paths of every crash record currently in the sideband, sorted by
+    filename (i.e. by rank).  The serving plane uses this to NAME the
+    culprit when accepted work cannot complete after an eviction — a
+    ``ServeError(reason="eviction")`` carries these paths so the
+    operator lands on the exact crash record, not a generic timeout."""
+    try:
+        names = os.listdir(crash_dir)
+    except OSError:
+        return []
+    return [
+        os.path.join(crash_dir, name)
+        for name in sorted(names)
+        if name.startswith(_CRASH_PREFIX) and name.endswith(".json")
+    ]
+
+
 def record_fatal(site: str, exc: BaseException) -> None:
     """Coordinated-abort hook for a fatal fault outside any collective:
     classify it (utils/resilience.classify_fault) and poison the world

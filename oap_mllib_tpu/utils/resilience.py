@@ -204,6 +204,22 @@ class RetryPolicy:
             deadline_s=max(float(cfg.retry_deadline), 0.0),
         )
 
+    @classmethod
+    def for_serving(cls) -> "RetryPolicy":
+        """The traffic plane's durable-future envelope
+        (``Config.serve_retry_limit`` / ``serve_retry_backoff``): same
+        site-hashed deterministic jitter as the fit ladder, but a tight
+        backoff cap — a serving retry must stay inside request-latency
+        scales, not fit scales.  The deadline is per-REQUEST (each
+        request carries its own), so the policy itself is unbounded."""
+        cfg = get_config()
+        return cls(
+            max_retries=max(int(cfg.serve_retry_limit), 0),
+            backoff_s=max(float(cfg.serve_retry_backoff), 0.0),
+            max_backoff_s=0.5,
+            deadline_s=float("inf"),
+        )
+
     def delay_s(self, attempt: int, site: str = "") -> float:
         """Backoff before retry ``attempt`` (0-based), jittered."""
         base = min(

@@ -213,6 +213,7 @@ class Supervisor:
         self.shrinks = 0
         self.balance_hints: List[Dict[str, Any]] = []
         self.scale_hints: List[Dict[str, Any]] = []
+        self.drain_reports: List[Dict[str, Any]] = []
         self._blame_rank: Optional[int] = None
         self._blame_count = 0
 
@@ -364,6 +365,37 @@ class Supervisor:
             return None
         return hint
 
+    def _read_drain_reports(self) -> List[Dict[str, Any]]:
+        """Consume graceful-drain reports (serving/traffic.py
+        ``serve.drain.done.rank<r>.json`` — a released replica's proof
+        that it flushed or loudly failed every accepted future before
+        letting go).  Read-and-remove; the shrink path logs whether the
+        shrunk replica drained clean or dropped futures on the floor."""
+        reports: List[Dict[str, Any]] = []
+        try:
+            names = sorted(os.listdir(self.crash_dir))
+        except OSError:
+            return reports
+        import json
+
+        for name in names:
+            if not (name.startswith("serve.drain.done.rank")
+                    and name.endswith(".json")):
+                continue
+            path = os.path.join(self.crash_dir, name)
+            try:
+                with open(path) as f:
+                    rep = json.load(f)
+            except Exception:  # noqa: BLE001 — torn report
+                rep = None
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            if isinstance(rep, dict):
+                reports.append(rep)
+        return reports
+
     # -- the supervision loop ------------------------------------------------
 
     def run(self) -> Dict[str, Any]:
@@ -403,6 +435,14 @@ class Supervisor:
                 log.warning(
                     "supervisor: serving scale hint — %s (%s)",
                     scale_hint.get("action"), scale_hint.get("reason"),
+                )
+            for rep in self._read_drain_reports():
+                self.drain_reports.append(rep)
+                log.warning(
+                    "supervisor: replica rank %s drained before release "
+                    "— answered=%s failed=%s",
+                    rep.get("rank"), rep.get("answered"),
+                    rep.get("failed"),
                 )
             if att.ok and clean:
                 return self._summary(True, world, outs)
@@ -493,6 +533,7 @@ class Supervisor:
             "shrinks": self.shrinks,
             "balance_hints": list(self.balance_hints),
             "scale_hints": list(self.scale_hints),
+            "drain_reports": list(self.drain_reports),
             "attempts": [a.as_dict() for a in self.attempts],
             "outputs": list(outs),
         }

@@ -31,6 +31,17 @@ Modes (env ``TRAFFIC_WORKER_MODE``):
 - ``bench`` — the ``serving_kmeans_qps_mp`` headline: a sustained
   storm through the async queue, printing ``BENCH_QPS rank=0 qps=
   p50_ms= p99_ms=`` for bench.py to parse.
+- ``drill`` — the ISSUE 18 request-lifecycle chaos drill: a >=200
+  request storm with armed ``serve.dispatch`` transient faults (the
+  retry envelope), an injected ``serve.batch`` poison plus real
+  NaN-payload requests at known indices (bisection + quarantine),
+  and rank 1 SIGKILLed mid-storm (eviction).  The survivor must
+  resolve EVERY accepted future — answered bit-identically to direct
+  ``handle.predict`` or failed with a classified ``ServeError`` —
+  with zero steady-state compiles, print ``DRILL_OK`` with the exact
+  counters, then re-form the leg-1 sharded sweep on its local layout
+  (``shard_factors_local``) and prove bit-identical answers
+  (``REFORM_OK``).
 
 Invoked as:  python pseudo_cluster_worker_traffic.py RANK NPROC COORD LOCAL_DEV
 (the standard worker argv — the shared _launch_world plumbing spawns it).
@@ -151,6 +162,124 @@ if mode == "bench":
         for r in range(nproc)
     ):
         time.sleep(0.05)
+    os._exit(0)
+
+# -- drill mode: durable futures under replica death + poison + retries
+if mode == "drill":
+    from oap_mllib_tpu.telemetry import metrics as _tm
+
+    set_config(serve_queue_depth=1024, serve_retry_limit=3,
+               serve_retry_backoff=0.005)
+    guard = serving.ReplicaGuard()
+    q = serving.TrafficQueue(handle)
+    # warm wave: async path, bucket family, and the heartbeat shapes
+    # all hot BEFORE the chaos arms — the zero-compile clock starts
+    # here.  Coalesced flushes bucket on the SUM of request rows (the
+    # 1024-row flush bound), so the family warms to that bound, not
+    # just the largest single request.
+    handle.warmup(1024)
+    for b in [
+        rng.normal(size=(int(s), 8)).astype(np.float32)
+        for s in rng.integers(5, 128, size=12)
+    ]:
+        q.submit(b, deadline_ms=120_000).result(timeout=120)
+    with guard.leg():
+        if nproc > 1:
+            serving.heartbeat(requests=handle.requests,
+                              queue_depth=q.depth())
+    compile_snap = progcache.xla_compile_count()
+    # the storm: two transient dispatcher faults (retry envelope), one
+    # injected coalesced-batch poison (bisection with innocents), and
+    # three REAL NaN-payload requests at known indices (data poison the
+    # finite-guard quarantines deterministically)
+    set_config(fault_spec="serve.dispatch:fail=2,serve.batch:nan=1")
+    n_req = 220
+    per_wave = n_req // 5
+    poison_at = {31, 97, 171}
+    reqs = []
+    for i, s in enumerate(rng.integers(5, 128, size=n_req)):
+        b = rng.normal(size=(int(s), 8)).astype(np.float32)
+        if i in poison_at:
+            b[0, 0] = np.nan
+        reqs.append(b)
+    futs = {}
+    announced = False
+    for w in range(5):
+        if rank == 1 and nproc > 1 and w == 1:
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)  # a preempted replica
+        wave = range(w * per_wave, (w + 1) * per_wave)
+        with guard.leg():
+            for i in wave:
+                futs[i] = q.submit(reqs[i], deadline_ms=120_000)
+            for i in wave:
+                try:
+                    futs[i].result(timeout=120)
+                except Exception:
+                    pass  # classified failures audited below
+            if not guard.local_only and nproc > 1:
+                serving.heartbeat(requests=handle.requests,
+                                  queue_depth=q.depth())
+        if guard.local_only and not announced:
+            announced = True
+            err = type(guard.last_error).__name__
+            print(f"EVICTED rank={rank} wave={w} err={err}", flush=True)
+    steady_compiles = progcache.xla_compile_count() - compile_snap
+    q.close()
+    # the request-lifecycle audit: EVERY accepted future resolved —
+    # exactly the poison requests quarantined, everything else answered
+    # bit-identically to a direct predict on the same handle
+    unresolved = sum(1 for f in futs.values() if not f.done())
+    assert unresolved == 0, f"{unresolved} futures leaked"
+    poison, answered = [], 0
+    for i, f in sorted(futs.items()):
+        exc = f.exception()
+        if exc is None:
+            answered += 1
+            assert np.array_equal(f.result(), handle.predict(reqs[i])), (
+                f"req {i}: async answer diverges from direct predict"
+            )
+        else:
+            assert isinstance(exc, serving.ServeError), (
+                f"req {i}: unclassified failure {exc!r}"
+            )
+            assert exc.reason == "poison", f"req {i}: {exc.reason}"
+            poison.append(i)
+    assert set(poison) == poison_at, (poison, poison_at)
+    retried = int(_tm.family_total("oap_serve_retries_total"))
+    bisects = int(_tm.family_total("oap_serve_bisect_total"))
+    assert retried >= 1, "dispatcher transients never retried"
+    assert bisects >= 1, "poison batches never bisected"
+    print(
+        f"DRILL_OK rank={rank} submitted={n_req} answered={answered} "
+        f"poison={len(poison)} retried={retried} bisects={bisects} "
+        f"unresolved={unresolved} compiles={steady_compiles}",
+        flush=True,
+    )
+    # -- re-form the leg-1 sharded sweep on the survivor's live layout:
+    # the old mesh spans the dead rank, so the sweep must refuse it
+    # (classified, pre-launch) and the reform hook re-shards the host
+    # tables across LOCAL devices — answers stay bit-identical
+    if rank == 0 and nproc > 1:
+        assert serving.fleet_evicted(), "drill requires an eviction"
+        ids2, s2 = sweep.recommend_for_all_users(
+            sharded, 8, with_scores=True,
+            reform=lambda exc: ALSModel(
+                None, None,
+                sharded_user=sweep.shard_factors_local(uf),
+                sharded_item=sweep.shard_factors_local(itf),
+            ),
+        )
+        assert np.array_equal(ids2, ids_ref), "re-formed sweep ids diverge"
+        assert np.array_equal(s2, s_ref), "re-formed sweep score bits diverge"
+        reforms = int(_tm.family_total("oap_serve_sweep_reforms_total"))
+        rdigest = hashlib.sha256(
+            ids2.tobytes() + s2.tobytes()
+        ).hexdigest()[:16]
+        print(f"REFORM_OK rank={rank} reforms={reforms} digest={rdigest}",
+              flush=True)
+    open(os.path.join(crash_dir, f"traffic.done.rank{rank}"), "w").close()
     os._exit(0)
 
 # -- leg 2: jittered storm, heartbeats between waves, zero steady compiles

@@ -442,6 +442,131 @@ class TestShardedSweep:
         assert np.array_equal(m.user_factors_, f)
 
 
+class TestEvictionReform:
+    """ISSUE 18: a sharded sweep that loses a replica mid-flight either
+    re-forms on the survivors' local layout (reform hook) or fails
+    loudly naming the culprit crash records — never a silent hang."""
+
+    def _host_tables(self, rng):
+        uf = rng.normal(size=(40, 5)).astype(np.float32)
+        itf = rng.normal(size=(32, 5)).astype(np.float32)
+        return uf, itf
+
+    def _local_model(self, uf, itf):
+        return ALSModel(
+            None, None,
+            sharded_user=sweep.shard_factors_local(uf),
+            sharded_item=sweep.shard_factors_local(itf),
+        )
+
+    def test_shard_factors_local_serves_bit_identical(self, rng):
+        uf, itf = self._host_tables(rng)
+        ids, scores = sweep.recommend_for_all_users(
+            self._local_model(uf, itf), 6, with_scores=True
+        )
+        ref = ALSModel(uf, itf)
+        ids_ref, s_ref = ref._top_k_scores(uf, itf, 6)
+        assert np.array_equal(ids, ids_ref)
+        np.testing.assert_array_equal(scores, s_ref)
+
+    def test_reform_hook_reforms_once_and_answers(self, rng, monkeypatch):
+        from oap_mllib_tpu.utils import recovery
+
+        uf, itf = self._host_tables(rng)
+        real = sweep._sweep_sharded
+        calls = {"n": 0}
+
+        def dies_once(model, n, ws):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise recovery.CollectiveTimeoutError(
+                    "peer died mid-sweep"
+                )
+            return real(model, n, ws)
+
+        monkeypatch.setattr(sweep, "_sweep_sharded", dies_once)
+        reforms0 = tm.family_total("oap_serve_sweep_reforms_total")
+        reformed = []
+
+        def reform(exc):
+            reformed.append(exc)
+            return self._local_model(uf, itf)
+
+        ids, scores = sweep.recommend_for_all_users(
+            self._local_model(uf, itf), 6, with_scores=True,
+            reform=reform,
+        )
+        ref = ALSModel(uf, itf)
+        ids_ref, s_ref = ref._top_k_scores(uf, itf, 6)
+        assert np.array_equal(ids, ids_ref)
+        np.testing.assert_array_equal(scores, s_ref)
+        assert len(reformed) == 1
+        assert isinstance(
+            reformed[0], recovery.CollectiveTimeoutError
+        )
+        assert (
+            tm.family_total("oap_serve_sweep_reforms_total")
+            == reforms0 + 1
+        )
+
+    def test_reform_runs_once_then_raw_recovery_error(
+        self, rng, monkeypatch
+    ):
+        # the re-formed sweep gets NO second reform: a hook that hands
+        # back another doomed mesh surfaces the recovery error raw
+        from oap_mllib_tpu.utils import recovery
+
+        uf, itf = self._host_tables(rng)
+
+        def always_dies(model, n, ws):
+            raise recovery.CollectiveTimeoutError("still doomed")
+
+        monkeypatch.setattr(sweep, "_sweep_sharded", always_dies)
+        with pytest.raises(serving.ServeError) as ei:
+            sweep.recommend_for_all_users(
+                self._local_model(uf, itf), 6,
+                reform=lambda exc: self._local_model(uf, itf),
+            )
+        assert ei.value.reason == "eviction"
+
+    def test_no_reform_hook_names_the_crash_records(
+        self, rng, monkeypatch, tmp_path
+    ):
+        from oap_mllib_tpu.utils import recovery
+
+        set_config(crash_dir=str(tmp_path))
+        recovery.write_crash_record(
+            "serve.heartbeat", "collective_timeout", "peer preempted"
+        )
+
+        def dead_mesh(model, n, ws):
+            raise recovery.PeerAbortError("mesh spans a dead peer")
+
+        monkeypatch.setattr(sweep, "_sweep_sharded", dead_mesh)
+        uf, itf = self._host_tables(rng)
+        with pytest.raises(serving.ServeError) as ei:
+            sweep.recommend_for_all_users(self._local_model(uf, itf), 6)
+        err = ei.value
+        assert err.reason == "eviction"
+        assert isinstance(err.__cause__, recovery.PeerAbortError)
+        assert len(err.crash_records) == 1
+        assert "crash" in str(err)  # the culprit record is NAMED
+
+    def test_list_crash_records_filters_and_sorts(self, tmp_path):
+        from oap_mllib_tpu.utils import recovery
+
+        set_config(crash_dir=str(tmp_path))
+        recovery.write_crash_record("site.a", "unclassified", "x")
+        (tmp_path / "serve.drain.done.rank0.json").write_text("{}")
+        (tmp_path / "notes.txt").write_text("ignore")
+        recs = recovery.list_crash_records(str(tmp_path))
+        assert len(recs) == 1
+        assert recs[0].endswith(".json") and "crash" in recs[0]
+        assert recovery.list_crash_records(
+            str(tmp_path / "missing")
+        ) == []
+
+
 class TestHA:
     def test_heartbeat_single_process_view(self):
         view = serving.heartbeat(requests=7, queue_depth=2)

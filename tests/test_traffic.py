@@ -37,11 +37,18 @@ from oap_mllib_tpu.telemetry import metrics as tm
 
 @pytest.fixture(autouse=True)
 def _clear_serving():
+    from oap_mllib_tpu.serving import ha
+    from oap_mllib_tpu.utils import faults
+
     registry.clear()
     traffic._reset_for_tests()
+    ha._reset_for_tests()
+    faults.reset()  # fresh injection counters per test
     yield
     registry.clear()
     traffic._reset_for_tests()
+    ha._reset_for_tests()
+    faults.reset()
 
 
 class FakeClock:
@@ -476,3 +483,629 @@ class TestSummary:
         assert s["queue_depth"] == 0
         assert s["shed"]["total"] >= 1
         assert s["shed"]["queue_full"] >= 1
+
+
+# -- ISSUE 18: request-lifecycle fault tolerance ------------------------------
+
+
+def _total(name: str) -> int:
+    return int(tm.family_total(name))
+
+
+class FlakyHandle:
+    """Fails the first ``fail_times`` flushes with ``exc_factory()``,
+    then answers like SpyHandle — the durable-future retry drill."""
+
+    def __init__(self, fail_times: int,
+                 exc_factory=lambda: ConnectionError("peer reset")):
+        self.flushes: list[list[int]] = []
+        self.fail_times = fail_times
+        self.exc_factory = exc_factory
+        self.calls = 0
+
+    def predict_many(self, batches):
+        self.flushes.append([b.shape[0] for b in batches])
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise self.exc_factory()
+        return [np.full(b.shape[0], b.shape[0], np.int32) for b in batches]
+
+
+class PoisonSpy:
+    """Mimics registry._flush_many's finite-guard: a flush containing
+    any nonfinite row raises NonFiniteError — the data-driven poison
+    that re-faults deterministically in whichever bisection half holds
+    it."""
+
+    def __init__(self):
+        self.flushes: list[list[int]] = []
+
+    def predict_many(self, batches):
+        from oap_mllib_tpu.utils.resilience import NonFiniteError
+
+        self.flushes.append([b.shape[0] for b in batches])
+        if any(not np.isfinite(b).all() for b in batches):
+            raise NonFiniteError("poison flush")
+        return [np.full(b.shape[0], b.shape[0], np.int32) for b in batches]
+
+
+def _pump_until_done(q, futs, clock, rounds=60):
+    """Drive a start=False queue until every future resolves —
+    advancing the injected clock past retry backoffs each round."""
+    for _ in range(rounds):
+        if all(f.done() for f in futs):
+            return
+        clock.advance(1.0)
+        try:
+            q.pump()
+        except Exception:
+            pass  # dispatcher-crash cycles already landed their futures
+    raise AssertionError(
+        f"unresolved futures after {rounds} pump rounds: "
+        f"{sum(not f.done() for f in futs)}"
+    )
+
+
+class TestDurableFutures:
+    def test_transient_fault_retries_then_answers(self):
+        set_config(serve_retry_limit=2, serve_retry_backoff=0.01)
+        clock = FakeClock()
+        h = FlakyHandle(fail_times=1)
+        q = serving.TrafficQueue(h, start=False, clock=clock)
+        before = _total("oap_serve_retries_total")
+        f = q.submit(np.zeros((3, 2)))
+        q.pump()  # transient fault -> requeued, future still pending
+        assert not f.done()
+        _pump_until_done(q, [f], clock)
+        assert f.result()[0] == 3  # answered after the retry
+        assert _total("oap_serve_retries_total") == before + 1
+        assert h.calls == 2
+        q.close()
+
+    def test_retries_exhausted_fails_classified(self):
+        set_config(serve_retry_limit=1, serve_retry_backoff=0.0)
+        clock = FakeClock()
+        q = serving.TrafficQueue(
+            FlakyHandle(fail_times=99), start=False, clock=clock
+        )
+        f = q.submit(np.zeros((2, 2)))
+        _pump_until_done(q, [f], clock)
+        exc = f.exception()
+        assert isinstance(exc, serving.ServeError)
+        assert exc.reason == "retries-exhausted"
+        assert exc.retries == 1
+        assert "serve_retry_limit" in str(exc)
+        q.close()
+
+    def test_retry_limit_zero_fails_immediately(self):
+        set_config(serve_retry_limit=0)
+        clock = FakeClock()
+        q = serving.TrafficQueue(
+            FlakyHandle(fail_times=99), start=False, clock=clock
+        )
+        f = q.submit(np.zeros((2, 2)))
+        q.pump()
+        assert isinstance(f.exception(), serving.ServeError)
+        assert f.exception().reason == "retries-exhausted"
+        q.close()
+
+    def test_retry_preserves_deadline_priority(self):
+        # the retried pair must flush tight-deadline-first again, not
+        # in requeue order
+        set_config(serve_retry_limit=2, serve_retry_backoff=0.0)
+        clock = FakeClock()
+        h = FlakyHandle(fail_times=1)
+        q = serving.TrafficQueue(h, start=False, clock=clock)
+        fa = q.submit(np.zeros((3, 2)), deadline_ms=500_000)  # loose
+        fb = q.submit(np.zeros((7, 2)), deadline_ms=100_000)  # tight
+        _pump_until_done(q, [fa, fb], clock)
+        assert h.flushes[0] == [7, 3] and h.flushes[-1] == [7, 3]
+        assert fa.result()[0] == 3 and fb.result()[0] == 7
+        q.close()
+
+    def test_dispatcher_crash_fails_futures_and_restarts(self):
+        # an injected serve.dispatch fault (kind err = unclassified
+        # crash) fails the in-cycle futures with a classified
+        # ServeError, books the crash counter, and the queue keeps
+        # working afterwards
+        set_config(fault_spec="serve.dispatch:err=1")
+        clock = FakeClock()
+        q = serving.TrafficQueue(SpyHandle(), start=False, clock=clock)
+        before = _total("oap_serve_dispatch_crashes_total")
+        f = q.submit(np.zeros((2, 2)))
+        with pytest.raises(Exception, match="serve.dispatch"):
+            q.pump()
+        exc = f.exception()
+        assert isinstance(exc, serving.ServeError)
+        assert exc.reason == "dispatcher-crash"
+        assert _total("oap_serve_dispatch_crashes_total") == before + 1
+        # the fault is spent: the next cycle answers normally
+        f2 = q.submit(np.zeros((4, 2)))
+        q.pump()
+        assert f2.result()[0] == 4
+        q.close()
+
+    def test_dispatcher_thread_survives_crash(self):
+        # with the live thread, the crash is absorbed by _run (warned,
+        # loop restarts) and later submissions still answer
+        set_config(fault_spec="serve.dispatch:err=1")
+        q = serving.TrafficQueue(SpyHandle(), poll_s=0.005)
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("ignore", RuntimeWarning)
+            f1 = q.submit(np.zeros((2, 2)))
+            with pytest.raises(Exception):
+                f1.result(timeout=10)
+            f2 = q.submit(np.zeros((4, 2)))
+            assert f2.result(timeout=10)[0] == 4
+        assert q._thread.is_alive()  # the loop restarted, not died
+        q.close()
+
+    def test_transient_dispatcher_crash_requeues(self):
+        set_config(fault_spec="serve.dispatch:fail=1",
+                   serve_retry_limit=2, serve_retry_backoff=0.0)
+        clock = FakeClock()
+        q = serving.TrafficQueue(SpyHandle(), start=False, clock=clock)
+        f = q.submit(np.zeros((3, 2)))
+        with pytest.raises(Exception):
+            q.pump()
+        assert not f.done()  # requeued, not failed: retries remain
+        _pump_until_done(q, [f], clock)
+        assert f.result()[0] == 3
+        q.close()
+
+
+class TestPoisonBisection:
+    def test_poison_isolated_innocents_answered(self):
+        clock = FakeClock()
+        spy = PoisonSpy()
+        q = serving.TrafficQueue(spy, start=False, clock=clock)
+        poison_before = _total("oap_serve_poison_total")
+        bisect_before = _total("oap_serve_bisect_total")
+        futs = [q.submit(np.full((3, 2), float(i))) for i in range(3)]
+        bad = np.full((5, 2), np.nan)
+        fp = q.submit(bad)
+        futs2 = [q.submit(np.full((4, 2), 9.0))]
+        q.pump()
+        # every innocent answered despite sharing the poisoned flush
+        for f in futs + futs2:
+            assert f.exception() is None, f.exception()
+        exc = fp.exception()
+        assert isinstance(exc, serving.ServeError)
+        assert exc.reason == "poison"
+        assert exc.fault_class == "nonfinite"
+        assert "digest" in str(exc)
+        assert _total("oap_serve_poison_total") == poison_before + 1
+        assert _total("oap_serve_bisect_total") > bisect_before
+        q.close()
+
+    def test_two_poisons_both_isolated(self):
+        clock = FakeClock()
+        q = serving.TrafficQueue(PoisonSpy(), start=False, clock=clock)
+        before = _total("oap_serve_poison_total")
+        good = [q.submit(np.full((2, 2), float(i))) for i in range(4)]
+        bad = [q.submit(np.full((2, 2), np.nan)) for _ in range(2)]
+        q.pump()
+        for f in good:
+            assert f.exception() is None
+        for f in bad:
+            assert isinstance(f.exception(), serving.ServeError)
+            assert f.exception().reason == "poison"
+        assert _total("oap_serve_poison_total") == before + 2
+        q.close()
+
+    def test_end_to_end_kmeans_flush_guard_zero_compiles(self, rng):
+        # the real registry._flush_many finite-guard + bisection: the
+        # poison request quarantines, innocents match direct predict,
+        # and the bisection halves re-coalesce on the warm bucket
+        # family — zero new XLA compiles
+        from oap_mllib_tpu.serving import batcher
+
+        handle, _ = _kmeans_handle(rng)
+        handle.warmup(64)
+        clock = FakeClock()
+        q = serving.TrafficQueue(handle, start=False, clock=clock)
+        innocents = [
+            rng.normal(size=(int(s), 8)).astype(np.float32)
+            for s in (5, 12, 30)
+        ]
+        bad = np.full((7, 8), np.nan, np.float32)
+        snap = batcher.xla_snapshot()
+        futs = [q.submit(b) for b in innocents]
+        fp = q.submit(bad)
+        q.pump()
+        assert batcher.xla_snapshot() == snap  # bisection compiled nothing
+        assert isinstance(fp.exception(), serving.ServeError)
+        assert fp.exception().reason == "poison"
+        for b, f in zip(innocents, futs):
+            np.testing.assert_array_equal(f.result(), handle.predict(b))
+        q.close()
+
+    def test_injected_batch_fault_triggers_bisection(self, rng):
+        # fault_spec-driven serve.batch poison: the first flush faults,
+        # bisection rescoring answers everyone once the count is spent
+        handle, _ = _kmeans_handle(rng)
+        handle.warmup(64)
+        set_config(fault_spec="serve.batch:nan=1")
+        clock = FakeClock()
+        q = serving.TrafficQueue(handle, start=False, clock=clock)
+        before = _total("oap_serve_bisect_total")
+        futs = [
+            q.submit(rng.normal(size=(4, 8)).astype(np.float32))
+            for _ in range(4)
+        ]
+        q.pump()
+        assert _total("oap_serve_bisect_total") > before
+        for f in futs:
+            assert f.exception() is None
+        q.close()
+
+
+class TestDrain:
+    def test_drain_flushes_then_sheds_draining(self):
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        before = _total("oap_serve_drains_total")
+        futs = [q.submit(np.zeros((2, 2))) for _ in range(4)]
+        stats = q.drain(timeout_s=2.0)
+        assert stats["drained"] and stats["failed"] == 0
+        assert stats["answered"] == 4
+        assert all(f.exception() is None for f in futs)
+        assert _total("oap_serve_drains_total") == before + 1
+        shed_before = _shed_total("draining")
+        with pytest.raises(serving.ShedError) as ei:
+            q.submit(np.zeros((1, 2)))
+        assert ei.value.reason == "draining"
+        assert _shed_total("draining") == shed_before + 1
+        q.close()
+
+    def test_drain_deadline_fails_leftovers_loudly(self):
+        # a handle that keeps transient-faulting + a frozen clock: the
+        # retries' backoff never elapses, so the wall deadline expires
+        # and every leftover future fails with drain-deadline
+        set_config(serve_retry_limit=5, serve_retry_backoff=0.05)
+        clock = FakeClock()
+        q = serving.TrafficQueue(
+            FlakyHandle(fail_times=99), start=False, clock=clock
+        )
+        futs = [q.submit(np.zeros((2, 2))) for _ in range(3)]
+        stats = q.drain(timeout_s=0.2)
+        assert not stats["drained"] and stats["failed"] == 3
+        for f in futs:
+            exc = f.exception()
+            assert isinstance(exc, serving.ServeError)
+            assert exc.reason == "drain-deadline"
+        assert q.depth() == 0
+        q.close()
+
+    def test_drain_posts_sideband_report(self, tmp_path):
+        set_config(crash_dir=str(tmp_path))
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        q.submit(np.zeros((2, 2)))
+        q.drain(timeout_s=1.0)
+        q.close()
+        import json
+
+        path = tmp_path / "serve.drain.done.rank0.json"
+        assert path.exists()
+        with open(path) as f:
+            rep = json.load(f)
+        assert rep["rank"] == 0 and rep["answered"] == 1
+
+    def test_supervisor_consumes_drain_reports(self, tmp_path):
+        from oap_mllib_tpu.utils.supervisor import Supervisor
+
+        set_config(crash_dir=str(tmp_path))
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        q.submit(np.zeros((2, 2)))
+        q.drain(timeout_s=1.0)
+        q.close()
+        sup = Supervisor(
+            lambda rank, world, coord, local: ["true"],
+            1, str(tmp_path),
+        )
+        reports = sup._read_drain_reports()
+        assert len(reports) == 1 and reports[0]["answered"] == 1
+        # read-and-remove: a second read finds nothing
+        assert sup._read_drain_reports() == []
+
+    def test_scale_in_drains_attached_queue(self):
+        set_config(serve_scale_idle_s=5.0)
+        clock = FakeClock()
+        q = serving.TrafficQueue(SpyHandle(), start=False, clock=clock)
+        f = q.submit(np.zeros((2, 2)))
+        sc = serving.ScaleController(
+            2, min_replicas=1, clock=clock, queue=q
+        )
+        sc.observe(queue_depth=0)
+        clock.advance(6.0)
+        d = sc.observe(queue_depth=0)
+        assert d["action"] == "in"
+        assert d["drained"]["drained"] is True
+        assert f.exception() is None  # flushed by the drain
+        with pytest.raises(serving.ShedError, match="draining"):
+            q.submit(np.zeros((1, 2)))
+        q.close()
+
+    def test_drain_fault_site_armed(self):
+        set_config(fault_spec="serve.drain:err=1")
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        with pytest.raises(Exception, match="serve.drain"):
+            q.drain(timeout_s=0.1)
+        q.close()
+
+
+class TestCloseFailOrFlush:
+    def test_wedged_scoring_callable_fails_futures_not_hangs(self):
+        # satellite 2: a scoring callable that never returns must not
+        # strand pending futures behind the daemon flag — close(...)
+        # with a join timeout fails every unresolved future explicitly
+        gate = threading.Event()
+        release = threading.Event()
+
+        class WedgedHandle:
+            def predict_many(self, batches):
+                gate.set()
+                release.wait(30)  # wedged until the test frees it
+                return [np.zeros(b.shape[0], np.int32) for b in batches]
+
+        q = serving.TrafficQueue(WedgedHandle(), poll_s=0.005)
+        before = _total("oap_serve_close_wedged_total")
+        f_wedged = q.submit(np.zeros((2, 2)))
+        assert gate.wait(10)  # dispatcher is now stuck scoring it
+        f_pending = q.submit(np.zeros((3, 2)))
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("ignore", RuntimeWarning)
+            q.close(timeout_s=0.2)
+        for f in (f_wedged, f_pending):
+            exc = f.exception(timeout=1)
+            assert isinstance(exc, serving.ServeError)
+            assert exc.reason == "shutdown"
+        assert _total("oap_serve_close_wedged_total") == before + 1
+        # free the wedge: its late set_result lands on an already-
+        # failed future and is swallowed (exactly-once preserved)
+        release.set()
+        t = q._thread
+        if t is not None:
+            t.join(5)
+        assert isinstance(f_wedged.exception(), serving.ServeError)
+
+    def test_close_fails_unresolvable_retries(self):
+        # pending retries at close get the final pump; if they fault
+        # again the closing queue fails them with reason=shutdown
+        # instead of leaking them
+        set_config(serve_retry_limit=10, serve_retry_backoff=0.05)
+        clock = FakeClock()
+        q = serving.TrafficQueue(
+            FlakyHandle(fail_times=99), start=False, clock=clock
+        )
+        f = q.submit(np.zeros((2, 2)))
+        q.pump()  # -> requeued with a backoff the FakeClock never meets
+        assert not f.done()
+        q.close()
+        exc = f.exception()
+        assert isinstance(exc, serving.ServeError)
+        assert exc.reason == "shutdown"
+
+    def test_queue_depth_zero_after_failed_close(self):
+        set_config(serve_retry_limit=10, serve_retry_backoff=0.05)
+        clock = FakeClock()
+        q = serving.TrafficQueue(
+            FlakyHandle(fail_times=99), start=False, clock=clock
+        )
+        for _ in range(3):
+            q.submit(np.zeros((2, 2)))
+        q.pump()
+        q.close()
+        s = registry.serving_summary()
+        assert s["queue_depth"] == 0
+
+
+class TestEvictionFutureAccounting:
+    def test_no_future_leaks_across_eviction_and_release(self, rng):
+        # satellite 3: a jittered storm exercising shed, retry, and
+        # answer paths; mid-storm the replica evicts; release() must
+        # leave EVERY submitted future resolved and the depth gauge at 0
+        from oap_mllib_tpu.serving import ha
+        from oap_mllib_tpu.utils import recovery
+
+        set_config(serve_queue_depth=6, serve_retry_limit=1,
+                   serve_retry_backoff=0.0)
+        clock = FakeClock()
+        h = FlakyHandle(fail_times=2)
+        q = serving.TrafficQueue(h, start=False, clock=clock)
+        guard = serving.ReplicaGuard(queue=q)
+        futs = []
+        sheds = 0
+        for i in range(30):
+            try:
+                futs.append(
+                    q.submit(rng.normal(size=(1 + i % 4, 2)))
+                )
+            except serving.ShedError:
+                sheds += 1
+            if i == 10:
+                with guard.leg():
+                    raise recovery.RecoveryError("peer died mid-storm")
+            if i % 5 == 4:
+                clock.advance(1.0)
+                q.pump()
+        assert guard.local_only and serving.fleet_evicted()
+        assert sheds > 0  # the storm really exercised the shed path
+        stats = guard.release(timeout_s=2.0)
+        assert stats is not None
+        for f in futs:
+            assert f.done(), "future leaked across eviction"
+        s = registry.serving_summary()
+        assert s["queue_depth"] == 0
+        assert s["evictions"] >= 1
+
+
+class TestBrownout:
+    def test_grammar_validates_at_submit(self):
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        set_config(serve_brownout="bogus")
+        with pytest.raises(ValueError, match="serve_brownout"):
+            q.submit(np.zeros((1, 2)))
+        set_config(serve_brownout="pin:bogus")
+        with pytest.raises(ValueError, match="serve_brownout"):
+            q.submit(np.zeros((1, 2)))
+        set_config(serve_brownout="pin:topk")
+        q.submit(np.zeros((1, 2)))
+        q.pump()
+        q.close()
+
+    def test_ladder_steps_up_under_sustained_pressure(self):
+        b = traffic.BrownoutController("auto")
+        before = _total("oap_serve_brownout_steps_total")
+        # three full windows of 2x pressure walk the ladder to the top
+        decisions = [b.observe(200, 100) for _ in range(12)]
+        assert b.rung == 3
+        assert [s["to"] for s in b.steps] == ["topk", "bf16", "stale"]
+        assert _total("oap_serve_brownout_steps_total") == before + 3
+        # breaches at intermediate rungs (and on the step itself) were
+        # absorbed rather than shed
+        assert any(d["absorb"] for d in decisions)
+        # at the top rung with pressure still sustained, absorb stops:
+        # the budget shed resumes as the OOM backstop
+        tail = [b.observe(200, 100) for _ in range(4)]
+        assert b.rung == 3 and not any(d["absorb"] for d in tail)
+
+    def test_falling_trend_blocks_the_step(self):
+        b = traffic.BrownoutController("auto")
+        for ratio in (4.0, 3.0, 1.2, 1.1):  # mean > 1 but falling
+            d = b.observe(int(ratio * 100), 100)
+        assert b.rung == 0 and d["stepped"] == 0
+
+    def test_ladder_steps_down_when_pressure_clears(self):
+        b = traffic.BrownoutController("auto")
+        for _ in range(4):
+            b.observe(200, 100)
+        assert b.rung == 1
+        for _ in range(4):
+            d = b.observe(10, 100)
+        assert b.rung == 0 and d["stepped"] == -1
+
+    def test_pinned_rung_never_absorbs(self):
+        set_config(serve_brownout="pin:bf16")
+        b = traffic.brownout()
+        assert b.rung == 2
+        d = b.observe(500, 100)
+        assert d["absorb"] is False  # pinned quality, intact admission
+
+    def test_off_never_steps(self):
+        set_config(serve_brownout="off")
+        b = traffic.brownout()
+        for _ in range(8):
+            d = b.observe(500, 100)
+        assert b.rung == 0 and d["absorb"] is False
+
+    def test_topk_depth_halves_at_rung(self):
+        set_config(serve_brownout="pin:topk")
+        traffic._reset_for_tests()
+        assert traffic.brownout_topk(8) == 4
+        assert traffic.brownout_topk(1) == 1  # floor
+        set_config(serve_brownout="off")
+        assert traffic.brownout_topk(8) == 8
+
+    def test_bf16_rung_overrides_precision_with_parity_bound(self):
+        from oap_mllib_tpu.serving import batcher
+
+        set_config(serve_brownout="pin:bf16")
+        traffic._reset_for_tests()
+        assert batcher.resolve_policy("kmeans").name == "bf16"
+        # an explicit operator pin always beats the rung
+        set_config(serving_precision="f32")
+        assert batcher.resolve_policy("kmeans").name == "f32"
+        set_config(serving_precision="", serve_brownout="auto")
+        assert batcher.resolve_policy("kmeans").name != "bf16"
+
+    def test_stale_rung_answers_from_previous_pin(self):
+        set_config(serve_brownout="pin:stale")
+        traffic._reset_for_tests()
+        before = _total("oap_serve_stale_pins_total")
+        cache: dict = {}
+        a1 = np.ones((4, 2), np.float32)
+        a2 = 2 * np.ones((4, 2), np.float32)
+        d1 = registry.pin(cache, "t", a1)
+        stale = registry.pin(cache, "t", a2, allow_stale=True)
+        assert stale is d1  # the previous pin answered
+        assert _total("oap_serve_stale_pins_total") == before + 1
+        set_config(serve_brownout="off")
+        traffic._reset_for_tests()
+        fresh = registry.pin(cache, "t", a2, allow_stale=True)
+        assert fresh is not d1  # off the rung: re-pins fresh
+
+    def test_submit_absorbs_breach_at_active_rung(self):
+        # 4 KiB x 0.5 headroom = 2048 B allowance; a 100x8 f32 request
+        # prices over it — at an active rung the breach is ABSORBED
+        set_config(memory_budget_hbm="4K", serve_shed_headroom=0.5)
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        set_config(serve_brownout="auto")
+        b = traffic.brownout()
+        b.rung = 1  # an active intermediate rung
+        before = _total("oap_serve_brownout_absorbed_total")
+        f = q.submit(np.zeros((100, 8), np.float32))  # no ShedError
+        assert _total("oap_serve_brownout_absorbed_total") == before + 1
+        q.pump()
+        assert f.exception() is None
+        q.close()
+
+    def test_summary_and_gauge_are_loud(self):
+        set_config(serve_brownout="pin:stale")
+        traffic._reset_for_tests()
+        traffic.brownout()
+        s = registry.serving_summary()
+        assert s["brownout"]["rung"] == "stale"
+        assert s["brownout"]["policy"] == "pin:stale"
+        reg = tm.registry()
+        with tm._LOCK:
+            rungs = [
+                m.value for (name, _), m in reg._metrics.items()
+                if name == "oap_serve_brownout_rung"
+            ]
+        assert rungs == [3.0]
+
+
+class TestServingChaos:
+    def _storm_outcomes(self, handle, rng_seed: int):
+        """One seeded storm under armed chaos; returns the per-request
+        outcome tags (deterministic iff the chaos schedule is)."""
+        clock = FakeClock()
+        q = serving.TrafficQueue(handle, start=False, clock=clock)
+        r = np.random.default_rng(rng_seed)
+        futs = [
+            q.submit(r.normal(size=(int(s), 8)).astype(np.float32))
+            for s in r.integers(2, 20, size=16)
+        ]
+        _pump_until_done(q, futs, clock)
+        q.close()
+        out = []
+        for f in futs:
+            exc = f.exception()
+            if exc is None:
+                out.append("ok")
+            elif isinstance(exc, serving.ServeError):
+                out.append(f"serve:{exc.reason}")
+            else:
+                out.append(type(exc).__name__)
+        return out
+
+    def test_seeded_serving_chaos_is_deterministic(self, rng):
+        # satellite 1: chaos over the serve.* sites, same seed + same
+        # call sequence -> identical per-request outcome vector
+        handle, _ = _kmeans_handle(rng)
+        handle.warmup(32)
+        set_config(serve_retry_limit=1, serve_retry_backoff=0.0)
+        from oap_mllib_tpu.utils import faults
+
+        spec = "1234:0.35:fail+nan"
+        set_config(chaos=spec)
+        run1 = self._storm_outcomes(handle, rng_seed=7)
+        faults.reset()  # restart the schedule's call counters
+        run2 = self._storm_outcomes(handle, rng_seed=7)
+        set_config(chaos="")
+        assert run1 == run2
+        assert any(tag != "ok" for tag in run1)  # chaos really fired
