@@ -1385,6 +1385,7 @@ def bench_serving(requests: int = 200, sweep_users: int = 1_000_000,
     qps = requests / storm_wall
     rows = int(np.sum(sizes))
     block = serving.serving_summary()
+    attribution = _bench_serving_attribution(handle, x, sizes)
     if emit:
         _emit(
             "serving_kmeans_qps", qps, "req/sec", 0.0,
@@ -1392,7 +1393,7 @@ def bench_serving(requests: int = 200, sweep_users: int = 1_000_000,
             rows_per_sec=round(rows / storm_wall, 1),
             steady_compiles=steady_compiles,
             pad_rows=block["pad_rows"], requests=requests,
-            batch_d=d, batch_k=k,
+            batch_d=d, batch_k=k, **attribution,
         )
 
     nu, ni, r, topk = int(sweep_users), 256, 16, 10
@@ -1422,6 +1423,40 @@ def bench_serving(requests: int = 200, sweep_users: int = 1_000_000,
         "users_per_sec": users_per_sec,
         "qps_brownout": None if bo is None else bo["qps"],
         "qps_mp": None if mp is None else mp["qps_mp"],
+    }
+
+
+def _bench_serving_attribution(handle, x, sizes) -> dict:
+    """Deadline-budget attribution fields for the ``--serving`` line
+    (ISSUE 19): a short traced storm through the async TrafficQueue
+    (``serve_trace_sample=1.0``) whose per-stage p99s say where a
+    request's wall goes — fields are name-keyed extras, so
+    dev/bench_regress.py picks them up with no changes."""
+    from oap_mllib_tpu.config import get_config, set_config
+    from oap_mllib_tpu.serving import reqtrace, traffic as traffic_mod
+
+    prev = float(get_config().serve_trace_sample)
+    n = min(100, len(sizes))
+    set_config(serve_trace_sample=1.0)
+    try:
+        with traffic_mod.TrafficQueue(handle) as q:
+            futs = [
+                q.submit(x[: int(s)], deadline_ms=0.0)
+                for s in sizes[:n]
+            ]
+            for f in futs:
+                f.result(timeout=60)
+        sq = reqtrace.stage_quantiles()
+    finally:
+        set_config(serve_trace_sample=prev)
+
+    def p99_ms(stage: str) -> float:
+        return round(sq.get(stage, {}).get("p99_s", 0.0) * 1e3, 3)
+
+    return {
+        "queue_wait_p99_ms": p99_ms("queue_wait"),
+        "batch_form_p99_ms": p99_ms("batch_form"),
+        "execute_p99_ms": p99_ms("execute"),
     }
 
 

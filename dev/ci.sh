@@ -154,6 +154,16 @@ echo "   parity on the 8-device pseudo-mesh, p99-within-bound-of-p50 tail"
 echo "   latency, and a <1% disarmed pin seam (dev/serve_gate.py) =="
 python dev/serve_gate.py
 
+echo "== slo gate: request-lifecycle tracing + the SLO/error-budget plane —"
+echo "   ledger stages sum to the request wall within 5% on a traced"
+echo "   jittered storm (zero-compile + p99 tail contracts hold armed),"
+echo "   deterministic hash sampling across processes, multi-window burn"
+echo "   rates breach under induced latency with decisions recording SLO"
+echo "   state, a 2-replica traced fleet merging through dev/oaptrace.py"
+echo "   (request lanes + ring-hop flow arrows), and a <1% tracing-off"
+echo "   seam (dev/slo_gate.py) =="
+python dev/slo_gate.py
+
 echo "== bench regression gate (soft): newest BENCH_r*.json vs the best"
 echo "   prior round per headline metric+backend; >10% fails, a single"
 echo "   recorded round warns only (dev/bench_regress.py) =="

@@ -24,6 +24,17 @@ carry a per-process monotonic clock.  This tool merges them:
   cumulatively (children sequential inside their parent, fits
   sequential per rank, every rank's fit aligned at t=0) — shape-true,
   not clock-true; the tool says so in ``otherData``.
+- **Request flows** (``type: "request"`` ledger records,
+  serving/reqtrace.py): each sampled request renders as a lane of
+  sequential stage slices (admission / queue_wait / batch_form /
+  bucket_pad / compile / execute / dispatch) on its rank's track, with
+  instants for its lifecycle events (shed / retry / poison / brownout
+  / drain).  Ledger ``t0`` and recorder times share the monotonic
+  clock family, so request lanes land on the recorder timeline
+  clock-true; recorder-off worlds get a per-rank aligned layout.
+  ``ring_hop`` recorder events (serving/sweep.py) additionally become
+  cross-replica **flow arrows** per rotated item block — the sharded
+  sweep's ring schedule made visible.
 
 Usage::
 
@@ -41,6 +52,23 @@ import sys
 from typing import Any, Dict, List, Tuple
 
 US = 1e6  # trace-event timestamps are microseconds
+
+# the fixed ledger stage order (serving/reqtrace.STAGES — kept literal
+# here so the tool stays standalone); unknown stages render after these
+REQUEST_STAGES = (
+    "admission",
+    "queue_wait",
+    "batch_form",
+    "bucket_pad",
+    "compile",
+    "execute",
+    "dispatch",
+)
+
+# request lanes share a rank's pid but live on high tids so they group
+# below the real threads; 16 lanes round-robined by admission seq
+_REQUEST_LANE_BASE = 900_000
+_REQUEST_LANES = 16
 
 
 def expand_paths(paths: List[str]) -> List[str]:
@@ -122,13 +150,25 @@ def _clock_offsets(per_rank) -> Dict[int, float]:
     return offsets
 
 
-def _recorder_trace(per_rank) -> List[Dict[str, Any]]:
+def _parse_kv(detail: str) -> Dict[str, str]:
+    """``"rank=0 hop=1 block=1"`` -> dict (ring_hop detail format)."""
+    out: Dict[str, str] = {}
+    for part in detail.split():
+        if "=" in part:
+            k, _, v = part.partition("=")
+            out[k] = v
+    return out
+
+
+def _recorder_trace(per_rank, offsets=None, t0=None) -> List[Dict[str, Any]]:
     """Trace events from real recorder events (clock-true mode)."""
-    offsets = _clock_offsets(per_rank)
-    t0 = min(
-        e["t"] - offsets[r]
-        for r, evs in per_rank.items() for e in evs
-    )
+    if offsets is None:
+        offsets = _clock_offsets(per_rank)
+    if t0 is None:
+        t0 = min(
+            e["t"] - offsets[r]
+            for r, evs in per_rank.items() for e in evs
+        )
     out: List[Dict[str, Any]] = []
 
     def ts(r, t):
@@ -137,6 +177,12 @@ def _recorder_trace(per_rank) -> List[Dict[str, Any]]:
     flow_id = 0
     coll_index: Dict[int, int] = {}  # rank -> collectives seen so far
     flows: Dict[int, List[Tuple[int, int, float, str]]] = {}
+    # ring-hop flow members: (sweep occurrence, item block) ->
+    # [(hop, rank, tid, t)] — the deterministic ring schedule means
+    # block b sits on rank (b - t) mod world at hop t, so chaining a
+    # block's members in hop order draws its rotation across replicas
+    ring: Dict[Tuple[int, int], List[Tuple[int, int, int, float]]] = {}
+    rank_sweeps: Dict[int, int] = {}  # rank -> hop-0 events seen
     for r, events in sorted(per_rank.items()):
         # span open/close pairing per (thread) — unmatched events (ring
         # wrap-around ate the partner) are dropped, slices must nest
@@ -171,7 +217,17 @@ def _recorder_trace(per_rank) -> List[Dict[str, Any]]:
                     "pid": r, "tid": tid,
                     "args": {"detail": e.get("detail", ""), "seq": e["seq"]},
                 })
-            else:  # chunk / fault / retry / degrade / ckpt_commit / crash
+            else:  # chunk / fault / retry / serve / request / ring_hop / ...
+                if kind == "ring_hop":
+                    kv = _parse_kv(e.get("detail", ""))
+                    hop = int(kv.get("hop", 0))
+                    block = int(kv.get("block", 0))
+                    if hop == 0:
+                        rank_sweeps[r] = rank_sweeps.get(r, 0) + 1
+                    occ = max(0, rank_sweeps.get(r, 1) - 1)
+                    ring.setdefault((occ, block), []).append(
+                        (hop, r, tid, e["t"])
+                    )
                 out.append({
                     "name": f"{kind}:{e['name']}", "ph": "i", "s": "t",
                     "cat": kind, "ts": ts(r, e["t"]),
@@ -196,6 +252,93 @@ def _recorder_trace(per_rank) -> List[Dict[str, Any]]:
                 "pid": r, "tid": tid,
             })
         flow_id += 1
+    # ring-hop flow arrows: one chain per rotated item block, hop
+    # order — start where the block begins, step ("t") through the
+    # intermediate replicas, finish on its last holder
+    for (occ, block), members in sorted(ring.items()):
+        if len(members) < 2:
+            continue
+        members.sort(key=lambda m: m[0])
+        name = f"ring:block{block}"
+        _, r0, tid0, t_first = members[0]
+        out.append({
+            "name": name, "ph": "s", "cat": "ring_hop", "id": flow_id,
+            "ts": ts(r0, t_first), "pid": r0, "tid": tid0,
+        })
+        for _, r, tid, t in members[1:-1]:
+            out.append({
+                "name": name, "ph": "t", "cat": "ring_hop",
+                "id": flow_id, "ts": ts(r, t), "pid": r, "tid": tid,
+            })
+        _, rn, tidn, t_last = members[-1]
+        out.append({
+            "name": name, "ph": "f", "bp": "e", "cat": "ring_hop",
+            "id": flow_id, "ts": ts(rn, t_last), "pid": rn, "tid": tidn,
+        })
+        flow_id += 1
+    return out
+
+
+def _request_records(records) -> Dict[int, List[Dict[str, Any]]]:
+    """rank -> finalized request-ledger records, admission order."""
+    per: Dict[int, List[Dict[str, Any]]] = {}
+    for rec in records:
+        if rec.get("type") != "request":
+            continue
+        per.setdefault(int(rec.get("rank", 0)), []).append(rec)
+    for recs in per.values():
+        recs.sort(key=lambda rec: rec.get("t0", 0.0))
+    return per
+
+
+def _request_trace(per_rank_reqs, offsets,
+                   t0: float) -> List[Dict[str, Any]]:
+    """Request lanes: each ledger renders as sequential stage slices
+    from its ``t0`` (the stages sum to the wall by construction, so
+    the lane IS the request's deadline budget), plus instants for its
+    lifecycle events.  Lanes are high tids on the owning rank's track
+    (16 lanes, round-robined by admission seq)."""
+    out: List[Dict[str, Any]] = []
+    for r, recs in sorted(per_rank_reqs.items()):
+        off = offsets.get(r, 0.0)
+        for rec in recs:
+            lane = _REQUEST_LANE_BASE + int(
+                rec.get("seq", 0)
+            ) % _REQUEST_LANES
+            stages = rec.get("stages", {}) or {}
+            order = [s for s in REQUEST_STAGES if s in stages]
+            order += [s for s in stages if s not in REQUEST_STAGES]
+            cursor = float(rec.get("t0", 0.0))
+            args = {
+                "trace_id": rec.get("trace_id", ""),
+                "outcome": rec.get("outcome", ""),
+                "model": rec.get("model", ""),
+                "retries": rec.get("retries", 0),
+            }
+            for s in order:
+                dur = float(stages.get(s, 0.0))
+                if dur <= 0.0:
+                    continue
+                out.append({
+                    "name": s, "ph": "X", "cat": "request",
+                    "ts": round((cursor - off - t0) * US, 1),
+                    "dur": round(dur * US, 1),
+                    "pid": r, "tid": lane, "args": args,
+                })
+                cursor += dur
+            for ev in rec.get("events", []) or []:
+                out.append({
+                    "name": f"request:{ev.get('kind', 'event')}",
+                    "ph": "i", "s": "t", "cat": "request",
+                    "ts": round(
+                        (float(ev.get("t", cursor)) - off - t0) * US, 1
+                    ),
+                    "pid": r, "tid": lane,
+                    "args": {
+                        "detail": ev.get("detail", ""),
+                        "trace_id": rec.get("trace_id", ""),
+                    },
+                })
     return out
 
 
@@ -248,14 +391,38 @@ def merge_trace(paths: List[str]) -> Dict[str, Any]:
     """The merged Chrome trace object for a set of JSONL sink files."""
     records = load_records(paths)
     per_rank = _rank_events(records)
+    reqs = _request_records(records)
     mode = "recorder" if per_rank else "synthesized"
-    events = (
-        _recorder_trace(per_rank) if per_rank
-        else _synthesized_trace(records)
-    )
+    if per_rank:
+        offsets = _clock_offsets(per_rank)
+        t0 = min(
+            e["t"] - offsets[r]
+            for r, evs in per_rank.items() for e in evs
+        )
+        # request ledgers share the recorder's monotonic clock family —
+        # widen the origin so an early admission never goes negative
+        req_t0s = [
+            rec["t0"] - offsets.get(r, 0.0)
+            for r, recs in reqs.items() for rec in recs
+            if isinstance(rec.get("t0"), (int, float))
+        ]
+        if req_t0s:
+            t0 = min(t0, min(req_t0s))
+        events = _recorder_trace(per_rank, offsets, t0)
+        events += _request_trace(reqs, offsets, t0)
+    else:
+        events = _synthesized_trace(records)
+        if reqs:
+            # no recorder clock to align against: lay each rank's
+            # request lanes out from its own earliest admission
+            for r, recs in reqs.items():
+                r_t0 = min(
+                    (rec.get("t0", 0.0) for rec in recs), default=0.0
+                )
+                events += _request_trace({r: recs}, {r: 0.0}, r_t0)
     ranks = sorted(
         {int(r.get("rank", 0)) for r in records}
-        | set(per_rank)
+        | set(per_rank) | set(reqs)
     )
     meta = [
         {
@@ -271,6 +438,7 @@ def merge_trace(paths: List[str]) -> Dict[str, Any]:
             "tool": "oaptrace",
             "mode": mode,
             "ranks": ranks,
+            "requests": sum(len(v) for v in reqs.values()),
             "clock": (
                 "per-rank monotonic clocks aligned via the collective "
                 "event sequence" if mode == "recorder"

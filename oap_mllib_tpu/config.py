@@ -428,6 +428,41 @@ class Config:
     # behavior); "pin:<rung>" holds a fixed rung (off|topk|bf16|stale)
     # without automatic stepping.  A typo raises at submit time.
     serve_brownout: str = "auto"
+    # Request-lifecycle tracing (serving/reqtrace.py): > 0 arms a trace
+    # context on every ADMITTED request — a deterministic id plus a
+    # fixed-schema deadline-budget ledger (admission / queue_wait /
+    # batch_form / bucket_pad / compile / execute / dispatch stage
+    # walls that sum to the measured request wall by construction),
+    # attached to the answered future (serving.ledger_of), booked into
+    # the oap_serve_stage_seconds{stage=} histograms, and folded into
+    # serving_summary()["attribution"].  The value is the SAMPLING
+    # fraction for heavy emission (flight-recorder request events,
+    # JSONL "request" records, /metrics exemplars): a request is
+    # sampled when crc32(trace_id)/2^32 < serve_trace_sample — a pure
+    # hash, no RNG, so every process of a world samples the same ids.
+    # 0 (default) = off, one config check per submit; must be in
+    # [0, 1], a typo raises at submit time.
+    serve_trace_sample: float = 0.0
+    # Serving latency SLO target in milliseconds (serving/slo.py): > 0
+    # arms the multi-window burn-rate error-budget engine — a request
+    # is "bad" when it fails/sheds or its wall exceeds this p99 target;
+    # burn rates over the fast (serve_slo_window_s / 12) and slow
+    # (serve_slo_window_s) windows land in oap_slo_burn_rate{window=},
+    # oap_slo_error_budget_remaining, serving_summary()["slo"], the
+    # /sloz endpoint, and every scale/brownout decision (observe-only:
+    # the SLO state is RECORDED with the decision, it never makes one).
+    # 0 (default) = disarmed; must be >= 0.
+    serve_slo_p99_ms: float = 0.0
+    # Availability objective for the error-budget engine: the target
+    # fraction of requests answered within SLO (e.g. 0.999 = a 0.1%
+    # error budget).  Burn rate 1.0 means bad requests arrive exactly
+    # at the rate that exhausts the budget over the window.  Must be in
+    # (0, 1); a typo raises when the engine is consulted.
+    serve_slo_availability: float = 0.999
+    # Slow burn-rate window in seconds (the error-budget accounting
+    # horizon); the fast window is this / 12 (the SRE 5m/1h pairing).
+    # Must be > 0.
+    serve_slo_window_s: float = 3600.0
     # -- telemetry layer (oap_mllib_tpu/telemetry/) --------------------------
     # jax.profiler trace directory: non-empty wraps every estimator fit
     # in a profiler trace written there (utils/profiling.maybe_trace),

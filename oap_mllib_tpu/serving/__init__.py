@@ -49,6 +49,22 @@ existing subsystems instead of a per-call eager afterthought:
   before a replica lets go, and the :class:`BrownoutController` ladder
   (``Config.serve_brownout``) degrades top-k depth / precision /
   pin freshness under sustained pressure before anything sheds.
+- :mod:`~oap_mllib_tpu.serving.reqtrace` — request-lifecycle tracing
+  (ISSUE 19): ``Config.serve_trace_sample`` > 0 gives every admitted
+  request a deadline-budget :class:`~oap_mllib_tpu.serving.reqtrace
+  .Ledger` (admission / queue wait / batch formation / bucket pad /
+  compile / execute / dispatch — stages sum to the request wall by
+  construction) attached to its future (:func:`ledger_of`), booked as
+  ``oap_serve_stage_seconds{stage=}`` histograms with trace-id
+  exemplars, rolled into ``serving_summary()["attribution"]``, and —
+  for sampled requests — emitted as flight-recorder events + JSONL
+  records that ``dev/oaptrace.py`` merges into Perfetto request flows.
+- :mod:`~oap_mllib_tpu.serving.slo` — the error-budget plane over the
+  same ledger stream: a multi-window burn-rate engine
+  (``Config.serve_slo_p99_ms`` / ``serve_slo_availability``) behind
+  ``oap_slo_*`` gauges, ``serving_summary()["slo"]``, the ``/sloz``
+  endpoint, and observe-only SLO state recorded with every
+  scale/brownout decision.
 
 Usage (docs/user-guide.md "Serving")::
 
@@ -85,5 +101,18 @@ from oap_mllib_tpu.serving.traffic import (  # noqa: F401
     brownout,
     brownout_stale_ok,
     brownout_topk,
+    serving_health_block,
     write_scale_hint,
+)
+from oap_mllib_tpu.serving.reqtrace import (  # noqa: F401
+    Ledger,
+    TraceContext,
+    attribution_block,
+    is_sampled,
+    ledger_of,
+    make_trace_id,
+)
+from oap_mllib_tpu.serving.slo import (  # noqa: F401
+    SLOEngine,
+    slo_state,
 )

@@ -27,6 +27,7 @@ model calls).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -100,6 +101,7 @@ def bucket_batch(x: np.ndarray,
     rows appended; every consumer slices the result back to ``n``).
     ``Config.shape_bucketing`` governs the series exactly as it does
     for fits ("off" = exact padding to the multiple)."""
+    t0 = time.perf_counter()
     x = np.ascontiguousarray(np.atleast_2d(x))
     n = x.shape[0]
     b = bucket_rows(max(n, 1), multiple)
@@ -107,6 +109,11 @@ def bucket_batch(x: np.ndarray,
         x = np.concatenate(
             [x, np.zeros((b - n, x.shape[1]), x.dtype)], axis=0
         )
+    # fold the pad wall into any attached request ledgers (a thread-
+    # local miss when no traced flush is in flight — the disarmed seam)
+    from oap_mllib_tpu.serving import reqtrace
+
+    reqtrace.note_flush("bucket_pad", time.perf_counter() - t0)
     return x, n
 
 

@@ -67,7 +67,12 @@ def heartbeat(requests: Optional[int] = None,
     if requests is None:
         requests = int(_tm.family_total("oap_serve_requests_total"))
     if queue_depth is None:
-        queue_depth = 0
+        # default to the live traffic-queue depth (pending + coalesced
+        # in-flight) so fleet views and the scale controller see real
+        # backlog without every call site plumbing it
+        from oap_mllib_tpu.serving import registry
+
+        queue_depth = registry.queue_depth()
     rank = jax.process_index()
     frame = np.asarray(
         [float(rank), float(requests), float(queue_depth)], np.float64
@@ -131,8 +136,14 @@ class ReplicaGuard:
         if q is not None:
             stats = q.drain(timeout_s)
             q.close()
+            from oap_mllib_tpu.serving import slo
             from oap_mllib_tpu.telemetry import flightrec
 
+            # the release record carries the SLO state it let go under
+            # (observe-only — the release itself stays drain-driven)
+            brief = slo.brief()
+            if brief:
+                stats["slo"] = brief
             flightrec.record(
                 "serve", "release",
                 f"replica released: answered={stats['answered']} "

@@ -67,6 +67,14 @@ def note_queue_depth(delta: int) -> int:
     return depth
 
 
+def queue_depth() -> int:
+    """The live traffic-queue depth (pending + coalesced in-flight) —
+    the /healthz serving block reads this without touching the gauge
+    registry."""
+    with _DEPTH_LOCK:
+        return _queue_depth
+
+
 def pin(cache: dict, name: str, host_array, *,
         allow_stale: bool = False) -> Any:
     """Device copy of ``host_array`` cached in ``cache[name]``, keyed by
@@ -111,10 +119,18 @@ def _observe_request(kind: str, wall_s: float, rows: int) -> None:
         "oap_serve_rows_total", lab,
         help="Request rows scored by the serving plane",
     ).inc(rows)
+    # a traced coalesced flush pins one of its sampled trace ids to the
+    # latency bucket as an OpenMetrics exemplar — a dashboard's slow
+    # bucket links to a concrete request ledger
+    from oap_mllib_tpu.serving import reqtrace
+
+    tid = reqtrace.exemplar_trace_id()
     _tm.histogram(
         "oap_serve_request_seconds", lab,
         help="Per-request serving latency (staging + scoring + fetch)",
-    ).observe(wall_s)
+    ).observe(
+        wall_s, exemplar={"trace_id": tid} if tid is not None else None
+    )
 
 
 class ServedModel:

@@ -1,0 +1,409 @@
+"""Request-lifecycle tracing: the deadline-budget ledger every admitted
+request carries through the traffic plane.
+
+The traffic plane (ISSUE 13/16/18) exposes only aggregates — an
+operator can watch ``oap_serve_shed_total`` rise or p99 drift, but
+cannot answer "where did THIS request's deadline go?".  This module is
+the per-request answer: when ``Config.serve_trace_sample`` > 0, every
+ADMITTED request gets a :class:`TraceContext` (deterministic id — no
+RNG — plus a sampled flag from a pure hash of that id) and a
+:class:`Ledger` that rides the request's future through its whole
+lifecycle, recording a FIXED-schema stage breakdown:
+
+========== ==================================================
+stage       what the wall covers
+========== ==================================================
+admission   ``submit`` entry -> admitted (pricing, brownout,
+            queue checks under the admission lock)
+queue_wait  admitted -> popped by a dispatch cycle (includes
+            retry backoff waits — requeues re-enter here)
+batch_form  popped -> its coalesced group's scoring call
+            begins (shed triage, deadline sort, group slicing)
+bucket_pad  inside the flush: rounding the joined batch onto
+            its geometric bucket (batcher.bucket_batch wall)
+compile     inside the flush: XLA backend compile wall
+            attributed to the flush (progcache ground truth —
+            zero in the warmed steady state)
+execute     inside the flush: the remainder of the scoring
+            call (staging + device execute + fetch)
+dispatch    scoring returned -> future resolved (result
+            split, landing)
+========== ==================================================
+
+The stages sum to the measured request wall BY CONSTRUCTION: every
+boundary cut accumulates the full interval since the previous cut
+(:meth:`Ledger.cut`), and the within-flush split
+(:meth:`Ledger.cut_flush`) clamps its parts to the flush interval.
+Lifecycle events that are not stages — retry/requeue, poison
+quarantine, brownout rung steps, drain, shed, per-hop ring-sweep
+rotations — append to the ledger's event list (and the flight
+recorder) instead.
+
+Where the ledger lands:
+
+- attached to the answered/failed future (:func:`ledger_of`);
+- ``oap_serve_stage_seconds{stage=}`` histograms (with trace-id
+  exemplars on sampled requests — telemetry/metrics.py);
+- ``serving_summary()["attribution"]`` (p50/p99 per stage + the
+  stage-sum vs request-wall coverage ratio);
+- flight-recorder ``request`` events + JSONL ``type: "request"``
+  records for SAMPLED requests — dev/oaptrace.py merges them into
+  Perfetto request flows (one lane per replica, ring-hop arrows);
+- the SLO engine (serving/slo.py) observes every finalized ledger.
+
+Disarmed (``serve_trace_sample == 0``, the default) the whole plane is
+one config check per submit — ``begin()`` returns None and every other
+hook is a None/thread-local-miss check (dev/slo_gate.py bounds the
+seam at <1% of the serving microbench).
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Any, Dict, List, Optional
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.utils import locktrace
+
+# the fixed stage schema, in lifecycle order (the JSONL record, the
+# attribution block, and the oaptrace lanes all render this order)
+STAGES = (
+    "admission",
+    "queue_wait",
+    "batch_form",
+    "bucket_pad",
+    "compile",
+    "execute",
+    "dispatch",
+)
+
+# terminal outcomes a ledger finalizes with
+OUTCOMES = ("answered", "shed", "failed", "cancelled")
+
+_STATE_LOCK = locktrace.TrackedLock("serving.reqtrace")
+_wall_sum = 0.0   # finalized request walls (coverage denominator)
+_stage_sum = 0.0  # finalized stage sums (coverage numerator)
+_finalized = 0
+
+_tls = threading.local()
+
+
+def trace_sample_cfg(cfg=None) -> float:
+    """Validated ``Config.serve_trace_sample`` — out of [0, 1] must
+    raise, not silently disarm (the kmeans_kernel/fault_spec
+    contract)."""
+    cfg = cfg or get_config()
+    sample = float(cfg.serve_trace_sample)
+    if not 0.0 <= sample <= 1.0:
+        raise ValueError(
+            f"serve_trace_sample must be in [0, 1] (0 = tracing off), "
+            f"got {sample}"
+        )
+    return sample
+
+
+def armed() -> bool:
+    """One config check — the off-path cost at the submit seam."""
+    return get_config().serve_trace_sample != 0
+
+
+def is_sampled(trace_id: str, sample: float) -> bool:
+    """Deterministic sampling decision: a pure hash of the trace id
+    against the sampling fraction — NO RNG, so every process of a
+    world (and every rerun) samples the same ids."""
+    if sample >= 1.0:
+        return True
+    if sample <= 0.0:
+        return False
+    return (zlib.crc32(trace_id.encode()) & 0xFFFFFFFF) / 2**32 < sample
+
+
+def make_trace_id(rank: int, seq: int) -> str:
+    """Deterministic per-request id: rank + admission seq (unique
+    within a process lifetime, stable across reruns of a deterministic
+    storm)."""
+    return f"{rank:02x}-{seq:08x}"
+
+
+class TraceContext:
+    """The identity half of a traced request: who it is (trace id,
+    rank, admission seq), what it promised (deadline), and whether the
+    heavy emission paths fire for it (``sampled``)."""
+
+    __slots__ = ("trace_id", "rank", "seq", "deadline_ms", "sampled")
+
+    def __init__(self, rank: int, seq: int, deadline_ms: float,
+                 sample: float):
+        self.rank = int(rank)
+        self.seq = int(seq)
+        self.deadline_ms = float(deadline_ms)
+        self.trace_id = make_trace_id(self.rank, self.seq)
+        self.sampled = is_sampled(self.trace_id, sample)
+
+
+class Ledger:
+    """The budget half: where this request's wall went, stage by
+    stage, plus the lifecycle events that are not stages.
+
+    All stamps use the OWNING QUEUE's clock (injectable — fake-clock
+    tests stay deterministic); ``cut`` accumulates the full interval
+    since the previous boundary into one stage, so the stages sum to
+    ``t_end - t0`` exactly, retries and all."""
+
+    __slots__ = ("ctx", "t0", "stages", "events", "outcome", "model",
+                 "wall_s", "retries", "_last")
+
+    def __init__(self, ctx: TraceContext, t0: float):
+        self.ctx = ctx
+        self.t0 = float(t0)
+        self.stages: Dict[str, float] = {s: 0.0 for s in STAGES}
+        self.events: List[Dict[str, Any]] = []
+        self.outcome = ""
+        self.model = ""
+        self.wall_s = 0.0
+        self.retries = 0
+        self._last = float(t0)
+
+    def cut(self, stage: str, now: float) -> None:
+        """Close the interval since the last boundary into ``stage``."""
+        self.stages[stage] += max(0.0, float(now) - self._last)
+        self._last = float(now)
+
+    def cut_flush(self, now: float, pad_s: float, compile_s: float) -> None:
+        """Close the scoring-flush interval, split three ways: bucket
+        padding (measured in the batcher), XLA compile (the progcache
+        ground-truth delta across the flush), execute (the remainder).
+        Parts are clamped to the interval so the ledger's sum-to-wall
+        invariant survives measurement skew (or a fake clock)."""
+        flush = max(0.0, float(now) - self._last)
+        pad = min(max(0.0, float(pad_s)), flush)
+        comp = min(max(0.0, float(compile_s)), flush - pad)
+        self.stages["bucket_pad"] += pad
+        self.stages["compile"] += comp
+        self.stages["execute"] += flush - pad - comp
+        self._last = float(now)
+
+    def event(self, kind: str, detail: str, t: float) -> None:
+        """Append one non-stage lifecycle event (retry, poison,
+        brownout, drain, shed, ring_hop, ...)."""
+        self.events.append(
+            {"kind": str(kind), "t": float(t), "detail": str(detail)}
+        )
+
+    def stage_sum(self) -> float:
+        return sum(self.stages.values())
+
+    def as_record(self) -> Dict[str, Any]:
+        """The JSONL ``type: "request"`` payload (rank-tagged by the
+        sink caller)."""
+        return {
+            "trace_id": self.ctx.trace_id,
+            "seq": self.ctx.seq,
+            "rank": self.ctx.rank,
+            "deadline_ms": self.ctx.deadline_ms,
+            "sampled": self.ctx.sampled,
+            "t0": self.t0,
+            "wall_s": self.wall_s,
+            "outcome": self.outcome,
+            "model": self.model,
+            "retries": self.retries,
+            "stages": {s: self.stages[s] for s in STAGES},
+            "events": list(self.events),
+        }
+
+
+def begin(queue_clock_now: float, rank: int, seq: int,
+          deadline_ms: float) -> Optional[Ledger]:
+    """Open a ledger for one admission attempt, or None when tracing
+    is disarmed (the one-config-check off path)."""
+    sample = trace_sample_cfg()
+    if sample == 0.0:
+        return None
+    ctx = TraceContext(rank, seq, deadline_ms, sample)
+    return Ledger(ctx, queue_clock_now)
+
+
+def finalize(ledger: Optional[Ledger], outcome: str, now: float,
+             model: str = "") -> None:
+    """Close a ledger: stamp the outcome and wall, book the per-stage
+    histograms (+ exemplars when sampled), feed the SLO engine, and —
+    for SAMPLED requests — emit the flight-recorder request event and
+    the JSONL ``request`` record.  Idempotent: a ledger finalizes
+    exactly once (the future-resolution race goes to whoever lands the
+    future)."""
+    if ledger is None:
+        return
+    if ledger.outcome:
+        return
+    ledger.outcome = outcome if outcome in OUTCOMES else "failed"
+    if model:
+        ledger.model = model
+    # close any open interval into dispatch: the final boundary is the
+    # future landing, whatever path got here
+    ledger.cut("dispatch", now)
+    ledger.wall_s = max(0.0, float(now) - ledger.t0)
+    exemplar = (
+        {"trace_id": ledger.ctx.trace_id} if ledger.ctx.sampled else None
+    )
+    for stage in STAGES:
+        v = ledger.stages[stage]
+        if v > 0.0 or stage in ("queue_wait", "execute"):
+            _tm.histogram(
+                "oap_serve_stage_seconds", {"stage": stage},
+                help="Per-request wall attributed to each traffic-plane "
+                     "lifecycle stage (serving/reqtrace.py; stages sum "
+                     "to the request wall)",
+            ).observe(v, exemplar=exemplar)
+    global _wall_sum, _stage_sum, _finalized
+    with _STATE_LOCK:
+        _wall_sum += ledger.wall_s
+        _stage_sum += ledger.stage_sum()
+        _finalized += 1
+    _tm.counter(
+        "oap_serve_traced_total", {"outcome": ledger.outcome},
+        help="Traced requests finalized, by outcome",
+    ).inc()
+    from oap_mllib_tpu.serving import slo
+
+    slo.observe_request(
+        ledger.wall_s, ok=ledger.outcome == "answered", t=now
+    )
+    if ledger.ctx.sampled:
+        from oap_mllib_tpu.telemetry import flightrec
+
+        flightrec.record(
+            "request", ledger.ctx.trace_id,
+            f"outcome={ledger.outcome} wall_ms="
+            f"{ledger.wall_s * 1e3:.3f} retries={ledger.retries}",
+        )
+        _emit_request_record(ledger)
+
+
+def _emit_request_record(ledger: Ledger) -> None:
+    """Append one JSONL ``type: "request"`` record to the telemetry
+    sink (no-op when ``Config.telemetry_log`` is off).  Request ``t0``
+    and flight-recorder event times share the monotonic clock family,
+    so dev/oaptrace.py can lay both on one timeline."""
+    from oap_mllib_tpu.telemetry import export
+
+    export.emit_requests([ledger.as_record()])
+
+
+# -- thread-local attach: flush-internal notes + ring-hop fan-in --------------
+
+
+class attach:
+    """Context manager binding the ledgers of an in-flight coalesced
+    flush to the scoring thread, so seams BELOW the traffic plane
+    (batcher pad timing, sharded-sweep ring hops) can fold into them
+    without plumbing arguments through ``predict_many``."""
+
+    def __init__(self, ledgers: List[Ledger]):
+        self._ledgers = [lg for lg in ledgers if lg is not None]
+
+    def __enter__(self):
+        _tls.ledgers = self._ledgers
+        _tls.flush = {}
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _tls.ledgers = None
+        _tls.flush = None
+
+    def flush_notes(self) -> Dict[str, float]:
+        return dict(getattr(_tls, "flush", None) or {})
+
+
+def current_ledgers() -> List[Ledger]:
+    """The ledgers attached to this thread's in-flight flush ([] when
+    none — the common, un-traced case)."""
+    return list(getattr(_tls, "ledgers", None) or [])
+
+
+def exemplar_trace_id() -> Optional[str]:
+    """A sampled trace id from the attached flush (the exemplar the
+    request-latency histogram pins to its bucket), or None."""
+    for lg in getattr(_tls, "ledgers", None) or ():
+        if lg.ctx.sampled:
+            return lg.ctx.trace_id
+    return None
+
+
+def note_flush(stage: str, seconds: float) -> None:
+    """Accumulate a within-flush measurement (today: ``bucket_pad``
+    from batcher.bucket_batch) into the attached flush's note dict.
+    A thread-local miss when no traced flush is in flight — the
+    disarmed seam."""
+    acc = getattr(_tls, "flush", None)
+    if acc is not None:
+        acc[stage] = acc.get(stage, 0.0) + float(seconds)
+
+
+def note_event(kind: str, detail: str, t: float) -> None:
+    """Append a lifecycle event to every attached ledger (ring-hop
+    rotations from serving/sweep.py ride this)."""
+    for lg in getattr(_tls, "ledgers", None) or ():
+        lg.event(kind, detail, t)
+
+
+# -- attribution rollup --------------------------------------------------------
+
+
+def stage_quantiles() -> Dict[str, Dict[str, float]]:
+    """Per-stage p50/p99 from the ``oap_serve_stage_seconds``
+    histograms (upper-bound bucket estimates, the
+    registry._latency_quantiles convention)."""
+    reg = _tm.registry()
+    out: Dict[str, Dict[str, float]] = {}
+    with _tm._LOCK:
+        series = [
+            (dict(labels).get("stage", ""), m)
+            for (name, labels), m in reg._metrics.items()
+            if name == "oap_serve_stage_seconds"
+        ]
+    for stage, h in series:
+        if h.count == 0:
+            continue
+        out[stage] = {
+            "p50_s": _tm.histogram_quantile(h, 0.50),
+            "p99_s": _tm.histogram_quantile(h, 0.99),
+            "count": int(h.count),
+            "sum_s": round(float(h.sum), 6),
+        }
+    return out
+
+
+def attribution_block() -> Dict[str, Any]:
+    """The ``serving_summary()["attribution"]`` block: per-stage
+    p50/p99 plus the stage-sum vs request-wall coverage ratio (1.0 by
+    construction — the slo_gate contract asserts the 5% tolerance on
+    per-request ledgers).  {} when nothing was traced."""
+    with _STATE_LOCK:
+        finalized, wall, stages = _finalized, _wall_sum, _stage_sum
+    if finalized == 0:
+        return {}
+    return {
+        "traced": finalized,
+        "wall_s": round(wall, 6),
+        "stage_s": round(stages, 6),
+        "coverage": round(stages / wall, 4) if wall > 0 else 1.0,
+        "stages": stage_quantiles(),
+    }
+
+
+def ledger_of(future) -> Optional[Ledger]:
+    """The ledger attached to an answered/failed traffic future, or
+    None (tracing disarmed when the request was admitted)."""
+    return getattr(future, "ledger", None)
+
+
+def _reset_for_tests() -> None:
+    global _wall_sum, _stage_sum, _finalized
+    with _STATE_LOCK:
+        _wall_sum = 0.0
+        _stage_sum = 0.0
+        _finalized = 0
+    _tls.ledgers = None
+    _tls.flush = None

@@ -369,6 +369,8 @@ def _sweep_sharded(model, n: int, with_scores: bool):
         (pol.name, int(n), progcache.array_key(xb, yb)),
     ):
         s_blk, i_blk = fn(xb, yb, offs_dev)
+    if item_sharded and world > 1:
+        _note_ring_hops(mesh, axis, int(world))
     # replicate the RESULT blocks (k per user, not the factors) and
     # reassemble valid rows per block — the _gather_blocks offset
     # bookkeeping; multi-process worlds make this fetch a collective
@@ -387,6 +389,36 @@ def _sweep_sharded(model, n: int, with_scores: bool):
         help="Query rows swept by full-sweep top-k",
     ).inc(n_users)
     return out_i, (out_s if with_scores else None)
+
+
+def _note_ring_hops(mesh, axis: str, world: int) -> None:
+    """Host-side trace of the ring schedule the sharded sweep just ran:
+    one ``ring_hop`` flight-recorder event (and request-ledger event,
+    when a traced flush is attached) per rotation step.  The schedule
+    is deterministic — item block ``b`` is resident on rank
+    ``(b - t) mod world`` at hop ``t`` — so dev/oaptrace.py can draw
+    cross-replica flow arrows per block from these stamps alone; the
+    device ring itself (collective.ppermute inside the jit) is never
+    perturbed."""
+    import time as _time
+
+    import jax
+
+    from oap_mllib_tpu.serving import reqtrace
+    from oap_mllib_tpu.telemetry import flightrec
+
+    rank = int(jax.process_index())
+    for t in range(world):
+        detail = (
+            f"rank={rank} hop={t} block={(rank + t) % world} "
+            f"world={world}"
+        )
+        flightrec.record("ring_hop", f"hop{t}", detail)
+        reqtrace.note_event("ring_hop", detail, _time.perf_counter())
+    _tm.counter(
+        "oap_serve_ring_hops_total",
+        help="Ring-rotation hops traced by the sharded sweep",
+    ).inc(world)
 
 
 def _serving_policy_als():

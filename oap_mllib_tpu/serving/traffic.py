@@ -80,6 +80,7 @@ import os
 import threading
 import time
 import warnings
+import weakref
 import zlib
 from collections import deque
 from concurrent.futures import Future
@@ -88,6 +89,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.serving import reqtrace
 from oap_mllib_tpu.telemetry import metrics as _tm
 from oap_mllib_tpu.utils import faults, locktrace
 
@@ -100,6 +102,15 @@ SCALE_HINT_FILENAME = "serve.scale.hint.json"
 # tracked lock below — the dispatcher thread and fit threads both read)
 _STATE_LOCK = locktrace.TrackedLock("serving.scale")
 _scale_state: Dict[str, Any] = {}
+
+# the last request shed by this process: (reason, monotonic time) — the
+# /healthz serving block reports reason + age so a scrape of a shedding
+# replica names why without grepping counters
+_last_shed: Optional[tuple] = None
+
+# live queues (weakly held) — the /healthz serving block sums their
+# in-flight sets without keeping a closed queue alive
+_QUEUES: "weakref.WeakSet[TrafficQueue]" = weakref.WeakSet()
 
 
 class ShedError(RuntimeError):
@@ -206,12 +217,20 @@ def _fmt_bytes(n: int) -> str:
 def _shed(reason: str, msg: str, **ctx) -> ShedError:
     """Build a ShedError and book the shed counter — every shed is
     visible on the metrics plane whether it raises at submit or lands
-    on a future at dispatch."""
+    on a future at dispatch.  Also stamps the /healthz last-shed state
+    and drops a flight-recorder instant (shed instants land on the
+    oaptrace request timeline)."""
+    global _last_shed
     _tm.counter(
         "oap_serve_shed_total", {"reason": reason},
         help="Requests shed by traffic-plane admission control / "
              "deadline expiry, by reason",
     ).inc()
+    with _STATE_LOCK:
+        _last_shed = (reason, time.monotonic())
+    from oap_mllib_tpu.telemetry import flightrec
+
+    flightrec.record("serve", "shed", f"reason={reason}")
     return ShedError(reason, msg, **ctx)
 
 
@@ -251,6 +270,8 @@ def traffic_cfg() -> Dict[str, float]:
         )
     brownout = str(cfg.serve_brownout).strip().lower()
     _parse_brownout(brownout)  # a typo raises here, at submit time
+    # a serve_trace_sample typo raises here too — before a storm queues
+    trace_sample = reqtrace.trace_sample_cfg(cfg)
     return {
         "queue_depth": depth,
         "deadline_ms": deadline_ms,
@@ -258,6 +279,7 @@ def traffic_cfg() -> Dict[str, float]:
         "retry_limit": retry_limit,
         "retry_backoff": retry_backoff,
         "brownout": brownout,
+        "trace_sample": trace_sample,
     }
 
 
@@ -285,7 +307,7 @@ def _parse_brownout(raw: str) -> Optional[int]:
 
 class _Request:
     __slots__ = ("x", "rows", "deadline", "deadline_ms", "seq", "future",
-                 "submitted", "retries", "not_before", "running")
+                 "submitted", "retries", "not_before", "running", "trace")
 
     def __init__(self, x: np.ndarray, deadline: float, deadline_ms: float,
                  seq: int, submitted: float):
@@ -304,6 +326,9 @@ class _Request:
         self.retries = 0
         self.not_before = 0.0
         self.running = False
+        # the request's deadline-budget ledger (serving/reqtrace.py),
+        # or None when tracing is disarmed
+        self.trace: Optional[reqtrace.Ledger] = None
 
 
 class TrafficQueue:
@@ -343,6 +368,7 @@ class TrafficQueue:
                 f"max_batch_rows must be >= 1, got {max_batch_rows}"
             )
         self._handle = handle
+        self._kind = str(getattr(handle, "kind", ""))
         self._max_batch_rows = int(max_batch_rows)
         self._poll_s = float(poll_s)
         self._clock = clock
@@ -359,6 +385,7 @@ class TrafficQueue:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        _QUEUES.add(self)
         if start:
             t = threading.Thread(
                 target=self._run, name="oap-serve-dispatch", daemon=True
@@ -410,6 +437,7 @@ class TrafficQueue:
                     "drains or scale out",
                     queue_depth=depth, deadline_ms=deadline_ms,
                 )
+            bo: Optional[Dict[str, Any]] = None
             if allowance > 0:
                 from oap_mllib_tpu.utils.membudget import _OVERHEAD
 
@@ -432,6 +460,29 @@ class TrafficQueue:
                     )
             req = _Request(x, deadline, deadline_ms, self._seq, now)
             self._seq += 1
+            if knobs["trace_sample"] > 0:
+                # the deadline-budget ledger opens at submit entry (t0 =
+                # now, stamped before the lock) and closes its first
+                # stage — admission — here, still under the lock so the
+                # dispatcher can never pop an un-traced request
+                lg = reqtrace.begin(
+                    now, int(get_config().process_id), req.seq,
+                    deadline_ms,
+                )
+                if lg is not None:
+                    t_adm = self._clock()
+                    lg.cut("admission", t_adm)
+                    if bo is not None and (bo["stepped"] or bo["rung"]):
+                        lg.event(
+                            "brownout",
+                            f"rung={bo['rung_name']} "
+                            f"stepped={bo['stepped']}",
+                            t_adm,
+                        )
+                    req.trace = lg
+                    # the future carries the ledger to the caller
+                    # (reqtrace.ledger_of) — answered or failed alike
+                    req.future.ledger = lg  # type: ignore[attr-defined]
             self._pending.append(req)
             self.submitted += 1
         from oap_mllib_tpu.serving import registry
@@ -485,17 +536,30 @@ class TrafficQueue:
         self._inflight.pop(id(r), None)
         try:
             r.future.set_result(out)
-            return True
         except Exception:  # InvalidStateError: close()/drain() beat us
             return False
+        self._finalize_trace(r, "answered")
+        return True
 
     def _land_exc(self, r: _Request, exc: BaseException) -> bool:
         self._inflight.pop(id(r), None)
         try:
             r.future.set_exception(exc)
-            return True
         except Exception:  # InvalidStateError: close()/drain() beat us
             return False
+        self._finalize_trace(
+            r, "shed" if isinstance(exc, ShedError) else "failed"
+        )
+        return True
+
+    def _finalize_trace(self, r: _Request, outcome: str) -> None:
+        """Close the request's ledger on whichever path landed its
+        future — answered, shed, failed, or cancelled."""
+        lg = r.trace
+        if lg is None:
+            return
+        lg.retries = r.retries
+        reqtrace.finalize(lg, outcome, self._clock(), model=self._kind)
 
     def pump(self) -> int:
         """One dispatch cycle: pop every pending request whose retry
@@ -522,6 +586,11 @@ class TrafficQueue:
                 ]
             for r in ready:
                 self._inflight[id(r)] = r
+        for r in ready:
+            if r.trace is not None:
+                # admitted (or requeued) -> popped by this cycle; retry
+                # backoff waits accumulate here too, by construction
+                r.trace.cut("queue_wait", now)
         from oap_mllib_tpu.serving import registry
 
         registry.note_queue_depth(-len(ready))
@@ -539,6 +608,7 @@ class TrafficQueue:
             if not r.running:
                 if not r.future.set_running_or_notify_cancel():
                     self._inflight.pop(id(r), None)
+                    self._finalize_trace(r, "cancelled")
                     resolved += 1  # caller cancelled before dispatch
                     continue
                 r.running = True
@@ -580,10 +650,41 @@ class TrafficQueue:
         bucket family, no new compiles), quarantine (isolated poison),
         or land the raw exception (unclassified: a programming error
         must propagate unchanged, never masked)."""
-        try:
-            parts = self._handle.predict_many([r.x for r in g])
-        except Exception as exc:  # noqa: BLE001 — classified below
-            return self._group_fault(g, exc, now)
+        ledgers = [r.trace for r in g if r.trace is not None]
+        if not ledgers:
+            try:
+                parts = self._handle.predict_many([r.x for r in g])
+            except Exception as exc:  # noqa: BLE001 — classified below
+                return self._group_fault(g, exc, now)
+        else:
+            # popped -> this group's scoring call begins: deadline
+            # triage, sorting, and group slicing all land in batch_form
+            t_score = self._clock()
+            for lg in ledgers:
+                lg.cut("batch_form", t_score)
+            from oap_mllib_tpu.utils import progcache
+
+            compile0 = progcache.xla_compile_secs()
+            try:
+                # bind the group's ledgers to the scoring thread so the
+                # batcher's pad timing and the sharded sweep's ring-hop
+                # events fold in without plumbing predict_many
+                with reqtrace.attach(ledgers) as att:
+                    parts = self._handle.predict_many([r.x for r in g])
+            except Exception as exc:  # noqa: BLE001 — classified below
+                t_fault = self._clock()
+                for lg in ledgers:
+                    lg.cut("execute", t_fault)
+                    lg.event("fault", type(exc).__name__, t_fault)
+                return self._group_fault(g, exc, now)
+            t_done = self._clock()
+            pad_s = att.flush_notes().get("bucket_pad", 0.0)
+            comp_s = progcache.xla_compile_secs() - compile0
+            # each request's flush interval splits pad/compile/execute;
+            # the shared pad/compile walls are attributed per-request
+            # (every rider of the flush paid them)
+            for lg in ledgers:
+                lg.cut_flush(t_done, pad_s, comp_s)
         resolved = 0
         for r, out in zip(g, parts):
             if self._land(r, out):
@@ -656,6 +757,10 @@ class TrafficQueue:
                 "serve", "poison",
                 f"seq={r.seq} rows={r.rows} digest={digest:08x}: {exc}",
             )
+            if r.trace is not None:
+                r.trace.event(
+                    "poison", f"digest={digest:08x}", self._clock()
+                )
             err = ServeError(
                 "poison",
                 f"request seq={r.seq} quarantined: scoring it produces "
@@ -681,6 +786,12 @@ class TrafficQueue:
             r.not_before = now + policy.delay_s(r.retries,
                                                 site="serve.batch")
             r.retries += 1
+            if r.trace is not None:
+                r.trace.retries = r.retries
+                r.trace.event("retry", f"retries={r.retries}", now)
+        from oap_mllib_tpu.telemetry import flightrec
+
+        flightrec.record("serve", "retry", f"n={len(rs)}")
         _tm.counter(
             "oap_serve_retries_total",
             help="Transient scoring faults re-enqueued by the durable-"
@@ -761,9 +872,20 @@ class TrafficQueue:
         ``ScaleController`` scale-in decisions and
         ``ha.ReplicaGuard.release``."""
         faults.maybe_fault("serve.drain")
+        from oap_mllib_tpu.telemetry import flightrec
+
         with self._lock:
             self._draining = True
             start_pending = len(self._pending) + len(self._inflight)
+            for r in self._pending:
+                if r.trace is not None:
+                    r.trace.event(
+                        "drain", f"pending={start_pending}",
+                        self._clock(),
+                    )
+        flightrec.record(
+            "serve", "drain", f"pending={start_pending}"
+        )
         deadline = time.monotonic() + max(0.0, float(timeout_s))
         answered0 = self.answered
         while True:
@@ -969,6 +1091,13 @@ class BrownoutController:
             "ratio": round(float(ratio), 3),
             "trend": trend,
         }
+        # observe-only SLO wiring: the step stays pressure-driven, but
+        # it RECORDS the burn-rate state that witnessed it
+        from oap_mllib_tpu.serving import slo
+
+        slo_brief = slo.brief()
+        if slo_brief:
+            step["slo"] = slo_brief
         self.steps.append(step)
         self._ratios.clear()  # each rung needs fresh sustained samples
         self._gauge()
@@ -1253,6 +1382,13 @@ class ScaleController:
             "depth_trend": depth_trend,
             "p99_trend": p99_trend,
         }
+        # observe-only SLO wiring: the decision stays queue-driven, but
+        # it RECORDS the burn-rate state that witnessed it
+        from oap_mllib_tpu.serving import slo
+
+        slo_brief = slo.brief()
+        if slo_brief:
+            decision["slo"] = slo_brief
         if action == "in" and self._queue is not None:
             # graceful shrink: the released replica stops admission and
             # flushes every accepted future BEFORE the world resizes
@@ -1327,11 +1463,51 @@ def summary_block() -> Dict[str, Any]:
     with _STATE_LOCK:
         if _scale_state:
             out["scale"] = dict(_scale_state)
+    attr = reqtrace.attribution_block()
+    if attr:
+        out["attribution"] = attr
+    from oap_mllib_tpu.serving import slo
+
+    s = slo.summary_block()
+    if s:
+        out["slo"] = s
+    return out
+
+
+def serving_health_block() -> Dict[str, Any]:
+    """The ``serving`` block of ``/healthz`` (telemetry/fleet.py): what
+    a pure-serving replica is DOING — queue depth, in-flight count,
+    pinned models, the brownout rung, the last shed (reason + age), and
+    the SLO burn state — so a scrape is no longer empty of the thing
+    the replica exists for."""
+    from oap_mllib_tpu.serving import registry, slo
+
+    out: Dict[str, Any] = {
+        "queue_depth": registry.queue_depth(),
+        "in_flight": sum(
+            len(q._inflight) for q in list(_QUEUES)
+        ),
+        "pinned_models": len(registry.served_models()),
+    }
+    b = _BROWNOUT
+    out["brownout_rung"] = BROWNOUT_RUNGS[b.rung] if b is not None \
+        else "off"
+    with _STATE_LOCK:
+        last = _last_shed
+    if last is not None:
+        out["last_shed"] = {
+            "reason": last[0],
+            "age_s": round(max(0.0, time.monotonic() - last[1]), 3),
+        }
+    s = slo.brief()
+    if s:
+        out["slo"] = s
     return out
 
 
 def _reset_for_tests() -> None:
-    global _BROWNOUT
+    global _BROWNOUT, _last_shed
     with _STATE_LOCK:
         _scale_state.clear()
         _BROWNOUT = None
+        _last_shed = None

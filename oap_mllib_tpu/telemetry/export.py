@@ -140,6 +140,29 @@ def _emit_final_snapshot() -> None:
         pass  # a torn-down filesystem at exit must not mask the real exit
 
 
+def emit_requests(records: List[Dict[str, Any]]) -> int:
+    """Append finalized request-ledger records (serving/reqtrace.py) to
+    the sink as ``type: "request"`` lines — rank- and seq-tagged like
+    every other record, so dev/oaptrace.py merges them into the same
+    per-rank stream.  Returns the number written (0 when the sink is
+    off; an OSError is swallowed — the sink is a diagnosis channel,
+    never a liveness one)."""
+    path = sink_path()
+    if path is None or not records:
+        return 0
+    register_shutdown()
+    rank = _rank()
+    out = [
+        dict(rec, type="request", rank=rank, seq=next(_seq))
+        for rec in records
+    ]
+    try:
+        _write_lines(path, out)
+    except OSError:
+        return 0
+    return len(out)
+
+
 def emit_fit(root: Span) -> None:
     """Append one record per span in ``root``'s tree (depth-first) plus
     a registry snapshot — the per-fit JSONL batch.  No-op when the sink

@@ -408,7 +408,7 @@ def _healthz_payload() -> Dict[str, Any]:
             if _rank_row_totals is not None
             and rank < len(_rank_row_totals) else 0
         )
-    return {
+    payload = {
         "ok": True,
         "rank": rank,
         "world": int(cfg.num_processes),
@@ -424,6 +424,23 @@ def _healthz_payload() -> Dict[str, Any]:
         "capability": balance.cached_capability(),
         "rows_processed": rows_done,
     }
+    # the serving side of the replica: a scrape of a pure-serving
+    # process is no longer empty of the thing it's doing
+    try:
+        from oap_mllib_tpu.serving import traffic
+
+        payload["serving"] = traffic.serving_health_block()
+    except Exception:  # noqa: BLE001 — health must render regardless
+        payload["serving"] = {}
+    return payload
+
+
+def _sloz_payload() -> Dict[str, Any]:
+    """``GET /sloz``: the SLO engine's full state (serving/slo.py) —
+    ``{"armed": false}`` when ``serve_slo_p99_ms`` is 0."""
+    from oap_mllib_tpu.serving import slo
+
+    return slo.state()
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
@@ -433,6 +450,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif self.path.split("?")[0] == "/healthz":
             body = (json.dumps(_healthz_payload(), sort_keys=True)
+                    + "\n").encode()
+            ctype = "application/json"
+        elif self.path.split("?")[0] == "/sloz":
+            body = (json.dumps(_sloz_payload(), sort_keys=True)
                     + "\n").encode()
             ctype = "application/json"
         else:
@@ -506,7 +527,9 @@ def maybe_serve(cfg=None) -> Optional[int]:
     from oap_mllib_tpu.telemetry import export as _export
 
     _export.register_shutdown()
-    log.info("fleet: serving /metrics and /healthz on port %d", port)
+    log.info(
+        "fleet: serving /metrics, /healthz and /sloz on port %d", port
+    )
     return port
 
 

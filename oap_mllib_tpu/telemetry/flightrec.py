@@ -17,6 +17,13 @@ count, 0 = off) that every instrumented seam appends one tiny event to:
 - ``fault`` / ``retry`` / ``degrade`` — utils/resilience.py
 - ``ckpt_commit`` — utils/checkpoint.py manifest flips
 - ``crash`` — utils/recovery.write_crash_record
+- ``serve`` — traffic-plane lifecycle instants (shed / retry / poison
+  / brownout / drain / release — serving/traffic.py, serving/ha.py)
+- ``request`` — one event per SAMPLED finalized request ledger
+  (serving/reqtrace.py: outcome, wall, retries)
+- ``ring_hop`` — per-rotation stamps of the sharded sweep's ring
+  schedule (serving/sweep.py; dev/oaptrace.py draws cross-replica
+  flow arrows from them)
 
 Each event is ``(seq, t, tid, kind, name, detail)``: ``seq`` is a
 process-lifetime monotonic counter (it keeps counting across ring

@@ -81,22 +81,38 @@ class Histogram:
 
     ``counts[i]`` is the number of observations ``<= bounds[i]`` in that
     bucket (non-cumulative storage; the Prometheus renderer emits the
-    cumulative form); ``counts[-1]`` is the +Inf overflow."""
+    cumulative form); ``counts[-1]`` is the +Inf overflow.
 
-    __slots__ = ("bounds", "counts", "sum", "count")
+    ``observe(..., exemplar={...})`` pins an OpenMetrics exemplar
+    (label dict + the observed value, e.g. a sampled request trace id)
+    to the bucket the observation lands in — latest observation wins
+    per bucket; ``render_prometheus`` emits it after the bucket line
+    (`` # {trace_id="..."} 0.0042``).  Storage stays None until the
+    first exemplar, so un-traced histograms pay nothing."""
+
+    __slots__ = ("bounds", "counts", "sum", "count", "exemplars")
 
     def __init__(self, bounds: Tuple[float, ...] = DURATION_BUCKETS):
         self.bounds = tuple(float(b) for b in bounds)
         self.counts = [0] * (len(self.bounds) + 1)
         self.sum = 0.0
         self.count = 0
+        # bucket index -> (labels dict, observed value); lazily built
+        self.exemplars: Optional[Dict[int, Tuple[Dict[str, str], float]]] \
+            = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
         v = float(v)
         with _LOCK:
-            self.counts[bisect.bisect_left(self.bounds, v)] += 1
+            idx = bisect.bisect_left(self.bounds, v)
+            self.counts[idx] += 1
             self.sum += v
             self.count += 1
+            if exemplar:
+                if self.exemplars is None:
+                    self.exemplars = {}
+                self.exemplars[idx] = (dict(exemplar), v)
 
 
 _LOCK = threading.Lock()
@@ -241,16 +257,16 @@ class Registry:
                 lab = _render_labels(labels)
                 if isinstance(m, Histogram):
                     cum = 0
-                    for b, c in zip(m.bounds, m.counts):
+                    for i, (b, c) in enumerate(zip(m.bounds, m.counts)):
                         cum += c
                         lines.append(
                             f"{name}_bucket{_render_labels(labels, le=_fmt(b))}"
-                            f" {cum}"
+                            f" {cum}{_render_exemplar(m, i)}"
                         )
                     cum += m.counts[-1]
                     lines.append(
                         f'{name}_bucket{_render_labels(labels, le="+Inf")}'
-                        f" {cum}"
+                        f" {cum}{_render_exemplar(m, len(m.bounds))}"
                     )
                     lines.append(f"{name}_sum{lab} {_fmt(m.sum)}")
                     lines.append(f"{name}_count{lab} {m.count}")
@@ -284,6 +300,22 @@ def _escape_label(value: str) -> str:
     return (
         value.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
     )
+
+
+def _render_exemplar(m: Histogram, idx: int) -> str:
+    """OpenMetrics exemplar suffix for one bucket line (`` # {k="v"}
+    value``), or "" when the bucket holds none.  Label values get the
+    standard promtext escaping."""
+    if m.exemplars is None:
+        return ""
+    ex = m.exemplars.get(idx)
+    if ex is None:
+        return ""
+    labels, v = ex
+    body = ",".join(
+        f'{k}="{_escape_label(str(val))}"' for k, val in sorted(labels.items())
+    )
+    return f" # {{{body}}} {_fmt(v)}"
 
 
 def _render_labels(labels: LabelSet, le: Optional[str] = None) -> str:

@@ -283,6 +283,11 @@ def key_digest(key) -> str:
 # -- XLA compile ground truth ----------------------------------------------
 
 _XLA_EVENTS = {"count": 0, "secs": 0.0}
+# the compile-event listener fires on whatever thread XLA compiles on,
+# and the counters are read from fit flows AND the serving dispatcher
+# thread (the request tracer's compile attribution) — same witnessed
+# seam as _CLEAR_LOCK
+_XLA_EVENTS_LOCK = _locktrace.TrackedLock("progcache.xla_events")
 _xla_listener_installed = False
 _BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
@@ -296,8 +301,9 @@ def _install_xla_listener() -> None:
 
         def _on_event(event, duration_secs, **kwargs):
             if event == _BACKEND_COMPILE_EVENT:
-                _XLA_EVENTS["count"] += 1
-                _XLA_EVENTS["secs"] += float(duration_secs)
+                with _XLA_EVENTS_LOCK:
+                    _XLA_EVENTS["count"] += 1
+                    _XLA_EVENTS["secs"] += float(duration_secs)
                 _tm.counter(
                     "oap_xla_compiles_total",
                     help="Real XLA backend compiles (jax monitoring event)",
@@ -324,14 +330,16 @@ def xla_compile_count() -> int:
     truth the compile-sweep bench and the CI gate assert on (the
     registry's miss count is what *we* think; this is what XLA did)."""
     _install_xla_listener()
-    return _XLA_EVENTS["count"]
+    with _XLA_EVENTS_LOCK:
+        return _XLA_EVENTS["count"]
 
 
 def xla_compile_secs() -> float:
     """Cumulative seconds spent in XLA backend compilation (same event
     stream as :func:`xla_compile_count`)."""
     _install_xla_listener()
-    return _XLA_EVENTS["secs"]
+    with _XLA_EVENTS_LOCK:
+        return _XLA_EVENTS["secs"]
 
 
 # install at import so compiles that happen before the first explicit

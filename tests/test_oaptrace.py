@@ -242,3 +242,184 @@ class TestBenchRegress:
         assert trace["otherData"]["mode"] == "recorder"
         assert oaptrace.validate_trace(trace) == []
         assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+
+
+class TestRequestFlows:
+    """ISSUE 19: request-ledger records merge into per-replica stage
+    lanes, ring-hop recorder events become cross-replica flow arrows,
+    and both survive clock alignment."""
+
+    def _request(self, rank, seq, t0, outcome="answered", events=()):
+        return {
+            "type": "request", "rank": rank, "seq": seq,
+            "trace_id": f"{rank:02x}-{seq:08x}", "deadline_ms": 50.0,
+            "sampled": True, "t0": t0, "wall_s": 0.45,
+            "outcome": outcome, "model": "kmeans", "retries": 0,
+            "stages": {
+                "admission": 0.05, "queue_wait": 0.1, "batch_form": 0.05,
+                "bucket_pad": 0.0, "compile": 0.0, "execute": 0.2,
+                "dispatch": 0.05,
+            },
+            "events": list(events),
+        }
+
+    def _aligned_sinks(self, tmp_path, rank1_offset=100.0):
+        """Two ranks, rank 1's clock at +offset, one collective each
+        for alignment, one traced request each."""
+        base = str(tmp_path / "serve.jsonl")
+        for rank, off in ((0, 0.0), (1, rank1_offset)):
+            t = off + 10.0
+            events = [
+                _event(0, t, "collective", "process_allgather", "(2,3)"),
+            ]
+            _write_sink(f"{base}.rank{rank}", [
+                _flightrec_record(rank, events),
+                self._request(
+                    rank, rank, t + 0.2,
+                    events=[{"kind": "retry", "t": t + 0.3,
+                             "detail": "n=1"}],
+                ),
+            ])
+        return base
+
+    def test_request_lanes_merge_clock_true(self, tmp_path):
+        base = self._aligned_sinks(tmp_path)
+        trace = oaptrace.merge_trace(oaptrace.expand_paths([base]))
+        assert trace["otherData"]["mode"] == "recorder"
+        assert trace["otherData"]["requests"] == 2
+        assert oaptrace.validate_trace(trace) == []
+        lanes = [e for e in trace["traceEvents"]
+                 if e.get("cat") == "request" and e.get("ph") == "X"]
+        assert {e["pid"] for e in lanes} == {0, 1}
+        # rank 1's +100 s clock is recovered via the collective: both
+        # requests land near each other on the merged timeline
+        t0s = {e["pid"]: e["ts"] for e in lanes
+               if e["name"] == "admission"}
+        assert abs(t0s[0] - t0s[1]) < 1e5  # < 100 ms apart
+        # lanes are high tids, grouped below the real threads
+        assert all(e["tid"] >= 900_000 for e in lanes)
+
+    def test_stage_slices_lay_out_in_ledger_order(self, tmp_path):
+        base = str(tmp_path / "solo.jsonl")
+        _write_sink(base + ".rank0",
+                    [_flightrec_record(0, [_event(0, 5.0, "collective",
+                                                  "g", "")]),
+                     self._request(0, 3, 5.5)])
+        trace = oaptrace.merge_trace(oaptrace.expand_paths([base]))
+        lane = [e for e in trace["traceEvents"]
+                if e.get("cat") == "request" and e.get("ph") == "X"]
+        names = [e["name"] for e in sorted(lane, key=lambda e: e["ts"])]
+        # zero-duration stages are skipped; the rest keep STAGES order
+        assert names == ["admission", "queue_wait", "batch_form",
+                         "execute", "dispatch"]
+        starts = sorted(e["ts"] for e in lane)
+        durs = [e["dur"] for e in sorted(lane, key=lambda e: e["ts"])]
+        for i in range(1, len(starts)):
+            assert starts[i] == pytest.approx(
+                starts[i - 1] + durs[i - 1], abs=0.2
+            )
+        args = lane[0]["args"]
+        assert args["trace_id"] == "00-00000003"
+        assert args["outcome"] == "answered"
+
+    def test_lifecycle_events_become_instants(self, tmp_path):
+        base = self._aligned_sinks(tmp_path)
+        trace = oaptrace.merge_trace(oaptrace.expand_paths([base]))
+        instants = [e for e in trace["traceEvents"]
+                    if e.get("ph") == "i" and e.get("cat") == "request"]
+        assert {e["name"] for e in instants} == {"request:retry"}
+        assert {e["pid"] for e in instants} == {0, 1}
+
+    def test_ring_hops_chain_the_right_replica_rotation_pairs(
+            self, tmp_path):
+        """The ring schedule: block b sits on rank (b - t) mod world at
+        hop t — each block's flow must step through exactly that rank
+        sequence, in hop order."""
+        world = 3
+        base = str(tmp_path / "ring.jsonl")
+        for r in range(world):
+            events = [
+                _event(t, 10.0 + 0.1 * t, "ring_hop", f"hop{t}",
+                       f"rank={r} hop={t} block={(r + t) % world} "
+                       f"world={world}")
+                for t in range(world)
+            ]
+            _write_sink(f"{base}.rank{r}", [_flightrec_record(r, events)])
+        trace = oaptrace.merge_trace(oaptrace.expand_paths([base]))
+        assert oaptrace.validate_trace(trace) == []
+        flows = {}
+        for e in trace["traceEvents"]:
+            if e.get("cat") == "ring_hop" and e.get("ph") in ("s", "t",
+                                                             "f"):
+                flows.setdefault(e["name"], []).append(e)
+        assert set(flows) == {f"ring:block{b}" for b in range(world)}
+        for b in range(world):
+            chain = sorted(flows[f"ring:block{b}"], key=lambda e: e["ts"])
+            assert [e["ph"] for e in chain] == ["s", "t", "f"]
+            assert [e["pid"] for e in chain] == [
+                (b - t) % world for t in range(world)
+            ]
+            assert len({e["id"] for e in chain}) == 1
+        # the per-hop instants still render alongside the flows
+        assert sum(
+            1 for e in trace["traceEvents"]
+            if e.get("ph") == "i" and e.get("cat") == "ring_hop"
+        ) == world * world
+
+    def test_second_sweep_occurrence_gets_its_own_flows(self, tmp_path):
+        """hop=0 restarts an occurrence counter: two sweeps on one rank
+        pair up independently instead of cross-linking."""
+        base = str(tmp_path / "two.jsonl")
+        for r in range(2):
+            events = []
+            seq = 0
+            for occ in range(2):
+                for t in range(2):
+                    events.append(_event(
+                        seq, 10.0 + 5.0 * occ + 0.1 * t, "ring_hop",
+                        f"hop{t}",
+                        f"rank={r} hop={t} block={(r + t) % 2} world=2",
+                    ))
+                    seq += 1
+            _write_sink(f"{base}.rank{r}", [_flightrec_record(r, events)])
+        trace = oaptrace.merge_trace(oaptrace.expand_paths([base]))
+        starts = [e for e in trace["traceEvents"]
+                  if e.get("cat") == "ring_hop" and e.get("ph") == "s"]
+        # 2 occurrences x 2 blocks, each its own flow id
+        assert len(starts) == 4
+        assert len({e["id"] for e in starts}) == 4
+
+    def test_synthesized_fallback_lays_request_lanes(self, tmp_path):
+        """Recorder off: request records alone still merge — per-rank
+        layout from each rank's earliest admission — and validate."""
+        base = str(tmp_path / "noflight.jsonl")
+        for r in range(2):
+            _write_sink(f"{base}.rank{r}", [
+                self._request(r, 0, 50.0 + r * 7.0),
+                self._request(r, 1, 50.4 + r * 7.0, outcome="shed",
+                              events=[{"kind": "shed", "t": 50.6,
+                                       "detail": "deadline"}]),
+            ])
+        trace = oaptrace.merge_trace(oaptrace.expand_paths([base]))
+        assert trace["otherData"]["mode"] == "synthesized"
+        assert trace["otherData"]["requests"] == 4
+        assert oaptrace.validate_trace(trace) == []
+        lanes = [e for e in trace["traceEvents"]
+                 if e.get("cat") == "request" and e.get("ph") == "X"]
+        assert {e["pid"] for e in lanes} == {0, 1}
+        # each rank laid out from ITS earliest admission: no negative
+        # timestamps, first slice at ~0 per rank
+        per_rank_min = {}
+        for e in lanes:
+            per_rank_min[e["pid"]] = min(
+                per_rank_min.get(e["pid"], float("inf")), e["ts"]
+            )
+        assert all(ts == pytest.approx(0.0, abs=1.0)
+                   for ts in per_rank_min.values())
+        assert any(e["name"] == "request:shed"
+                   for e in trace["traceEvents"] if e.get("ph") == "i")
+
+    def test_requests_count_lands_in_other_data(self, tmp_path):
+        base = self._aligned_sinks(tmp_path)
+        trace = oaptrace.merge_trace(oaptrace.expand_paths([base]))
+        assert trace["otherData"]["requests"] == 2

@@ -1,0 +1,519 @@
+"""Request-lifecycle tracing + SLO plane tests (ISSUE 19).
+
+Contracts under test:
+
+- the ledger's fixed stage schema sums to the request wall BY
+  CONSTRUCTION (``cut`` closes full intervals; ``cut_flush`` clamps
+  its parts to the flush interval) — fake-clock exact, live within 5%;
+- sampling is a pure hash of the deterministic trace id (no RNG):
+  identical decisions for identical ids, [0, 1] edge behavior, and a
+  validated ``serve_trace_sample`` knob;
+- armed tracing attaches a finalized ledger to every answered/shed
+  future (``ledger_of``), books ``oap_serve_stage_seconds`` +
+  ``oap_serve_traced_total``, and folds into
+  ``serving_summary()["attribution"]``; disarmed, ``begin`` returns
+  None and every hook is a miss;
+- OpenMetrics exemplars ride histogram bucket lines with spec
+  escaping and round-trip through a parser of the exposition format;
+- the SLO engine's multi-window burn rates move under an induced
+  breach (fake clock), the breach flag needs BOTH windows, windows
+  prune, and brownout/scale decisions RECORD the witnessed SLO state;
+- ``/healthz`` gains the serving block (queue depth, brownout rung,
+  pins, last shed, SLO) and ``/sloz`` serves the engine state.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu import serving
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.serving import registry, reqtrace, slo, traffic
+from oap_mllib_tpu.telemetry import metrics as tm
+
+
+@pytest.fixture(autouse=True)
+def _clear_serving():
+    from oap_mllib_tpu.serving import ha
+
+    registry.clear()
+    traffic._reset_for_tests()
+    ha._reset_for_tests()
+    reqtrace._reset_for_tests()
+    slo._reset_for_tests()
+    yield
+    registry.clear()
+    traffic._reset_for_tests()
+    ha._reset_for_tests()
+    reqtrace._reset_for_tests()
+    slo._reset_for_tests()
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class SpyHandle:
+    kind = "spy"
+
+    def predict_many(self, batches):
+        return [np.full(b.shape[0], b.shape[0], np.int32) for b in batches]
+
+
+class TestSampling:
+    def test_trace_id_deterministic_and_rank_tagged(self):
+        assert reqtrace.make_trace_id(3, 7) == "03-00000007"
+        assert reqtrace.make_trace_id(3, 7) == reqtrace.make_trace_id(3, 7)
+        assert reqtrace.make_trace_id(0, 7) != reqtrace.make_trace_id(1, 7)
+
+    def test_sampling_is_pure_hash_of_the_id(self):
+        ids = [reqtrace.make_trace_id(r, s)
+               for r in range(3) for s in range(300)]
+        first = [reqtrace.is_sampled(i, 0.37) for i in ids]
+        again = [reqtrace.is_sampled(i, 0.37) for i in ids]
+        assert first == again
+        frac = sum(first) / len(first)
+        assert 0.2 < frac < 0.55  # hash is not degenerately skewed
+
+    def test_sampling_edges(self):
+        tid = reqtrace.make_trace_id(0, 1)
+        assert reqtrace.is_sampled(tid, 1.0) is True
+        assert reqtrace.is_sampled(tid, 0.0) is False
+
+    def test_knob_validated_at_begin(self):
+        set_config(serve_trace_sample=1.5)
+        with pytest.raises(ValueError, match="serve_trace_sample"):
+            reqtrace.begin(0.0, 0, 0, 0.0)
+
+    def test_disarmed_begin_returns_none(self):
+        assert reqtrace.begin(0.0, 0, 0, 0.0) is None
+
+
+class TestLedger:
+    def _ledger(self, t0=100.0):
+        set_config(serve_trace_sample=1.0)
+        return reqtrace.begin(t0, 0, 5, 50.0)
+
+    def test_cuts_sum_to_wall_exactly(self):
+        lg = self._ledger(100.0)
+        lg.cut("admission", 100.25)
+        lg.cut("queue_wait", 101.0)
+        lg.cut("batch_form", 101.125)
+        lg.cut_flush(102.0, pad_s=0.25, compile_s=0.5)
+        reqtrace.finalize(lg, "answered", 102.5, model="kmeans")
+        assert lg.wall_s == pytest.approx(2.5)
+        assert lg.stage_sum() == pytest.approx(lg.wall_s)
+        assert lg.stages["admission"] == pytest.approx(0.25)
+        assert lg.stages["queue_wait"] == pytest.approx(0.75)
+        assert lg.stages["bucket_pad"] == pytest.approx(0.25)
+        assert lg.stages["compile"] == pytest.approx(0.5)
+        assert lg.stages["execute"] == pytest.approx(0.125)
+        assert lg.stages["dispatch"] == pytest.approx(0.5)
+
+    def test_cut_flush_clamps_parts_to_the_interval(self):
+        """Measurement skew (pad + compile claiming more than the
+        flush wall) must not break the sum-to-wall invariant."""
+        lg = self._ledger(0.0)
+        lg.cut("queue_wait", 1.0)
+        lg.cut_flush(2.0, pad_s=5.0, compile_s=5.0)
+        assert lg.stages["bucket_pad"] == pytest.approx(1.0)
+        assert lg.stages["compile"] == pytest.approx(0.0)
+        assert lg.stages["execute"] == pytest.approx(0.0)
+        reqtrace.finalize(lg, "answered", 2.0)
+        assert lg.stage_sum() == pytest.approx(lg.wall_s)
+
+    def test_finalize_is_idempotent(self):
+        lg = self._ledger(0.0)
+        reqtrace.finalize(lg, "answered", 1.0)
+        reqtrace.finalize(lg, "failed", 9.0)  # the race loser is a no-op
+        assert lg.outcome == "answered"
+        assert lg.wall_s == pytest.approx(1.0)
+
+    def test_unknown_outcome_classifies_as_failed(self):
+        lg = self._ledger(0.0)
+        reqtrace.finalize(lg, "exploded", 1.0)
+        assert lg.outcome == "failed"
+
+    def test_record_schema_is_fixed(self):
+        lg = self._ledger(10.0)
+        lg.event("retry", "n=1", 10.5)
+        reqtrace.finalize(lg, "answered", 11.0)
+        rec = lg.as_record()
+        assert set(rec["stages"]) == set(reqtrace.STAGES)
+        for key in ("trace_id", "seq", "rank", "deadline_ms", "sampled",
+                    "t0", "wall_s", "outcome", "model", "retries",
+                    "events"):
+            assert key in rec
+        assert rec["events"][0]["kind"] == "retry"
+
+    def test_finalize_books_histograms_and_outcome_counter(self):
+        before = tm.family_total("oap_serve_traced_total")
+        lg = self._ledger(0.0)
+        lg.cut("queue_wait", 0.5)
+        reqtrace.finalize(lg, "answered", 1.0)
+        assert tm.family_total("oap_serve_traced_total") == before + 1
+        q = reqtrace.stage_quantiles()
+        assert q["queue_wait"]["count"] >= 1
+        assert q["dispatch"]["count"] >= 1
+
+
+class TestAttach:
+    def test_notes_fold_into_attached_flush(self):
+        set_config(serve_trace_sample=1.0)
+        lg = reqtrace.begin(0.0, 0, 1, 0.0)
+        with reqtrace.attach([lg, None]) as att:
+            reqtrace.note_flush("bucket_pad", 0.25)
+            reqtrace.note_flush("bucket_pad", 0.25)
+            reqtrace.note_event("ring_hop", "hop=0 block=1", 0.5)
+            assert reqtrace.exemplar_trace_id() == lg.ctx.trace_id
+            assert att.flush_notes() == {"bucket_pad": 0.5}
+        assert lg.events[0]["kind"] == "ring_hop"
+        assert reqtrace.current_ledgers() == []
+
+    def test_misses_outside_attach_are_noops(self):
+        reqtrace.note_flush("bucket_pad", 1.0)
+        reqtrace.note_event("ring_hop", "", 0.0)
+        assert reqtrace.exemplar_trace_id() is None
+        assert reqtrace.current_ledgers() == []
+
+
+class TestTrafficIntegration:
+    def test_answered_future_carries_finalized_ledger(self):
+        clock = FakeClock(100.0)
+        q = serving.TrafficQueue(SpyHandle(), start=False, clock=clock)
+        set_config(serve_trace_sample=1.0)
+        f = q.submit(np.zeros((4, 3), np.float32), deadline_ms=60_000)
+        clock.advance(0.5)
+        q.pump()
+        q.close()
+        lg = reqtrace.ledger_of(f)
+        assert lg is not None
+        assert lg.outcome == "answered"
+        assert lg.model == "spy"
+        assert lg.stage_sum() == pytest.approx(lg.wall_s)
+        assert lg.stages["queue_wait"] == pytest.approx(0.5)
+
+    def test_live_storm_ledgers_cover_wall_within_5pct(self, rng):
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = rng.normal(size=(400, 8)).astype(np.float32)
+        handle = serving.serve(
+            KMeans(k=3, seed=0, init_mode="random", max_iter=2).fit(x)
+        )
+        set_config(serve_trace_sample=1.0)
+        with serving.TrafficQueue(handle) as q:
+            futs = [
+                q.submit(x[: int(s)], deadline_ms=60_000)
+                for s in rng.integers(5, 128, size=20)
+            ]
+            for f in futs:
+                f.result(timeout=60)
+        for f in futs:
+            lg = reqtrace.ledger_of(f)
+            assert lg is not None and lg.outcome == "answered"
+            assert abs(lg.stage_sum() - lg.wall_s) <= max(
+                0.05 * lg.wall_s, 1e-6
+            )
+        attr = reqtrace.attribution_block()
+        assert attr["traced"] >= 20
+        assert 0.95 <= attr["coverage"] <= 1.05
+        summ = serving.serving_summary()
+        assert summ["attribution"]["traced"] >= 20
+
+    def test_deadline_shed_finalizes_ledger_as_shed(self):
+        clock = FakeClock(0.0)
+        q = serving.TrafficQueue(SpyHandle(), start=False, clock=clock)
+        set_config(serve_trace_sample=1.0)
+        f = q.submit(np.zeros((4, 3), np.float32), deadline_ms=1.0)
+        clock.advance(10.0)
+        q.pump()
+        q.close()
+        assert isinstance(f.exception(), serving.ShedError)
+        lg = reqtrace.ledger_of(f)
+        assert lg is not None and lg.outcome == "shed"
+        assert lg.stage_sum() == pytest.approx(lg.wall_s)
+
+    def test_disarmed_future_has_no_ledger(self):
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        f = q.submit(np.zeros((4, 3), np.float32))
+        q.pump()
+        q.close()
+        assert reqtrace.ledger_of(f) is None
+        assert reqtrace.attribution_block() == {}
+
+
+class TestExemplars:
+    # the OpenMetrics exemplar suffix: `` # {labels} value`` after a
+    # bucket line — this regex is the round-trip parser
+    _EX = re.compile(
+        r'^(?P<name>\w+_bucket)\{(?P<labels>[^}]*)\} (?P<count>\d+)'
+        r'(?: # \{(?P<exlabels>[^}]*)\} (?P<exvalue>\S+))?$'
+    )
+
+    def test_exemplar_rides_the_bucket_line(self):
+        h = tm.histogram("test_ex_seconds", {"stage": "execute"})
+        h.observe(0.003, exemplar={"trace_id": "00-0000002a"})
+        text = tm.render_prometheus()
+        lines = [ln for ln in text.splitlines()
+                 if ln.startswith("test_ex_seconds_bucket") and "#" in ln]
+        assert len(lines) == 1  # latest-wins, exactly one bucket pinned
+        m = self._EX.match(lines[0])
+        assert m is not None, lines[0]
+        assert 'trace_id="00-0000002a"' in m.group("exlabels")
+        assert float(m.group("exvalue")) == pytest.approx(0.003)
+
+    def test_exemplar_labels_are_spec_escaped_and_round_trip(self):
+        h = tm.histogram("test_ex_escape_seconds")
+        raw = 'id "quoted" back\\slash\nnewline'
+        h.observe(0.001, exemplar={"trace_id": raw})
+        text = tm.render_prometheus()
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("test_ex_escape_seconds_bucket") and "#" in ln
+        )
+        m = self._EX.match(line)
+        assert m is not None, line
+        body = m.group("exlabels")
+        _, _, escaped = body.partition('="')
+        escaped = escaped[:-1]  # strip the closing quote
+        unescaped = (
+            escaped.replace(r"\n", "\n").replace(r"\"", '"')
+            .replace(r"\\", "\\")
+        )
+        assert unescaped == raw
+
+    def test_latest_observation_wins_per_bucket(self):
+        h = tm.histogram("test_ex_latest_seconds")
+        h.observe(0.002, exemplar={"trace_id": "a"})
+        h.observe(0.002, exemplar={"trace_id": "b"})
+        text = tm.render_prometheus()
+        assert 'trace_id="b"' in text
+        assert 'trace_id="a"' not in text
+
+    def test_plus_inf_bucket_carries_exemplars(self):
+        h = tm.histogram("test_ex_inf_seconds")
+        h.observe(1e9, exemplar={"trace_id": "huge"})
+        line = next(
+            ln for ln in tm.render_prometheus().splitlines()
+            if 'le="+Inf"' in ln and ln.startswith("test_ex_inf")
+        )
+        assert 'trace_id="huge"' in line
+
+    def test_untraced_histograms_render_unchanged(self):
+        h = tm.histogram("test_ex_off_seconds")
+        h.observe(0.001)
+        assert h.exemplars is None
+        for ln in tm.render_prometheus().splitlines():
+            if ln.startswith("test_ex_off_seconds_bucket"):
+                assert "#" not in ln
+
+    def test_request_histogram_pins_sampled_trace_ids(self, rng):
+        from oap_mllib_tpu.models.kmeans import KMeans
+
+        x = rng.normal(size=(200, 6)).astype(np.float32)
+        handle = serving.serve(
+            KMeans(k=2, seed=0, init_mode="random", max_iter=2).fit(x)
+        )
+        set_config(serve_trace_sample=1.0)
+        with serving.TrafficQueue(handle) as q:
+            q.submit(x[:32], deadline_ms=60_000).result(timeout=60)
+        text = tm.render_prometheus()
+        stage_ex = [
+            ln for ln in text.splitlines()
+            if ln.startswith("oap_serve_stage_seconds_bucket")
+            and "trace_id=" in ln
+        ]
+        assert stage_ex, "no exemplars on the stage histograms"
+
+
+class TestSLOEngine:
+    def _engine(self, clock, p99_ms=100.0, availability=0.99,
+                window_s=600.0):
+        return slo.SLOEngine(p99_ms, availability, window_s, clock=clock)
+
+    def test_healthy_baseline_burns_nothing(self):
+        clock = FakeClock()
+        eng = self._engine(clock)
+        for _ in range(100):
+            clock.advance(0.1)
+            eng.observe(0.010, ok=True)
+        assert eng.burn_rate(eng.fast_window_s) == 0.0
+        assert eng.budget_remaining() == 1.0
+        assert eng.state()["breach"] is False
+
+    def test_breach_moves_both_windows_and_flag(self):
+        clock = FakeClock()
+        eng = self._engine(clock)
+        for _ in range(100):
+            clock.advance(0.1)
+            eng.observe(0.010, ok=True)
+        for _ in range(50):  # every request blows the 100 ms target
+            clock.advance(0.1)
+            eng.observe(0.500, ok=True)
+        st = eng.state()
+        assert st["burn_rate_fast"] > 1.0
+        assert st["burn_rate_slow"] > 1.0
+        assert st["breach"] is True
+        assert st["error_budget_remaining"] < 1.0
+        assert tm.family_total("oap_slo_burn_rate") > 1.0
+
+    def test_failures_are_bad_regardless_of_wall(self):
+        clock = FakeClock()
+        eng = self._engine(clock)
+        eng.observe(0.001, ok=False)
+        assert eng.bad == 1
+
+    def test_breach_needs_both_windows(self):
+        """Old badness outside the fast window burns the slow window
+        only — no page."""
+        clock = FakeClock()
+        eng = self._engine(clock)  # fast window = 50 s
+        for _ in range(20):
+            clock.advance(0.1)
+            eng.observe(0.500, ok=True)  # burst of bad
+        clock.advance(60.0)  # bad burst ages out of the fast window
+        for _ in range(20):
+            clock.advance(0.1)
+            eng.observe(0.010, ok=True)
+        st = eng.state()
+        assert st["burn_rate_slow"] > 1.0
+        assert st["burn_rate_fast"] < 1.0
+        assert st["breach"] is False
+
+    def test_windows_prune_old_samples(self):
+        clock = FakeClock()
+        eng = self._engine(clock, window_s=10.0)
+        for _ in range(5):
+            clock.advance(0.1)
+            eng.observe(0.500, ok=True)
+        clock.advance(100.0)
+        assert eng.burn_rate(eng.window_s) == 0.0
+        assert eng.budget_remaining() == 1.0
+        assert len(eng._samples) == 0  # pruned, not just filtered
+
+    def test_knob_validation(self):
+        set_config(serve_slo_availability=1.5, serve_slo_p99_ms=10.0)
+        with pytest.raises(ValueError, match="serve_slo_availability"):
+            slo.engine()
+
+    def test_singleton_rebuilds_on_knob_change(self):
+        set_config(serve_slo_p99_ms=100.0)
+        e1 = slo.engine()
+        set_config(serve_slo_p99_ms=200.0)
+        e2 = slo.engine()
+        assert e1 is not e2 and e2.p99_ms == 200.0
+        assert slo.engine() is e2
+
+    def test_disarmed_surface(self):
+        assert slo.engine() is None
+        assert slo.brief() == {}
+        assert slo.summary_block() == {}
+        assert slo.state() == {"armed": False}
+        assert slo.slo_state() == {"armed": False}
+        slo.observe_request(99.0, ok=False)  # one config check, no-op
+
+
+class TestDecisionRecords:
+    def _arm_breach(self):
+        set_config(serve_slo_p99_ms=100.0, serve_slo_availability=0.99,
+                   serve_slo_window_s=600.0)
+        for _ in range(10):
+            slo.observe_request(0.5, ok=False)
+
+    def test_brownout_steps_record_slo_state(self):
+        self._arm_breach()
+        bc = serving.BrownoutController("auto")
+        for _ in range(12):
+            bc.observe(200, 100)
+        assert bc.steps
+        for step in bc.steps:
+            assert step["slo"]["breach"] is True
+
+    def test_scale_decisions_record_slo_state(self):
+        self._arm_breach()
+        sc = serving.ScaleController(1)
+        d = sc.observe(queue_depth=3)
+        assert d["slo"]["burn_rate_fast"] > 1.0
+        assert d["slo"]["breach"] is True
+
+    def test_disarmed_decisions_stay_clean(self):
+        sc = serving.ScaleController(1)
+        assert "slo" not in sc.observe(queue_depth=0)
+
+    def test_traced_requests_feed_the_engine(self):
+        set_config(serve_trace_sample=1.0, serve_slo_p99_ms=1000.0)
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        q.submit(np.zeros((4, 3), np.float32), deadline_ms=60_000)
+        q.pump()
+        q.close()
+        eng = slo.engine()
+        assert eng is not None and eng.total >= 1
+
+
+class TestHealthSurfaces:
+    def test_serving_health_block_fields(self):
+        set_config(serve_slo_p99_ms=100.0)
+        block = serving.serving_health_block()
+        assert block["queue_depth"] == 0
+        assert block["in_flight"] == 0
+        assert block["pinned_models"] == 0
+        assert block["brownout_rung"] == "off"
+        assert "last_shed" not in block
+        assert "burn_rate_fast" in block["slo"]
+
+    def test_last_shed_reason_and_age_surface(self):
+        set_config(serve_queue_depth=1)
+        q = serving.TrafficQueue(SpyHandle(), start=False)
+        q.submit(np.zeros((2, 3), np.float32))
+        with pytest.raises(serving.ShedError):
+            q.submit(np.zeros((2, 3), np.float32))
+        q.close()
+        block = serving.serving_health_block()
+        assert block["last_shed"]["reason"] == "queue_full"
+        assert block["last_shed"]["age_s"] >= 0.0
+
+    def test_healthz_payload_carries_serving_block(self):
+        from oap_mllib_tpu.telemetry import fleet
+
+        payload = fleet._healthz_payload()
+        assert "serving" in payload
+        assert "queue_depth" in payload["serving"]
+
+    def test_sloz_payload_tracks_engine_state(self):
+        from oap_mllib_tpu.telemetry import fleet
+
+        assert fleet._sloz_payload() == {"armed": False}
+        set_config(serve_slo_p99_ms=100.0)
+        slo.observe_request(0.5, ok=False)
+        payload = fleet._sloz_payload()
+        assert payload["armed"] is True
+        assert payload["lifetime_requests"] >= 1
+
+    def test_sloz_endpoint_served_next_to_metrics(self):
+        import json
+        import urllib.request
+
+        from oap_mllib_tpu.parallel.bootstrap import free_port
+        from oap_mllib_tpu.telemetry import fleet
+
+        port = free_port("127.0.0.1", 9500)
+        set_config(serve_slo_p99_ms=100.0, metrics_port=port)
+        assert fleet.maybe_serve() == port
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/sloz", timeout=10
+            ) as resp:
+                payload = json.loads(resp.read())
+            assert payload["armed"] is True
+        finally:
+            fleet.stop_server()
