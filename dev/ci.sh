@@ -164,6 +164,15 @@ echo "   (request lanes + ring-hop flow arrows), and a <1% tracing-off"
 echo "   seam (dev/slo_gate.py) =="
 python dev/slo_gate.py
 
+echo "== online gate: incremental fit paths — fold-in matches a from-"
+echo "   scratch refit in prediction space within the documented bound"
+echo "   at >=5x the refit wall, a second delta commit performs zero XLA"
+echo "   compiles and zero autotune sweeps with the served handle"
+echo "   answering through the new version, the staleness gauge drops"
+echo "   across a commit, and a mid-commit fault or SIGKILL leaves the"
+echo "   old pin serving bit-identically (dev/online_gate.py) =="
+python dev/online_gate.py
+
 echo "== bench regression gate (soft): newest BENCH_r*.json vs the best"
 echo "   prior round per headline metric+backend; >10% fails, a single"
 echo "   recorded round warns only (dev/bench_regress.py) =="

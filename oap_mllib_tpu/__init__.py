@@ -30,12 +30,14 @@ __version__ = "0.1.0"
 
 from oap_mllib_tpu.config import Config, get_config, set_config
 from oap_mllib_tpu import telemetry
+from oap_mllib_tpu import online
 from oap_mllib_tpu.models.kmeans import KMeans, KMeansModel
 from oap_mllib_tpu.models.pca import PCA, PCAModel
 from oap_mllib_tpu.models.als import ALS, ALSModel
 
 __all__ = [
     "telemetry",
+    "online",
     "KMeans",
     "KMeansModel",
     "PCA",

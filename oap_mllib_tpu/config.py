@@ -463,6 +463,34 @@ class Config:
     # horizon); the fast window is this / 12 (the SRE 5m/1h pairing).
     # Must be > 0.
     serve_slo_window_s: float = 3600.0
+    # -- online / incremental fits (oap_mllib_tpu/online/) -------------------
+    # Count-decay factor for mini-batch Lloyd (online/minibatch.py
+    # KMeansModel.partial_fit): each partial_fit multiplies the
+    # accumulated per-center counts by this BEFORE folding the new
+    # mini-batch in, so the per-center learning rate
+    # counts_new / (decay * counts_old + counts_new) forgets old data
+    # geometrically.  1.0 (default) = no forgetting — the streaming
+    # average converges to the full-batch Lloyd step over the union of
+    # all chunks seen; values in (0, 1) track drifting distributions.
+    # Must be in (0, 1]; a typo raises at partial_fit entry.
+    online_decay: float = 1.0
+    # Row batching for the ALS fold-in solve (online/foldin.py): how
+    # many touched user/item rows solve per normal-equation launch.  0
+    # (default) solves every touched row in ONE batched launch — the
+    # fold-in contract (the per-delta cost is one edge pass + one
+    # solve, never a full refit); > 0 chunks huge deltas so the
+    # (batch, r, r) moment block stays bounded.  Negative raises.
+    online_foldin_batch: int = 0
+    # In-place serving re-pin on delta commit (online/delta.py): "auto"
+    # (default) re-pins every registry handle serving the committed
+    # model — version bump + fresh device pins under the registry lock,
+    # in-flight requests keep answering, zero new XLA compiles while
+    # shapes stay in-bucket — and resets the
+    # oap_serve_model_staleness_seconds gauge; "off" leaves served
+    # handles on the old pin (they go stale, LOUD via the staleness
+    # gauge) until the caller re-serves explicitly.  A typo raises at
+    # commit time.
+    online_repin: str = "auto"
     # -- telemetry layer (oap_mllib_tpu/telemetry/) --------------------------
     # jax.profiler trace directory: non-empty wraps every estimator fit
     # in a profiler trace written there (utils/profiling.maybe_trace),

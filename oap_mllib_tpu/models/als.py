@@ -276,6 +276,32 @@ class ALSModel:
             with_scores,
         )
 
+    def fold_in_users(self, users, items, ratings, **kw) -> dict:
+        """Incremental fold-in of new/changed USER rows against the
+        frozen item table (online/foldin.py): one batched
+        normal-equation solve per delta — the PR 9 half-update kernel,
+        zero full refit — then an in-place serving re-pin.  ``users``/
+        ``items``/``ratings`` are the touched users' FULL current
+        rating rows (the standard fold-in contract; a partial row would
+        silently solve against a truncated normal equation).  The user
+        axis may GROW: ids beyond the current table extend it, with
+        untouched new rows at the deterministic init.  Keyword
+        arguments (reg/alpha/implicit/seed) default to the base fit's
+        ``summary["params"]``.  Returns the commit record (rows solved,
+        growth, new model version)."""
+        from oap_mllib_tpu.online import foldin
+
+        return foldin.fold_in(self, users, items, ratings,
+                              side="user", **kw)
+
+    def fold_in_items(self, users, items, ratings, **kw) -> dict:
+        """Symmetric fold-in of new/changed ITEM rows against the
+        frozen user table — see :meth:`fold_in_users`."""
+        from oap_mllib_tpu.online import foldin
+
+        return foldin.fold_in(self, users, items, ratings,
+                              side="item", **kw)
+
     def save(self, path: str) -> None:
         """Atomic per-file writes, metadata last (data/io primitives) —
         the KMeansModel.save torn-write contract.  Sharded fits gather
@@ -399,6 +425,34 @@ class ALS:
         self.num_item_blocks = num_item_blocks
 
     def fit(
+        self,
+        users,
+        items: Optional[np.ndarray] = None,
+        ratings: Optional[np.ndarray] = None,
+        n_users: Optional[int] = None,
+        n_items: Optional[int] = None,
+        init: Optional[tuple] = None,
+    ) -> ALSModel:
+        """Fit factors from (user, item, rating) triples — see
+        :meth:`_fit_impl` for the full contract.  This public wrapper
+        additionally stamps the fit hyperparameters into
+        ``model.summary["params"]`` so the incremental paths
+        (online/foldin.py) can default reg/alpha/implicit/seed to
+        exactly what the base fit used instead of asking the caller to
+        re-plumb them."""
+        model = self._fit_impl(
+            users, items, ratings, n_users, n_items, init
+        )
+        model.summary.setdefault("params", {
+            "rank": int(self.rank),
+            "reg": float(self.reg_param),
+            "alpha": float(self.alpha),
+            "implicit": bool(self.implicit_prefs),
+            "seed": int(self.seed),
+        })
+        return model
+
+    def _fit_impl(
         self,
         users,
         items: Optional[np.ndarray] = None,
@@ -570,18 +624,48 @@ class ALS:
              **self._block_summary(1)},
         )
 
+    # the id-space axes may GROW across restores (utils/checkpoint.py
+    # growable axes): yesterday's checkpoint warm-starts today's fit
+    # over a larger user/item universe — old rows restore bit-identical,
+    # the grown tail initializes deterministically (_fill_grown)
+    _GROWABLE = ("n_users", "n_items")
+
     def _ckpt_signature(self, n_users: int, n_items: int) -> dict:
         """Checkpoint identity (utils/checkpoint.py): the solver params
         and id-space shape.  World size, block layout, kernel choice,
         chunking, and precision policy are deliberately absent — every
         one of them may change across a preemption and the factor
-        iterates remain valid state."""
+        iterates remain valid state.  ``n_users``/``n_items`` are
+        declared growable (``_GROWABLE``), so a restore accepts a
+        manifest with a smaller id space (shape-prefix match) and
+        records the growth in ``summary.checkpoint["grown"]``."""
         return {
             "rank": self.rank, "implicit": bool(self.implicit_prefs),
             "reg": float(self.reg_param), "alpha": float(self.alpha),
             "seed": int(self.seed), "n_users": int(n_users),
             "n_items": int(n_items),
         }
+
+    def _fill_grown(self, grown: dict, x=None, y=None):
+        """Initialize the GROWN tail of restored factor tables: rows
+        [old, new) of a grown axis carry no checkpointed state (they
+        restore zero-filled), so they get the deterministic init —
+        ``als_np.init_factors_rows`` is position-addressable, making the
+        filled rows bit-identical to what a from-scratch fit of the
+        grown universe would have started those ids at."""
+        if x is not None and "n_users" in grown:
+            lo, hi = grown["n_users"]
+            x = np.asarray(x, np.float32)
+            x[lo:hi] = als_np.init_factors_rows(
+                lo, hi, self.rank, self.seed
+            )
+        if y is not None and "n_items" in grown:
+            lo, hi = grown["n_items"]
+            y = np.asarray(y, np.float32)
+            y[lo:hi] = als_np.init_factors_rows(
+                lo, hi, self.rank, self.seed + 1
+            )
+        return x, y
 
     def _run_segmented(self, ckpt, x0, y0, run_iters, n_users, n_items):
         """Checkpoint-armed in-memory ALS: run the compiled scan in
@@ -597,6 +681,8 @@ class ALS:
             # restores onto this single-device fit too
             x = ckpt_mod.factors_from_result(resume, "x", n_users)
             y = ckpt_mod.factors_from_result(resume, "y", n_items)
+            if resume.grown:
+                x, y = self._fill_grown(resume.grown, x, y)
             done = min(int(resume.step), self.max_iter)
             if "x" not in resume.arrays:
                 ckpt.mark_resharded()  # sharded state -> one device
@@ -691,7 +777,8 @@ class ALS:
         from oap_mllib_tpu.utils.profiling import maybe_trace
 
         ckpt = ckpt_mod.maybe_open(
-            "als", self._ckpt_signature(n_users, n_items), timings=timings
+            "als", self._ckpt_signature(n_users, n_items), timings=timings,
+            growable=self._GROWABLE,
         )
         with phase_timer(timings, "als_iterations"), maybe_trace():
             if grouped_ok and stream_route:
@@ -702,7 +789,7 @@ class ALS:
                     self.max_iter, self.reg_param, self.alpha,
                     self.implicit_prefs, timings=timings,
                     degraded=bool(degraded), policy=pol.name,
-                    checkpoint=ckpt,
+                    checkpoint=ckpt, grown_fill=self._fill_grown,
                 )
             elif grouped_ok:
                 def run_iters(xa, ya, iters):
@@ -969,7 +1056,7 @@ class ALS:
 
             ckpt = ckpt_mod.maybe_open(
                 "als", self._ckpt_signature(n_users, n_items),
-                timings=timings,
+                timings=timings, growable=self._GROWABLE,
             )
             with phase_timer(timings, "als_iterations"), maybe_trace():
                 x, y = als_stream.als_run_streamed(
@@ -977,6 +1064,7 @@ class ALS:
                     self.max_iter, self.reg_param, self.alpha,
                     self.implicit_prefs, timings=timings,
                     degraded=degraded, policy=pol.name, checkpoint=ckpt,
+                    grown_fill=self._fill_grown,
                 )
             summary = {
                 "timings": timings, "accelerated": True, "streamed": True,
@@ -1139,7 +1227,8 @@ class ALS:
         from oap_mllib_tpu.utils.profiling import maybe_trace
 
         ckpt = ckpt_mod.maybe_open(
-            "als", self._ckpt_signature(n_users, n_items), timings=timings
+            "als", self._ckpt_signature(n_users, n_items), timings=timings,
+            growable=self._GROWABLE,
         )
         with phase_timer(timings, "als_iterations"), maybe_trace():
             x_blocks, y = als_block_stream.als_block_run_streamed(
@@ -1213,6 +1302,11 @@ class ALS:
                 y_host = ckpt_mod.replicated_from_result(
                     resume, "y", int(y0_dev.shape[0]),
                 )
+                if resume.grown:
+                    # grown item tail gets the deterministic init (the
+                    # grown USER tail stays zero in the sharded x — its
+                    # rows re-solve from y in the next half-iteration)
+                    _, y_host = self._fill_grown(resume.grown, None, y_host)
                 y = jax.make_array_from_callback(
                     y_host.shape, NamedSharding(mesh, P()),
                     lambda idx: y_host[idx],
@@ -1339,7 +1433,8 @@ class ALS:
         from oap_mllib_tpu.utils.profiling import maybe_trace
 
         ckpt = ckpt_mod.maybe_open(
-            "als", self._ckpt_signature(n_users, n_items), timings=timings
+            "als", self._ckpt_signature(n_users, n_items), timings=timings,
+            growable=self._GROWABLE,
         )
         with phase_timer(timings, "als_iterations"), maybe_trace():
             if item_sharded:

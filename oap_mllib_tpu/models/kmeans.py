@@ -140,6 +140,21 @@ class KMeansModel:
     def transform(self, x: np.ndarray) -> np.ndarray:
         return self.predict(x)
 
+    def partial_fit(self, x, sample_weight=None) -> "KMeansModel":
+        """Mini-batch Lloyd delta (online/minibatch.py): ONE decayed,
+        count-weighted assignment pass over the arriving chunks through
+        the streamed-pass machinery (stream_ops.streamed_accumulate) —
+        no re-init, no convergence loop.  The update is compute-then
+        -swap: the centers array is replaced atomically at the end, so
+        a fault mid-pass leaves the model (and its served pin) exactly
+        as it was.  Commits re-pin any serving handle in place
+        (serving/registry.repin_model) — in-flight requests keep their
+        handle, the next batch scores the new centers.  Returns
+        ``self`` (mutated)."""
+        from oap_mllib_tpu.online import minibatch
+
+        return minibatch.partial_fit_kmeans(self, x, sample_weight)
+
     def compute_cost(self, x) -> float:
         from oap_mllib_tpu.data.stream import ChunkSource
 

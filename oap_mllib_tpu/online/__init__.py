@@ -1,0 +1,36 @@
+"""Live models: incremental fit paths with in-place serving re-pin.
+
+A nightly full refit is how the reference keeps Spark-served models
+fresh (train -> write -> reload -> swap).  This package is the delta
+path: each estimator family gets an incremental update that reuses the
+batch fit's own accumulation/solve machinery — same math, one pass over
+the arriving delta, no re-initialization — and every committed delta
+re-pins the model's device state IN PLACE through serving/registry.py
+(version bump + identity-keyed pin refresh; in-flight requests keep
+their handle, nothing is evicted, and no new XLA programs compile when
+shapes stay in-bucket).
+
+- :func:`minibatch.partial_fit_kmeans` — decayed mini-batch Lloyd over
+  streamed chunks (stream_ops.streamed_accumulate; the
+  ``KMeansModel.partial_fit`` entry point);
+- :class:`ipca.IncrementalPCA` — rank-chunk Gram/colsum updates folded
+  into the Kahan-compensated streaming accumulators, eigh re-solve
+  only at commit time;
+- :func:`foldin.fold_in` — ALS user/item fold-in: new or changed rows
+  solved against the frozen opposite table through the batched
+  normal-equation kernel (``ALSModel.fold_in_users`` /
+  ``fold_in_items``), with axis growth;
+- :mod:`delta` — the shared commit plumbing (config validation,
+  telemetry, flight-recorder events, the registry re-pin).
+
+Fault contract (utils/faults.py): ``delta.ingest`` fires at every
+delta entry BEFORE any model mutation and ``delta.solve`` immediately
+before the fold-in solve launch — every path is compute-then-swap, so
+an injected failure leaves the base model and its served pin exactly
+as they were (regression-tested; dev/online_gate.py kill leg).
+"""
+
+from oap_mllib_tpu.online.delta import commit  # noqa: F401
+from oap_mllib_tpu.online.foldin import fold_in  # noqa: F401
+from oap_mllib_tpu.online.ipca import IncrementalPCA  # noqa: F401
+from oap_mllib_tpu.online.minibatch import partial_fit_kmeans  # noqa: F401

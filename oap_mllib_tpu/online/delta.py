@@ -1,0 +1,86 @@
+"""Shared delta-commit plumbing for the incremental fit paths.
+
+Every online path (minibatch / ipca / foldin) ends a successful delta
+with :func:`commit`: book the commit counter, drop a flight-recorder
+instant, and — unless ``Config.online_repin`` disables it — re-pin any
+serving handle bound to the model through
+:func:`serving.registry.repin_model`.  The re-pin is IN PLACE: the
+handle's model version bumps, its identity-keyed device pins re-stage
+the replaced host arrays exactly once, and in-flight requests keep the
+handle they already hold (registry swap under the tracked lock, no
+eviction, zero new XLA compiles while shapes stay in-bucket).
+
+Config validation lives here — one place — so a typo'd knob raises at
+the FIRST delta, not silently downstream (the repo-wide
+validate-at-use contract, docs/configuration.md).
+"""
+
+from __future__ import annotations
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.telemetry import flightrec
+from oap_mllib_tpu.telemetry import metrics as _tm
+
+
+def decay_cfg() -> float:
+    """Validated ``Config.online_decay``: the per-delta discount on the
+    accumulated per-center counts in mini-batch Lloyd.  1.0 keeps every
+    past observation at full weight (the classic mini-batch k-means
+    count rule); values below 1 let the centers track drift."""
+    decay = get_config().online_decay
+    if not (0.0 < float(decay) <= 1.0):
+        raise ValueError(
+            f"online_decay must be in (0, 1], got {decay!r}"
+        )
+    return float(decay)
+
+
+def foldin_batch_cfg() -> int:
+    """Validated ``Config.online_foldin_batch``: 0 solves the whole
+    delta in one batched launch (the default — one solve per commit);
+    a positive value chunks huge deltas into that many destination
+    rows per launch (bounds the (batch, r, r) normal-equation moments
+    when a delta touches millions of rows)."""
+    batch = get_config().online_foldin_batch
+    if int(batch) < 0:
+        raise ValueError(
+            f"online_foldin_batch must be >= 0, got {batch!r}"
+        )
+    return int(batch)
+
+
+def repin_cfg() -> str:
+    """Validated ``Config.online_repin``: "auto" re-pins served handles
+    on every commit; "off" leaves serving on the old device state until
+    the operator re-pins explicitly (registry.repin_model)."""
+    mode = get_config().online_repin
+    if mode not in ("auto", "off"):
+        raise ValueError(
+            f"online_repin must be auto|off, got {mode!r}"
+        )
+    return mode
+
+
+def commit(model, kind: str, detail: str = "") -> dict:
+    """Commit one successful delta: telemetry + flight-recorder event +
+    the in-place serving re-pin.  Called AFTER the model's host arrays
+    have been swapped (compute-then-swap is each path's job — a fault
+    before this point must leave the old pin serving).  Returns
+    ``{"repinned": n}`` — the number of serving handles whose version
+    advanced (0 when the model is not being served, or repin is
+    off)."""
+    _tm.counter(
+        "oap_online_commits_total", {"model": kind},
+        help="Committed incremental-fit deltas per model family.",
+    ).inc()
+    if flightrec.enabled():
+        flightrec.record(
+            "serve", "delta_commit",
+            f"model={kind} {detail}".strip(),
+        )
+    repinned = 0
+    if repin_cfg() == "auto":
+        from oap_mllib_tpu.serving import registry
+
+        repinned = registry.repin_model(model)
+    return {"repinned": repinned}

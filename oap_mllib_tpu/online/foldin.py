@@ -1,0 +1,277 @@
+"""ALS fold-in: incremental user/item rows against a frozen table.
+
+The classic serving-time answer to "a new user rated five movies":
+holding the item table Y fixed, the user's optimal factor row is the
+same regularized normal-equation solve ALS runs every half-iteration,
+
+    x_u = (Y_u^T C_u Y_u + reg * n_u * I [+ alpha Y^T Y])^{-1} Y_u^T c_u
+
+— so a delta of new/changed rows needs ONE batched solve against the
+frozen opposite table, not a full refit.  This module routes that
+solve through the exact training kernels (als_ops.normal_eq_partials
+for the Spark-parity weighting/ALS-WR lambda scaling,
+als_ops.regularized_solve for the masked batched Cholesky — the fused
+Pallas consumer on TPU f32 small-rank, XLA elsewhere, resolved by the
+same resolve_solve_kernel decision point), so a folded-in row is
+BIT-IDENTICAL to what a training half-iteration would have produced
+for that row against the same frozen table.
+
+Shapes bucket (edges and destination rows pad to power-of-two
+buckets with valid=0) so successive deltas of different sizes reuse
+the compiled program — the second commit is zero new XLA compiles,
+zero autotune sweeps (the tuned geometry resolves through the
+persistent cache).  ``Config.online_foldin_batch`` chunks enormous
+deltas; 0 (default) is one launch per commit.
+
+The destination axis may GROW: ids beyond the current table extend it,
+the grown tail seeded with the deterministic counter-based init
+(fallback/als_np.init_factors_rows — position-addressable, so an
+unrated new row is bit-identical to what a from-scratch fit would
+have initialized).  Growth composes with the growable-axis checkpoint
+restore (utils/checkpoint.py): a later warm start admits the grown
+extent.
+
+Compute-then-swap: all solves land in a private copy of the table;
+the model's host array is replaced only after every batch succeeded —
+the ``delta.ingest`` (entry) and ``delta.solve`` (pre-launch) fault
+sites, or any error, leave the model and its served pin untouched.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.online import delta
+from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.utils import precision as psn
+from oap_mllib_tpu.utils import progcache
+from oap_mllib_tpu.utils.faults import maybe_fault
+from oap_mllib_tpu.utils.timing import tick
+
+
+def _bucket(n: int, floor: int) -> int:
+    """Next power of two >= max(n, floor): the fold-in shape bucket.
+    Geometric buckets bound the compiled-shape count at log2(max delta
+    size) programs per geometry — and keep the padded edge count a
+    power of two, which is what als_ops._edge_chunks needs to chunk the
+    per-edge outer-product buffer."""
+    b = int(floor)
+    while b < n:
+        b *= 2
+    return b
+
+
+def _foldin_solve_jit():
+    """The one compiled program per (shape bucket, config) a fold-in
+    commit launches: normal-equation partials + regularized solve,
+    fused under a single jit so the delta costs one dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    from oap_mllib_tpu.ops import als_ops
+
+    @functools.partial(
+        jax.jit,
+        static_argnames=(
+            "n_dst", "implicit", "policy", "solve_kernel", "solve_geo",
+            "gram_geo",
+        ),
+    )
+    def solve(dst_idx, src_idx, conf, valid, src_factors, reg, alpha,
+              n_dst, implicit, policy, solve_kernel, solve_geo, gram_geo):
+        a, b, n_reg = als_ops.normal_eq_partials(
+            dst_idx, src_idx, conf, valid, src_factors, n_dst,
+            alpha, implicit, policy,
+        )
+        r = src_factors.shape[1]
+        eye = jnp.eye(r, dtype=src_factors.dtype)
+        gram = (
+            als_ops._factor_gram(src_factors, solve_kernel, gram_geo)
+            if implicit else None
+        )
+        return (
+            als_ops.regularized_solve(
+                a, b, n_reg, reg, eye, gram, solve_kernel, solve_geo
+            ),
+            n_reg,
+        )
+
+    return solve
+
+
+def _resolve_params(model, reg, alpha, implicit, seed):
+    """Hyperparameter defaults from the base fit's summary["params"]
+    (stamped by ALS.fit) — explicit keyword arguments win.  ``reg``
+    has no safe fallback: folding in under a different lambda than the
+    table was trained with silently skews every solved row."""
+    params = (
+        model.summary.get("params", {})
+        if isinstance(model.summary, dict) else {}
+    )
+    if reg is None:
+        reg = params.get("reg")
+    if reg is None:
+        raise ValueError(
+            "fold_in needs reg= (the model summary carries no fit "
+            "params — pass the base fit's reg_param explicitly)"
+        )
+    if implicit is None:
+        implicit = bool(params.get("implicit", False))
+    if alpha is None:
+        alpha = float(params.get("alpha", 1.0))
+    if seed is None:
+        seed = int(params.get("seed", get_config().seed))
+    return float(reg), float(alpha), bool(implicit), int(seed)
+
+
+def fold_in(model, users, items, ratings, *, side: str = "user",
+            reg=None, alpha=None, implicit=None, seed=None) -> dict:
+    """Solve a delta of new/changed rows on ``side`` against the frozen
+    opposite table and swap them into ``model`` in place — the
+    ``ALSModel.fold_in_users``/``fold_in_items`` implementation.
+
+    The triples are the touched rows' FULL current ratings (standard
+    fold-in contract).  Rows whose delta carries no reg-counted rating
+    (e.g. implicit with all non-positive ratings) keep their previous
+    factors — new rows keep the deterministic init.  Returns
+    ``{"side", "rows_solved", "grown", "repinned"}``.
+    """
+    import jax.numpy as jnp
+
+    from oap_mllib_tpu.fallback import als_np
+    from oap_mllib_tpu.ops import als_ops
+
+    if side not in ("user", "item"):
+        raise ValueError(f"side must be user|item, got {side!r}")
+    batch_rows = delta.foldin_batch_cfg()
+    # the delta-ingestion fault site: before any compute or mutation
+    maybe_fault("delta.ingest")
+    users = np.asarray(users).reshape(-1)
+    items = np.asarray(items).reshape(-1)
+    ratings = np.asarray(ratings, np.float32).reshape(-1)
+    if not (len(users) == len(items) == len(ratings)):
+        raise ValueError(
+            f"users/items/ratings lengths differ: "
+            f"{len(users)}/{len(items)}/{len(ratings)}"
+        )
+    if len(users) == 0:
+        raise ValueError("fold_in needs at least one rating")
+    reg, alpha, implicit, seed = _resolve_params(
+        model, reg, alpha, implicit, seed
+    )
+    r = model.rank
+    if side == "user":
+        dst, src = users, items
+        frozen = np.asarray(model.item_factors_, np.float32)
+        table = model.user_factors_
+        seed_side = seed  # matches init_factors(n_users, r, seed)
+    else:
+        dst, src = items, users
+        frozen = np.asarray(model.user_factors_, np.float32)
+        table = model.item_factors_
+        seed_side = seed + 1  # the item-table init stream
+    if dst.min() < 0:
+        raise ValueError(f"{side} ids must be >= 0, got {dst.min()}")
+    if src.min() < 0 or src.max() >= frozen.shape[0]:
+        raise ValueError(
+            f"frozen-side ids must be in [0, {frozen.shape[0]}); got "
+            f"range [{src.min()}, {src.max()}] — the fold-in axis is "
+            f"{side!r}, the opposite table cannot grow in the same delta"
+        )
+    uniq, inv = np.unique(dst, return_inverse=True)
+    n_old = table.shape[0]
+    n_new = max(n_old, int(uniq.max()) + 1)
+    # private working copy: grown tail at the deterministic init (an
+    # unrated new row is bit-identical to a from-scratch fit's init)
+    new_table = np.empty((n_new, r), np.float32)
+    new_table[:n_old] = table
+    if n_new > n_old:
+        new_table[n_old:] = als_np.init_factors_rows(
+            n_old, n_new, r, seed_side
+        )
+    pol = psn.resolve("als")
+    solve_kernel = als_ops.resolve_solve_kernel(r, np.float32)
+    solve_geo, gram_geo = als_ops._tuned_geometry(
+        r, solve_kernel, implicit
+    )
+    frozen_dev = jnp.asarray(frozen)
+    reg_j = jnp.asarray(reg, np.float32)
+    alpha_j = jnp.asarray(alpha, np.float32)
+    solve = progcache.get_or_build(
+        "online.foldin_solve_fn", (), _foldin_solve_jit
+    )
+    elapsed = tick()
+    rows_solved = 0
+    step = batch_rows or len(uniq)
+    for lo in range(0, len(uniq), step):
+        hi = min(lo + step, len(uniq))
+        if batch_rows:
+            mask = (inv >= lo) & (inv < hi)
+            e_dst = (inv[mask] - lo).astype(np.int32)
+            e_src = src[mask].astype(np.int32)
+            e_conf = ratings[mask]
+        else:
+            e_dst = inv.astype(np.int32)
+            e_src = src.astype(np.int32)
+            e_conf = ratings
+        # bucketed padding (valid=0 edges contribute zero moments):
+        # successive deltas share compiled programs per bucket
+        nnz_pad = _bucket(len(e_dst), 256)
+        n_dst_pad = _bucket(hi - lo, 64)
+        pad = nnz_pad - len(e_dst)
+        dst_b = np.concatenate([e_dst, np.zeros(pad, np.int32)])
+        src_b = np.concatenate([e_src, np.zeros(pad, np.int32)])
+        conf_b = np.concatenate([e_conf, np.zeros(pad, np.float32)])
+        valid_b = np.concatenate(
+            [np.ones(len(e_dst), np.float32), np.zeros(pad, np.float32)]
+        )
+        step_key = (
+            progcache.backend_fingerprint(),
+            (nnz_pad, n_dst_pad, r), implicit, pol.name, solve_kernel,
+            solve_geo, gram_geo,
+        )
+        # the fold-in solve fault site: immediately before the one
+        # batched launch this delta (batch) costs
+        maybe_fault("delta.solve")
+        with progcache.launch(
+            "online.foldin_solve", step_key, None, "foldin",
+        ):
+            solved, n_reg = solve(
+                jnp.asarray(dst_b), jnp.asarray(src_b),
+                jnp.asarray(conf_b), jnp.asarray(valid_b),
+                frozen_dev, reg_j, alpha_j,
+                n_dst_pad, implicit, pol.name, solve_kernel,
+                solve_geo, gram_geo,
+            )
+        solved = np.asarray(solved)[: hi - lo]
+        n_reg = np.asarray(n_reg)[: hi - lo]
+        take = n_reg > 0  # zero-reg-count rows keep old factors / init
+        new_table[uniq[lo:hi][take]] = solved[take]
+        rows_solved += int(take.sum())
+    wall = elapsed()
+    # compute-then-swap: the model's table is replaced atomically —
+    # the fresh array identity is what re-stages the serving pin
+    if side == "user":
+        model._user_factors = new_table
+    else:
+        model._item_factors = new_table
+    grown = [int(n_old), int(n_new)] if n_new > n_old else None
+    _tm.counter(
+        "oap_online_foldin_rows_total", {"side": side},
+        help="Destination rows solved by ALS fold-in deltas.",
+    ).inc(rows_solved)
+    _tm.histogram(
+        "oap_online_foldin_seconds", {"side": side},
+        help="Wall time of ALS fold-in delta commits.",
+    ).observe(wall)
+    out = delta.commit(
+        model, "als",
+        detail=f"side={side} rows={rows_solved} grown={grown}",
+    )
+    return {
+        "side": side, "rows_solved": rows_solved, "grown": grown,
+        "repinned": out["repinned"],
+    }

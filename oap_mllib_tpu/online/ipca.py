@@ -1,0 +1,179 @@
+"""Incremental PCA: streaming moment updates, commit-time eigensolve.
+
+The batch streamed fit (stream_ops.covariance_streamed) is two passes
+because it centers the Gram against the final mean.  An incremental
+fit cannot see the final mean, so this class accumulates the RAW
+(uncentered) second moment and the column sums instead — folded
+through the SAME Kahan/Neumaier-compensated chunk accumulators the
+streamed fit uses (stream_ops._gram_chunk_comp / _colsum_chunk_comp,
+with mean pinned at zero), keeping the cross-delta summation error
+bounded independent of how many deltas arrive.  Centering happens
+algebraically at commit time:
+
+    cov = (G_raw - colsum colsum^T / n) / max(n - 1, 1)
+
+then symmetrized 0.5*(cov + cov^T) — the batch path's exact
+normalization convention — and the spectrum re-solves through the
+batch estimator's own eigensolver tail (PCA._solve_spectrum: full eigh
+or the randomized top-k, per ``Config.pca_solver``).  The eigh runs
+ONLY at commit time: ingesting a delta is O(chunk * d^2) accumulation,
+never an O(d^3) factorization.
+
+Compute-then-swap at both levels: ``partial_fit`` accumulates into
+fresh device buffers and stores the host state back only after the
+whole delta succeeded (the ``delta.ingest`` fault site fires before
+any of it), and ``commit`` mutates the published :class:`PCAModel`'s
+arrays only after the solve finished — so a fault anywhere leaves the
+model and its served pin on the previous spectrum.  Later commits
+mutate the SAME model object in place (fresh arrays, same identity),
+which is what lets serving/registry re-pin the handle without
+eviction.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.online import delta
+from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.utils import precision as psn
+from oap_mllib_tpu.utils.faults import maybe_fault
+from oap_mllib_tpu.utils.timing import Timings
+
+
+class IncrementalPCA:
+    """Streaming PCA: ``partial_fit`` deltas fold into compensated raw
+    moments; ``commit`` re-solves the spectrum and publishes (or
+    in-place updates) a :class:`~oap_mllib_tpu.models.pca.PCAModel`."""
+
+    def __init__(self, k: int):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.k = k
+        self._d: Optional[int] = None
+        # host-resident accumulator state (value + Kahan compensation);
+        # swapped wholesale at the end of each successful delta
+        self._gram = self._gcomp = None
+        self._colsum = self._ccomp = None
+        self._n = 0.0
+        self._commits = 0
+        self.model = None  # published PCAModel after the first commit
+
+    def partial_fit(self, x) -> "IncrementalPCA":
+        """Fold one delta (array or ChunkSource) into the running raw
+        moments — no eigensolve, O(chunk * d^2) per chunk."""
+        import jax.numpy as jnp
+
+        from oap_mllib_tpu.data.stream import ChunkSource
+        from oap_mllib_tpu.ops import stream_ops
+
+        # the delta-ingestion fault site: before any accumulation, so
+        # an injected failure leaves the running moments untouched
+        maybe_fault("delta.ingest")
+        cfg = get_config()
+        dtype = np.float64 if cfg.enable_x64 else np.float32
+        if not isinstance(x, ChunkSource):
+            x = ChunkSource.from_array(np.atleast_2d(np.asarray(x)))
+        d = x.n_features
+        if self._d is None:
+            self._d = d
+        elif d != self._d:
+            raise ValueError(
+                f"partial_fit chunk width {d} != accumulated "
+                f"dimensionality {self._d}"
+            )
+        pol = psn.resolve("pca")
+        tier = (
+            "highest" if cfg.enable_x64
+            else psn.kernel_tier(pol.name, cfg.matmul_precision)
+        )
+        # fresh device buffers (jnp.asarray copies the host state), so
+        # the donation chain below never invalidates what we hold —
+        # a mid-delta error leaves the host accumulators as they were
+        if self._gram is None:
+            g = jnp.zeros((d, d), dtype)
+            gc = jnp.zeros((d, d), dtype)
+            cs = jnp.zeros((d,), dtype)
+            cc = jnp.zeros((d,), dtype)
+        else:
+            g = jnp.asarray(self._gram, dtype)
+            gc = jnp.asarray(self._gcomp, dtype)
+            cs = jnp.asarray(self._colsum, dtype)
+            cc = jnp.asarray(self._ccomp, dtype)
+        zero_mean = jnp.zeros((d,), dtype)
+        rows = 0.0
+        for chunk, nv in x:
+            cj = jnp.asarray(chunk, dtype)
+            wj = (jnp.arange(chunk.shape[0]) < nv).astype(dtype)
+            cs, cc = stream_ops._colsum_chunk_comp(cs, cc, cj, wj)
+            # RAW moment: mean pinned at zero — centering is algebraic
+            # at commit time (class docstring)
+            g, gc = stream_ops._gram_chunk_comp(
+                g, gc, cj, wj, zero_mean, tier, pol.name
+            )
+            rows += float(nv)
+        # compute-then-swap of the accumulator state
+        self._gram = np.asarray(g)
+        self._gcomp = np.asarray(gc)
+        self._colsum = np.asarray(cs)
+        self._ccomp = np.asarray(cc)
+        self._n += rows
+        _tm.counter(
+            "oap_online_delta_rows_total", {"model": "pca"},
+            help="Rows ingested by incremental-fit deltas.",
+        ).inc(rows)
+        return self
+
+    def commit(self):
+        """Re-solve the spectrum from the accumulated moments and
+        publish it: the FIRST commit creates the PCAModel, later
+        commits replace its component/variance arrays in place (same
+        object — served handles re-pin, nothing re-registers).
+        Returns the model."""
+        from oap_mllib_tpu.models.pca import PCA, PCAModel
+
+        if self._n <= 0 or self._gram is None:
+            raise ValueError(
+                "commit() before any partial_fit delta — nothing to solve"
+            )
+        d = int(self._d)
+        if self.k > d:
+            raise ValueError(
+                f"k={self.k} exceeds data dimensionality {d}"
+            )
+        n = self._n
+        colsum = np.asarray(self._colsum, np.float64)
+        gram = np.asarray(self._gram, np.float64)
+        cov = (gram - np.outer(colsum, colsum) / n) / max(n - 1.0, 1.0)
+        cov = 0.5 * (cov + cov.T)  # the batch path's symmetrization
+        timings = Timings("pca.commit")
+        vals, vecs, total, solver = PCA(self.k)._solve_spectrum(
+            np.asarray(cov, np.float32), d, timings
+        )
+        ratio = vals / total if total > 0 else np.zeros(self.k)
+        self._commits += 1
+        online = {
+            "n_rows": int(n), "commits": self._commits,
+            "pca_solver": solver,
+        }
+        if self.model is None:
+            self.model = PCAModel(
+                vecs, ratio,
+                {"timings": timings, "accelerated": True,
+                 "streamed": True, "online": online,
+                 "n_rows": int(n), "pca_solver": solver},
+            )
+        else:
+            # in-place: fresh arrays on the SAME model object — the
+            # identity-keyed serving pin re-stages them on re-pin
+            self.model.components_ = np.asarray(vecs)
+            self.model.explained_variance_ = np.asarray(ratio)
+            self.model.summary["online"] = online
+        delta.commit(
+            self.model, "pca",
+            detail=f"rows={int(n)} commits={self._commits}",
+        )
+        return self.model

@@ -1,0 +1,128 @@
+"""Mini-batch Lloyd: decayed incremental K-Means updates.
+
+One ``partial_fit`` call = ONE assignment pass over the arriving
+chunks through the batch fit's own streamed-pass machinery
+(stream_ops.streamed_accumulate — same chunk programs, same prefetch
+pipeline, same cross-process psum reduction), folded into the model as
+the classic count-weighted mini-batch k-means rule (Sculley 2010,
+web-scale k-means):
+
+    c_new = (n_eff * c_old + batch_sum) / (n_eff + batch_count)
+
+where ``n_eff = online_decay * n_accum`` is the decayed per-center
+observation count carried across deltas (seeded from the batch fit's
+cluster sizes).  ``online_decay=1`` weights every past observation
+equally — the stationary-stream rule; below 1 the centers track drift
+with an effective horizon of ~1/(1-decay) deltas.  No re-init, no
+convergence loop: a delta is one pass, always.
+
+Compute-then-swap: the pass accumulates into fresh buffers and the
+model's centers array is REPLACED (never written in place) only after
+the whole pass finished and passed the finite guard — so the
+``delta.ingest`` fault site (and any mid-pass error) leaves the model
+and its served pin untouched.  The replacement array is a new object,
+which is exactly what the identity-keyed serving pin needs to re-stage
+once on the next request (serving/registry.pin).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from oap_mllib_tpu.config import get_config
+from oap_mllib_tpu.online import delta
+from oap_mllib_tpu.telemetry import metrics as _tm
+from oap_mllib_tpu.utils import precision as psn
+from oap_mllib_tpu.utils.faults import maybe_fault
+
+
+def _seed_counts(model, k: int) -> np.ndarray:
+    """The per-center observation counts a first delta starts from: the
+    batch fit's cluster sizes when the summary carries them (the counts
+    those centroids ARE the weighted mean of), zeros otherwise (a
+    zero-count center adopts the first batch mean that hits it)."""
+    counts = getattr(model, "_online_counts", None)
+    if counts is not None:
+        return np.asarray(counts, np.float64)
+    sizes = getattr(model.summary, "cluster_sizes", None)
+    if sizes is not None and np.asarray(sizes).shape == (k,):
+        return np.asarray(sizes, np.float64)
+    return np.zeros((k,), np.float64)
+
+
+def partial_fit_kmeans(model, x, sample_weight=None):
+    """One decayed mini-batch Lloyd delta over ``x`` (array or
+    ChunkSource; optional per-row weights) folded into ``model`` —
+    the ``KMeansModel.partial_fit`` implementation.  Commits through
+    :func:`online.delta.commit` (telemetry + in-place serving
+    re-pin).  Returns the mutated model."""
+    from oap_mllib_tpu.data.stream import ChunkSource
+    from oap_mllib_tpu.ops import stream_ops
+    from oap_mllib_tpu.utils.resilience import check_finite
+    from oap_mllib_tpu.utils.timing import x64_scope
+
+    decay = delta.decay_cfg()  # typo'd knob raises before the fault site
+    # the delta-ingestion fault site: BEFORE any accumulation or model
+    # mutation, so an injected failure is indistinguishable from the
+    # caller never having delivered the delta
+    maybe_fault("delta.ingest")
+    if model.distance_measure != "euclidean":
+        raise NotImplementedError(
+            "partial_fit requires distance_measure='euclidean' (the "
+            "streamed assignment pass is euclidean-only)"
+        )
+    cfg = get_config()
+    dtype = np.float64 if cfg.enable_x64 else np.float32
+    centers_old = np.asarray(model.cluster_centers_, dtype)
+    k, d = centers_old.shape
+    if not isinstance(x, ChunkSource):
+        x = ChunkSource.from_array(np.atleast_2d(np.asarray(x)))
+    if x.n_features != d:
+        raise ValueError(
+            f"partial_fit chunk width {x.n_features} != model "
+            f"dimensionality {d}"
+        )
+    if sample_weight is not None and not isinstance(
+        sample_weight, ChunkSource
+    ):
+        sample_weight = ChunkSource.from_array(
+            np.asarray(sample_weight).reshape(-1, 1),
+            chunk_rows=x.chunk_rows,
+        )
+    if sample_weight is not None:
+        stream_ops._checked_entry(
+            lambda: stream_ops._check_weight_source(x, sample_weight)
+        )
+    pol = psn.resolve("kmeans")
+    tier = psn.kernel_tier(pol.name, cfg.matmul_precision)
+    import jax.numpy as jnp
+
+    with x64_scope(cfg.enable_x64):
+        sums, counts, _ = stream_ops.streamed_accumulate(
+            x, jnp.asarray(centers_old), dtype, tier, need_cost=False,
+            weights=sample_weight, phase="partial_fit", policy=pol.name,
+        )
+    sums = np.asarray(sums, np.float64)
+    counts = np.asarray(counts, np.float64)
+    # decayed count-weighted fold — host math on the psum-reduced pass
+    # moments (identical on every process, so the swap is too)
+    n_eff = decay * _seed_counts(model, k)
+    denom = n_eff + counts
+    new_centers = np.where(
+        denom[:, None] > 0,
+        (centers_old.astype(np.float64) * n_eff[:, None] + sums)
+        / np.maximum(denom[:, None], 1e-300),
+        centers_old,
+    ).astype(dtype)
+    check_finite(new_centers, "K-Means centroids (partial_fit delta)")
+    rows = float(counts.sum())
+    # compute-then-swap: everything above this line is side-effect-free
+    # on the model
+    model.cluster_centers_ = new_centers
+    model._online_counts = denom
+    _tm.counter(
+        "oap_online_delta_rows_total", {"model": "kmeans"},
+        help="Rows ingested by incremental-fit deltas.",
+    ).inc(rows)
+    delta.commit(model, "kmeans", detail=f"rows={rows:g}")
+    return model

@@ -205,6 +205,7 @@ def als_run_streamed(
     degraded: bool = False,
     policy: str = "f32",
     checkpoint=None,
+    grown_fill=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Full streamed ALS loop (both feedback modes), host-driven.
 
@@ -248,6 +249,11 @@ def als_run_streamed(
             # every old shard — a world of one)
             x0 = ckpt_mod.factors_from_result(resume, "x", n_users)
             y0 = ckpt_mod.factors_from_result(resume, "y", n_items)
+            if resume.grown and grown_fill is not None:
+                # growable-axis warm start (models/als._fill_grown):
+                # the grown tail of either table takes the
+                # deterministic init, not the restore's zero-fill
+                x0, y0 = grown_fill(resume.grown, x0, y0)
             start_it = min(int(resume.step), max_iter)
             if "x" not in resume.arrays:
                 checkpoint.mark_resharded()  # sharded state -> one device

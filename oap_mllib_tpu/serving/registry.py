@@ -32,6 +32,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from oap_mllib_tpu.telemetry import flightrec
 from oap_mllib_tpu.telemetry import metrics as _tm
 from oap_mllib_tpu.utils import locktrace
 
@@ -136,7 +137,12 @@ def _observe_request(kind: str, wall_s: float, rows: int) -> None:
 class ServedModel:
     """One pinned model + its request accounting.  Subclasses expose the
     estimator's scoring surface; every public request runs under
-    :meth:`_request`, which books the latency histogram and counters."""
+    :meth:`_request`, which books the latency histogram and counters.
+
+    Handles carry a ``model_version`` (bumped by :meth:`repin` on every
+    delta commit — online/delta.py) and a staleness clock (seconds since
+    the pinned state last changed), so serving freshness is a METRIC
+    (``oap_serve_model_staleness_seconds``), not a cron job."""
 
     kind = "model"
 
@@ -144,6 +150,12 @@ class ServedModel:
         self.model = model
         self._cache: dict = {}
         self.requests = 0
+        # in-place update plane: version 1 is the initial pin; every
+        # repin() (a committed delta fit) bumps it and resets the
+        # staleness clock — the HANDLE object never changes, so
+        # in-flight requests keep answering through it
+        self.model_version = 1
+        self._committed_at = time.monotonic()
 
     # -- request accounting ---------------------------------------------------
     def _request(self, rows: int, fn):
@@ -154,7 +166,73 @@ class ServedModel:
         return out
 
     def stats(self) -> Dict[str, Any]:
-        return {"kind": self.kind, "requests": self.requests}
+        return {
+            "kind": self.kind, "requests": self.requests,
+            "model_version": self.model_version,
+            "staleness_seconds": round(self.staleness_seconds(), 3),
+        }
+
+    # -- in-place update (delta commits) --------------------------------------
+    def staleness_seconds(self) -> float:
+        """Seconds since this handle's pinned state last changed (the
+        initial pin or the newest delta commit's re-pin)."""
+        return max(0.0, time.monotonic() - self._committed_at)
+
+    def touch_staleness(self) -> float:
+        """Refresh + return the staleness gauge for this handle — called
+        by serving_summary()/the /healthz serving block so a scrape
+        always sees the CURRENT age of the pinned state."""
+        s = self.staleness_seconds()
+        _tm.gauge(
+            "oap_serve_model_staleness_seconds", {"model": self.kind},
+            help="Seconds since the served model's pinned state last "
+                 "changed (a delta commit re-pin resets it)",
+        ).set(s)
+        return s
+
+    def repin(self) -> int:
+        """Refresh the device pins from the model's CURRENT host state
+        and bump the version — the in-place half of a delta commit
+        (online/delta.py).  The pin refresh runs OUTSIDE the registry
+        lock — ``pin`` can consult the traffic plane's brownout state,
+        whose lock is also taken while calling back into the registry
+        (observe -> clear), so holding ``_LOCK`` across it would invert
+        the lock order; the version/clock bump alone runs under
+        ``_LOCK`` so it stays atomic against serve()/unserve().
+        In-flight requests are NEVER evicted — they hold the handle
+        (and at worst the previous device buffer, which stays valid
+        until they drop it; a request racing the refresh answers
+        through whichever pin generation it grabbed, both of which are
+        committed states).  Zero new XLA compiles by construction: the
+        pinned array SHAPES are unchanged (same centers (k, d), same
+        item table), so every bucketed scoring program re-binds to the
+        fresh buffers without recompiling (dev/online_gate.py asserts
+        it against xla_compile_count)."""
+        self._repin_pins()
+        with _LOCK:
+            self.model_version += 1
+            self._committed_at = time.monotonic()
+            version = self.model_version
+        _tm.gauge(
+            "oap_serve_model_version", {"model": self.kind},
+            help="Version of the served model's pinned state (bumped "
+                 "by every committed delta fit)",
+        ).set(version)
+        self.touch_staleness()
+        _tm.counter(
+            "oap_serve_repins_total", {"model": self.kind},
+            help="In-place serving re-pins (committed delta fits)",
+        ).inc()
+        if flightrec.enabled():
+            flightrec.record(
+                "serve", "repin", f"kind={self.kind} version={version}"
+            )
+        return version
+
+    def _repin_pins(self) -> None:
+        """Refresh the subclass's device pins (identity-keyed ``pin``
+        calls: a commit that swapped a host array re-stages exactly
+        once; unchanged arrays are free)."""
 
     # -- micro-batch coalescing ----------------------------------------------
     def _flush_many(self, batches, score_rows):
@@ -232,6 +310,11 @@ class ServedKMeans(ServedModel):
             self._cache, "centers", model.cluster_centers_
         )
 
+    def _repin_pins(self) -> None:
+        self.centers_dev = pin(
+            self._cache, "centers", self.model.cluster_centers_
+        )
+
     def predict(self, x) -> np.ndarray:
         from oap_mllib_tpu.serving import batcher
 
@@ -276,6 +359,11 @@ class ServedPCA(ServedModel):
             self._cache, "components", model.components_
         )
 
+    def _repin_pins(self) -> None:
+        self.components_dev = pin(
+            self._cache, "components", self.model.components_
+        )
+
     def transform(self, x) -> np.ndarray:
         from oap_mllib_tpu.serving import batcher
 
@@ -310,6 +398,18 @@ class ServedALS(ServedModel):
             )
             self.item_dev = pin(
                 self._cache, "item", model.item_factors_
+            )
+
+    def _repin_pins(self) -> None:
+        # sharded layouts serve straight from the live device blocks —
+        # nothing host-pinned to refresh (the fold-in paths update the
+        # host-factor form; sharded models re-serve after a refit)
+        if not self.sharded:
+            self.user_dev = pin(
+                self._cache, "user", self.model.user_factors_
+            )
+            self.item_dev = pin(
+                self._cache, "item", self.model.item_factors_
             )
 
     def predict(self, users, items) -> np.ndarray:
@@ -428,6 +528,21 @@ def served_models() -> Dict[tuple, ServedModel]:
         return dict(_SERVED)
 
 
+def repin_model(model) -> int:
+    """Re-pin every registry handle serving ``model`` (in-place delta
+    commit — online/delta.py): each handle's device pins refresh from
+    the model's current host arrays, its ``model_version`` bumps, and
+    its staleness clock resets, WITHOUT evicting the handle (in-flight
+    requests keep answering through it).  Returns the number of handles
+    re-pinned (0 when the model is not served — commits on unserved
+    models are free)."""
+    with _LOCK:
+        handles = [h for h in _SERVED.values() if h.model is model]
+    for h in handles:
+        h.repin()
+    return len(handles)
+
+
 def clear() -> None:
     """Tests: drop every handle (per-model pins die with them)."""
     global _queue_depth
@@ -455,6 +570,20 @@ def serving_summary() -> Dict[str, Any]:
         p50, p99 = _latency_quantiles()
         block["latency_p50_s"] = p50
         block["latency_p99_s"] = p99
+    with _LOCK:
+        handles = list(_SERVED.values())
+    if handles:
+        # per-handle freshness: version + staleness (gauge refreshed on
+        # the way out, so a summary/scrape always sees the current age)
+        block["models"] = [
+            {
+                "kind": h.kind,
+                "model_version": h.model_version,
+                "staleness_seconds": round(h.touch_staleness(), 3),
+                "requests": h.requests,
+            }
+            for h in handles
+        ]
     with _DEPTH_LOCK:
         block["queue_depth"] = _queue_depth
     from oap_mllib_tpu.serving import traffic

@@ -1489,6 +1489,18 @@ def serving_health_block() -> Dict[str, Any]:
         ),
         "pinned_models": len(registry.served_models()),
     }
+    handles = list(registry.served_models().values())
+    if handles:
+        # model freshness (online/delta.py commits): the staleness
+        # gauge refreshes on every scrape through touch_staleness
+        out["models"] = [
+            {
+                "kind": h.kind,
+                "model_version": h.model_version,
+                "staleness_seconds": round(h.touch_staleness(), 3),
+            }
+            for h in handles
+        ]
     b = _BROWNOUT
     out["brownout_rung"] = BROWNOUT_RUNGS[b.rung] if b is not None \
         else "off"

@@ -188,9 +188,19 @@ def factors_from_result(res: "RestoreResult", name: str,
     device restores of a checkpoint written by a block-parallel world
     land here: the reading process holds every old shard (round-robin
     over a world of one), so assembly is exact; rows no shard carried
-    stay zero (a shrunken id space's tail)."""
+    stay zero (a shrunken id space's tail).  A GROWN axis (the manifest
+    recorded fewer rows than ``n_rows`` — growable-axis restore) pads
+    the tail with zeros either way; the caller's grown-fill pass seeds
+    those rows with the deterministic init."""
     if name in res.arrays:
-        return np.asarray(res.arrays[name], np.float32)
+        arr = np.asarray(res.arrays[name], np.float32)
+        if arr.ndim == 2 and arr.shape[0] < n_rows:
+            arr = np.concatenate([
+                arr,
+                np.zeros((n_rows - arr.shape[0], arr.shape[1]),
+                         np.float32),
+            ])
+        return arr
     ids, vals = res.sharded[name]
     r = vals.shape[1] if vals.ndim == 2 else 1
     out = np.zeros((n_rows, r), np.float32)
@@ -271,6 +281,13 @@ class RestoreResult:
     )
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
     layout: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # growable-axis restore (warm-start): signature key -> (old, new)
+    # extent for every declared growable axis the manifest recorded
+    # SMALLER than this fit — the restored state covers the old prefix,
+    # the caller initializes the grown tail (ALS: init_factors_rows)
+    grown: Dict[str, Tuple[int, int]] = dataclasses.field(
+        default_factory=dict
+    )
 
     @property
     def found(self) -> bool:
@@ -290,15 +307,32 @@ class Checkpointer:
     """
 
     def __init__(self, algo: str, signature: Dict[str, Any], *,
-                 cfg=None, timings=None):
+                 cfg=None, timings=None, growable: Tuple[str, ...] = ()):
         cfg = cfg or get_config()
         self.algo = algo
         self.signature = dict(signature)
         self.signature["algo"] = algo
+        self.growable = tuple(growable)
+        for key in self.growable:
+            if key not in self.signature:
+                raise ValueError(
+                    f"growable axis {key!r} is not a signature key "
+                    f"(have {sorted(self.signature)})"
+                )
         self.resume = resume_cfg(cfg)
         self.interval = max(int(cfg.checkpoint_interval), 1)
+        # growable axes are EXCLUDED from the directory hash (replaced
+        # by the sorted axis-name set), so yesterday's fit and today's
+        # grown one share a directory — the warm-start-is-restore
+        # contract; the full signature still rides the manifest and is
+        # checked key-by-key at restore (shape-prefix match)
+        dir_sig = dict(self.signature)
+        if self.growable:
+            for key in self.growable:
+                dir_sig.pop(key, None)
+            dir_sig["__growable__"] = sorted(self.growable)
         self.dir = os.path.join(
-            cfg.checkpoint_dir, f"{algo}-{_sig_hash(self.signature)}"
+            cfg.checkpoint_dir, f"{algo}-{_sig_hash(dir_sig)}"
         )
         self.timings = timings
         self.world, self.rank = _world()
@@ -470,6 +504,7 @@ class Checkpointer:
             "extra": extra,
             "layout": layout,
             "signature": self.signature,
+            "growable": list(self.growable),
             "interval": self.interval,
         }
         _io.atomic_write_json(os.path.join(self.dir, MANIFEST), manifest)
@@ -590,12 +625,7 @@ class Checkpointer:
             raise CheckpointError(
                 f"manifest version {manifest.get('version')!r} != {_VERSION}"
             )
-        if manifest.get("signature") != self.signature:
-            raise CheckpointError(
-                "checkpoint signature mismatch (different problem): "
-                f"manifest {manifest.get('signature')!r} vs fit "
-                f"{self.signature!r}"
-            )
+        grown = self._check_signature(manifest)
         step = int(manifest["step"])
         old_world = int(manifest["world"])
         decision = (
@@ -649,7 +679,65 @@ class Checkpointer:
             new_world=self.world, arrays=arrays, sharded=sharded,
             extra=dict(manifest.get("extra", {})),
             layout=dict(manifest.get("layout", {})),
+            grown=grown,
         )
+
+    def _check_signature(self, manifest) -> Dict[str, Tuple[int, int]]:
+        """Fit-identity check with growable axes: every NON-growable
+        signature key must match the manifest exactly (different
+        problem otherwise); a growable key may be LARGER in this fit
+        than the manifest recorded — the grown tail is the caller's to
+        initialize — and the growth is returned (old, new) per axis.
+        A shrunk axis (restored rows would silently truncate) and a
+        changed growable declaration (the manifest's rows were bucketed
+        under different axis semantics) both raise."""
+        man_sig = manifest.get("signature")
+        if not self.growable:
+            if man_sig != self.signature:
+                raise CheckpointError(
+                    "checkpoint signature mismatch (different problem): "
+                    f"manifest {man_sig!r} vs fit {self.signature!r}"
+                )
+            return {}
+        man_growable = list(manifest.get("growable", []))
+        if man_growable != list(self.growable):
+            raise CheckpointError(
+                "checkpoint growable-axis declaration mismatch "
+                "(reordered or changed axes): manifest declares "
+                f"{man_growable!r}, fit declares {list(self.growable)!r}"
+            )
+        if not isinstance(man_sig, dict):
+            raise CheckpointError(
+                "checkpoint signature mismatch (different problem): "
+                f"manifest {man_sig!r} vs fit {self.signature!r}"
+            )
+        fixed_man = {
+            k: v for k, v in man_sig.items() if k not in self.growable
+        }
+        fixed_fit = {
+            k: v for k, v in self.signature.items()
+            if k not in self.growable
+        }
+        if fixed_man != fixed_fit:
+            raise CheckpointError(
+                "checkpoint signature mismatch (different problem): "
+                f"manifest {fixed_man!r} vs fit {fixed_fit!r}"
+            )
+        grown: Dict[str, Tuple[int, int]] = {}
+        for key in self.growable:
+            old = int(man_sig.get(key, -1))
+            new = int(self.signature[key])
+            if old == new:
+                continue
+            if old > new:
+                raise CheckpointError(
+                    f"checkpoint axis {key!r} shrank: manifest has "
+                    f"{old}, fit has {new} — restored rows beyond the "
+                    "new extent would be silently dropped; refit from "
+                    "scratch (or restore into an axis >= the manifest's)"
+                )
+            grown[key] = (old, new)
+        return grown
 
     def _load_shard(self, step: int, rank: int) -> Dict[str, np.ndarray]:
         path = os.path.join(self.dir, self._shard_name(step, rank))
@@ -687,6 +775,12 @@ class Checkpointer:
             if res.found:
                 out["old_world"] = res.old_world
                 out["new_world"] = res.new_world
+                if res.grown:
+                    # warm-start growth, per axis: [old, new] extents
+                    out["grown"] = {
+                        k: [int(o), int(n)]
+                        for k, (o, n) in sorted(res.grown.items())
+                    }
             elif res.reason:
                 out["reason"] = res.reason
         return out
@@ -711,13 +805,21 @@ class Checkpointer:
 
 
 def maybe_open(algo: str, signature: Dict[str, Any], *,
-               timings=None) -> Optional[Checkpointer]:
+               timings=None,
+               growable: Tuple[str, ...] = ()) -> Optional[Checkpointer]:
     """The one checkpointing entry estimators call: None when
     ``Config.checkpoint_dir`` is empty (one string check — the
     checkpoint-off ~0% overhead contract, asserted by
     dev/checkpoint_gate.py), else a :class:`Checkpointer` rooted at the
-    fit's signature directory."""
+    fit's signature directory.  ``growable`` names signature keys (e.g.
+    ALS ``n_users``/``n_items``) allowed to GROW across restores — the
+    warm-start path: the axes are excluded from the directory hash and
+    checked prefix-wise at restore (see Checkpointer._check_signature),
+    with growth recorded in ``RestoreResult.grown`` /
+    ``summary.checkpoint["grown"]``."""
     cfg = get_config()
     if not cfg.checkpoint_dir:
         return None
-    return Checkpointer(algo, signature, cfg=cfg, timings=timings)
+    return Checkpointer(
+        algo, signature, cfg=cfg, timings=timings, growable=growable
+    )

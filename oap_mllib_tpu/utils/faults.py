@@ -63,6 +63,16 @@ site                  fires at
 ``serve.drain``       every graceful-drain entry
                       (serving/traffic.TrafficQueue.drain) — drain-path
                       faults during scale-in / shutdown
+``delta.ingest``      every incremental-fit delta ingested by the online
+                      paths (online/minibatch.py partial_fit chunks,
+                      online/ipca.py updates, online/foldin.py rating
+                      deltas) — a fault here must leave the base model
+                      AND its served pin untouched (compute-then-swap)
+``delta.solve``       every batched fold-in solve launch
+                      (online/foldin.py — the one
+                      ``als_ops.regularized_solve`` call per delta
+                      commit); drives the failed-commit regression:
+                      the old model version keeps answering
 ====================  =====================================================
 
 Arming: ``Config.fault_spec`` / env ``OAP_MLLIB_TPU_FAULT_SPEC``, a
@@ -112,6 +122,7 @@ SITES = (
     "ckpt.write", "ckpt.restore", "collective.dispatch",
     "disk.read", "spill.write", "spill.read", "serve.request",
     "serve.dispatch", "serve.batch", "serve.drain",
+    "delta.ingest", "delta.solve",
 )
 
 KIND_FAIL = "fail"

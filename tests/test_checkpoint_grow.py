@@ -1,0 +1,198 @@
+"""Growable checkpoint axes (ISSUE 20): a warm start may RESUME into a
+grown user/item extent — the live-models delta path grows tables
+between fits, and refusing the old checkpoint would throw away every
+converged iteration.
+
+Contracts under test:
+
+- growable axes are excluded from the directory hash, so an old fit's
+  checkpoint and a grown fit's land in the same directory;
+- restore into a grown axis: old rows bit-identical, growth recorded
+  in ``RestoreResult.grown`` (and ``summary["checkpoint"]["grown"]``),
+  the grown tail of an ALS warm start at the deterministic init;
+- a SHRUNK axis is rejected with a clear :class:`CheckpointError`
+  (restored rows beyond the new extent would be silently dropped), as
+  is a reordered/changed growable declaration;
+- non-growable signature keys still match exactly;
+- a fabricated 2-rank manifest restores into a grown single-process
+  world (reshard + growth compose).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.fallback import als_np
+from oap_mllib_tpu.models.als import ALS
+from oap_mllib_tpu.utils import checkpoint as ckpt_mod
+from oap_mllib_tpu.utils.checkpoint import CheckpointError
+
+
+def _sig(n_users=40, n_items=30, rank=3):
+    return {"rank": rank, "reg": 0.1, "n_users": n_users,
+            "n_items": n_items}
+
+
+GROWABLE = ("n_users", "n_items")
+
+
+def _write(tmp_path, n_users=40, n_items=30, rank=3, step=4):
+    set_config(checkpoint_dir=str(tmp_path))
+    ck = ckpt_mod.Checkpointer(
+        "als", _sig(n_users, n_items, rank), growable=GROWABLE
+    )
+    x = np.arange(n_users * rank, dtype=np.float32).reshape(n_users, rank)
+    y = -np.arange(n_items * rank, dtype=np.float32).reshape(n_items, rank)
+    ck._write_shard(step, {"x": x, "y": y}, {})
+    ck._write_manifest(step, ["x", "y"], {}, {}, {})
+    return x, y
+
+
+class TestGrowableAxes:
+    def test_growable_excluded_from_dir_hash(self, tmp_path):
+        set_config(checkpoint_dir=str(tmp_path))
+        a = ckpt_mod.Checkpointer("als", _sig(40, 30), growable=GROWABLE)
+        b = ckpt_mod.Checkpointer("als", _sig(45, 33), growable=GROWABLE)
+        assert a.dir == b.dir
+        # a NON-growable key still separates directories
+        c = ckpt_mod.Checkpointer(
+            "als", _sig(40, 30, rank=4), growable=GROWABLE
+        )
+        assert c.dir != a.dir
+        # and the no-growable form keeps its pre-existing naming
+        d = ckpt_mod.Checkpointer("als", _sig(40, 30))
+        assert d.dir != a.dir
+
+    def test_growable_key_must_be_in_signature(self, tmp_path):
+        set_config(checkpoint_dir=str(tmp_path))
+        with pytest.raises(ValueError, match="growable"):
+            ckpt_mod.Checkpointer(
+                "als", _sig(), growable=("n_users", "n_rows")
+            )
+
+    def test_restore_into_grown_axis(self, tmp_path):
+        x, y = _write(tmp_path, n_users=40, n_items=30)
+        ck = ckpt_mod.Checkpointer(
+            "als", _sig(45, 30), growable=GROWABLE
+        )
+        res = ck._load()
+        assert res.found and res.grown == {"n_users": (40, 45)}
+        got = ckpt_mod.factors_from_result(res, "x", 45)
+        np.testing.assert_array_equal(got[:40], x)  # old rows bit-exact
+        np.testing.assert_array_equal(got[40:], 0.0)  # caller fills init
+        # unchanged axes restore with grown == {}
+        same = ckpt_mod.Checkpointer(
+            "als", _sig(40, 30), growable=GROWABLE
+        )._load()
+        assert same.found and same.grown == {}
+
+    def test_grown_lands_in_summary_checkpoint(self, tmp_path):
+        _write(tmp_path, n_users=40)
+        ck = ckpt_mod.Checkpointer("als", _sig(44, 30), growable=GROWABLE)
+        res = ck._load()
+        ck._result = res
+        summary: dict = {}
+        ck.record(summary)
+        assert summary["checkpoint"]["grown"] == {"n_users": [40, 44]}
+
+    def test_shrunk_axis_rejected(self, tmp_path):
+        _write(tmp_path, n_users=40)
+        ck = ckpt_mod.Checkpointer("als", _sig(38, 30), growable=GROWABLE)
+        with pytest.raises(CheckpointError, match="shrank"):
+            ck._load()
+
+    def test_growable_declaration_mismatch_rejected(self, tmp_path):
+        _write(tmp_path)
+        # the same dir reached with a REORDERED declaration must refuse
+        ck = ckpt_mod.Checkpointer(
+            "als", _sig(), growable=("n_items", "n_users")
+        )
+        ck.dir = ckpt_mod.Checkpointer(
+            "als", _sig(), growable=GROWABLE
+        ).dir
+        with pytest.raises(CheckpointError, match="growable-axis"):
+            ck._load()
+
+    def test_fixed_key_mismatch_still_rejected(self, tmp_path):
+        _write(tmp_path)
+        ck = ckpt_mod.Checkpointer("als", _sig(), growable=GROWABLE)
+        ck.signature = dict(_sig(), reg=0.2)
+        with pytest.raises(CheckpointError, match="signature"):
+            ck._load()
+
+    def test_two_rank_manifest_restores_into_grown_world(self, tmp_path):
+        """Reshard + growth compose: a 2-rank world's sharded user
+        factors (rows 0-39) restore in THIS 1-process world into a
+        45-row fit — old rows bit-identical, tail zero-filled for the
+        caller's init pass."""
+        set_config(checkpoint_dir=str(tmp_path))
+        rank = 3
+        ck = ckpt_mod.Checkpointer("als", _sig(40, 30), growable=GROWABLE)
+        ck.world = 2
+        vals = np.arange(120, dtype=np.float32).reshape(40, 3)
+        for r in (0, 1):
+            ck.rank = r
+            ids = np.arange(20, dtype=np.int64) + 20 * r
+            ck._write_shard(5, {}, {"x": (ids, vals[ids])})
+        ck.rank = 0
+        ck._write_manifest(
+            5, [], {}, {"x": (np.arange(20), vals[:20])}, {}
+        )
+        grown = ckpt_mod.Checkpointer(
+            "als", _sig(45, 30), growable=GROWABLE
+        )
+        res = grown._load()
+        assert res.decision == "resharded" and res.old_world == 2
+        assert res.grown == {"n_users": (40, 45)}
+        got = ckpt_mod.factors_from_result(res, "x", 45)
+        np.testing.assert_array_equal(got[:40], vals)
+        np.testing.assert_array_equal(got[40:], 0.0)
+
+
+class TestALSWarmStartGrown:
+    def test_resume_into_grown_user_axis_end_to_end(self, tmp_path, rng=None):
+        """An interrupted fit's checkpoint warm-starts a fit whose user
+        axis GREW: restored rows continue bit-identically, the grown
+        tail takes the deterministic init (what a from-scratch fit
+        would have initialized those rows to)."""
+        rng = np.random.default_rng(11)
+        u = rng.integers(0, 40, size=2000)
+        i = rng.integers(0, 30, size=2000)
+        v = rng.normal(1.0, 0.5, size=2000).astype(np.float32)
+        set_config(checkpoint_dir=str(tmp_path))
+        est = dict(rank=3, max_iter=4, reg_param=0.1, seed=7,
+                   num_user_blocks=1)
+        base = ALS(**est).fit(u, i, v, n_users=40, n_items=30)
+        # same data, grown user extent, SAME max_iter: the restore is
+        # at the recorded step, so zero further iterations run — the
+        # output IS the restored+grown state
+        grown = ALS(**est).fit(u, i, v, n_users=45, n_items=30)
+        assert grown.summary["checkpoint"]["grown"] == {
+            "n_users": [40, 45]
+        }
+        np.testing.assert_array_equal(
+            grown.user_factors_[:40], base.user_factors_
+        )
+        np.testing.assert_array_equal(
+            grown.user_factors_[40:],
+            als_np.init_factors_rows(40, 45, 3, 7),
+        )
+        np.testing.assert_array_equal(
+            grown.item_factors_, base.item_factors_
+        )
+
+    def test_shrunk_fit_refused_under_require(self, tmp_path):
+        rng = np.random.default_rng(11)
+        u = rng.integers(0, 40, size=1500)
+        i = rng.integers(0, 30, size=1500)
+        v = rng.normal(1.0, 0.5, size=1500).astype(np.float32)
+        set_config(checkpoint_dir=str(tmp_path))
+        est = dict(rank=3, max_iter=3, reg_param=0.1, seed=7,
+                   num_user_blocks=1)
+        ALS(**est).fit(u, i, v, n_users=40, n_items=30)
+        set_config(resume="require")
+        with pytest.raises(CheckpointError, match="shrank"):
+            ALS(**est).fit(u[u < 38], i[u < 38], v[u < 38],
+                           n_users=38, n_items=30)

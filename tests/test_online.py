@@ -1,0 +1,375 @@
+"""Incremental fit paths (ISSUE 20): mini-batch Lloyd, streaming PCA,
+ALS fold-in.
+
+Contracts under test:
+
+- mini-batch Lloyd from zero accumulated counts IS one Lloyd step over
+  the batch (the count-weighted rule degenerates to the batch mean),
+  and the decayed counts carry across deltas;
+- IncrementalPCA over any chunking of the data matches the batch
+  streamed PCA spectrum (same covariance convention, same solver);
+- a folded-in ALS row is the EXACT regularized normal-equation solve
+  against the frozen opposite table (Spark-parity weighting, both
+  feedback modes), the axis grows with untouched new rows at the
+  deterministic init, and fold-in approximates a from-scratch refit;
+- every path is compute-then-swap: an injected ``delta.ingest`` /
+  ``delta.solve`` fault leaves the model bit-identical.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.fallback import als_np
+from oap_mllib_tpu.models.als import ALS, ALSModel
+from oap_mllib_tpu.models.kmeans import KMeans, KMeansModel
+from oap_mllib_tpu.models.pca import PCA
+from oap_mllib_tpu.online import IncrementalPCA
+from oap_mllib_tpu.telemetry import metrics as tm
+from oap_mllib_tpu.utils.faults import FaultInjected
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+# ---------------------------------------------------------------------------
+# mini-batch Lloyd
+# ---------------------------------------------------------------------------
+
+
+class TestPartialFitKMeans:
+    def test_zero_counts_is_one_lloyd_step(self, rng):
+        """With no accumulated counts the decayed update degenerates to
+        the plain batch mean per assigned center — exactly one Lloyd
+        step from the current centers."""
+        centers = rng.normal(size=(4, 6)).astype(np.float32)
+        x = rng.normal(size=(300, 6)).astype(np.float32)
+        m = KMeansModel(centers.copy())
+        m.partial_fit(x)
+        d = ((x[:, None, :] - centers[None]) ** 2).sum(-1)
+        assign = d.argmin(1)
+        expect = centers.copy()
+        for c in range(4):
+            sel = assign == c
+            if sel.any():
+                expect[c] = x[sel].mean(0)
+        np.testing.assert_allclose(m.cluster_centers_, expect, atol=1e-5)
+
+    def test_counts_carry_and_weight_later_deltas(self, rng):
+        """Second delta's update is count-weighted: a center that has
+        already absorbed many rows moves less than a fresh one."""
+        centers = np.array([[0.0], [10.0]], np.float32)
+        m = KMeansModel(centers.copy())
+        m.partial_fit(np.full((100, 1), 1.0, np.float32))
+        c_after_1 = float(m.cluster_centers_[0, 0])
+        assert c_after_1 == pytest.approx(1.0, abs=1e-5)
+        m.partial_fit(np.full((100, 1), 3.0, np.float32))
+        # 100 rows at mean 1 + 100 at 3 -> 2.0 under decay=1
+        assert float(m.cluster_centers_[0, 0]) == pytest.approx(2.0, abs=1e-4)
+        assert float(m.cluster_centers_[1, 0]) == pytest.approx(10.0)
+
+    def test_decay_forgets_history(self, rng):
+        set_config(online_decay=0.5)
+        m = KMeansModel(np.array([[0.0]], np.float32))
+        m.partial_fit(np.full((100, 1), 1.0, np.float32))
+        m.partial_fit(np.full((100, 1), 3.0, np.float32))
+        # n_eff = 50 at mean 1, 100 at 3 -> (50*1 + 300)/150
+        assert float(m.cluster_centers_[0, 0]) == pytest.approx(
+            (50 * 1.0 + 100 * 3.0) / 150, abs=1e-4
+        )
+
+    def test_seeds_counts_from_batch_fit_sizes(self, rng):
+        """After a batch fit the summary cluster sizes ARE the starting
+        counts — the first delta does not stomp the fitted centers."""
+        x = rng.normal(size=(4000, 3)).astype(np.float32)
+        m = KMeans(k=2, seed=1, max_iter=8).fit(x)
+        before = m.cluster_centers_.copy()
+        m.partial_fit(x[:8])  # tiny delta vs 4000 accumulated rows
+        assert np.abs(m.cluster_centers_ - before).max() < 0.05
+
+    def test_sample_weight(self, rng):
+        m = KMeansModel(np.array([[0.0]], np.float32))
+        x = np.array([[1.0], [5.0]], np.float32)
+        m.partial_fit(x, sample_weight=np.array([3.0, 1.0]))
+        assert float(m.cluster_centers_[0, 0]) == pytest.approx(2.0, abs=1e-5)
+
+    def test_decay_typo_raises(self):
+        set_config(online_decay=0.0)
+        m = KMeansModel(np.zeros((2, 2), np.float32))
+        with pytest.raises(ValueError, match="online_decay"):
+            m.partial_fit(np.zeros((4, 2), np.float32))
+
+    def test_width_mismatch_raises(self):
+        m = KMeansModel(np.zeros((2, 3), np.float32))
+        with pytest.raises(ValueError, match="width"):
+            m.partial_fit(np.zeros((4, 2), np.float32))
+
+    def test_fault_leaves_model_untouched(self, rng):
+        x = rng.normal(size=(100, 4)).astype(np.float32)
+        m = KMeansModel(rng.normal(size=(3, 4)).astype(np.float32))
+        before = m.cluster_centers_.copy()
+        set_config(fault_spec="delta.ingest:err=1")
+        with pytest.raises(FaultInjected):
+            m.partial_fit(x)
+        np.testing.assert_array_equal(m.cluster_centers_, before)
+        assert not hasattr(m, "_online_counts")
+        # the armed count is spent: the retry succeeds
+        m.partial_fit(x)
+        assert np.abs(m.cluster_centers_ - before).max() > 0
+
+    def test_books_delta_telemetry(self, rng):
+        before = tm.family_total("oap_online_commits_total")
+        rows_before = tm.family_total("oap_online_delta_rows_total")
+        m = KMeansModel(rng.normal(size=(2, 3)).astype(np.float32))
+        m.partial_fit(rng.normal(size=(50, 3)).astype(np.float32))
+        assert tm.family_total("oap_online_commits_total") == before + 1
+        assert (
+            tm.family_total("oap_online_delta_rows_total")
+            == rows_before + 50
+        )
+
+
+# ---------------------------------------------------------------------------
+# incremental PCA
+# ---------------------------------------------------------------------------
+
+
+class TestIncrementalPCA:
+    def test_matches_batch_pca_any_chunking(self, rng):
+        x = rng.normal(size=(600, 10)).astype(np.float32)
+        x[:, 0] *= 4.0  # a dominant direction
+        ref = PCA(3).fit(x)
+        ip = IncrementalPCA(3)
+        for lo in (0, 100, 350):
+            hi = {0: 100, 100: 350, 350: 600}[lo]
+            ip.partial_fit(x[lo:hi])
+        m = ip.commit()
+        np.testing.assert_allclose(
+            m.explained_variance_, ref.explained_variance_, atol=1e-5
+        )
+        # components match up to sign
+        align = np.abs((m.components_ * ref.components_).sum(0))
+        np.testing.assert_allclose(align, 1.0, atol=1e-4)
+
+    def test_second_commit_updates_same_model_inplace(self, rng):
+        ip = IncrementalPCA(2)
+        ip.partial_fit(rng.normal(size=(200, 5)).astype(np.float32))
+        m1 = ip.commit()
+        comps1 = m1.components_
+        ip.partial_fit(
+            (rng.normal(size=(200, 5)) + [3, 0, 0, 0, 0]).astype(np.float32)
+        )
+        m2 = ip.commit()
+        assert m2 is m1  # same object: serving handles re-pin in place
+        assert m1.components_ is not comps1  # fresh array: pin re-stages
+        assert m1.summary["online"]["commits"] == 2
+        assert m1.summary["online"]["n_rows"] == 400
+
+    def test_commit_before_fit_raises(self):
+        with pytest.raises(ValueError, match="partial_fit"):
+            IncrementalPCA(2).commit()
+
+    def test_width_mismatch_raises(self, rng):
+        ip = IncrementalPCA(2)
+        ip.partial_fit(rng.normal(size=(50, 4)))
+        with pytest.raises(ValueError, match="dimensionality"):
+            ip.partial_fit(rng.normal(size=(50, 5)))
+
+    def test_k_exceeds_d_raises(self, rng):
+        ip = IncrementalPCA(6)
+        ip.partial_fit(rng.normal(size=(50, 4)))
+        with pytest.raises(ValueError, match="dimensionality"):
+            ip.commit()
+
+    def test_fault_leaves_accumulators_untouched(self, rng):
+        x = rng.normal(size=(300, 4)).astype(np.float32)
+        ip = IncrementalPCA(2)
+        ip.partial_fit(x)
+        ref = np.array(ip._gram), ip._n
+        set_config(fault_spec="delta.ingest:err=1")
+        with pytest.raises(FaultInjected):
+            ip.partial_fit(x)
+        np.testing.assert_array_equal(ip._gram, ref[0])
+        assert ip._n == ref[1]
+
+
+# ---------------------------------------------------------------------------
+# ALS fold-in
+# ---------------------------------------------------------------------------
+
+
+def _fit_als(rng, nu=40, ni=30, rank=4, implicit=False, **kw):
+    u = rng.integers(0, nu, size=2500)
+    i = rng.integers(0, ni, size=2500)
+    r = rng.normal(1.0, 0.6, size=2500).astype(np.float32)
+    if implicit:
+        r = np.abs(r)
+    model = ALS(
+        rank=rank, max_iter=6, reg_param=0.1, seed=5,
+        implicit_prefs=implicit, alpha=0.8 if implicit else 1.0, **kw
+    ).fit(u, i, r, n_users=nu, n_items=ni)
+    return model, (u, i, r)
+
+
+def _exact_row_explicit(y, items, ratings, reg, rank):
+    yu = y[items]
+    a = yu.T @ yu + reg * len(ratings) * np.eye(rank)
+    return np.linalg.solve(a, yu.T @ ratings)
+
+
+class TestALSFoldIn:
+    def test_existing_user_row_is_exact_normal_eq_solve(self, rng):
+        model, _ = _fit_als(rng)
+        y = np.asarray(model.item_factors_, np.float64)
+        items = np.arange(8)
+        vals = rng.normal(1.0, 0.5, size=8).astype(np.float32)
+        out = model.fold_in_users(np.full(8, 3), items, vals)
+        assert out["rows_solved"] == 1 and out["grown"] is None
+        expect = _exact_row_explicit(y, items, vals.astype(np.float64),
+                                     0.1, 4)
+        np.testing.assert_allclose(
+            model.user_factors_[3], expect, atol=1e-4
+        )
+
+    def test_implicit_row_matches_spark_weighting(self, rng):
+        model, _ = _fit_als(rng, implicit=True)
+        y = np.asarray(model.item_factors_, np.float64)
+        items = np.arange(6)
+        vals = rng.uniform(0.5, 2.0, size=6).astype(np.float32)
+        model.fold_in_users(np.full(6, 1), items, vals)
+        alpha = 0.8
+        yu = y[items]
+        cw = alpha * np.abs(vals)
+        a = (yu * cw[:, None]).T @ yu + y.T @ y \
+            + 0.1 * len(items) * np.eye(4)
+        b = yu.T @ (1.0 + cw)  # all ratings positive here
+        np.testing.assert_allclose(
+            model.user_factors_[1], np.linalg.solve(a, b), atol=1e-4
+        )
+
+    def test_grows_axis_untouched_rows_at_init(self, rng):
+        model, _ = _fit_als(rng, nu=40)
+        old = model.user_factors_.copy()
+        items = np.arange(5)
+        # touch user 44; users 40-43 and 45-49 appear only via growth
+        out = model.fold_in_users(
+            np.full(5, 44), items,
+            rng.normal(1.0, 0.5, size=5).astype(np.float32),
+            seed=5,
+        )
+        assert out["grown"] == [40, 45]
+        assert model.user_factors_.shape == (45, 4)
+        np.testing.assert_array_equal(model.user_factors_[:40], old)
+        expect_init = als_np.init_factors_rows(40, 45, 4, 5)
+        np.testing.assert_array_equal(
+            model.user_factors_[40:44], expect_init[:4]
+        )
+        # the touched new row was SOLVED, not left at init
+        assert np.abs(model.user_factors_[44] - expect_init[4]).max() > 0
+
+    def test_item_side_symmetric(self, rng):
+        model, _ = _fit_als(rng, ni=30)
+        x = np.asarray(model.user_factors_, np.float64)
+        users = np.arange(7)
+        vals = rng.normal(1.0, 0.5, size=7).astype(np.float32)
+        out = model.fold_in_items(users, np.full(7, 33), vals, seed=5)
+        assert out["side"] == "item" and out["grown"] == [30, 34]
+        expect = _exact_row_explicit(x, users, vals.astype(np.float64),
+                                     0.1, 4)
+        np.testing.assert_allclose(
+            model.item_factors_[33], expect, atol=1e-4
+        )
+        # untouched grown item rows take the seed+1 init stream
+        np.testing.assert_array_equal(
+            model.item_factors_[30:33],
+            als_np.init_factors_rows(30, 33, 4, 6),
+        )
+
+    def test_batched_matches_single_launch(self, rng):
+        model_a, _ = _fit_als(rng)
+        model_b = ALSModel(
+            model_a.user_factors_.copy(), model_a.item_factors_.copy(),
+            dict(model_a.summary),
+        )
+        rng2 = np.random.default_rng(3)
+        users = rng2.integers(0, 40, size=60)
+        items = rng2.integers(0, 30, size=60)
+        vals = rng2.normal(1.0, 0.5, size=60).astype(np.float32)
+        model_a.fold_in_users(users, items, vals)
+        set_config(online_foldin_batch=3)
+        model_b.fold_in_users(users, items, vals)
+        np.testing.assert_allclose(
+            model_a.user_factors_, model_b.user_factors_, atol=1e-5
+        )
+
+    def test_foldin_approximates_refit(self, rng):
+        """Fold-in of a few new users over a large base approximates the
+        from-scratch refit on the combined ratings.  Parity is measured
+        on PREDICTIONS (new users x all items), not raw factors — an
+        ALS factorization is only unique up to an invertible transform
+        applied oppositely to X and Y, so a fresh refit lands on a
+        rotated basis whose raw rows are incomparable.  The documented
+        bound (docs/user-guide.md): relative Frobenius error < 0.15."""
+        model, (u, i, r) = _fit_als(rng, nu=40, ni=30)
+        rng2 = np.random.default_rng(9)
+        nu_new = 4
+        un = np.repeat(np.arange(40, 40 + nu_new), 20)
+        un_items = rng2.integers(0, 30, size=20 * nu_new)
+        un_vals = rng2.normal(1.0, 0.6, size=20 * nu_new).astype(np.float32)
+        model.fold_in_users(un, un_items, un_vals)
+        refit = ALS(rank=4, max_iter=6, reg_param=0.1, seed=5).fit(
+            np.concatenate([u, un]), np.concatenate([i, un_items]),
+            np.concatenate([r, un_vals]), n_users=40 + nu_new, n_items=30,
+        )
+        pred_fold = model.user_factors_[40:] @ model.item_factors_.T
+        pred_refit = refit.user_factors_[40:] @ refit.item_factors_.T
+        rel = (
+            np.linalg.norm(pred_fold - pred_refit)
+            / np.linalg.norm(pred_refit)
+        )
+        assert rel < 0.15  # docs/user-guide.md parity bound
+
+    def test_defaults_come_from_fit_params(self, rng):
+        model, _ = _fit_als(rng)
+        assert model.summary["params"]["reg"] == pytest.approx(0.1)
+        # a bare model (no params) demands an explicit reg
+        bare = ALSModel(
+            model.user_factors_.copy(), model.item_factors_.copy()
+        )
+        with pytest.raises(ValueError, match="reg"):
+            bare.fold_in_users([0], [0], [1.0])
+        bare.fold_in_users([0], [0], [1.0], reg=0.1)  # explicit works
+
+    def test_validation_errors(self, rng):
+        model, _ = _fit_als(rng)
+        with pytest.raises(ValueError, match="side"):
+            from oap_mllib_tpu.online import foldin
+
+            foldin.fold_in(model, [0], [0], [1.0], side="row")
+        with pytest.raises(ValueError, match="frozen-side"):
+            model.fold_in_users([0], [99], [1.0])  # item 99 of 30
+        with pytest.raises(ValueError, match="lengths"):
+            model.fold_in_users([0, 1], [0], [1.0])
+        with pytest.raises(ValueError, match="at least one"):
+            model.fold_in_users([], [], [])
+
+    def test_solve_fault_leaves_model_untouched(self, rng):
+        model, _ = _fit_als(rng)
+        before_u = model.user_factors_.copy()
+        set_config(fault_spec="delta.solve:err=1")
+        with pytest.raises(FaultInjected):
+            model.fold_in_users([50, 50], [0, 1], [1.0, 2.0])
+        np.testing.assert_array_equal(model.user_factors_, before_u)
+        assert model.user_factors_.shape == (40, 4)  # no growth either
+
+    def test_ingest_fault_leaves_model_untouched(self, rng):
+        model, _ = _fit_als(rng)
+        before_u = model.user_factors_.copy()
+        set_config(fault_spec="delta.ingest:err=1")
+        with pytest.raises(FaultInjected):
+            model.fold_in_users([0], [0], [1.0])
+        np.testing.assert_array_equal(model.user_factors_, before_u)

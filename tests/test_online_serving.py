@@ -1,0 +1,209 @@
+"""In-place serving re-pin (ISSUE 20): delta commits bump the served
+model version and refresh the device pins WITHOUT evicting the handle.
+
+Contracts under test:
+
+- a committed delta re-pins every handle bound to the model: version
+  bumps, the staleness clock resets, and the SAME handle object
+  answers through the new state (bit-identical to a direct model
+  call);
+- a FAILED delta commit leaves the pin untouched — the handle keeps
+  answering bit-identically through the old version (the compute-then
+  -swap regression);
+- ``online_repin="off"`` freezes the pin until an explicit
+  ``repin_model``;
+- serving_summary()/serving_health_block() expose per-handle
+  ``model_version`` + ``staleness_seconds``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from oap_mllib_tpu import serving
+from oap_mllib_tpu.config import set_config
+from oap_mllib_tpu.models.als import ALS
+from oap_mllib_tpu.models.kmeans import KMeans
+from oap_mllib_tpu.online import IncrementalPCA
+from oap_mllib_tpu.serving import registry
+from oap_mllib_tpu.telemetry import metrics as tm
+from oap_mllib_tpu.utils.faults import FaultInjected
+
+
+@pytest.fixture(autouse=True)
+def _clear_registry():
+    registry.clear()
+    yield
+    registry.clear()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(21)
+
+
+class TestRepinOnCommit:
+    def test_kmeans_partial_fit_repins_served_handle(self, rng):
+        x = rng.normal(size=(500, 6)).astype(np.float32)
+        m = KMeans(k=3, seed=1, max_iter=5).fit(x)
+        h = serving.serve(m)
+        assert h.model_version == 1
+        q = rng.normal(size=(40, 6)).astype(np.float32)
+        h.predict(q)  # warm the old pin
+        m.partial_fit(rng.normal(size=(200, 6)).astype(np.float32) + 2.0)
+        assert h.model_version == 2
+        # the SAME handle answers through the NEW centers, exactly
+        np.testing.assert_array_equal(h.predict(q), m.predict(q))
+        assert serving.serve(m) is h  # never evicted, never re-keyed
+
+    def test_staleness_resets_on_commit(self, rng):
+        x = rng.normal(size=(300, 4)).astype(np.float32)
+        m = KMeans(k=2, seed=1, max_iter=4).fit(x)
+        h = serving.serve(m)
+        h._committed_at -= 100.0  # age the pin
+        assert h.staleness_seconds() > 99
+        m.partial_fit(x[:50])
+        assert h.staleness_seconds() < 5
+        assert (
+            tm.gauge(
+                "oap_serve_model_staleness_seconds", {"model": "kmeans"}
+            ).value < 5
+        )
+        assert (
+            tm.gauge("oap_serve_model_version", {"model": "kmeans"}).value
+            == 2
+        )
+
+    def test_ipca_commit_repins_same_handle(self, rng):
+        x = rng.normal(size=(400, 5)).astype(np.float32)
+        ip = IncrementalPCA(2)
+        ip.partial_fit(x[:200])
+        m = ip.commit()
+        h = serving.serve(m)
+        q = rng.normal(size=(30, 5)).astype(np.float32)
+        h.transform(q)
+        ip.partial_fit(x[200:] + 1.5)
+        ip.commit()
+        assert h.model_version == 2
+        np.testing.assert_array_equal(h.transform(q), m.transform(q))
+
+    def test_als_foldin_repins_and_serves_grown_table(self, rng):
+        u = rng.integers(0, 30, size=1500)
+        i = rng.integers(0, 25, size=1500)
+        r = rng.normal(1.0, 0.5, size=1500).astype(np.float32)
+        m = ALS(rank=3, max_iter=4, reg_param=0.1, seed=2).fit(
+            u, i, r, n_users=30, n_items=25
+        )
+        h = serving.serve(m)
+        ids_before = m.recommend_for_users(np.arange(5), 3)
+        out = m.fold_in_users(
+            np.full(6, 34), np.arange(6),
+            rng.normal(1.0, 0.5, size=6).astype(np.float32),
+        )
+        assert out["repinned"] == 1 and h.model_version == 2
+        # the grown user serves top-k through the frozen item table
+        ids = m.recommend_for_users([34], 4)
+        assert ids.shape == (1, 4)
+        # untouched users still answer (and the old rows were untouched)
+        np.testing.assert_array_equal(
+            m.recommend_for_users(np.arange(5), 3), ids_before
+        )
+
+    def test_repin_off_freezes_pin_until_explicit(self, rng):
+        set_config(online_repin="off")
+        x = rng.normal(size=(300, 4)).astype(np.float32)
+        m = KMeans(k=2, seed=1, max_iter=4).fit(x)
+        h = serving.serve(m)
+        old_centers = h.centers_dev
+        m.partial_fit(x + 3.0)
+        assert h.model_version == 1
+        assert h.centers_dev is old_centers  # still the old pin
+        assert registry.repin_model(m) == 1  # the explicit operator path
+        assert h.model_version == 2
+        assert h.centers_dev is not old_centers
+
+    def test_repin_typo_raises(self, rng):
+        set_config(online_repin="eager")
+        m = KMeans(k=2, seed=1, max_iter=3).fit(
+            rng.normal(size=(200, 3)).astype(np.float32)
+        )
+        with pytest.raises(ValueError, match="online_repin"):
+            m.partial_fit(rng.normal(size=(50, 3)).astype(np.float32))
+
+    def test_repin_model_unserved_is_zero(self, rng):
+        m = KMeans(k=2, seed=1, max_iter=3).fit(
+            rng.normal(size=(200, 3)).astype(np.float32)
+        )
+        assert registry.repin_model(m) == 0
+
+    def test_books_repin_counter(self, rng):
+        before = tm.family_total("oap_serve_repins_total")
+        x = rng.normal(size=(200, 3)).astype(np.float32)
+        m = KMeans(k=2, seed=1, max_iter=3).fit(x)
+        serving.serve(m)
+        m.partial_fit(x[:50])
+        assert tm.family_total("oap_serve_repins_total") == before + 1
+
+
+class TestFailedCommitLeavesPinServing:
+    def test_kmeans_fault_keeps_old_answers_bit_identical(self, rng):
+        x = rng.normal(size=(400, 5)).astype(np.float32)
+        m = KMeans(k=3, seed=1, max_iter=5).fit(x)
+        h = serving.serve(m)
+        q = rng.normal(size=(60, 5)).astype(np.float32)
+        before = h.predict(q)
+        set_config(fault_spec="delta.ingest:err=1")
+        with pytest.raises(FaultInjected):
+            m.partial_fit(x + 5.0)
+        assert h.model_version == 1
+        np.testing.assert_array_equal(h.predict(q), before)
+
+    def test_als_solve_fault_keeps_old_pin(self, rng):
+        u = rng.integers(0, 25, size=1200)
+        i = rng.integers(0, 20, size=1200)
+        r = rng.normal(1.0, 0.5, size=1200).astype(np.float32)
+        m = ALS(rank=3, max_iter=4, reg_param=0.1, seed=2).fit(
+            u, i, r, n_users=25, n_items=20
+        )
+        h = serving.serve(m)
+        before = m.recommend_for_users(np.arange(6), 3)
+        set_config(fault_spec="delta.solve:err=1")
+        with pytest.raises(FaultInjected):
+            m.fold_in_users([30, 30], [0, 1], [1.0, 2.0])
+        assert h.model_version == 1
+        assert m.user_factors_.shape == (25, 3)
+        np.testing.assert_array_equal(
+            m.recommend_for_users(np.arange(6), 3), before
+        )
+
+
+class TestObservabilitySurfaces:
+    def test_serving_summary_models_block(self, rng):
+        x = rng.normal(size=(200, 4)).astype(np.float32)
+        m = KMeans(k=2, seed=1, max_iter=3).fit(x)
+        serving.serve(m)
+        m.partial_fit(x[:40])
+        block = registry.serving_summary()
+        models = {b["kind"]: b for b in block["models"]}
+        assert models["kmeans"]["model_version"] == 2
+        assert models["kmeans"]["staleness_seconds"] < 60
+
+    def test_health_block_carries_versions(self, rng):
+        from oap_mllib_tpu.serving import traffic
+
+        x = rng.normal(size=(200, 4)).astype(np.float32)
+        m = KMeans(k=2, seed=1, max_iter=3).fit(x)
+        serving.serve(m)
+        out = traffic.serving_health_block()
+        kinds = {b["kind"] for b in out["models"]}
+        assert "kmeans" in kinds
+        assert all("model_version" in b for b in out["models"])
+
+    def test_handle_stats_carry_version(self, rng):
+        x = rng.normal(size=(200, 4)).astype(np.float32)
+        m = KMeans(k=2, seed=1, max_iter=3).fit(x)
+        h = serving.serve(m)
+        s = h.stats()
+        assert s["model_version"] == 1
+        assert s["staleness_seconds"] >= 0
